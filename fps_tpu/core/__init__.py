@@ -1,0 +1,12 @@
+from fps_tpu.core.api import ServerLogic, WorkerLogic, StepOutput
+from fps_tpu.core.store import TableSpec, ParamStore, pull, push
+
+__all__ = [
+    "ServerLogic",
+    "WorkerLogic",
+    "StepOutput",
+    "TableSpec",
+    "ParamStore",
+    "pull",
+    "push",
+]

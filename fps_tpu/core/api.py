@@ -1,0 +1,100 @@
+"""The user contract: WorkerLogic / ServerLogic, functionalized for SPMD.
+
+Reference contract being preserved (SURVEY.md §2 #2–#4; expected upstream
+``src/main/scala/hu/sztaki/ilab/ps/WorkerLogic.scala`` and the
+``ParameterServerLogic`` / ``ParameterServerClient`` traits):
+
+* ``WorkerLogic.onRecv(data, psClient)`` — consume a training record, issue
+  ``psClient.pull(id)`` / ``psClient.push(id, delta)`` / ``psClient.output(o)``.
+* ``WorkerLogic.onPullRecv(id, value, psClient)`` — continue once the pulled
+  value arrives.
+* ``ParameterServerLogic`` — per-shard state with ``onPullRecv`` /
+  ``onPushRecv``; the shipped default ``SimplePSLogic`` is just
+  ``paramInit: Int => P`` + ``paramUpdate: (P, P) => P``.
+
+TPU functionalization
+---------------------
+The callback pair (onRecv → pull → onPullRecv) exists only because the
+reference is asynchronous message passing. Under SPMD the round trip is a
+collective with a known latency, so the two callbacks collapse into one pure
+batch-step function and the client object disappears:
+
+* ``WorkerLogic.pull_ids(batch)``  — which rows each table needs (the
+  pull phase; one vectorized ``pull`` per table replaces per-record
+  ``psClient.pull`` calls).
+* ``WorkerLogic.step(batch, pulled, local_state, key)`` — the fused
+  onRecv+onPullRecv body: compute updates, return pushes + outputs.
+* ``ServerLogic`` — exactly ``SimplePSLogic``: per-table ``init_fn`` +
+  fold for pushed deltas (additive by default, like every shipped
+  reference algorithm).
+
+Worker-local state (the reference keeps e.g. MF user vectors in worker
+operator state) is the ``local_state`` pytree: arrays sharded over the
+worker axes that only their owning device reads/writes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping
+
+import jax
+
+Array = jax.Array
+Pytree = Any
+
+
+@dataclasses.dataclass
+class StepOutput:
+    """What one worker step returns.
+
+    Attributes:
+      pushes: per-table ``(ids, deltas)`` — ids ``(B,)`` int32, deltas
+        ``(B, dim)``. Zero-weight (padding) rows must carry id ``-1``
+        (dropped by the store even for non-additive server folds); zero
+        deltas alone are only a no-op for the additive default.
+      local_state: updated worker-local pytree.
+      out: the reference's ``WOut`` channel (``ParameterServerClient.output``)
+        — a metrics/prediction pytree, summed or collected by the driver.
+    """
+
+    pushes: Mapping[str, tuple[Array, Array]]
+    local_state: Pytree
+    out: Pytree
+
+
+class WorkerLogic:
+    """Base class for worker-side algorithm logic (pure functions only)."""
+
+    def init_local_state(self, key: Array, num_workers: int) -> Pytree:
+        """Per-device local state; called once under the driver's sharding."""
+        return ()
+
+    def pull_ids(self, batch: Pytree) -> Mapping[str, Array]:
+        """Map table name -> (B,) int32 ids to pull for this batch."""
+        raise NotImplementedError
+
+    def step(
+        self,
+        batch: Pytree,
+        pulled: Mapping[str, Array],
+        local_state: Pytree,
+        key: Array,
+    ) -> StepOutput:
+        """Fused onRecv/onPullRecv body — must be jit-traceable."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerLogic:
+    """Per-table server fold — the reference's ``SimplePSLogic``.
+
+    ``apply_fn(current_rows, summed_deltas) -> new_rows``; ``None`` means
+    plain addition (``paramUpdate = _ + _``), which every algorithm shipped
+    with the reference uses and which takes the fastest scatter-add path.
+    """
+
+    apply_fn: Callable[[Array, Array], Array] | None = None
+
+
+ADDITIVE = ServerLogic(apply_fn=None)

@@ -1,0 +1,117 @@
+"""Host-side streaming ingest — the replacement for the Flink DataStream source.
+
+The reference consumes an unbounded ``DataStream[T]``; parallelism comes from
+Flink splitting the source across worker subtasks, and data locality (e.g.
+matrix factorization keeping user vectors in worker state) comes from how the
+stream is partitioned before ``FlinkParameterServer.transform``.
+
+Here ingest is a plain Python iterator producing fixed-shape *chunks* (a
+``scan``-able stack of microbatches) that the compiled driver consumes. Key
+responsibilities:
+
+* **routing**: optionally place each example on the worker that owns its
+  route key (``route_key % num_workers == worker_index``), preserving the
+  reference's worker-local-state locality trick;
+* **static shapes**: every chunk has identical shape; short queues are
+  padded with zero-weight examples (the ``weight`` field), so XLA compiles
+  the step exactly once;
+* **epochs vs one-pass**: the reference is one-pass streaming; wrapping the
+  iterator for multiple epochs gives the multi-epoch mode the benchmarks
+  need.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping
+
+import numpy as np
+
+
+def epoch_chunks(
+    data: Mapping[str, np.ndarray],
+    *,
+    num_workers: int,
+    local_batch: int,
+    steps_per_chunk: int,
+    route_key: str | None = None,
+    sync_every: int | None = None,
+    seed: int | None = 0,
+    drop_remainder: bool = False,
+) -> Iterator[dict]:
+    """Yield fixed-shape chunks covering one (shuffled) pass over ``data``.
+
+    Args:
+      data: columnar examples — dict of equal-length 1-D/2-D arrays.
+      num_workers: total worker devices (mesh data*shard).
+      local_batch: examples per worker per step.
+      steps_per_chunk: microbatch steps stacked per compiled call. For SSP
+        mode this must be a multiple of ``sync_every``.
+      route_key: name of an integer column; examples are routed to worker
+        ``value % num_workers``. ``None`` routes round-robin.
+      sync_every: if set, chunks are shaped ``(R, sync_every, B, ...)`` for
+        the SSP driver instead of ``(T, B, ...)``.
+      seed: shuffle seed (None = no shuffle, stream order preserved, which
+        matches the reference's online one-pass semantics).
+      drop_remainder: drop the final partial chunk instead of padding it.
+
+    Yields:
+      dict with the columns of ``data`` plus ``weight`` (1.0 real, 0.0 pad),
+      each shaped ``(T, B, ...)`` or ``(R, s, B, ...)``; the batch dim ``B``
+      is ordered worker-major (worker 0's rows first), matching the
+      ``P(None, ('data','shard'))`` batch sharding.
+    """
+    n = len(next(iter(data.values())))
+    for k, v in data.items():
+        if len(v) != n:
+            raise ValueError(f"column {k!r} length {len(v)} != {n}")
+
+    order = np.arange(n)
+    if seed is not None:
+        np.random.default_rng(seed).shuffle(order)
+
+    if route_key is not None:
+        keys = np.asarray(data[route_key])[order]
+        queues = [order[keys % num_workers == w] for w in range(num_workers)]
+    else:
+        queues = [order[w::num_workers] for w in range(num_workers)]
+
+    steps_total = max(-(-len(q) // local_batch) for q in queues)
+    if sync_every is not None:
+        if steps_per_chunk % sync_every:
+            raise ValueError("steps_per_chunk must be a multiple of sync_every")
+        steps_total = -(-steps_total // sync_every) * sync_every
+    if drop_remainder:
+        steps_total = (steps_total // steps_per_chunk) * steps_per_chunk
+    else:
+        steps_total = -(-steps_total // steps_per_chunk) * steps_per_chunk
+    if steps_total == 0:
+        return
+
+    # Pad every queue to steps_total*local_batch with sentinel -1.
+    full = steps_total * local_batch
+    idx = np.full((num_workers, full), -1, dtype=np.int64)
+    for w, q in enumerate(queues):
+        idx[w, : min(len(q), full)] = q[:full]
+    # (steps_total, num_workers, local_batch) -> (steps_total, B)
+    idx = idx.reshape(num_workers, steps_total, local_batch).transpose(1, 0, 2)
+    idx = idx.reshape(steps_total, num_workers * local_batch)
+
+    weight = (idx >= 0).astype(np.float32)
+    safe = np.maximum(idx, 0)
+
+    for start in range(0, steps_total, steps_per_chunk):
+        sl = slice(start, start + steps_per_chunk)
+        chunk = {k: np.asarray(v)[safe[sl]] for k, v in data.items()}
+        chunk["weight"] = weight[sl]
+        if sync_every is not None:
+            chunk = {
+                k: v.reshape((-1, sync_every) + v.shape[1:]) for k, v in chunk.items()
+            }
+        yield chunk
+
+
+def multi_epoch_chunks(data, epochs: int, *, seed: int | None = 0, **kw):
+    """Repeat :func:`epoch_chunks` for several epochs with distinct shuffles."""
+    for e in range(epochs):
+        eseed = None if seed is None else seed + e
+        yield from epoch_chunks(data, seed=eseed, **kw)

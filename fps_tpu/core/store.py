@@ -1,0 +1,322 @@
+"""Sharded parameter store — the TPU-native replacement for the PS server side.
+
+Reference semantics being rebuilt (from SURVEY.md; expected upstream paths
+``src/main/scala/hu/sztaki/ilab/ps/server/SimplePSLogic.scala`` and
+``.../ps/entities/``):
+
+* the parameter space is a map ``id -> P`` hash-partitioned across
+  ``psParallelism`` server instances (``hash(paramId) % psParallelism``),
+* ``Pull(id)`` routes to the owning shard, which answers with the value
+  (initializing it on first touch via a deterministic ``paramInit(id)``),
+* ``Push(id, delta)`` routes to the owning shard, which folds the delta in
+  via ``paramUpdate`` (``_ + _`` for every shipped algorithm).
+
+TPU-native design
+-----------------
+A table is one jax array of shape ``(rows, dim)`` laid out **owner-major
+cyclic**: parameter id ``i`` lives at physical row ``(i % S) * rps + i // S``
+where ``S`` is the shard count and ``rps`` rows-per-shard. Under a
+``NamedSharding(P('shard', None))`` this puts id ``i`` on device ``i % S`` —
+exactly the reference's hash partitioning, and it balances Zipfian id
+frequencies the way block partitioning would not.
+
+Inside ``shard_map``:
+
+* :func:`pull`  = ``all_gather(ids)`` → local gather of owned rows →
+  ``psum_scatter`` so each worker receives exactly its requested values.
+  This is the collective-gather collapse of the reference's
+  pull/partitionCustom/answerPull round trip.
+* :func:`push`  = ``all_gather(ids, deltas)`` (over the data axis too, so
+  every replica applies every delta) → masked local ``scatter-add``.
+  Duplicate ids within a batch accumulate, matching the reference's
+  per-message ``paramUpdate`` fold.
+
+Everything is static-shape and jit-compatible; XLA lowers the collectives
+onto ICI when the mesh spans a pod slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Physical layout helpers (owner-major cyclic).
+# ---------------------------------------------------------------------------
+
+def rows_per_shard(num_ids: int, num_shards: int) -> int:
+    return -(-num_ids // num_shards)  # ceil
+
+
+def padded_rows(num_ids: int, num_shards: int) -> int:
+    return rows_per_shard(num_ids, num_shards) * num_shards
+
+
+def id_to_phys(ids: Array, num_shards: int, rps: int) -> Array:
+    """Global physical row index of each parameter id."""
+    return (ids % num_shards) * rps + ids // num_shards
+
+
+def phys_to_id(phys: Array, num_shards: int, rps: int) -> Array:
+    """Inverse of :func:`id_to_phys` (may exceed num_ids for padding rows)."""
+    return (phys % rps) * num_shards + phys // rps
+
+
+# ---------------------------------------------------------------------------
+# Collective pull / push (call inside shard_map).
+# ---------------------------------------------------------------------------
+
+def pull(
+    local_shard: Array,
+    ids: Array,
+    *,
+    num_shards: int,
+    shard_axis: str = SHARD_AXIS,
+) -> Array:
+    """Gather parameter rows for ``ids`` from the sharded table.
+
+    Args:
+      local_shard: this device's ``(rps, dim)`` block of the table.
+      ids: ``(B,)`` int32 parameter ids requested by this worker.
+      num_shards: size of the shard axis (static).
+
+    Returns:
+      ``(B, dim)`` values, one row per requested id.
+
+    Replaces the reference's ``ParameterServerClient.pull`` →
+    ``ParameterServerLogic.onPullRecv`` → ``answerPull`` round trip
+    (expected upstream ``.../ps/FlinkParameterServer.scala``).
+    """
+    me = lax.axis_index(shard_axis)
+    # Every shard sees every worker's request ids: (S*B,).
+    all_ids = lax.all_gather(ids, shard_axis, tiled=True)
+    owned = (all_ids % num_shards) == me
+    local_idx = jnp.where(owned, all_ids // num_shards, 0)
+    vals = jnp.take(local_shard, local_idx, axis=0)
+    vals = jnp.where(owned[:, None], vals, jnp.zeros_like(vals))
+    # Each worker ends up with its own (B, dim) slice, summed over shards
+    # (exactly one shard contributed each row).
+    return lax.psum_scatter(vals, shard_axis, scatter_dimension=0, tiled=True)
+
+
+def pull_local(
+    local_shard: Array,
+    ids: Array,
+    *,
+    num_shards: int,
+) -> Array:
+    """Gather rows the calling device already owns (no communication).
+
+    For worker-local tables (e.g. MF user factors, reference
+    ``.../matrix/factorization/`` keeps user vectors in worker state): the
+    ingest layer routes examples so that ``ids % num_shards`` equals the
+    worker index, making every lookup local.
+    """
+    return jnp.take(local_shard, ids // num_shards, axis=0)
+
+
+def push(
+    local_shard: Array,
+    ids: Array,
+    deltas: Array,
+    *,
+    num_shards: int,
+    shard_axis: str = SHARD_AXIS,
+    data_axis: str | None = DATA_AXIS,
+    apply_fn: Callable[[Array, Array], Array] | None = None,
+) -> Array:
+    """Scatter-add ``deltas`` for ``ids`` into the sharded table.
+
+    Args:
+      local_shard: this device's ``(rps, dim)`` block.
+      ids: ``(B,)`` ids this worker is pushing to. **Negative ids are
+        dropped entirely** — use ``-1`` for padding rows so that even
+        non-additive ``apply_fn`` folds never see them.
+      deltas: ``(B, dim)`` deltas.
+      data_axis: if the mesh has a replicated data axis, deltas are gathered
+        across it too so all replicas stay bit-identical.
+      apply_fn: fold function ``(current_rows, summed_delta) -> new_rows``;
+        defaults to addition (the reference's ``paramUpdate = _ + _``,
+        ``SimplePSLogic``). Non-additive folds see the batch-summed delta
+        once per id (duplicates are pre-combined with ``segment_sum``) and
+        are applied only to rows with at least one non-dropped push.
+
+    Returns:
+      Updated ``(rps, dim)`` local block.
+    """
+    gathered_ids = ids
+    gathered_deltas = deltas
+    if data_axis is not None:
+        gathered_ids = lax.all_gather(gathered_ids, data_axis, tiled=True)
+        gathered_deltas = lax.all_gather(gathered_deltas, data_axis, tiled=True)
+    gathered_ids = lax.all_gather(gathered_ids, shard_axis, tiled=True)
+    gathered_deltas = lax.all_gather(gathered_deltas, shard_axis, tiled=True)
+
+    me = lax.axis_index(shard_axis)
+    rps = local_shard.shape[0]
+    owned = ((gathered_ids % num_shards) == me) & (gathered_ids >= 0)
+    # Unowned/dropped rows get an out-of-range index, dropped by the scatter.
+    local_idx = jnp.where(owned, gathered_ids // num_shards, rps)
+    masked = jnp.where(owned[:, None], gathered_deltas, jnp.zeros_like(gathered_deltas))
+
+    if apply_fn is None:
+        return local_shard.at[local_idx].add(
+            masked.astype(local_shard.dtype), mode="drop"
+        )
+
+    # General fold: combine duplicate ids first, then apply once per row.
+    summed = jax.ops.segment_sum(masked, local_idx, num_segments=rps + 1)[:rps]
+    touched = jax.ops.segment_sum(
+        jnp.ones_like(local_idx, jnp.int32), local_idx, num_segments=rps + 1
+    )[:rps] > 0
+    new_rows = apply_fn(local_shard, summed.astype(local_shard.dtype))
+    return jnp.where(touched[:, None], new_rows, local_shard)
+
+
+# ---------------------------------------------------------------------------
+# Table spec + host-side store container.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TableSpec:
+    """Declaration of one parameter table (one sharded ``id -> vector`` map).
+
+    ``init_fn(key, ids) -> (len(ids), dim) values`` must be deterministic in
+    ``ids`` — the reference seeds its factor initializers with the parameter
+    id so that initialization is reproducible regardless of which shard
+    first touches an id (expected upstream
+    ``.../matrix/factorization/factors/``); we keep that contract.
+    """
+
+    name: str
+    num_ids: int
+    dim: int
+    init_fn: Callable[[Array, Array], Array] = None  # (key, ids) -> values
+    dtype: Any = jnp.float32
+
+    def zeros_init(self) -> "TableSpec":
+        return dataclasses.replace(
+            self, init_fn=lambda key, ids: jnp.zeros((ids.shape[0], self.dim), self.dtype)
+        )
+
+
+def ranged_uniform_init(min_val: float, max_val: float, dim: int, dtype=jnp.float32):
+    """Per-id seeded uniform init in ``[min_val, max_val)`` — mirrors the
+    reference's ranged-random factor initializer (seeded by parameter id so
+    initialization is reproducible across any shard count; expected upstream
+    ``.../matrix/factorization/factors/``)."""
+
+    def init(key: Array, ids: Array) -> Array:
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(ids)
+        return jax.vmap(
+            lambda k: jax.random.uniform(
+                k, (dim,), jnp.float32, minval=min_val, maxval=max_val
+            )
+        )(keys).astype(dtype)
+
+    return init
+
+
+def _default_init(key: Array, ids: Array, dim: int, dtype) -> Array:
+    return ranged_uniform_init(-0.01, 0.01, dim, dtype)(key, ids)
+
+
+def make_table_values(
+    key: Array,
+    num_ids: int,
+    dim: int,
+    num_shards: int,
+    init_fn: Callable[[Array, Array], Array] | None = None,
+    dtype=jnp.float32,
+) -> Array:
+    """Build a full ``(rps*num_shards, dim)`` table in owner-major layout.
+
+    Usable both for PS tables (sharded over the shard axis) and for
+    worker-local tables (sharded over all worker devices, e.g. MF user
+    factors). Initialization is per-id deterministic: padding rows and real
+    rows alike get ``init_fn(fold_in(key, id))``-style values, so the result
+    is identical regardless of shard count (matching the reference's
+    id-seeded reproducible factor initializers).
+    """
+    rps = rows_per_shard(num_ids, num_shards)
+    phys = jnp.arange(rps * num_shards, dtype=jnp.int32)
+    ids = phys_to_id(phys, num_shards, rps)
+    fn = init_fn or partial(_default_init, dim=dim, dtype=dtype)
+    return fn(key, ids).astype(dtype)
+
+
+class ParamStore:
+    """Host-side container creating and tracking sharded parameter tables.
+
+    The device-side compute never touches this class — it works on the pytree
+    of arrays (``store.tables``) passed through the jitted step functions.
+    """
+
+    def __init__(self, mesh: Mesh, specs: Mapping[str, TableSpec] | list[TableSpec]):
+        if not isinstance(specs, Mapping):
+            specs = {s.name: s for s in specs}
+        self.mesh = mesh
+        self.specs = dict(specs)
+        self.num_shards = mesh.shape[SHARD_AXIS]
+        self.sharding = NamedSharding(mesh, P(SHARD_AXIS, None))
+        self.tables: dict[str, Array] = {}
+
+    def init(self, key: Array) -> dict[str, Array]:
+        """Materialize all tables directly in their sharded layout."""
+        for name, spec in self.specs.items():
+            tkey = jax.random.fold_in(key, _stable_hash(name))
+            make = partial(
+                make_table_values,
+                tkey,
+                spec.num_ids,
+                spec.dim,
+                self.num_shards,
+                spec.init_fn,
+                spec.dtype,
+            )
+            self.tables[name] = jax.jit(make, out_shardings=self.sharding)()
+        return self.tables
+
+    def table_specs_static(self) -> dict[str, tuple[int, int]]:
+        """(num_shards, rows_per_shard) per table, for device-side code."""
+        return {
+            name: (self.num_shards, rows_per_shard(spec.num_ids, self.num_shards))
+            for name, spec in self.specs.items()
+        }
+
+    def lookup_host(self, name: str, ids: np.ndarray) -> np.ndarray:
+        """Host-side (numpy) read of current values — for eval / model dump.
+
+        Replaces the reference's end-of-job model emission
+        (``ParameterServerLogic.close`` → ``output((id, param))``).
+        """
+        spec = self.specs[name]
+        rps = rows_per_shard(spec.num_ids, self.num_shards)
+        table = np.asarray(self.tables[name])
+        phys = np.asarray(id_to_phys(np.asarray(ids), self.num_shards, rps))
+        return table[phys]
+
+    def dump_model(self, name: str) -> tuple[np.ndarray, np.ndarray]:
+        """Return ``(ids, values)`` for the whole table (padding rows excluded)."""
+        spec = self.specs[name]
+        ids = np.arange(spec.num_ids)
+        return ids, self.lookup_host(name, ids)
+
+
+def _stable_hash(s: str) -> int:
+    h = 0
+    for c in s.encode():
+        h = (h * 131 + c) % (2**31 - 1)
+    return h

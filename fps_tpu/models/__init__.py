@@ -1,0 +1,3 @@
+from fps_tpu.models.matrix_factorization import MatrixFactorizationWorker, online_mf
+
+__all__ = ["MatrixFactorizationWorker", "online_mf"]

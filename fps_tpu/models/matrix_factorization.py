@@ -1,0 +1,161 @@
+"""Online matrix factorization (SGD) — the reference's flagship algorithm.
+
+Reference behavior being rebuilt (SURVEY.md §2 #8 / §3.3; expected upstream
+``src/main/scala/hu/sztaki/ilab/ps/matrix/factorization/PSOnlineMatrixFactorization.scala``):
+
+* rating stream ``(userId, itemId, score)`` (MovieLens-style);
+* **item factor vectors are the PS parameters** — pulled/pushed by item id,
+  hash-sharded across servers;
+* **user factor vectors live in worker-local state** — the stream is
+  partitioned by user so each worker owns its users' vectors outright;
+* per rating: pull ``q_i`` → SGD step on ``(p_u, q_i)`` with learning rate
+  and L2 regularization → ``p_u`` updated locally, ``Δq_i`` pushed;
+* factors initialized by a per-id seeded uniform in a configured range so
+  initialization is reproducible across shards;
+* worker emits the prediction/error on the ``WOut`` channel.
+
+TPU design: a batch of ratings per worker per step; one collective ``pull``
+of the batch's item vectors; dense vectorized SGD on the (B, rank) blocks
+(VPU work — rank is small); local scatter-add into the user block; collective
+scatter-add ``push`` of item deltas. Duplicate users/items within a batch
+accumulate additively into the same row — Hogwild-flavored, exactly the
+update interleaving the asynchronous reference produces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fps_tpu.core.api import StepOutput, WorkerLogic
+from fps_tpu.core.store import (
+    ParamStore,
+    TableSpec,
+    make_table_values,
+    pull_local,
+    ranged_uniform_init,
+    rows_per_shard,
+)
+
+Array = jax.Array
+
+ITEM_TABLE = "item_factors"
+
+
+@dataclasses.dataclass
+class MFConfig:
+    num_users: int
+    num_items: int
+    rank: int = 10
+    learning_rate: float = 0.05
+    reg: float = 0.01
+    init_min: float = -0.1
+    init_max: float = 0.1
+    dtype: object = jnp.float32
+
+
+class MatrixFactorizationWorker(WorkerLogic):
+    """Worker logic: local user factors, pulled item factors, SGD updates."""
+
+    def __init__(self, config: MFConfig, num_workers: int):
+        self.cfg = config
+        self.num_workers = num_workers
+
+    # local_state = the worker-sharded user factor table (owner-major cyclic
+    # over num_workers, like a PS table but never communicated).
+    def init_local_state(self, key: Array, num_workers: int):
+        return make_table_values(
+            key,
+            self.cfg.num_users,
+            self.cfg.rank,
+            num_workers,
+            ranged_uniform_init(
+                self.cfg.init_min, self.cfg.init_max, self.cfg.rank, self.cfg.dtype
+            ),
+            self.cfg.dtype,
+        )
+
+    def pull_ids(self, batch) -> Mapping[str, Array]:
+        return {ITEM_TABLE: batch["item"].astype(jnp.int32)}
+
+    def step(self, batch, pulled, local_state, key) -> StepOutput:
+        cfg = self.cfg
+        user_factors = local_state
+        u = batch["user"].astype(jnp.int32)
+        w = batch["weight"].astype(cfg.dtype)
+        r = batch["rating"].astype(cfg.dtype)
+        q = pulled[ITEM_TABLE]  # (B, rank)
+
+        uidx = u // self.num_workers  # local row (ingest routes u % W == me)
+        p = pull_local(user_factors, u, num_shards=self.num_workers)
+
+        pred = jnp.sum(p * q, axis=-1)
+        err = (r - pred) * w
+        lr = cfg.learning_rate
+        # Reference SGDUpdater: d_p = lr*(err*q - reg*p), d_q = lr*(err*p - reg*q).
+        dp = lr * (err[:, None] * q - cfg.reg * w[:, None] * p)
+        dq = lr * (err[:, None] * p - cfg.reg * w[:, None] * q)
+
+        user_factors = user_factors.at[uidx].add(dp.astype(cfg.dtype))
+
+        out = {
+            "se": jnp.sum(err * err).astype(jnp.float32),
+            "n": jnp.sum(w).astype(jnp.float32),
+        }
+        # Padding rows push id -1 so the store drops them outright.
+        push_ids = jnp.where(w > 0, batch["item"].astype(jnp.int32), -1)
+        pushes = {ITEM_TABLE: (push_ids, dq)}
+        return StepOutput(pushes=pushes, local_state=user_factors, out=out)
+
+
+def make_store(mesh, cfg: MFConfig) -> ParamStore:
+    spec = TableSpec(
+        name=ITEM_TABLE,
+        num_ids=cfg.num_items,
+        dim=cfg.rank,
+        init_fn=ranged_uniform_init(cfg.init_min, cfg.init_max, cfg.rank, cfg.dtype),
+        dtype=cfg.dtype,
+    )
+    return ParamStore(mesh, [spec])
+
+
+def online_mf(mesh, cfg: MFConfig, *, sync_every: int | None = None,
+              donate: bool = True):
+    """Construct (trainer, store) for online MF — the analog of
+    ``PSOnlineMatrixFactorization.psOnlineMF(...)``."""
+    from fps_tpu.core.driver import Trainer, TrainerConfig, num_workers_of
+
+    store = make_store(mesh, cfg)
+    worker = MatrixFactorizationWorker(cfg, num_workers_of(mesh))
+    trainer = Trainer(
+        mesh, store, worker,
+        config=TrainerConfig(sync_every=sync_every, donate=donate),
+    )
+    return trainer, store
+
+
+def predict_host(
+    store: ParamStore,
+    user_factors_global: np.ndarray,
+    num_workers: int,
+    users: np.ndarray,
+    items: np.ndarray,
+) -> np.ndarray:
+    """Host-side predictions from the live tables (for eval/RMSE)."""
+    rps = rows_per_shard_global(user_factors_global, num_workers)
+    phys = (users % num_workers) * rps + users // num_workers
+    p = np.asarray(user_factors_global)[phys]
+    q = store.lookup_host(ITEM_TABLE, items)
+    return np.sum(p * q, axis=-1)
+
+
+def rows_per_shard_global(table: np.ndarray, num_shards: int) -> int:
+    return table.shape[0] // num_shards
+
+
+def rmse(pred: np.ndarray, truth: np.ndarray) -> float:
+    return float(np.sqrt(np.mean((pred - truth) ** 2)))

@@ -1,0 +1,3 @@
+from fps_tpu.parallel.mesh import make_ps_mesh, DATA_AXIS, SHARD_AXIS
+
+__all__ = ["make_ps_mesh", "DATA_AXIS", "SHARD_AXIS"]

@@ -1,0 +1,80 @@
+"""Device-mesh construction for the parameter-server layout.
+
+The reference runs ``workerParallelism`` worker subtasks and ``psParallelism``
+server subtasks as separate Flink operators connected by a network shuffle
+(``FlinkParameterServer.transform``, expected upstream path
+``src/main/scala/hu/sztaki/ilab/ps/FlinkParameterServer.scala``).
+
+On TPU we use an SPMD layout instead: every chip is *both* a worker and a
+parameter shard. The mesh has two named axes:
+
+* ``data``  — pure data parallelism: parameter tables are **replicated** along
+  it, the example stream is split across it.
+* ``shard`` — the parameter-server axis: tables are **row-sharded** along it
+  (the analog of ``psParallelism``), and the example stream is split across it
+  too (workers = all devices).
+
+So ``workerParallelism == data * shard`` and ``psParallelism == shard``.
+A plain single-axis PS is ``data=1``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax.sharding import Mesh
+
+# Canonical axis names used throughout the framework.
+DATA_AXIS = "data"
+SHARD_AXIS = "shard"
+
+
+def make_ps_mesh(
+    num_shards: int | None = None,
+    num_data: int = 1,
+    *,
+    devices=None,
+) -> Mesh:
+    """Build a ``(data, shard)`` mesh over the available devices.
+
+    Args:
+      num_shards: size of the parameter-shard axis (the reference's
+        ``psParallelism``). Defaults to ``len(devices) // num_data``.
+      num_data: size of the replicated data-parallel axis.
+      devices: optional explicit device list (defaults to ``jax.devices()``).
+
+    Returns:
+      A ``jax.sharding.Mesh`` with axes ``('data', 'shard')``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if num_shards is None:
+        if n % num_data != 0:
+            raise ValueError(f"{n} devices not divisible by num_data={num_data}")
+        num_shards = n // num_data
+    if num_data * num_shards != n:
+        raise ValueError(
+            f"mesh {num_data}x{num_shards} does not cover {n} devices"
+        )
+    import numpy as np
+
+    dev_grid = np.asarray(devices).reshape(num_data, num_shards)
+    return Mesh(dev_grid, (DATA_AXIS, SHARD_AXIS))
+
+
+def default_mesh_shape(n_devices: int) -> tuple[int, int]:
+    """Factor ``n_devices`` into a (data, shard) shape.
+
+    Prefers a square-ish split with shard >= data so that parameter sharding
+    (the scarce resource: HBM) gets the larger axis.
+    """
+    best = (1, n_devices)
+    d = int(math.isqrt(n_devices))
+    while d >= 1:
+        if n_devices % d == 0 and n_devices // d >= d:
+            best = (d, n_devices // d)
+            break
+        d -= 1
+    return best

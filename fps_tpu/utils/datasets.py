@@ -1,0 +1,181 @@
+"""Dataset loaders & synthetic generators for the benchmark workloads.
+
+BASELINE.json configs: MovieLens-100K / MovieLens-20M ratings (MF, iALS),
+RCV1 (passive-aggressive), text8 (word2vec SGNS), Criteo CTR (logreg SSP).
+
+This environment has zero network egress, so each loader first looks for a
+real dataset file on disk and otherwise falls back to a *synthetic* generator
+with matched shape/statistics (latent-structured ratings, Zipfian token
+stream, sparse labeled examples). The synthetic sets have known structure so
+convergence tests can assert learning actually happens.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# MovieLens-style ratings.
+# ---------------------------------------------------------------------------
+
+def synthetic_ratings(
+    num_users: int,
+    num_items: int,
+    num_ratings: int,
+    *,
+    rank: int = 6,
+    noise: float = 0.1,
+    seed: int = 0,
+    dtype=np.float32,
+):
+    """Ratings with planted low-rank structure: r = <p_u, q_i> + noise.
+
+    Popularity is Zipfian over items (like MovieLens) so the scatter-add path
+    sees realistic hot-id skew.
+    """
+    rng = np.random.default_rng(seed)
+    p = rng.normal(0, 1.0 / np.sqrt(rank), (num_users, rank))
+    q = rng.normal(0, 1.0 / np.sqrt(rank), (num_items, rank))
+    users = rng.integers(0, num_users, num_ratings)
+    item_pop = 1.0 / np.arange(1, num_items + 1) ** 0.8
+    item_pop /= item_pop.sum()
+    items = rng.choice(num_items, num_ratings, p=item_pop)
+    ratings = np.sum(p[users] * q[items], axis=-1) + rng.normal(
+        0, noise, num_ratings
+    )
+    return {
+        "user": users.astype(np.int32),
+        "item": items.astype(np.int32),
+        "rating": ratings.astype(dtype),
+    }
+
+
+def load_movielens(path: str | None = None, scale: str = "100k"):
+    """Load MovieLens ``u.data``-format ratings if present, else synthesize.
+
+    Returns (data dict, num_users, num_items). Synthetic sizes follow the
+    named scale: 100k -> (943, 1682, 100_000) like ML-100K;
+    20m -> (138_493, 26_744, 20_000_263) like ML-20M.
+    """
+    if path and os.path.exists(path):
+        raw = np.loadtxt(path, dtype=np.int64)
+        users = raw[:, 0].astype(np.int32) - 1
+        items = raw[:, 1].astype(np.int32) - 1
+        data = {
+            "user": users,
+            "item": items,
+            "rating": raw[:, 2].astype(np.float32),
+        }
+        return data, int(users.max()) + 1, int(items.max()) + 1
+    sizes = {
+        "100k": (943, 1682, 100_000),
+        "1m": (6040, 3706, 1_000_209),
+        "20m": (138_493, 26_744, 20_000_263),
+    }
+    nu, ni, nr = sizes[scale]
+    return synthetic_ratings(nu, ni, nr), nu, ni
+
+
+def train_test_split(data: dict, test_frac: float = 0.1, seed: int = 1):
+    n = len(next(iter(data.values())))
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(n)
+    cut = int(n * (1 - test_frac))
+    tr, te = order[:cut], order[cut:]
+    return (
+        {k: v[tr] for k, v in data.items()},
+        {k: v[te] for k, v in data.items()},
+    )
+
+
+# ---------------------------------------------------------------------------
+# Zipfian token stream (text8-style) for word2vec.
+# ---------------------------------------------------------------------------
+
+def synthetic_corpus(
+    vocab_size: int,
+    num_tokens: int,
+    *,
+    num_topics: int = 16,
+    seed: int = 0,
+):
+    """Token stream with Zipfian unigram frequencies and topical locality
+    (nearby tokens share a topic), so skip-gram has real signal to learn."""
+    rng = np.random.default_rng(seed)
+    # Zipf over the vocab.
+    freq = 1.0 / np.arange(1, vocab_size + 1) ** 1.0
+    freq /= freq.sum()
+    # Each topic reweights a random slice of the vocab.
+    topic_boost = rng.gamma(0.3, 1.0, (num_topics, vocab_size))
+    topic_dist = freq * topic_boost
+    topic_dist /= topic_dist.sum(axis=1, keepdims=True)
+    # Markov chain over topics with sticky self-transitions.
+    tokens = np.empty(num_tokens, dtype=np.int32)
+    seg = 64
+    topic = 0
+    for start in range(0, num_tokens, seg):
+        if rng.random() < 0.3:
+            topic = rng.integers(num_topics)
+        end = min(start + seg, num_tokens)
+        tokens[start:end] = rng.choice(
+            vocab_size, end - start, p=topic_dist[topic]
+        )
+    return tokens
+
+
+def load_text8(path: str | None = None, vocab_size: int = 50_000,
+               num_tokens: int = 2_000_000, seed: int = 0):
+    """Load and tokenize text8 if present, else synthesize a Zipfian stream.
+
+    Returns (tokens int32 array, vocab_size, unigram_counts).
+    """
+    if path and os.path.exists(path):
+        with open(path) as f:
+            words = f.read().split()
+        from collections import Counter
+
+        counts = Counter(words)
+        vocab = [w for w, _ in counts.most_common(vocab_size - 1)]
+        w2i = {w: i + 1 for i, w in enumerate(vocab)}  # 0 = UNK
+        tokens = np.fromiter((w2i.get(w, 0) for w in words), np.int32, len(words))
+        uni = np.bincount(tokens, minlength=vocab_size).astype(np.float64)
+        return tokens, vocab_size, uni
+    tokens = synthetic_corpus(vocab_size, num_tokens, seed=seed)
+    uni = np.bincount(tokens, minlength=vocab_size).astype(np.float64)
+    return tokens, vocab_size, uni
+
+
+# ---------------------------------------------------------------------------
+# Sparse labeled examples (RCV1 / Criteo style) for PA + logreg.
+# ---------------------------------------------------------------------------
+
+def synthetic_sparse_classification(
+    num_examples: int,
+    num_features: int,
+    nnz_per_example: int,
+    *,
+    seed: int = 0,
+    noise: float = 0.1,
+):
+    """Linearly separable-ish sparse examples with Zipfian feature frequency.
+
+    Returns dict with ``feat_ids (N, nnz)``, ``feat_vals (N, nnz)``,
+    ``label (N,)`` in {-1, +1}.
+    """
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(0, 1, num_features)
+    feat_pop = 1.0 / np.arange(1, num_features + 1) ** 0.9
+    feat_pop /= feat_pop.sum()
+    ids = rng.choice(num_features, (num_examples, nnz_per_example), p=feat_pop)
+    vals = rng.normal(0, 1, (num_examples, nnz_per_example)).astype(np.float32)
+    margin = np.sum(w_true[ids] * vals, axis=-1) / np.sqrt(nnz_per_example)
+    flip = rng.random(num_examples) < noise
+    label = np.where((margin > 0) ^ flip, 1.0, -1.0).astype(np.float32)
+    return {
+        "feat_ids": ids.astype(np.int32),
+        "feat_vals": vals,
+        "label": label,
+    }

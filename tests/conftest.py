@@ -1,0 +1,60 @@
+"""Test harness: multi-device CPU mesh, mirroring the reference's strategy.
+
+The reference tests distributed behavior with no cluster by running real
+multi-subtask pipelines on Flink's local mini-cluster inside one JVM
+(SURVEY.md §4). The TPU-native analog: 8 virtual CPU devices via
+``--xla_force_host_platform_device_count=8`` so every collective in the
+store/driver runs against a real 8-way mesh.
+
+This container's sitecustomize eagerly registers the single-chip TPU (axon)
+backend at interpreter start, *before* pytest loads — too late to choose the
+CPU platform from inside this process. So on first import we re-exec pytest
+in a cleaned environment (no sitecustomize on PYTHONPATH, JAX_PLATFORMS=cpu).
+"""
+
+import os
+import sys
+
+import pytest
+
+_MARK = "_FPS_TPU_TEST_REEXEC"
+
+# Repo root on sys.path so `import fps_tpu` works without an install step.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    if os.environ.get(_MARK) == "1":
+        return
+    env = dict(os.environ)
+    env[_MARK] = "1"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    # Restore the real stdout/stderr fds before exec'ing, otherwise the new
+    # process inherits pytest's capture temp-files and all output is lost.
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    os.execve(
+        sys.executable,
+        [sys.executable, "-m", "pytest"] + sys.argv[1:],
+        env,
+    )
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, (
+        f"expected 8 virtual CPU devices, got {len(devs)} ({jax.default_backend()})"
+    )
+    return devs
