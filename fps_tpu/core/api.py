@@ -70,6 +70,11 @@ class WorkerLogic:
         """Per-device local state; called once under the driver's sharding."""
         return ()
 
+    def prepare(self, batch: Pytree, key: Array) -> Pytree:
+        """Augment the batch before pulling (e.g. sample negative ids
+        on-device). Runs inside the compiled step; default is identity."""
+        return batch
+
     def pull_ids(self, batch: Pytree) -> Mapping[str, Array]:
         """Map table name -> (B,) int32 ids to pull for this batch."""
         raise NotImplementedError
@@ -89,12 +94,18 @@ class WorkerLogic:
 class ServerLogic:
     """Per-table server fold — the reference's ``SimplePSLogic``.
 
-    ``apply_fn(current_rows, summed_deltas) -> new_rows``; ``None`` means
+    ``apply_fn(current_rows, combined_deltas) -> new_rows``; ``None`` means
     plain addition (``paramUpdate = _ + _``), which every algorithm shipped
     with the reference uses and which takes the fastest scatter-add path.
+
+    ``combine`` controls how duplicate ids in one batch merge before the
+    fold: ``"sum"`` (reference semantics) or ``"mean"`` (per-id averaged
+    step — stable for Zipfian hot ids under large batches).
     """
 
     apply_fn: Callable[[Array, Array], Array] | None = None
+    combine: str = "sum"
 
 
 ADDITIVE = ServerLogic(apply_fn=None)
+MEAN_COMBINE = ServerLogic(apply_fn=None, combine="mean")

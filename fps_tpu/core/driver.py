@@ -127,10 +127,13 @@ class Trainer:
                 shard_axis=SHARD_AXIS,
                 data_axis=DATA_AXIS if self.mesh.shape[DATA_AXIS] > 1 else None,
                 apply_fn=self.server_logic[name].apply_fn,
+                combine=self.server_logic[name].combine,
             )
         return new_tables
 
     def _sync_step(self, tables, local_state, batch, key):
+        key, prep_key = jax.random.split(key)
+        batch = self.logic.prepare(batch, prep_key)
         ids = self.logic.pull_ids(batch)
         pulled = {
             name: pull(tables[name], tids, num_shards=self.num_shards)
@@ -142,6 +145,8 @@ class Trainer:
 
     def _snapshot_step(self, tables, snapshot, local_state, batch, key):
         """SSP inner step: read from the replicated snapshot, push live."""
+        key, prep_key = jax.random.split(key)
+        batch = self.logic.prepare(batch, prep_key)
         ids = self.logic.pull_ids(batch)
         pulled = {}
         for name, tids in ids.items():

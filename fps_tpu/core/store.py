@@ -136,6 +136,7 @@ def push(
     shard_axis: str = SHARD_AXIS,
     data_axis: str | None = DATA_AXIS,
     apply_fn: Callable[[Array, Array], Array] | None = None,
+    combine: str = "sum",
 ) -> Array:
     """Scatter-add ``deltas`` for ``ids`` into the sharded table.
 
@@ -149,9 +150,15 @@ def push(
         across it too so all replicas stay bit-identical.
       apply_fn: fold function ``(current_rows, summed_delta) -> new_rows``;
         defaults to addition (the reference's ``paramUpdate = _ + _``,
-        ``SimplePSLogic``). Non-additive folds see the batch-summed delta
+        ``SimplePSLogic``). Non-additive folds see the batch-combined delta
         once per id (duplicates are pre-combined with ``segment_sum``) and
         are applied only to rows with at least one non-dropped push.
+      combine: how duplicate ids within one push combine — ``"sum"`` (the
+        reference's semantics: every message folds in) or ``"mean"``
+        (per-id average: each touched row takes one averaged step per
+        push, which keeps hot Zipfian ids stable under large batches —
+        the analog of the reference's batching senders combining pushes
+        to the same id, expected upstream ``.../ps/client/sender/``).
 
     Returns:
       Updated ``(rps, dim)`` local block.
@@ -171,17 +178,26 @@ def push(
     local_idx = jnp.where(owned, gathered_ids // num_shards, rps)
     masked = jnp.where(owned[:, None], gathered_deltas, jnp.zeros_like(gathered_deltas))
 
-    if apply_fn is None:
+    if combine not in ("sum", "mean"):
+        raise ValueError(f"unknown combine mode {combine!r}")
+
+    if apply_fn is None and combine == "sum":
         return local_shard.at[local_idx].add(
             masked.astype(local_shard.dtype), mode="drop"
         )
 
-    # General fold: combine duplicate ids first, then apply once per row.
+    # Combine duplicate ids first, then apply once per touched row.
     summed = jax.ops.segment_sum(masked, local_idx, num_segments=rps + 1)[:rps]
-    touched = jax.ops.segment_sum(
-        jnp.ones_like(local_idx, jnp.int32), local_idx, num_segments=rps + 1
-    )[:rps] > 0
-    new_rows = apply_fn(local_shard, summed.astype(local_shard.dtype))
+    counts = jax.ops.segment_sum(
+        owned.astype(jnp.int32), local_idx, num_segments=rps + 1
+    )[:rps]
+    if combine == "mean":
+        summed = summed / jnp.maximum(counts, 1)[:, None].astype(summed.dtype)
+    touched = counts > 0
+    if apply_fn is None:
+        new_rows = local_shard + summed.astype(local_shard.dtype)
+    else:
+        new_rows = apply_fn(local_shard, summed.astype(local_shard.dtype))
     return jnp.where(touched[:, None], new_rows, local_shard)
 
 

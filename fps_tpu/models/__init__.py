@@ -1,3 +1,23 @@
+from fps_tpu.models.logistic_regression import (
+    LogisticRegressionWorker,
+    logistic_regression,
+)
 from fps_tpu.models.matrix_factorization import MatrixFactorizationWorker, online_mf
+from fps_tpu.models.passive_aggressive import (
+    MulticlassPassiveAggressiveWorker,
+    PassiveAggressiveWorker,
+    passive_aggressive,
+)
+from fps_tpu.models.word2vec import Word2VecWorker, word2vec
 
-__all__ = ["MatrixFactorizationWorker", "online_mf"]
+__all__ = [
+    "LogisticRegressionWorker",
+    "logistic_regression",
+    "MatrixFactorizationWorker",
+    "online_mf",
+    "MulticlassPassiveAggressiveWorker",
+    "PassiveAggressiveWorker",
+    "passive_aggressive",
+    "Word2VecWorker",
+    "word2vec",
+]

@@ -1,0 +1,105 @@
+"""Sparse logistic regression with bounded-staleness SGD (Criteo CTR style).
+
+This is the "async bounded-staleness SGD, multi-worker data-parallel"
+workload named in BASELINE.json's configs. The reference framework runs any
+such model through the same WorkerLogic/ServerLogic machinery; here it is
+the canonical exerciser of the **SSP driver** (``sync_every=s``): workers
+read weights from a snapshot up to ``s`` steps stale, compute sigmoid-loss
+gradients over hashed sparse features, and push per-feature deltas that land
+in the authoritative sharded table every step.
+
+Batch columns: ``feat_ids (B, nnz)``, ``feat_vals (B, nnz)``,
+``label (B,)`` in {0, 1}, ``weight (B,)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fps_tpu.core.api import StepOutput, WorkerLogic
+from fps_tpu.core.store import ParamStore, TableSpec
+
+Array = jax.Array
+
+WEIGHT_TABLE = "weights"
+
+
+@dataclasses.dataclass
+class LogRegConfig:
+    num_features: int
+    learning_rate: float = 0.1
+    l2: float = 0.0
+    batch_average: bool = True  # average grads over the local batch
+    dtype: object = jnp.float32
+
+
+class LogisticRegressionWorker(WorkerLogic):
+    def __init__(self, cfg: LogRegConfig):
+        self.cfg = cfg
+
+    def pull_ids(self, batch) -> Mapping[str, Array]:
+        return {WEIGHT_TABLE: batch["feat_ids"].astype(jnp.int32).reshape(-1)}
+
+    def step(self, batch, pulled, local_state, key) -> StepOutput:
+        cfg = self.cfg
+        B, nnz = batch["feat_ids"].shape
+        x = batch["feat_vals"].astype(cfg.dtype)
+        y = batch["label"].astype(cfg.dtype)  # {0,1}
+        w = batch["weight"].astype(cfg.dtype)
+
+        wrows = pulled[WEIGHT_TABLE].reshape(B, nnz)
+        logit = jnp.sum(wrows * x, axis=-1)
+        p = jax.nn.sigmoid(logit)
+        g = (p - y) * w  # dL/dlogit, zeroed for padding
+
+        n_real = jnp.maximum(jnp.sum(w), 1.0)
+        scale = cfg.learning_rate / (n_real if cfg.batch_average else 1.0)
+        deltas = -scale * (g[:, None] * x + cfg.l2 * wrows * w[:, None])
+
+        active = (x != 0.0) & (w[:, None] > 0)
+        push_ids = jnp.where(active, batch["feat_ids"].astype(jnp.int32), -1)
+
+        # log loss, clipped for monitoring stability.
+        eps = 1e-7
+        ll = -(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps))
+        mistakes = jnp.sum(w * ((p > 0.5) != (y > 0.5)))
+        out = {
+            "logloss": jnp.sum(ll * w).astype(jnp.float32),
+            "mistakes": mistakes.astype(jnp.float32),
+            "n": jnp.sum(w).astype(jnp.float32),
+        }
+        pushes = {WEIGHT_TABLE: (push_ids.reshape(-1), deltas.reshape(-1, 1))}
+        return StepOutput(pushes=pushes, local_state=local_state, out=out)
+
+
+def make_store(mesh, cfg: LogRegConfig) -> ParamStore:
+    spec = TableSpec(
+        name=WEIGHT_TABLE, num_ids=cfg.num_features, dim=1, dtype=cfg.dtype
+    ).zeros_init()
+    return ParamStore(mesh, [spec])
+
+
+def logistic_regression(mesh, cfg: LogRegConfig, *,
+                        sync_every: int | None = None, donate: bool = True):
+    """(trainer, store); pass ``sync_every=s`` for SSP bounded staleness."""
+    from fps_tpu.core.driver import Trainer, TrainerConfig
+
+    store = make_store(mesh, cfg)
+    trainer = Trainer(
+        mesh, store, LogisticRegressionWorker(cfg),
+        config=TrainerConfig(sync_every=sync_every, donate=donate),
+    )
+    return trainer, store
+
+
+def predict_proba_host(store: ParamStore, feat_ids: np.ndarray,
+                       feat_vals: np.ndarray) -> np.ndarray:
+    rows = store.lookup_host(WEIGHT_TABLE, feat_ids.reshape(-1))
+    B, nnz = feat_ids.shape
+    logit = np.sum(rows.reshape(B, nnz) * feat_vals, axis=-1)
+    return 1.0 / (1.0 + np.exp(-logit))

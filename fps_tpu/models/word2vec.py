@@ -1,0 +1,272 @@
+"""word2vec skip-gram with negative sampling (SGNS) on the PS.
+
+Reference behavior being rebuilt (SURVEY.md §2 #10; confirmed to exist by
+BASELINE.json "word2vec SGNS (text8)"; exact upstream package unverified —
+the survey flags its location as [conf: L]):
+
+* two embedding matrices (input/center and output/context), sharded by
+  word id across the servers;
+* worker slides a window over the token stream, pulls the center vector,
+  the context vector, and K negative-sample vectors, computes the SGNS
+  gradient, pushes deltas to both tables;
+* negatives drawn from the unigram distribution raised to 3/4; frequent
+  words subsampled away (Mikolov et al. 2013); workload: text8.
+
+TPU design
+----------
+* Skip-gram **pair generation and subsampling are host-side streaming**
+  (ingest), producing static-shape (center, context) batches.
+* **Negative sampling is on-device** in ``WorkerLogic.prepare``: inverse-CDF
+  sampling (uniforms + ``searchsorted`` on the replicated unigram^0.75 CDF)
+  — O(B·K·log V), no giant Gumbel tensor, fully inside the compiled step.
+* One pull on the input table (centers) and one on the output table
+  (contexts ++ negatives, flattened) per step; one push each. The sigmoid/
+  gradient math is dense (B, 1+K, dim) VPU work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fps_tpu.core.api import StepOutput, WorkerLogic
+from fps_tpu.core.store import ParamStore, TableSpec, ranged_uniform_init
+
+Array = jax.Array
+
+IN_TABLE = "in_embeddings"
+OUT_TABLE = "out_embeddings"
+
+
+@dataclasses.dataclass
+class W2VConfig:
+    vocab_size: int
+    dim: int = 100
+    window: int = 5  # dynamic window: actual half-width ~ U{1..window}
+    negatives: int = 5
+    learning_rate: float = 0.025
+    subsample_t: float | None = 1e-4  # None disables frequent-word subsampling
+    neg_power: float = 0.75
+    dtype: object = jnp.float32
+
+
+class Word2VecWorker(WorkerLogic):
+    """SGNS worker. Batch columns: ``center (B,)``, ``context (B,)``,
+    ``weight (B,)``. ``prepare`` adds ``negatives (B, K)``."""
+
+    def __init__(self, cfg: W2VConfig, unigram_counts: np.ndarray):
+        self.cfg = cfg
+        p = np.asarray(unigram_counts, np.float64) ** cfg.neg_power
+        p /= p.sum()
+        self._neg_cdf = jnp.asarray(np.cumsum(p), jnp.float32)
+
+    def prepare(self, batch, key):
+        B = batch["center"].shape[0]
+        u = jax.random.uniform(key, (B, self.cfg.negatives))
+        negs = jnp.searchsorted(self._neg_cdf, u).astype(jnp.int32)
+        negs = jnp.minimum(negs, self.cfg.vocab_size - 1)
+        return dict(batch, negatives=negs)
+
+    def pull_ids(self, batch) -> Mapping[str, Array]:
+        ctx_and_neg = jnp.concatenate(
+            [batch["context"].astype(jnp.int32)[:, None], batch["negatives"]],
+            axis=1,
+        )  # (B, 1+K)
+        return {
+            IN_TABLE: batch["center"].astype(jnp.int32),
+            OUT_TABLE: ctx_and_neg.reshape(-1),
+        }
+
+    def step(self, batch, pulled, local_state, key) -> StepOutput:
+        cfg = self.cfg
+        B = batch["center"].shape[0]
+        K = cfg.negatives
+        w = batch["weight"].astype(cfg.dtype)  # (B,)
+
+        v = pulled[IN_TABLE]  # (B, dim) center vectors
+        u = pulled[OUT_TABLE].reshape(B, 1 + K, -1)  # ctx ++ negs
+
+        # labels: slot 0 positive, rest negative.
+        logits = jnp.einsum("bd,bkd->bk", v, u)  # (B, 1+K)
+        labels = jnp.zeros((B, 1 + K), cfg.dtype).at[:, 0].set(1.0)
+        sig = jax.nn.sigmoid(logits)
+        # dL/dlogit for L = -log σ(x_pos) - Σ log σ(-x_neg):
+        g = (sig - labels) * w[:, None]  # (B, 1+K)
+
+        lr = cfg.learning_rate
+        dv = -lr * jnp.einsum("bk,bkd->bd", g, u)  # (B, dim)
+        du = -lr * g[:, :, None] * v[:, None, :]  # (B, 1+K, dim)
+
+        # SGNS loss (for monitoring): -logσ(pos) - Σ logσ(-neg).
+        loss = -(
+            jax.nn.log_sigmoid(logits[:, 0])
+            + jnp.sum(jax.nn.log_sigmoid(-logits[:, 1:]), axis=1)
+        )
+
+        center_ids = jnp.where(w > 0, batch["center"].astype(jnp.int32), -1)
+        ctx_and_neg = jnp.concatenate(
+            [batch["context"].astype(jnp.int32)[:, None], batch["negatives"]],
+            axis=1,
+        )
+        out_ids = jnp.where(w[:, None] > 0, ctx_and_neg, -1)
+
+        out = {
+            "loss": jnp.sum(loss * w).astype(jnp.float32),
+            "n": jnp.sum(w).astype(jnp.float32),
+        }
+        pushes = {
+            IN_TABLE: (center_ids, dv),
+            OUT_TABLE: (out_ids.reshape(-1), du.reshape(B * (1 + K), -1)),
+        }
+        return StepOutput(pushes=pushes, local_state=local_state, out=out)
+
+
+def make_store(mesh, cfg: W2VConfig) -> ParamStore:
+    half = 0.5 / cfg.dim
+    in_spec = TableSpec(
+        name=IN_TABLE,
+        num_ids=cfg.vocab_size,
+        dim=cfg.dim,
+        init_fn=ranged_uniform_init(-half, half, cfg.dim, cfg.dtype),
+        dtype=cfg.dtype,
+    )
+    # word2vec initializes the output matrix to zeros.
+    out_spec = TableSpec(
+        name=OUT_TABLE, num_ids=cfg.vocab_size, dim=cfg.dim, dtype=cfg.dtype
+    ).zeros_init()
+    return ParamStore(mesh, [in_spec, out_spec])
+
+
+def word2vec(mesh, cfg: W2VConfig, unigram_counts: np.ndarray, *,
+             sync_every: int | None = None, donate: bool = True):
+    """(trainer, store) — the analog of the reference's word2vec transform."""
+    from fps_tpu.core.api import MEAN_COMBINE
+    from fps_tpu.core.driver import Trainer, TrainerConfig
+
+    store = make_store(mesh, cfg)
+    worker = Word2VecWorker(cfg, unigram_counts)
+    # Per-id mean combine: with Zipfian word frequencies a hot id appears
+    # many times per batch; summing those deltas diverges, averaging gives
+    # each touched row one stable step per batch (NuPS-style skew handling).
+    trainer = Trainer(
+        mesh, store, worker, server_logic=MEAN_COMBINE,
+        config=TrainerConfig(sync_every=sync_every, donate=donate),
+    )
+    return trainer, store
+
+
+# ---------------------------------------------------------------------------
+# Host-side streaming skip-gram pair generation (the ingest source).
+# ---------------------------------------------------------------------------
+
+def skipgram_chunks(
+    tokens: np.ndarray,
+    unigram_counts: np.ndarray,
+    cfg: W2VConfig,
+    *,
+    num_workers: int,
+    local_batch: int,
+    steps_per_chunk: int,
+    sync_every: int | None = None,
+    seed: int = 0,
+    segment_tokens: int = 1 << 20,
+) -> Iterator[dict]:
+    """Stream ``(center, context, weight)`` chunks over one pass of ``tokens``.
+
+    Works segment-by-segment so the full pair list (≈ 2·window·N) never
+    materializes. Applies frequent-word subsampling (prob. 1 - sqrt(t/f))
+    and a dynamic window (per-position half-width uniform in 1..window),
+    both matching word2vec's reference implementation.
+    """
+    rng = np.random.default_rng(seed)
+    n = len(tokens)
+    counts = np.asarray(unigram_counts, np.float64)
+    freq = counts / max(1.0, counts.sum())
+    if cfg.subsample_t is not None:
+        keep_p = np.minimum(
+            1.0, np.sqrt(cfg.subsample_t / np.maximum(freq, 1e-12))
+        )
+    else:
+        keep_p = np.ones_like(freq)
+
+    B = num_workers * local_batch
+    stride = steps_per_chunk * B
+    if sync_every is not None and steps_per_chunk % sync_every:
+        raise ValueError("steps_per_chunk must be a multiple of sync_every")
+
+    buf_c: list[np.ndarray] = []
+    buf_x: list[np.ndarray] = []
+    buffered = 0
+
+    def emit(c, x, wgt):
+        chunk = {
+            "center": c.reshape(steps_per_chunk, B),
+            "context": x.reshape(steps_per_chunk, B),
+            "weight": wgt.reshape(steps_per_chunk, B).astype(np.float32),
+        }
+        if sync_every is not None:
+            chunk = {
+                k: v.reshape(-1, sync_every, B) for k, v in chunk.items()
+            }
+        return chunk
+
+    # Segments are disjoint: cross-boundary pairs (at most window per
+    # ~million-token segment) are dropped rather than double-counted.
+    for start in range(0, n, segment_tokens):
+        seg = tokens[start : start + segment_tokens]
+        # subsample frequent words (drop positions entirely, like word2vec).
+        keep = rng.random(len(seg)) < keep_p[seg]
+        seg = seg[keep]
+        if len(seg) < 2:
+            continue
+        m = len(seg)
+        half = rng.integers(1, cfg.window + 1, m)  # dynamic window
+        for d in range(1, cfg.window + 1):
+            ok = (half >= d)[: m - d]
+            c = seg[: m - d][ok]
+            x = seg[d:][ok]
+            # both directions: (center, context) and (context, center)
+            buf_c.append(np.concatenate([c, x]))
+            buf_x.append(np.concatenate([x, c]))
+            buffered += 2 * len(c)
+
+        while buffered >= stride:
+            cs = np.concatenate(buf_c)
+            xs = np.concatenate(buf_x)
+            take_c, rest_c = cs[:stride], cs[stride:]
+            take_x, rest_x = xs[:stride], xs[stride:]
+            buf_c, buf_x = [rest_c], [rest_x]
+            buffered = len(rest_c)
+            yield emit(take_c, take_x, np.ones(stride))
+
+    if buffered:
+        cs = np.concatenate(buf_c)[:stride]
+        xs = np.concatenate(buf_x)[:stride]
+        pad = stride - len(cs)
+        wgt = np.concatenate([np.ones(len(cs)), np.zeros(pad)])
+        cs = np.concatenate([cs, np.zeros(pad, cs.dtype)])
+        xs = np.concatenate([xs, np.zeros(pad, xs.dtype)])
+        yield emit(cs, xs, wgt)
+
+
+def nearest_neighbors(store: ParamStore, word_ids: np.ndarray, k: int = 5,
+                      center: bool = True):
+    """Host-side cosine nearest neighbors in the input embedding table.
+
+    ``center=True`` removes the common mean vector first — SGNS embeddings
+    are strongly anisotropic (a large shared component; cf. "All-but-the-Top",
+    Mu et al. 2018), and raw cosine is dominated by it.
+    """
+    ids = np.arange(store.specs[IN_TABLE].num_ids)
+    emb = store.lookup_host(IN_TABLE, ids)
+    if center:
+        emb = emb - emb.mean(axis=0)
+    emb = emb / np.maximum(np.linalg.norm(emb, axis=1, keepdims=True), 1e-9)
+    q = emb[word_ids]
+    sims = q @ emb.T
+    order = np.argsort(-sims, axis=1)
+    return order[:, 1 : k + 1], np.take_along_axis(sims, order, 1)[:, 1 : k + 1]

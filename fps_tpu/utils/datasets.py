@@ -179,3 +179,30 @@ def synthetic_sparse_classification(
         "feat_vals": vals,
         "label": label,
     }
+
+
+def synthetic_sparse_multiclass(
+    num_examples: int,
+    num_features: int,
+    num_classes: int,
+    nnz_per_example: int,
+    *,
+    seed: int = 0,
+    noise: float = 0.05,
+):
+    """Sparse multiclass examples: label = argmax_c <w_c, x> with label noise."""
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(0, 1, (num_features, num_classes))
+    feat_pop = 1.0 / np.arange(1, num_features + 1) ** 0.9
+    feat_pop /= feat_pop.sum()
+    ids = rng.choice(num_features, (num_examples, nnz_per_example), p=feat_pop)
+    vals = rng.normal(0, 1, (num_examples, nnz_per_example)).astype(np.float32)
+    scores = np.einsum("bn,bnc->bc", vals, w_true[ids])
+    label = np.argmax(scores, axis=-1)
+    flip = rng.random(num_examples) < noise
+    label = np.where(flip, rng.integers(0, num_classes, num_examples), label)
+    return {
+        "feat_ids": ids.astype(np.int32),
+        "feat_vals": vals,
+        "label": label.astype(np.int32),
+    }

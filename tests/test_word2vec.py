@@ -1,0 +1,81 @@
+"""word2vec SGNS: loss falls during streaming training; negatives sampled
+on-device; both tables updated through the collective pull/push path."""
+
+import jax
+import numpy as np
+
+from fps_tpu.core.driver import num_workers_of
+from fps_tpu.models.word2vec import (
+    IN_TABLE,
+    OUT_TABLE,
+    W2VConfig,
+    skipgram_chunks,
+    word2vec,
+)
+from fps_tpu.parallel.mesh import make_ps_mesh
+from fps_tpu.utils.datasets import synthetic_corpus
+
+V = 300
+
+
+def train_w2v(mesh, sync_every=None, epochs=2, dim=16):
+    tokens = synthetic_corpus(V, 60_000, num_topics=8, seed=0)
+    uni = np.bincount(tokens, minlength=V).astype(np.float64)
+    cfg = W2VConfig(vocab_size=V, dim=dim, window=3, negatives=4,
+                    learning_rate=0.05, subsample_t=None)
+    trainer, store = word2vec(mesh, cfg, uni, sync_every=sync_every)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    W = num_workers_of(mesh)
+    all_m = []
+    for e in range(epochs):
+        chunks = skipgram_chunks(
+            tokens, uni, cfg, num_workers=W, local_batch=64,
+            steps_per_chunk=8, sync_every=sync_every, seed=e,
+        )
+        tables, ls, m = trainer.fit_stream(
+            tables, ls, chunks, jax.random.fold_in(jax.random.key(1), e)
+        )
+        all_m.extend(m)
+    loss = np.concatenate([m["loss"] for m in all_m])
+    n = np.concatenate([m["n"] for m in all_m])
+    return store, loss, n
+
+
+def test_w2v_loss_decreases(devices8):
+    mesh = make_ps_mesh(num_shards=8, num_data=1)
+    store, loss, n = train_w2v(mesh)
+    steps = len(loss)
+    early = loss[: steps // 5].sum() / n[: steps // 5].sum()
+    late = loss[-steps // 5 :].sum() / n[-steps // 5 :].sum()
+    # Initial loss ~ (1+K)*log 2 ≈ 3.47 with K=4; must drop clearly.
+    assert late < early * 0.8, (early, late)
+    # Input table moved away from init; output table moved away from zero.
+    in_emb = store.lookup_host(IN_TABLE, np.arange(V))
+    out_emb = store.lookup_host(OUT_TABLE, np.arange(V))
+    assert float(np.abs(out_emb).max()) > 0.01
+    assert float(np.linalg.norm(in_emb, axis=1).max()) > 0.1
+
+
+def test_w2v_ssp_matches_shape(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=2)
+    store, loss, n = train_w2v(mesh, sync_every=4, epochs=1)
+    assert len(loss) > 0 and np.all(np.isfinite(loss))
+    early = loss[: len(loss) // 4].sum() / n[: len(loss) // 4].sum()
+    late = loss[-len(loss) // 4 :].sum() / n[-len(loss) // 4 :].sum()
+    assert late < early, (early, late)
+
+
+def test_skipgram_chunks_static_shapes():
+    tokens = synthetic_corpus(50, 5000, seed=1)
+    uni = np.bincount(tokens, minlength=50).astype(np.float64)
+    cfg = W2VConfig(vocab_size=50, window=2, subsample_t=None)
+    shapes = set()
+    total_w = 0.0
+    for chunk in skipgram_chunks(tokens, uni, cfg, num_workers=4,
+                                 local_batch=8, steps_per_chunk=4):
+        shapes.add(chunk["center"].shape)
+        assert chunk["center"].shape == chunk["context"].shape
+        total_w += chunk["weight"].sum()
+    assert len(shapes) == 1  # every chunk identical shape
+    # pair count ≈ 2 * E[min(half,d) coverage] — just sanity-bound it.
+    assert total_w > 2 * 0.9 * len(tokens)
