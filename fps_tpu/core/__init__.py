@@ -1,4 +1,11 @@
 from fps_tpu.core.api import ServerLogic, WorkerLogic, StepOutput
+from fps_tpu.core.checkpoint import (
+    Checkpointer,
+    export_model,
+    load_model,
+    load_rows,
+    load_saved_model,
+)
 from fps_tpu.core.store import TableSpec, ParamStore, pull, push
 
 __all__ = [
@@ -9,4 +16,9 @@ __all__ = [
     "ParamStore",
     "pull",
     "push",
+    "Checkpointer",
+    "export_model",
+    "load_model",
+    "load_rows",
+    "load_saved_model",
 ]

@@ -1,0 +1,253 @@
+"""Model export, warm start, and periodic checkpointing.
+
+Reference persistence surface being rebuilt (SURVEY.md §5 checkpoint row;
+expected upstream ``src/main/scala/hu/sztaki/ilab/ps/FlinkParameterServer.scala``):
+
+* **final model emission** — at end of job, ``ParameterServerLogic.close``
+  streams every ``(paramId, value)`` pair out of each shard. Here:
+  :func:`export_model` writes every table as a logical ``(num_ids, dim)``
+  array (id order, padding rows stripped) to one ``.npz``.
+* **warm start** — the ``transformWithModelLoad``-style overloads union a
+  previously saved ``DataStream[(Int, P)]`` into the servers before/while
+  training. Here: :func:`load_model` / :func:`load_rows` overwrite table
+  rows from a saved model (whole table or an arbitrary id subset) directly
+  in the sharded layout.
+* **periodic snapshots** — the reference has none (Flink-era checkpointing
+  does not cover iterative streams, so a failure loses server state).
+  :class:`Checkpointer` snapshots the live tables + worker-local state every
+  N chunks and restores them for resume — the leapfrog SURVEY.md §5 calls
+  cheap on TPU because parameter state is just a sharded jax array.
+
+Format: plain ``.npz``; no framework lock-in, loadable from numpy alone.
+Tables are saved in *logical* id order, so a checkpoint taken on an S-shard
+mesh restores onto any other shard count.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+from fps_tpu.core.store import ParamStore, id_to_phys, rows_per_shard
+
+Pytree = Any
+
+_SEP = "::"  # npz key separator: kind::name
+
+
+# ---------------------------------------------------------------------------
+# Model export (the reference's close()-time (id, param) stream).
+# ---------------------------------------------------------------------------
+
+def _table_arrays(store: ParamStore) -> dict[str, np.ndarray]:
+    """All tables as npz entries, logical id order, padding stripped."""
+    return {
+        f"table{_SEP}{name}": store.dump_model(name)[1] for name in store.specs
+    }
+
+
+def export_model(store: ParamStore, path: str) -> None:
+    """Write all tables, logical id order, padding stripped, to ``path``.npz."""
+    _atomic_savez(path, _table_arrays(store))
+
+
+def load_saved_model(path: str) -> dict[str, np.ndarray]:
+    """Read a model saved by :func:`export_model` → ``{table: (n, dim)}``."""
+    with np.load(path) as z:
+        return {
+            k.split(_SEP, 1)[1]: z[k] for k in z.files if k.startswith(f"table{_SEP}")
+        }
+
+
+# ---------------------------------------------------------------------------
+# Warm start (transformWithModelLoad parity).
+# ---------------------------------------------------------------------------
+
+def load_rows(
+    store: ParamStore, name: str, ids: np.ndarray, values: np.ndarray
+) -> None:
+    """Overwrite rows ``ids`` of table ``name`` with ``values``.
+
+    The sharded-array equivalent of streaming ``(paramId, value)`` records
+    into the servers: each row lands on its owning shard (owner-major cyclic
+    layout), rows not mentioned keep their current (initialized or trained)
+    values. Call after ``store.init(key)``.
+    """
+    if name not in store.tables:
+        raise ValueError(f"table {name!r} not initialized; call store.init first")
+    spec = store.specs[name]
+    ids = np.asarray(ids, np.int64)
+    if ids.ndim != 1 or len(ids) != len(values):
+        raise ValueError("ids must be 1-D and match values length")
+    if len(ids) and (ids.min() < 0 or ids.max() >= spec.num_ids):
+        raise ValueError(f"ids out of range for table {name!r} ({spec.num_ids})")
+    rps = rows_per_shard(spec.num_ids, store.num_shards)
+    phys = np.asarray(id_to_phys(ids, store.num_shards, rps))
+    table = store.tables[name]
+    # Host-side row overwrite, then place back sharded. Loads are rare,
+    # host-bandwidth-bound events; keeping them out of jit avoids both
+    # per-call recompiles and baking multi-hundred-MB tables into XLA
+    # programs as constants.
+    host = np.array(table)
+    host[phys] = np.asarray(values, host.dtype)
+    store.tables[name] = jax.device_put(host, store.sharding)
+
+
+def load_model(
+    store: ParamStore,
+    model: Mapping[str, np.ndarray] | str,
+    *,
+    strict: bool = False,
+) -> None:
+    """Warm-start all tables of ``store`` from a saved model.
+
+    ``model`` is a path produced by :func:`export_model` or a dict
+    ``{table_name: (num_ids, dim) array}``. Tables absent from the model keep
+    their fresh initialization (``strict=True`` raises instead).
+    """
+    if isinstance(model, str):
+        model = load_saved_model(model)
+    for name, spec in store.specs.items():
+        if name not in model:
+            if strict:
+                raise ValueError(f"model has no table {name!r}")
+            continue
+        values = np.asarray(model[name])
+        if values.shape != (spec.num_ids, spec.dim):
+            raise ValueError(
+                f"table {name!r}: saved shape {values.shape} != "
+                f"({spec.num_ids}, {spec.dim})"
+            )
+        load_rows(store, name, np.arange(spec.num_ids), values)
+
+
+# ---------------------------------------------------------------------------
+# Periodic checkpointing (tables + worker-local state + step counter).
+# ---------------------------------------------------------------------------
+
+class Checkpointer:
+    """Snapshot/restore the full training state under a directory.
+
+    Layout: ``{dir}/ckpt_{step:012d}.npz`` holding every table (logical
+    order) plus the flattened ``local_state`` pytree. ``keep`` bounds how
+    many snapshots are retained.
+
+    Restore re-lays-out *tables* onto the current mesh, so a checkpoint taken
+    on one shard count resumes on another (the reference could not even
+    save). Worker-local state is saved with worker-count-dependent shapes —
+    resuming it requires the same worker count (or ``local_state=None``).
+    """
+
+    def __init__(self, directory: str, *, keep: int = 3):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.dir = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.dir, f"ckpt_{step:012d}.npz")
+
+    def save(self, step: int, store: ParamStore, local_state: Pytree = None) -> str:
+        arrays = _table_arrays(store)
+        leaves, treedef = jax.tree.flatten(local_state)
+        for i, leaf in enumerate(leaves):
+            arrays[f"ls{_SEP}{i}"] = np.asarray(leaf)
+        del treedef  # structure is supplied by local_state_like at restore
+        path = self._path(step)
+        _atomic_savez(path, arrays)
+        self._gc()
+        return path
+
+    def steps(self) -> list[int]:
+        out = []
+        for f in os.listdir(self.dir):
+            m = re.fullmatch(r"ckpt_(\d{12})\.npz", f)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def restore(
+        self,
+        store: ParamStore,
+        local_state_like: Pytree = None,
+        *,
+        step: int | None = None,
+    ) -> tuple[dict, Pytree, int]:
+        """Load a snapshot into ``store`` (sharded on its current mesh).
+
+        ``local_state_like`` supplies the pytree structure and shardings to
+        restore worker-local state into (pass the output of
+        ``Trainer.init_state``; pass ``None`` if there is none).
+
+        Returns ``(tables, local_state, step)``.
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {self.dir}")
+        with np.load(self._path(step)) as z:
+            for name, spec in store.specs.items():
+                values = z[f"table{_SEP}{name}"]
+                if values.shape != (spec.num_ids, spec.dim):
+                    raise ValueError(
+                        f"checkpoint table {name!r} shape {values.shape} != "
+                        f"store spec ({spec.num_ids}, {spec.dim})"
+                    )
+                load_rows(store, name, np.arange(len(values)), values)
+            ls_leaves = []
+            i = 0
+            while f"ls{_SEP}{i}" in z.files:
+                ls_leaves.append(z[f"ls{_SEP}{i}"])
+                i += 1
+        like_leaves, treedef = jax.tree.flatten(local_state_like)
+        if len(like_leaves) != len(ls_leaves):
+            raise ValueError(
+                f"checkpoint step {step} has {len(ls_leaves)} local-state "
+                f"leaves, local_state_like has {len(like_leaves)} — "
+                "was save() called without local_state?"
+            )
+        placed = [
+            jax.device_put(
+                np.asarray(saved, getattr(like, "dtype", None)),
+                like.sharding if isinstance(like, jax.Array) else None,
+            )
+            for saved, like in zip(ls_leaves, like_leaves)
+        ]
+        local_state = jax.tree.unflatten(treedef, placed)
+        return dict(store.tables), local_state, step
+
+    def _gc(self) -> None:
+        steps = self.steps()
+        for s in steps[: max(0, len(steps) - self.keep)]:
+            try:
+                os.remove(self._path(s))
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Atomic file helpers (a torn write must not corrupt the latest snapshot).
+# ---------------------------------------------------------------------------
+
+def _atomic_savez(path: str, arrays: Mapping[str, np.ndarray]) -> None:
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
+    os.close(fd)
+    try:
+        np.savez(tmp, **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+

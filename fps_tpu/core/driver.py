@@ -292,20 +292,40 @@ class Trainer:
         chunks: Iterable[Pytree],
         key: Array,
         metrics_reduce=None,
+        checkpointer=None,
+        checkpoint_every: int = 0,
+        start_step: int = 0,
     ):
         """Drive the compiled loop over a host-side stream of chunks.
 
         This is the ingest loop that replaces the Flink DataStream source —
         one-pass streaming (the reference's model) or multi-epoch, depending
         on what the iterator yields.
+
+        Pass a ``fps_tpu.core.checkpoint.Checkpointer`` plus
+        ``checkpoint_every=k`` to snapshot tables + local state every k
+        chunks (and once more at the end of the stream). To resume, restore
+        from the checkpointer and pass ``start_step=<restored step>`` with a
+        chunk iterator positioned after the already-consumed chunks — both
+        the per-chunk PRNG stream (``fold_in(key, step)``) and the snapshot
+        numbering continue where the interrupted run left off.
         """
         all_metrics = []
-        for i, chunk in enumerate(chunks):
+        i = start_step - 1
+        for i, chunk in enumerate(chunks, start=start_step):
             ckey = jax.random.fold_in(key, i)
             tables, local_state, metrics = self.run_chunk(
                 tables, local_state, chunk, ckey
             )
             all_metrics.append(jax.tree.map(np.asarray, metrics))
+            if checkpointer is not None and checkpoint_every > 0 and (
+                (i + 1) % checkpoint_every == 0
+            ):
+                checkpointer.save(i + 1, self.store, local_state)
+        if checkpointer is not None and i >= start_step and (
+            checkpoint_every <= 0 or (i + 1) % checkpoint_every != 0
+        ):
+            checkpointer.save(i + 1, self.store, local_state)
         if metrics_reduce is not None and all_metrics:
             return tables, local_state, metrics_reduce(all_metrics)
         return tables, local_state, all_metrics

@@ -1,3 +1,4 @@
+from fps_tpu.models.ials import IALSConfig, IALSSolver
 from fps_tpu.models.logistic_regression import (
     LogisticRegressionWorker,
     logistic_regression,
@@ -11,6 +12,8 @@ from fps_tpu.models.passive_aggressive import (
 from fps_tpu.models.word2vec import Word2VecWorker, word2vec
 
 __all__ = [
+    "IALSConfig",
+    "IALSSolver",
     "LogisticRegressionWorker",
     "logistic_regression",
     "MatrixFactorizationWorker",

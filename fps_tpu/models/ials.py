@@ -1,0 +1,378 @@
+"""Implicit-feedback iALS (Hu, Koren, Volinsky 2008) — sharded ALS driver.
+
+BASELINE.json names "Implicit-feedback iALS (MovieLens-20M)" as a required
+workload; SURVEY.md §6/§7 flag it as an *extension* (likely absent from the
+reference) needing a different driver from the streaming PS loop: per-epoch
+sharded normal-equation solves instead of per-record SGD.
+
+Model: observed interaction (u, i, r) has confidence ``c = 1 + alpha*r`` and
+preference 1; unobserved pairs have confidence 1 and preference 0. Each
+half-epoch fixes one side and solves, per id on the other side,
+
+    (G + alpha * sum_i r_ui * y_i y_i^T + reg*I) x_u = sum_i (1+alpha*r_ui) y_i
+
+with ``G = Y^T Y`` the Gramian over *all* items (the classic trick that makes
+the "all unobserved pairs" term tractable).
+
+TPU-native decomposition (everything static-shape, jit-compiled once):
+
+1. **Gramian** — each shard computes ``local_block^T @ local_block`` on its
+   ``(rps, k)`` rows (MXU matmul) and ``psum``s over the shard axis.
+   Padding rows are zeroed first via an on-device validity mask.
+2. **Accumulate** — stream interaction chunks through a scan: collective
+   :func:`fps_tpu.core.store.pull` of the fixed side's rows, form per-example
+   ``alpha*r * y y^T`` (k*k) and ``(1+alpha*r) * y`` (k) blocks, collective
+   :func:`~fps_tpu.core.store.push` into sharded accumulator tables keyed by
+   the solved side's id. iALS thus *reuses the PS fabric*: the normal
+   equations are just another sharded table being pushed to.
+3. **Solve** — each shard solves its own ``(rps, k, k)`` batched SPD systems
+   locally (``jnp.linalg.solve``; k is small so the batched LU is cheap
+   next to the accumulate pass), no communication.
+
+The user and item factor tables share the owner-major-cyclic layout of
+:mod:`fps_tpu.core.store`, so accumulators align row-for-row with the factor
+table being solved and the solve phase is purely local.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fps_tpu.core.store import (
+    ParamStore,
+    TableSpec,
+    phys_to_id,
+    pull,
+    push,
+    ranged_uniform_init,
+    rows_per_shard,
+)
+from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
+
+Array = jax.Array
+
+USER_TABLE = "user_factors"
+ITEM_TABLE = "item_factors"
+
+
+@dataclasses.dataclass
+class IALSConfig:
+    num_users: int
+    num_items: int
+    rank: int = 16
+    alpha: float = 40.0
+    reg: float = 0.1
+    init_scale: float = 0.01
+    dtype: object = jnp.float32
+
+
+class IALSSolver:
+    """Alternating sharded normal-equation solver for implicit feedback.
+
+    Usage::
+
+        solver = IALSSolver(mesh, IALSConfig(nu, ni, rank=16))
+        solver.init(jax.random.key(0))
+        for _ in range(epochs):
+            solver.epoch(lambda: interaction_chunks(...))
+        users, items = solver.factors()
+    """
+
+    def __init__(self, mesh, cfg: IALSConfig):
+        self.mesh = mesh
+        self.cfg = cfg
+        self.num_shards = mesh.shape[SHARD_AXIS]
+        if mesh.shape.get(DATA_AXIS, 1) != 1:
+            # The accumulate pass uses the shard axis both for table shards
+            # and for splitting the interaction stream; a data axis would
+            # double-count pushes. Keep iALS meshes 1 x shards.
+            raise ValueError("IALSSolver expects a mesh with data axis of size 1")
+        init = ranged_uniform_init(-cfg.init_scale, cfg.init_scale, cfg.rank,
+                                   cfg.dtype)
+        self.store = ParamStore(
+            mesh,
+            [
+                TableSpec(USER_TABLE, cfg.num_users, cfg.rank, init, cfg.dtype),
+                TableSpec(ITEM_TABLE, cfg.num_items, cfg.rank, init, cfg.dtype),
+            ],
+        )
+        self._sharding = self.store.sharding
+        self._replicated = NamedSharding(mesh, P())
+        self._compiled_gram = {}
+        self._compiled_acc = {}
+        self._compiled_solve = {}
+        self._compiled_zeros = {}
+
+    # -- state --------------------------------------------------------------
+
+    def init(self, key: Array) -> dict[str, Array]:
+        return self.store.init(key)
+
+    def factors(self) -> tuple[np.ndarray, np.ndarray]:
+        return (
+            self.store.dump_model(USER_TABLE)[1],
+            self.store.dump_model(ITEM_TABLE)[1],
+        )
+
+    # -- device-side pieces ---------------------------------------------------
+
+    def _valid_mask(self, num_ids: int, rps: int):
+        """(rps,) bool per shard: physical row is a real id (not padding)."""
+
+        def local(_):
+            me = lax.axis_index(SHARD_AXIS)
+            phys = me * rps + jnp.arange(rps, dtype=jnp.int32)
+            ids = phys_to_id(phys, self.num_shards, rps)
+            return ids < num_ids
+
+        return local
+
+    def _gram_fn(self, num_ids: int, rps: int):
+        """jit: sharded table -> replicated (k, k) Gramian (padding excluded)."""
+
+        def device_fn(table):
+            valid = self._valid_mask(num_ids, rps)(None)
+            rows = jnp.where(valid[:, None], table, 0.0)
+            g = rows.T @ rows
+            return lax.psum(g, SHARD_AXIS)
+
+        def run(table):
+            return jax.shard_map(
+                device_fn,
+                mesh=self.mesh,
+                in_specs=(P(SHARD_AXIS, None),),
+                out_specs=P(),
+                check_vma=False,
+            )(table)
+
+        return jax.jit(run)
+
+    def _accumulate_fn(self):
+        """jit: stream one chunk of interactions into (A, b) accumulators.
+
+        Chunk leaves are (T, B) with B split over the shard axis (workers ==
+        shards here): ``solve_ids``, ``fixed_ids``, ``rating``, ``weight``.
+        """
+        cfg = self.cfg
+        k = cfg.rank
+
+        def device_fn(fixed_table, A, b, chunk):
+            def body(carry, xs):
+                A, b = carry
+                solve_ids = xs["solve_ids"].astype(jnp.int32)
+                fixed_ids = xs["fixed_ids"].astype(jnp.int32)
+                r = xs["rating"].astype(cfg.dtype)
+                w = xs["weight"].astype(cfg.dtype)
+
+                y = pull(fixed_table, fixed_ids, num_shards=self.num_shards)
+                cr = cfg.alpha * r * w  # confidence minus 1, masked
+                outer = (cr[:, None, None] * y[:, :, None] * y[:, None, :])
+                vec = ((1.0 + cfg.alpha * r) * w)[:, None] * y
+
+                ids = jnp.where(w > 0, solve_ids, -1)
+                A = push(A, ids, outer.reshape(-1, k * k),
+                         num_shards=self.num_shards, data_axis=None)
+                b = push(b, ids, vec,
+                         num_shards=self.num_shards, data_axis=None)
+                return (A, b), None
+
+            (A, b), _ = lax.scan(body, (A, b), chunk)
+            return A, b
+
+        def run(fixed_table, A, b, chunk):
+            return jax.shard_map(
+                device_fn,
+                mesh=self.mesh,
+                in_specs=(
+                    P(SHARD_AXIS, None),
+                    P(SHARD_AXIS, None),
+                    P(SHARD_AXIS, None),
+                    jax.tree.map(lambda _: P(None, SHARD_AXIS), chunk),
+                ),
+                out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None)),
+                check_vma=False,
+            )(fixed_table, A, b, chunk)
+
+        return jax.jit(run, donate_argnums=(1, 2))
+
+    def _solve_fn(self, num_ids: int, rps: int):
+        """jit: (gram, A, b) -> solved factor table (local batched Cholesky)."""
+        cfg = self.cfg
+        k = cfg.rank
+
+        def device_fn(gram, A, b):
+            lhs = gram[None] + A.reshape(-1, k, k)
+            lhs = lhs + cfg.reg * jnp.eye(k, dtype=cfg.dtype)[None]
+            # Batched SPD solve; jnp.linalg handles the (rps, k, k) batch.
+            x = jnp.linalg.solve(lhs, b[:, :, None])[:, :, 0]
+            valid = self._valid_mask(num_ids, rps)(None)
+            return jnp.where(valid[:, None], x, 0.0).astype(cfg.dtype)
+
+        def run(gram, A, b):
+            return jax.shard_map(
+                device_fn,
+                mesh=self.mesh,
+                in_specs=(P(), P(SHARD_AXIS, None), P(SHARD_AXIS, None)),
+                out_specs=P(SHARD_AXIS, None),
+                check_vma=False,
+            )(gram, A, b)
+
+        return jax.jit(run)
+
+    # -- half-epoch ----------------------------------------------------------
+
+    def _zeros_acc(self, rows: int, dim: int) -> Array:
+        fn = self._compiled_zeros.get((rows, dim))
+        if fn is None:
+            fn = self._compiled_zeros[(rows, dim)] = jax.jit(
+                lambda: jnp.zeros((rows, dim), self.cfg.dtype),
+                out_shardings=self._sharding,
+            )
+        return fn()
+
+    def half_epoch(self, solve: str, chunks: Iterable[dict]) -> None:
+        """One ALS half-step: solve ``"user"`` or ``"item"`` factors.
+
+        ``chunks`` yield dicts with (T, B) arrays ``user``, ``item``,
+        ``rating``, ``weight`` (as produced by
+        :func:`fps_tpu.core.ingest.epoch_chunks`; B must be divisible by the
+        shard count).
+        """
+        cfg = self.cfg
+        if solve == "user":
+            solve_name, fixed_name = USER_TABLE, ITEM_TABLE
+            solve_col, fixed_col = "user", "item"
+            solve_n, fixed_n = cfg.num_users, cfg.num_items
+        elif solve == "item":
+            solve_name, fixed_name = ITEM_TABLE, USER_TABLE
+            solve_col, fixed_col = "item", "user"
+            solve_n, fixed_n = cfg.num_items, cfg.num_users
+        else:
+            raise ValueError(f"solve must be 'user' or 'item', got {solve!r}")
+
+        solve_rps = rows_per_shard(solve_n, self.num_shards)
+        fixed_rps = rows_per_shard(fixed_n, self.num_shards)
+        k = cfg.rank
+
+        if fixed_name not in self._compiled_gram:
+            self._compiled_gram[fixed_name] = self._gram_fn(fixed_n, fixed_rps)
+        gram = self._compiled_gram[fixed_name](self.store.tables[fixed_name])
+
+        A = self._zeros_acc(solve_rps * self.num_shards, k * k)
+        b = self._zeros_acc(solve_rps * self.num_shards, k)
+
+        acc = self._compiled_acc.get(solve)
+        if acc is None:
+            acc = self._compiled_acc[solve] = self._accumulate_fn()
+        for chunk in chunks:
+            dev_chunk = {
+                "solve_ids": np.asarray(chunk[solve_col]),
+                "fixed_ids": np.asarray(chunk[fixed_col]),
+                "rating": np.asarray(chunk["rating"]),
+                "weight": np.asarray(chunk["weight"]),
+            }
+            dev_chunk = jax.tree.map(
+                lambda x: jax.device_put(
+                    jnp.asarray(x),
+                    NamedSharding(self.mesh, P(None, SHARD_AXIS)),
+                ),
+                dev_chunk,
+            )
+            A, b = acc(self.store.tables[fixed_name], A, b, dev_chunk)
+
+        if solve_name not in self._compiled_solve:
+            self._compiled_solve[solve_name] = self._solve_fn(solve_n, solve_rps)
+        self.store.tables[solve_name] = self._compiled_solve[solve_name](
+            gram, A, b
+        )
+
+    def epoch(self, make_chunks) -> None:
+        """One full ALS epoch. ``make_chunks()`` returns a fresh chunk
+        iterator (it is consumed twice: once per half-epoch)."""
+        self.half_epoch("user", make_chunks())
+        self.half_epoch("item", make_chunks())
+
+    # -- evaluation ----------------------------------------------------------
+
+    def weighted_loss(self, users: np.ndarray, items: np.ndarray,
+                      ratings: np.ndarray, sample_unobserved: int = 0,
+                      seed: int = 0) -> float:
+        """Host-side iALS objective estimate: the observed confidence-weighted
+        term ``sum c*(1 - x·y)^2`` plus the exact regularizer
+        ``reg*(sum ||x_u||^2 + sum ||y_i||^2)`` (+ optionally a sampled
+        estimate of the unobserved ``(0 - x·y)^2`` term)."""
+        cfg = self.cfg
+        U, V = self.factors()
+        xy = np.sum(U[users] * V[items], axis=-1)
+        c = 1.0 + cfg.alpha * ratings
+        loss = float(np.sum(c * (1.0 - xy) ** 2))
+        loss += cfg.reg * float(np.sum(U * U) + np.sum(V * V))
+        if sample_unobserved:
+            rng = np.random.default_rng(seed)
+            su = rng.integers(0, cfg.num_users, sample_unobserved)
+            si = rng.integers(0, cfg.num_items, sample_unobserved)
+            loss += float(np.sum(np.sum(U[su] * V[si], axis=-1) ** 2))
+        return loss
+
+
+def interaction_chunks(
+    data: dict,
+    *,
+    num_shards: int,
+    local_batch: int,
+    steps_per_chunk: int,
+    seed: int | None = 0,
+) -> Iterator[dict]:
+    """Fixed-shape (T, B) interaction chunks for the accumulate pass.
+
+    Thin wrapper over :func:`fps_tpu.core.ingest.epoch_chunks` with
+    round-robin placement (iALS has no worker-local state to route for).
+    """
+    from fps_tpu.core.ingest import epoch_chunks
+
+    return epoch_chunks(
+        data,
+        num_workers=num_shards,
+        local_batch=local_batch,
+        steps_per_chunk=steps_per_chunk,
+        seed=seed,
+    )
+
+
+def recall_at_k(
+    solver: IALSSolver,
+    heldout_user: np.ndarray,
+    heldout_item: np.ndarray,
+    *,
+    k: int = 10,
+    exclude: tuple[np.ndarray, np.ndarray] | None = None,
+) -> float:
+    """Fraction of held-out (user, item) pairs ranked in the user's top-k.
+
+    ``exclude`` = (train_users, train_items) pairs masked out of the ranking
+    (standard leave-out evaluation).
+    """
+    U, V = solver.factors()
+    scores = U[heldout_user] @ V.T  # (H, num_items)
+    if exclude is not None:
+        tu, ti = exclude
+        # One groupby of train items per user, then mask each evaluated
+        # user's train items — but never the held-out item itself (it may
+        # also occur in train when interactions repeat).
+        order = np.argsort(tu, kind="stable")
+        tu_s, ti_s = np.asarray(tu)[order], np.asarray(ti)[order]
+        starts = np.searchsorted(tu_s, np.arange(solver.cfg.num_users))
+        ends = np.searchsorted(tu_s, np.arange(solver.cfg.num_users), "right")
+        for row, u in enumerate(heldout_user):
+            held = scores[row, heldout_item[row]]
+            scores[row, ti_s[starts[u]:ends[u]]] = -np.inf
+            scores[row, heldout_item[row]] = held
+    ranks = np.argsort(-scores, axis=1)[:, :k]
+    return float(np.mean(np.any(ranks == heldout_item[:, None], axis=1)))

@@ -79,6 +79,42 @@ def load_movielens(path: str | None = None, scale: str = "100k"):
     return synthetic_ratings(nu, ni, nr), nu, ni
 
 
+def synthetic_implicit(
+    num_users: int,
+    num_items: int,
+    interactions_per_user: int,
+    *,
+    rank: int = 4,
+    seed: int = 0,
+):
+    """Implicit-feedback interactions with planted low-rank preference.
+
+    Each user interacts with items sampled by softmax of a latent affinity,
+    with a count-like positive "rating" (confidence signal, like play counts).
+    Returns a dict with ``user``, ``item``, ``rating`` columns — the iALS
+    (MovieLens-20M implicit) workload shape.
+    """
+    rng = np.random.default_rng(seed)
+    p = rng.normal(0, 1.0, (num_users, rank))
+    q = rng.normal(0, 1.0, (num_items, rank))
+    logits = p @ q.T  # (U, I)
+    probs = np.exp(logits - logits.max(axis=1, keepdims=True))
+    probs /= probs.sum(axis=1, keepdims=True)
+    users = np.repeat(np.arange(num_users), interactions_per_user)
+    items = np.concatenate(
+        [
+            rng.choice(num_items, interactions_per_user, p=probs[u])
+            for u in range(num_users)
+        ]
+    )
+    rating = rng.poisson(2.0, len(users)).astype(np.float32) + 1.0
+    return {
+        "user": users.astype(np.int32),
+        "item": items.astype(np.int32),
+        "rating": rating,
+    }
+
+
 def train_test_split(data: dict, test_frac: float = 0.1, seed: int = 1):
     n = len(next(iter(data.values())))
     rng = np.random.default_rng(seed)

@@ -1,0 +1,208 @@
+"""Checkpoint/export/warm-start tests (SURVEY.md §5 checkpoint row).
+
+The reference's only persistence is the close()-time model stream plus
+transformWithModelLoad warm start; these tests cover that parity surface and
+the periodic-snapshot resume the rebuild adds on top.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jaxmods():
+    import jax
+
+    from fps_tpu.core import checkpoint as ck
+    from fps_tpu.core.driver import Trainer, num_workers_of
+    from fps_tpu.core.ingest import epoch_chunks
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.parallel.mesh import make_ps_mesh
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    return dict(
+        jax=jax, ck=ck, Trainer=Trainer, num_workers_of=num_workers_of,
+        epoch_chunks=epoch_chunks, MFConfig=MFConfig, online_mf=online_mf,
+        make_ps_mesh=make_ps_mesh, synthetic_ratings=synthetic_ratings,
+    )
+
+
+def _mf(jaxmods, num_shards, num_data=1, num_users=32, num_items=24, rank=4):
+    jax = jaxmods["jax"]
+    mesh = jaxmods["make_ps_mesh"](
+        num_shards=num_shards, num_data=num_data,
+        devices=jax.devices()[: num_shards * num_data],
+    )
+    cfg = jaxmods["MFConfig"](num_users=num_users, num_items=num_items, rank=rank)
+    trainer, store = jaxmods["online_mf"](mesh, cfg, donate=False)
+    return mesh, cfg, trainer, store
+
+
+def _chunks(jaxmods, data, W, seed=0):
+    return list(
+        jaxmods["epoch_chunks"](
+            data, num_workers=W, local_batch=8, steps_per_chunk=2,
+            route_key="user", seed=seed,
+        )
+    )
+
+
+def test_export_load_roundtrip(tmp_path, jaxmods, devices8):
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    _, cfg, trainer, store = _mf(jaxmods, num_shards=4)
+    store.init(jax.random.key(0))
+    path = str(tmp_path / "model.npz")
+    ck.export_model(store, path)
+
+    saved = ck.load_saved_model(path)
+    assert set(saved) == {"item_factors"}
+    assert saved["item_factors"].shape == (cfg.num_items, cfg.rank)
+    _, values = store.dump_model("item_factors")
+    np.testing.assert_array_equal(saved["item_factors"], values)
+
+
+def test_warm_start_across_shard_counts(tmp_path, jaxmods, devices8):
+    """A model exported from a 4-shard store loads into a 2-shard store."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    _, _, _, store4 = _mf(jaxmods, num_shards=4)
+    store4.init(jax.random.key(7))
+    path = str(tmp_path / "model.npz")
+    ck.export_model(store4, path)
+
+    _, _, _, store2 = _mf(jaxmods, num_shards=2)
+    store2.init(jax.random.key(99))  # different init — must be overwritten
+    ck.load_model(store2, path, strict=True)
+
+    _, v4 = store4.dump_model("item_factors")
+    _, v2 = store2.dump_model("item_factors")
+    np.testing.assert_allclose(v2, v4, rtol=1e-6)
+
+
+def test_load_rows_subset(jaxmods, devices8):
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    _, cfg, _, store = _mf(jaxmods, num_shards=4)
+    store.init(jax.random.key(0))
+    _, before = store.dump_model("item_factors")
+
+    ids = np.array([0, 5, 13])
+    new = np.full((3, cfg.rank), 42.0, np.float32)
+    ck.load_rows(store, "item_factors", ids, new)
+
+    _, after = store.dump_model("item_factors")
+    np.testing.assert_array_equal(after[ids], new)
+    mask = np.ones(cfg.num_items, bool)
+    mask[ids] = False
+    np.testing.assert_array_equal(after[mask], before[mask])
+
+
+def test_load_rows_validates(jaxmods, devices8):
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    _, cfg, _, store = _mf(jaxmods, num_shards=2)
+    store.init(jax.random.key(0))
+    with pytest.raises(ValueError):
+        ck.load_rows(store, "item_factors", np.array([cfg.num_items]),
+                     np.zeros((1, cfg.rank), np.float32))
+    with pytest.raises(ValueError):
+        ck.load_model(store, {"item_factors": np.zeros((3, 3), np.float32)})
+
+
+def test_checkpoint_resume_bit_exact(tmp_path, jaxmods, devices8):
+    """Train 4 chunks straight vs. 2 chunks → snapshot → restore → 2 chunks:
+    identical tables and local state (sync mode is deterministic)."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    W = 4
+
+    data = jaxmods["synthetic_ratings"](32, 24, 4 * W * 8 * 2, seed=3)
+    chunks = _chunks(jaxmods, data, W)[:4]
+    assert len(chunks) == 4
+    key = jax.random.key(5)
+
+    # Straight-through run.
+    _, _, trainerA, storeA = _mf(jaxmods, num_shards=4)
+    tabA, lsA = trainerA.init_state(jax.random.key(1))
+    for i, c in enumerate(chunks):
+        tabA, lsA, _ = trainerA.run_chunk(tabA, lsA, c, jax.random.fold_in(key, i))
+
+    # Interrupted run with snapshot at chunk 2.
+    _, _, trainerB, storeB = _mf(jaxmods, num_shards=4)
+    tabB, lsB = trainerB.init_state(jax.random.key(1))
+    for i, c in enumerate(chunks[:2]):
+        tabB, lsB, _ = trainerB.run_chunk(tabB, lsB, c, jax.random.fold_in(key, i))
+    ckpt = ck.Checkpointer(str(tmp_path / "ckpts"))
+    ckpt.save(2, storeB, lsB)
+
+    # Fresh process analog: new trainer/store, restore, continue.
+    _, _, trainerC, storeC = _mf(jaxmods, num_shards=4)
+    tabC, lsC = trainerC.init_state(jax.random.key(1234))  # different init
+    storeC.tables = tabC
+    tabC, lsC, step = ckpt.restore(storeC, lsC)
+    assert step == 2
+    for i, c in enumerate(chunks[2:], start=2):
+        tabC, lsC, _ = trainerC.run_chunk(tabC, lsC, c, jax.random.fold_in(key, i))
+
+    for name in storeA.specs:
+        _, vA = storeA.dump_model(name)
+        _, vC = storeC.dump_model(name)
+        np.testing.assert_array_equal(vA, vC)
+    np.testing.assert_array_equal(np.asarray(lsA), np.asarray(lsC))
+
+
+def test_checkpointer_gc_and_latest(tmp_path, jaxmods, devices8):
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    _, _, _, store = _mf(jaxmods, num_shards=2)
+    store.init(jax.random.key(0))
+    ckpt = ck.Checkpointer(str(tmp_path / "c"), keep=2)
+    for s in (1, 2, 3):
+        ckpt.save(s, store, None)
+    assert ckpt.steps() == [2, 3]
+    assert ckpt.latest_step() == 3
+    tables, ls, step = ckpt.restore(store, None)
+    assert step == 3 and ls is None
+
+
+def test_fit_stream_resume_matches_straight_run(tmp_path, jaxmods, devices8):
+    """fit_stream with start_step continues the PRNG stream and snapshot
+    numbering: interrupted+resumed == straight-through, bit for bit."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    W = 4
+    data = jaxmods["synthetic_ratings"](32, 24, 4 * W * 8 * 2, seed=3)
+    chunks = _chunks(jaxmods, data, W)[:4]
+    key = jax.random.key(5)
+
+    _, _, trainerA, storeA = _mf(jaxmods, num_shards=4)
+    tabA, lsA = trainerA.init_state(jax.random.key(1))
+    tabA, lsA, _ = trainerA.fit_stream(tabA, lsA, chunks, key)
+
+    _, _, trainerB, storeB = _mf(jaxmods, num_shards=4)
+    tabB, lsB = trainerB.init_state(jax.random.key(1))
+    ckpt = ck.Checkpointer(str(tmp_path / "c"))
+    trainerB.fit_stream(tabB, lsB, chunks[:2], key,
+                        checkpointer=ckpt, checkpoint_every=2)
+
+    _, _, trainerC, storeC = _mf(jaxmods, num_shards=4)
+    tabC, lsC = trainerC.init_state(jax.random.key(77))
+    storeC.tables = tabC
+    tabC, lsC, step = ckpt.restore(storeC, lsC)
+    assert step == 2
+    trainerC.fit_stream(tabC, lsC, chunks[2:], key,
+                        checkpointer=ckpt, checkpoint_every=2,
+                        start_step=step)
+    assert ckpt.latest_step() == 4
+
+    for name in storeA.specs:
+        np.testing.assert_array_equal(
+            storeA.dump_model(name)[1], storeC.dump_model(name)[1]
+        )
+
+
+def test_fit_stream_checkpoints(tmp_path, jaxmods, devices8):
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    W = 4
+    data = jaxmods["synthetic_ratings"](32, 24, 4 * W * 8 * 2, seed=3)
+    chunks = _chunks(jaxmods, data, W)
+    _, _, trainer, store = _mf(jaxmods, num_shards=4)
+    tables, ls = trainer.init_state(jax.random.key(1))
+    ckpt = ck.Checkpointer(str(tmp_path / "c"))
+    trainer.fit_stream(tables, ls, chunks, jax.random.key(2),
+                       checkpointer=ckpt, checkpoint_every=2)
+    assert ckpt.latest_step() == len(chunks)
