@@ -82,19 +82,33 @@ def load_rows(
         raise ValueError(f"table {name!r} not initialized; call store.init first")
     spec = store.specs[name]
     ids = np.asarray(ids, np.int64)
+    values = np.asarray(values)
     if ids.ndim != 1 or len(ids) != len(values):
         raise ValueError("ids must be 1-D and match values length")
+    if values.shape != (len(ids), spec.dim):
+        raise ValueError(
+            f"values shape {values.shape} != ({len(ids)}, {spec.dim}) "
+            f"for table {name!r}"
+        )
     if len(ids) and (ids.min() < 0 or ids.max() >= spec.num_ids):
         raise ValueError(f"ids out of range for table {name!r} ({spec.num_ids})")
     rps = rows_per_shard(spec.num_ids, store.num_shards)
     phys = np.asarray(id_to_phys(ids, store.num_shards, rps))
     table = store.tables[name]
+    dtype = table.dtype
     # Host-side row overwrite, then place back sharded. Loads are rare,
     # host-bandwidth-bound events; keeping them out of jit avoids both
     # per-call recompiles and baking multi-hundred-MB tables into XLA
     # programs as constants.
-    host = np.array(table)
-    host[phys] = np.asarray(values, host.dtype)
+    if len(ids) == spec.num_ids and len(np.unique(ids)) == spec.num_ids:
+        # Full overwrite: every real row is supplied, so skip downloading
+        # the about-to-be-discarded table; padding rows (never addressed by
+        # any valid id) are zero-filled.
+        host = np.zeros(table.shape, dtype)
+        host[phys] = values.astype(dtype)
+    else:
+        host = np.array(table)
+        host[phys] = values.astype(dtype)
     store.tables[name] = jax.device_put(host, store.sharding)
 
 
@@ -197,6 +211,11 @@ class Checkpointer:
                 raise FileNotFoundError(f"no checkpoints under {self.dir}")
         with np.load(self._path(step)) as z:
             for name, spec in store.specs.items():
+                if f"table{_SEP}{name}" not in z.files:
+                    raise ValueError(
+                        f"checkpoint step {step} has no table {name!r} — "
+                        "was it taken with an older model definition?"
+                    )
                 values = z[f"table{_SEP}{name}"]
                 if values.shape != (spec.num_ids, spec.dim):
                     raise ValueError(

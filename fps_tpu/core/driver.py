@@ -295,6 +295,7 @@ class Trainer:
         checkpointer=None,
         checkpoint_every: int = 0,
         start_step: int = 0,
+        on_chunk=None,
     ):
         """Drive the compiled loop over a host-side stream of chunks.
 
@@ -309,6 +310,11 @@ class Trainer:
         chunk iterator positioned after the already-consumed chunks — both
         the per-chunk PRNG stream (``fold_in(key, step)``) and the snapshot
         numbering continue where the interrupted run left off.
+
+        ``on_chunk(step, metrics)`` is called after every chunk with the
+        host-side metrics pytree — the live tap on the reference's ``WOut``
+        observability stream (per-chunk progress reporting, early stopping
+        via raising, etc.).
         """
         all_metrics = []
         i = start_step - 1
@@ -317,7 +323,10 @@ class Trainer:
             tables, local_state, metrics = self.run_chunk(
                 tables, local_state, chunk, ckey
             )
-            all_metrics.append(jax.tree.map(np.asarray, metrics))
+            host_metrics = jax.tree.map(np.asarray, metrics)
+            all_metrics.append(host_metrics)
+            if on_chunk is not None:
+                on_chunk(i, host_metrics)
             if checkpointer is not None and checkpoint_every > 0 and (
                 (i + 1) % checkpoint_every == 0
             ):

@@ -1,0 +1,84 @@
+"""Shared CLI plumbing for the example entrypoints."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def base_parser(description: str) -> argparse.ArgumentParser:
+    """Common flags: mesh shape (the reference's workerParallelism /
+    psParallelism pair), batching, execution mode, persistence."""
+    ap = argparse.ArgumentParser(description=description)
+    ap.add_argument("--input", default=None, help="dataset path (default: synthetic)")
+    ap.add_argument("--num-shards", type=int, default=None,
+                    help="parameter-shard axis size (reference: psParallelism); "
+                         "default: all devices")
+    ap.add_argument("--num-data", type=int, default=1,
+                    help="replicated data-parallel axis size")
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--local-batch", type=int, default=256,
+                    help="examples per worker per step")
+    ap.add_argument("--steps-per-chunk", type=int, default=16,
+                    help="microbatch steps per compiled call")
+    ap.add_argument("--sync-every", type=int, default=None,
+                    help="SSP staleness bound s (default: fully synchronous)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--export", default=None, help="write final model to this .npz")
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help="snapshot every N chunks (requires --checkpoint-dir)")
+    ap.add_argument("--warm-start", default=None,
+                    help="initialize tables from a saved model .npz "
+                         "(reference: transformWithModelLoad)")
+    return ap
+
+
+def make_mesh(args):
+    from fps_tpu.parallel.mesh import make_ps_mesh
+
+    return make_ps_mesh(num_shards=args.num_shards, num_data=args.num_data)
+
+
+def emit(record: dict) -> None:
+    """One JSON line per event — the WOut metrics stream."""
+    json.dump({k: _py(v) for k, v in record.items()}, sys.stdout)
+    sys.stdout.write("\n")
+    sys.stdout.flush()
+
+
+def _py(v):
+    if isinstance(v, (np.floating, np.integer)):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v
+
+
+def finish(args, store, trainer=None, local_state=None):
+    """Handle --export at end of run."""
+    if args.export:
+        from fps_tpu.core.checkpoint import export_model
+
+        export_model(store, args.export)
+        emit({"event": "export", "path": args.export})
+
+
+def maybe_checkpointer(args):
+    if args.checkpoint_dir and args.checkpoint_every > 0:
+        from fps_tpu.core.checkpoint import Checkpointer
+
+        return Checkpointer(args.checkpoint_dir)
+    return None
+
+
+def maybe_warm_start(args, store, key) -> None:
+    """Apply --warm-start after store init (tables must exist first)."""
+    if args.warm_start:
+        from fps_tpu.core.checkpoint import load_model
+
+        load_model(store, args.warm_start)
+        emit({"event": "warm_start", "path": args.warm_start})

@@ -1,0 +1,91 @@
+"""Bounded-staleness logistic regression entrypoint (Criteo CTR style).
+
+The "async bounded-staleness SGD, multi-worker data-parallel" workload from
+BASELINE.json's configs — the canonical SSP exerciser. ``--sync-every s``
+bounds how stale a worker's parameter snapshot may get (the framework analog
+of the reference's free-running asynchrony + pull limiter; SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from fps_tpu.examples.common import (
+    base_parser,
+    emit,
+    finish,
+    make_mesh,
+    maybe_checkpointer,
+    maybe_warm_start,
+)
+
+
+def main(argv=None) -> int:
+    ap = base_parser("SSP logistic regression on the TPU PS")
+    ap.add_argument("--num-features", type=int, default=1 << 18,
+                    help="hashed feature space size")
+    ap.add_argument("--num-examples", type=int, default=100_000)
+    ap.add_argument("--nnz", type=int, default=32)
+    ap.add_argument("--learning-rate", type=float, default=0.1)
+    ap.add_argument("--l2", type=float, default=0.0)
+    args = ap.parse_args(argv)
+    if args.sync_every is None:
+        args.sync_every = 8  # this entrypoint exists to exercise SSP
+
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.core.ingest import multi_epoch_chunks
+    from fps_tpu.models.logistic_regression import (
+        LogRegConfig,
+        logistic_regression,
+        predict_proba_host,
+    )
+    from fps_tpu.utils.datasets import (
+        synthetic_sparse_classification,
+        train_test_split,
+    )
+
+    data = synthetic_sparse_classification(
+        args.num_examples, args.num_features, args.nnz, seed=args.seed
+    )
+    data["label"] = (data["label"] > 0).astype(np.float32)  # {0,1}
+    train, test = train_test_split(data, test_frac=0.1, seed=args.seed + 1)
+
+    mesh = make_mesh(args)
+    W = num_workers_of(mesh)
+    emit({"event": "start", "workload": "logreg_ssp",
+          "sync_every": args.sync_every, "mesh": dict(mesh.shape)})
+
+    cfg = LogRegConfig(num_features=args.num_features,
+                       learning_rate=args.learning_rate, l2=args.l2)
+    trainer, store = logistic_regression(mesh, cfg, sync_every=args.sync_every)
+    tables, local_state = trainer.init_state(jax.random.key(args.seed))
+    maybe_warm_start(args, store, None)
+
+    chunks = multi_epoch_chunks(
+        train, epochs=args.epochs, num_workers=W, local_batch=args.local_batch,
+        steps_per_chunk=args.steps_per_chunk, sync_every=args.sync_every,
+        seed=args.seed,
+    )
+    def report(i, m):
+        n = max(1.0, float(np.sum(m["n"])))
+        emit({"event": "chunk", "i": i,
+              "logloss": float(np.sum(m["logloss"]) / n),
+              "error_rate": float(np.sum(m["mistakes"]) / n)})
+
+    tables, local_state, _ = trainer.fit_stream(
+        tables, local_state, chunks, jax.random.key(args.seed),
+        checkpointer=maybe_checkpointer(args),
+        checkpoint_every=args.checkpoint_every,
+        on_chunk=report,
+    )
+
+    p = predict_proba_host(store, test["feat_ids"], test["feat_vals"])
+    acc = float(np.mean((p > 0.5) == (test["label"] > 0.5)))
+    emit({"event": "done", "test_accuracy": acc})
+    finish(args, store)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,97 @@
+"""Passive-aggressive classifier entrypoint (RCV1-style sparse examples).
+
+The analog of the reference's PA example job
+(``PassiveAggressiveParameterServer.transformBinary`` / ``transformMulticlass``
+wired from a ``main``, SURVEY.md §3.4). ``--num-classes 2`` (default) runs the
+binary variant; ``>2`` the multiclass one.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from fps_tpu.examples.common import (
+    base_parser,
+    emit,
+    finish,
+    make_mesh,
+    maybe_checkpointer,
+    maybe_warm_start,
+)
+
+
+def main(argv=None) -> int:
+    ap = base_parser("Passive-aggressive classification on the TPU PS")
+    ap.add_argument("--num-features", type=int, default=10_000)
+    ap.add_argument("--num-classes", type=int, default=2)
+    ap.add_argument("--num-examples", type=int, default=50_000)
+    ap.add_argument("--nnz", type=int, default=16)
+    ap.add_argument("--variant", default="PA-I", choices=["PA", "PA-I", "PA-II"])
+    ap.add_argument("--C", type=float, default=1.0)
+    args = ap.parse_args(argv)
+
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.core.ingest import multi_epoch_chunks
+    from fps_tpu.models.passive_aggressive import (
+        PAConfig,
+        passive_aggressive,
+        predict_host,
+    )
+    from fps_tpu.utils.datasets import (
+        synthetic_sparse_classification,
+        synthetic_sparse_multiclass,
+        train_test_split,
+    )
+
+    if args.num_classes == 2:
+        data = synthetic_sparse_classification(
+            args.num_examples, args.num_features, args.nnz, seed=args.seed
+        )
+    else:
+        data = synthetic_sparse_multiclass(
+            args.num_examples, args.num_features, args.num_classes, args.nnz,
+            seed=args.seed,
+        )
+    train, test = train_test_split(data, test_frac=0.1, seed=args.seed + 1)
+
+    mesh = make_mesh(args)
+    W = num_workers_of(mesh)
+    emit({"event": "start", "workload": "passive_aggressive",
+          "variant": args.variant, "num_classes": args.num_classes,
+          "mesh": dict(mesh.shape)})
+
+    cfg = PAConfig(num_features=args.num_features, num_classes=args.num_classes,
+                   variant=args.variant, C=args.C)
+    trainer, store = passive_aggressive(mesh, cfg, sync_every=args.sync_every)
+    tables, local_state = trainer.init_state(jax.random.key(args.seed))
+    maybe_warm_start(args, store, None)
+
+    chunks = multi_epoch_chunks(
+        train, epochs=args.epochs, num_workers=W, local_batch=args.local_batch,
+        steps_per_chunk=args.steps_per_chunk, sync_every=args.sync_every,
+        seed=args.seed,
+    )
+    def report(i, m):
+        n = max(1.0, float(np.sum(m["n"])))
+        emit({"event": "chunk", "i": i,
+              "error_rate": float(np.sum(m["mistakes"]) / n),
+              "hinge_loss": float(np.sum(m["loss"]) / n)})
+
+    tables, local_state, _ = trainer.fit_stream(
+        tables, local_state, chunks, jax.random.key(args.seed),
+        checkpointer=maybe_checkpointer(args),
+        checkpoint_every=args.checkpoint_every,
+        on_chunk=report,
+    )
+
+    pred = predict_host(store, test["feat_ids"], test["feat_vals"],
+                        num_classes=args.num_classes)
+    acc = float(np.mean(pred == test["label"]))
+    emit({"event": "done", "test_accuracy": acc})
+    finish(args, store)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

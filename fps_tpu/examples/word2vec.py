@@ -1,0 +1,100 @@
+"""word2vec SGNS entrypoint (text8-style token stream).
+
+The analog of the reference's word2vec example job (SURVEY.md §2 #10;
+BASELINE.json workload "word2vec skip-gram negative sampling (text8)").
+Reports words/sec alongside the training loss — the BASELINE.json headline
+unit for this workload — and prints nearest neighbors of a few frequent
+words at the end as a qualitative check.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from fps_tpu.examples.common import (
+    base_parser,
+    emit,
+    finish,
+    make_mesh,
+    maybe_checkpointer,
+    maybe_warm_start,
+)
+
+
+def main(argv=None) -> int:
+    ap = base_parser("word2vec SGNS on the TPU PS")
+    ap.add_argument("--vocab-size", type=int, default=50_000)
+    ap.add_argument("--num-tokens", type=int, default=2_000_000,
+                    help="synthetic corpus length when no --input is given")
+    ap.add_argument("--dim", type=int, default=100)
+    ap.add_argument("--window", type=int, default=5)
+    ap.add_argument("--negatives", type=int, default=5)
+    ap.add_argument("--learning-rate", type=float, default=0.025)
+    args = ap.parse_args(argv)
+
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.models.word2vec import (
+        W2VConfig,
+        nearest_neighbors,
+        skipgram_chunks,
+        word2vec,
+    )
+    from fps_tpu.utils.datasets import load_text8
+
+    tokens, vocab, uni = load_text8(args.input, args.vocab_size,
+                                    args.num_tokens, seed=args.seed)
+    mesh = make_mesh(args)
+    W = num_workers_of(mesh)
+    emit({"event": "start", "workload": "word2vec", "vocab_size": vocab,
+          "tokens": len(tokens), "mesh": dict(mesh.shape)})
+
+    cfg = W2VConfig(vocab_size=vocab, dim=args.dim, window=args.window,
+                    negatives=args.negatives, learning_rate=args.learning_rate)
+    trainer, store = word2vec(mesh, cfg, uni, sync_every=args.sync_every)
+    tables, local_state = trainer.init_state(jax.random.key(args.seed))
+    maybe_warm_start(args, store, None)
+
+    total_pairs = 0.0
+
+    def report(i, m):
+        nonlocal total_pairs
+        n = max(1.0, float(np.sum(m["n"])))
+        total_pairs += n
+        emit({"event": "chunk", "i": i,
+              "sgns_loss": float(np.sum(m["loss"]) / n)})
+
+    def all_epochs():
+        for epoch in range(args.epochs):
+            yield from skipgram_chunks(
+                tokens, uni, cfg, num_workers=W, local_batch=args.local_batch,
+                steps_per_chunk=args.steps_per_chunk,
+                sync_every=args.sync_every, seed=args.seed + epoch,
+            )
+
+    t0 = time.perf_counter()
+    tables, local_state, _ = trainer.fit_stream(
+        tables, local_state, all_epochs(), jax.random.key(args.seed),
+        checkpointer=maybe_checkpointer(args),
+        checkpoint_every=args.checkpoint_every,
+        on_chunk=report,
+    )
+    dt = time.perf_counter() - t0
+    emit({"event": "done", "pairs_per_sec": total_pairs / max(dt, 1e-9),
+          "seconds": dt})
+
+    # Qualitative: neighbors of a few frequent words (ids 1..4; 0 may be UNK).
+    probes = np.arange(1, 5)
+    nn_ids, nn_sims = nearest_neighbors(store, probes, k=5)
+    for p, row_i, row_s in zip(probes, nn_ids, nn_sims):
+        emit({"event": "neighbors", "word": int(p), "nearest": row_i,
+              "sims": np.round(row_s, 3)})
+
+    finish(args, store)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

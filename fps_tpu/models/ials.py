@@ -306,8 +306,11 @@ class IALSSolver:
                       seed: int = 0) -> float:
         """Host-side iALS objective estimate: the observed confidence-weighted
         term ``sum c*(1 - x·y)^2`` plus the exact regularizer
-        ``reg*(sum ||x_u||^2 + sum ||y_i||^2)`` (+ optionally a sampled
-        estimate of the unobserved ``(0 - x·y)^2`` term)."""
+        ``reg*(sum ||x_u||^2 + sum ||y_i||^2)`` (+ optionally a Monte-Carlo
+        estimate of the unobserved ``(0 - x·y)^2`` term: the sampled mean
+        scaled by ``num_users * num_items``; pairs are drawn uniformly with
+        replacement, so observed pairs can be sampled too, biasing the
+        estimate up by O(nnz / (U·I)) — negligible for sparse data)."""
         cfg = self.cfg
         U, V = self.factors()
         xy = np.sum(U[users] * V[items], axis=-1)
@@ -318,7 +321,8 @@ class IALSSolver:
             rng = np.random.default_rng(seed)
             su = rng.integers(0, cfg.num_users, sample_unobserved)
             si = rng.integers(0, cfg.num_items, sample_unobserved)
-            loss += float(np.sum(np.sum(U[su] * V[si], axis=-1) ** 2))
+            mean_sq = float(np.mean(np.sum(U[su] * V[si], axis=-1) ** 2))
+            loss += mean_sq * cfg.num_users * cfg.num_items
         return loss
 
 

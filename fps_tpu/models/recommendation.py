@@ -1,0 +1,156 @@
+"""Top-K recommendation over a sharded factor table.
+
+Reference behavior being rebuilt (SURVEY.md §2 #8): the reference's online-MF
+package ships a top-K variant (upstream ``PSOnlineMatrixFactorizationAndTopK``,
+expected under ``src/main/scala/hu/sztaki/ilab/ps/matrix/factorization/``)
+that, alongside training, emits the current top-K items for a user by scoring
+the user's factor vector against the item factors held on the servers.
+
+TPU-native design — instead of the reference's per-rating pull-everything
+scoring on one worker, ranking is a sharded dense score + distributed top-k
+merge, all on-device:
+
+* each shard scores the queries against **its own rows only**:
+  ``(B, dim) @ (rps, dim)^T`` — one MXU matmul per shard, no table movement;
+* each shard takes a **local top-(k+E)** of its partial scores
+  (``E`` = exclusion capacity, so exclusions can never eat into the true
+  top-k);
+* the ``S*(k+E)`` candidates per query are ``all_gather``-ed over ICI
+  (tiny: candidates only, never the table) and merged with a final top-k.
+
+Exclusion (mask the user's already-rated items — the reference's top-K
+worker keeps exactly such a seen-set) is per-query: pass ``exclude`` ids,
+``-1`` for unused slots.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fps_tpu.core.store import ParamStore, phys_to_id
+from fps_tpu.parallel.mesh import SHARD_AXIS
+
+Array = jax.Array
+
+NEG_INF = jnp.float32(-3.0e38)
+
+
+def build_topk_fn(store: ParamStore, table: str, k: int,
+                  exclude_capacity: int = 0):
+    """Compile ``(tables, queries, exclude) -> (ids, scores)`` top-k ranking.
+
+    Args:
+      store: the :class:`ParamStore` holding ``table`` (its mesh is used).
+      table: name of the ``(num_ids, dim)`` factor table to rank over.
+      k: results per query.
+      exclude_capacity: max exclusion ids per query (0 disables the
+        ``exclude`` argument's effect; slots of ``-1`` are ignored).
+
+    Returns:
+      A jitted function ``fn(tables, queries, exclude)``:
+        * ``queries`` — ``(B, dim)`` float query vectors (user factors),
+        * ``exclude`` — ``(B, exclude_capacity)`` int32 ids to mask
+          (pass an all ``-1`` array when unused),
+      returning ``(ids (B, k) int32, scores (B, k))``, best first.
+    """
+    mesh = store.mesh
+    spec = store.specs[table]
+    num_shards = store.num_shards
+    cand = k + exclude_capacity
+    table_specs = {name: P(SHARD_AXIS, None) for name in store.specs}
+
+    def device_fn(tables, queries, exclude):
+        local = tables[table]  # (rps, dim) this shard's block
+        rps = local.shape[0]
+        me = lax.axis_index(SHARD_AXIS)
+        phys = me * rps + jnp.arange(rps, dtype=jnp.int32)
+        ids = phys_to_id(phys, num_shards, rps)
+
+        # MXU: score every owned row against every query.
+        scores = queries.astype(jnp.float32) @ local.astype(jnp.float32).T
+        scores = jnp.where((ids < spec.num_ids)[None, :], scores, NEG_INF)
+
+        n_local = min(cand, rps)
+        top_s, top_i = lax.top_k(scores, n_local)  # (B, n_local)
+        top_ids = jnp.take(ids, top_i)  # (B, n_local) logical ids
+
+        # Merge: gather every shard's candidates (concat along axis 1).
+        all_s = lax.all_gather(top_s, SHARD_AXIS, axis=1, tiled=True)
+        all_i = lax.all_gather(top_ids, SHARD_AXIS, axis=1, tiled=True)
+
+        if exclude_capacity:
+            hit = jnp.any(
+                all_i[:, :, None] == exclude[:, None, :], axis=-1
+            )  # (B, S*n_local)
+            all_s = jnp.where(hit, NEG_INF, all_s)
+
+        out_s, out_j = lax.top_k(all_s, k)
+        out_i = jnp.take_along_axis(all_i, out_j, axis=1)
+        return out_i.astype(jnp.int32), out_s
+
+    shmapped = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(table_specs, P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(shmapped)
+
+
+def recommend_topk(
+    store: ParamStore,
+    table: str,
+    queries: np.ndarray,
+    k: int,
+    *,
+    exclude: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """One-shot host API: rank ``table`` rows for ``queries``.
+
+    ``exclude`` is an optional ``(B, E)`` int array of ids to mask per query
+    (``-1`` = unused slot). Returns ``(ids, scores)`` as numpy arrays.
+
+    The online analog of streaming top-K emission: call this between chunks
+    (the tables passed are the live sharded arrays — no copies are made).
+    """
+    B = len(queries)
+    E = 0 if exclude is None else int(np.asarray(exclude).shape[1])
+    # Memoize the compiled program on the store (repeated streaming calls
+    # between training chunks must not re-trace/re-compile).
+    cache = store.__dict__.setdefault("_topk_fns", {})
+    cache_key = (table, k, E)
+    fn = cache.get(cache_key)
+    if fn is None:
+        fn = cache[cache_key] = build_topk_fn(store, table, k, exclude_capacity=E)
+    replicated = NamedSharding(store.mesh, P())
+    q = jax.device_put(jnp.asarray(queries), replicated)
+    ex = jax.device_put(
+        jnp.asarray(
+            exclude if exclude is not None else np.full((B, 1), -1), jnp.int32
+        ),
+        replicated,
+    )
+    ids, scores = fn(store.tables, q, ex)
+    return np.asarray(ids), np.asarray(scores)
+
+
+def mf_user_vectors(
+    user_factors_global: np.ndarray, num_workers: int, users: np.ndarray
+) -> np.ndarray:
+    """Extract user factor rows from MF's worker-sharded local state.
+
+    MF keeps user vectors worker-local in owner-major cyclic layout
+    (``fps_tpu.models.matrix_factorization``); this resolves logical user
+    ids to their physical rows for use as top-k ``queries``.
+    """
+    table = np.asarray(user_factors_global)
+    rps = table.shape[0] // num_workers
+    users = np.asarray(users)
+    return table[(users % num_workers) * rps + users // num_workers]

@@ -1,0 +1,104 @@
+"""Smoke tests for the L5 CLI entrypoints (the reference's example jobs).
+
+Each entrypoint runs in-process on a tiny synthetic workload and must emit a
+"done" event with a sane quality metric — the analog of the reference's
+example jobs being runnable end-to-end on the local mini-cluster.
+"""
+
+import json
+
+import pytest
+
+
+def run_main(module, argv, capsys):
+    rc = module.main(argv)
+    assert rc == 0
+    events = [json.loads(line) for line in capsys.readouterr().out.splitlines()]
+    by_event = {}
+    for e in events:
+        by_event.setdefault(e["event"], []).append(e)
+    assert "done" in by_event, f"no done event in {events}"
+    return by_event
+
+
+TINY = ["--epochs", "1", "--local-batch", "32", "--steps-per-chunk", "4"]
+
+
+def test_mf_entrypoint(devices8, capsys, tmp_path):
+    from fps_tpu.examples import mf
+
+    export = str(tmp_path / "mf.npz")
+    ev = run_main(
+        mf,
+        TINY + ["--scale", "100k", "--rank", "4", "--topk", "3",
+                "--export", export],
+        capsys,
+    )
+    assert ev["done"][0]["test_rmse"] < 2.0
+    assert len(ev["topk"][0]["items"]) == 3
+    assert ev["export"][0]["path"] == export
+
+    # Warm start from the exported model must load cleanly.
+    ev2 = run_main(
+        mf, TINY + ["--scale", "100k", "--rank", "4", "--warm-start", export],
+        capsys,
+    )
+    assert "warm_start" in ev2
+
+
+def test_pa_entrypoints(devices8, capsys):
+    from fps_tpu.examples import passive_aggressive as pa
+
+    ev = run_main(
+        pa, TINY + ["--num-examples", "4000", "--num-features", "500"], capsys
+    )
+    assert ev["done"][0]["test_accuracy"] > 0.6
+
+    ev = run_main(
+        pa,
+        TINY + ["--num-examples", "4000", "--num-features", "500",
+                "--num-classes", "4"],
+        capsys,
+    )
+    assert ev["done"][0]["test_accuracy"] > 0.4
+
+
+def test_word2vec_entrypoint(devices8, capsys):
+    from fps_tpu.examples import word2vec as w2v
+
+    ev = run_main(
+        w2v,
+        TINY + ["--vocab-size", "200", "--num-tokens", "20000", "--dim", "16"],
+        capsys,
+    )
+    assert ev["done"][0]["pairs_per_sec"] > 0
+    assert len(ev["neighbors"]) == 4
+
+
+def test_logreg_entrypoint(devices8, capsys, tmp_path):
+    from fps_tpu.examples import logreg_ssp
+
+    ckdir = tmp_path / "ck"
+    ev = run_main(
+        logreg_ssp,
+        TINY + ["--num-examples", "4000", "--num-features", "2000",
+                "--sync-every", "2", "--checkpoint-dir", str(ckdir),
+                "--checkpoint-every", "2"],
+        capsys,
+    )
+    assert ev["done"][0]["test_accuracy"] > 0.6
+    # --checkpoint-dir must actually produce snapshots (incl. end-of-stream).
+    snaps = sorted(ckdir.glob("ckpt_*.npz"))
+    assert snaps, "no checkpoints written despite --checkpoint-dir"
+
+
+def test_ials_entrypoint(devices8, capsys):
+    from fps_tpu.examples import ials
+
+    ev = run_main(
+        ials,
+        TINY + ["--num-users", "64", "--num-items", "48", "--per-user", "10",
+                "--rank", "4", "--epochs", "2"],
+        capsys,
+    )
+    assert ev["done"][0]["recall_at_10"] > 0.0
