@@ -33,6 +33,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from fps_tpu import ops
 from fps_tpu.core.api import ServerLogic, WorkerLogic
 from fps_tpu.core.store import ParamStore, id_to_phys, pull, pull_local, push
 from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
@@ -243,9 +244,12 @@ class Trainer:
         return jax.jit(run, donate_argnums=donate)
 
     def _get_compiled(self, mode: str):
-        if mode not in self._compiled:
-            self._compiled[mode] = self._build_chunk_fn(mode)
-        return self._compiled[mode]
+        # Keyed on the ops backend too: set_backend() after a compile must
+        # take effect on the next chunk, not be shadowed by the jit cache.
+        key = (mode, ops.get_backend())
+        if key not in self._compiled:
+            self._compiled[key] = self._build_chunk_fn(mode)
+        return self._compiled[key]
 
     # -- host API ---------------------------------------------------------
 

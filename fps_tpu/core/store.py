@@ -47,6 +47,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fps_tpu import ops
 from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
 
 Array = jax.Array
@@ -104,7 +105,7 @@ def pull(
     all_ids = lax.all_gather(ids, shard_axis, tiled=True)
     owned = (all_ids % num_shards) == me
     local_idx = jnp.where(owned, all_ids // num_shards, 0)
-    vals = jnp.take(local_shard, local_idx, axis=0)
+    vals = ops.gather_rows(local_shard, local_idx)
     vals = jnp.where(owned[:, None], vals, jnp.zeros_like(vals))
     # Each worker ends up with its own (B, dim) slice, summed over shards
     # (exactly one shard contributed each row).
@@ -124,7 +125,7 @@ def pull_local(
     ingest layer routes examples so that ``ids % num_shards`` equals the
     worker index, making every lookup local.
     """
-    return jnp.take(local_shard, ids // num_shards, axis=0)
+    return ops.gather_rows(local_shard, ids // num_shards)
 
 
 def push(
@@ -182,9 +183,7 @@ def push(
         raise ValueError(f"unknown combine mode {combine!r}")
 
     if apply_fn is None and combine == "sum":
-        return local_shard.at[local_idx].add(
-            masked.astype(local_shard.dtype), mode="drop"
-        )
+        return ops.scatter_add(local_shard, local_idx, masked)
 
     # Combine duplicate ids first, then apply once per touched row.
     summed = jax.ops.segment_sum(masked, local_idx, num_segments=rps + 1)[:rps]

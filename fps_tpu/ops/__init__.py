@@ -1,0 +1,103 @@
+"""Sparse hot-path ops with pluggable backends (XLA default, Pallas on TPU).
+
+The store's pull/push collectives bottom out in two local ops per shard:
+row **gather** (pull answers) and duplicate-combining **scatter-add** (push
+folds). Both have an XLA lowering (``jnp.take`` / ``.at[].add``) and a Pallas
+TPU kernel (:mod:`fps_tpu.ops.pallas_kernels`); this module picks per call.
+
+Backend selection:
+
+* ``set_backend("xla" | "pallas" | "auto")`` or env ``FPS_TPU_OPS`` at
+  import time. Default ``"xla"``.
+* ``"auto"``/``"pallas"`` route to Pallas kernels on TPU; off-TPU the
+  kernels run in interpreter mode (tests exercise them that way) only when
+  the backend is explicitly ``"pallas"``.
+* The one-hot-matmul scatter pays ``rows × batch × dim`` MXU FLOPs; for
+  tables/batches where that exceeds :data:`SCATTER_FLOP_BUDGET` the XLA
+  scatter is used instead even under ``"pallas"``/``"auto"``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_BACKEND = os.environ.get("FPS_TPU_OPS", "xla").lower()
+
+# One-hot scatter cost ceiling (MXU flops per call). ~2e10 fl32 flops is
+# ~0.2 ms on a v5e chip — beyond that the serialization cost XLA's scatter
+# pays is cheaper than the dense indicator matmul.
+SCATTER_FLOP_BUDGET = 2e10
+
+
+def set_backend(name: str) -> None:
+    """Select the hot-path backend for subsequently *traced* programs.
+
+    The choice is read at trace time: programs already compiled (e.g. a
+    ``Trainer`` that has run a chunk) keep the backend they were traced
+    with. ``Trainer`` keys its compile cache on this setting, so new
+    trainers — or the same trainer's next fresh trace — pick up the change.
+    """
+    global _BACKEND
+    if name not in ("xla", "pallas", "auto"):
+        raise ValueError(f"unknown ops backend {name!r}")
+    _BACKEND = name
+
+
+def get_backend() -> str:
+    return _BACKEND
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def _use_pallas() -> tuple[bool, bool]:
+    """(use_pallas, interpret) for the current backend setting."""
+    if _BACKEND == "xla":
+        return False, False
+    if _on_tpu():
+        return True, False
+    # Off-TPU: only the explicit "pallas" setting runs (interpreted);
+    # "auto" falls back to XLA for speed.
+    return _BACKEND == "pallas", True
+
+
+def gather_rows(table: Array, ids: Array) -> Array:
+    """``table[ids]``; ids outside ``[0, rows)`` yield **zero rows** on every
+    backend (the pull path's ``-1`` padding slots read as zeros; real pulls
+    are always in range)."""
+    use, interpret = _use_pallas()
+    R, D = table.shape
+    # Pallas gather only wins when the deltas occupy most of the 128-wide
+    # lane dim (see measured crossover in pallas_kernels.py); below that the
+    # indicator matmul wastes the MXU and XLA's gather is faster.
+    if use and D >= 64 and R * ids.shape[0] * D <= SCATTER_FLOP_BUDGET:
+        from fps_tpu.ops.pallas_kernels import gather_rows_pallas
+
+        return gather_rows_pallas(table, ids, interpret=interpret)
+    in_range = (ids >= 0) & (ids < R)
+    vals = jnp.take(table, jnp.where(in_range, ids, 0), axis=0)
+    return jnp.where(in_range[:, None], vals, jnp.zeros_like(vals))
+
+
+def scatter_add(table: Array, ids: Array, deltas: Array) -> Array:
+    """``table.at[ids].add(deltas)``; ids outside ``[0, rows)`` are dropped,
+    duplicate ids accumulate (the server's additive ``paramUpdate`` fold)."""
+    use, interpret = _use_pallas()
+    R, D = table.shape
+    if use and R * ids.shape[0] * max(D, 1) <= SCATTER_FLOP_BUDGET:
+        from fps_tpu.ops.pallas_kernels import scatter_add_pallas
+
+        return scatter_add_pallas(table, ids, deltas, interpret=interpret)
+    # XLA path: clamp dropped ids to an out-of-range row and use drop mode.
+    safe = jnp.where((ids >= 0) & (ids < R), ids, R)
+    masked = jnp.where(((ids >= 0) & (ids < R))[:, None], deltas, 0)
+    return table.at[safe].add(masked.astype(table.dtype), mode="drop")

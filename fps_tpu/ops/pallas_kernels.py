@@ -1,0 +1,208 @@
+"""Pallas TPU kernels for the sparse parameter-server hot paths.
+
+SURVEY.md §7 flags the sparse gather / scatter-add paths as the rebuild's
+throughput hard part (the reference's per-message ``onPullRecv`` /
+``onPushRecv`` handling, expected upstream
+``src/main/scala/hu/sztaki/ilab/ps/server/SimplePSLogic.scala``, becomes a
+bulk row gather + duplicate-combining scatter-add here). Both kernels use
+the same TPU-first idea — turn data-dependent indexing into dense
+**indicator (one-hot) matmuls on the MXU**, the systolic array's native
+operation, instead of the serialized dynamic-memory ops XLA's gather/scatter
+lower to:
+
+* :func:`scatter_add_pallas` — for each (row-tile, batch-tile) grid cell,
+  build the ``(row_tile, batch_tile)`` indicator ``ids == row`` and contract
+  with the delta block: duplicates accumulate exactly (the reference's
+  additive ``paramUpdate`` fold per message), drop sentinels (ids outside
+  ``[0, R)``) never match a row and vanish, and there is zero update
+  serialization.
+* :func:`gather_rows_pallas` — the transpose: ``(batch_tile, row_tile)``
+  indicator contracted with the table block accumulates each requested row
+  into the output (pull = one-hot matmul route, SURVEY.md §7 step 1).
+
+The FLOP cost of either is ``rows × batch × dim`` (with ``dim`` padded to
+the 128-lane width); the dispatcher (``fps_tpu.ops``) only routes here when
+that is small enough for the MXU to beat the memory-op path. Contractions
+run at ``Precision.HIGHEST`` — the default MXU path rounds operands to bf16,
+which visibly loses update mass on heavily-duplicated (Zipfian-hot) rows.
+
+Measured on the attached TPU chip (min over 5×100 calls, f32):
+
+=====================================  ============  =============
+shapes (R rows × B ids × D dim)        XLA scatter   Pallas scatter
+=====================================  ============  =============
+MF      26744 × 16384 × 10             23.8 µs       22.2 µs
+word2vec 6272 ×  8192 × 100            12.6 µs       12.4 µs
+logreg  32768 ×  8192 × 1              12.2 µs       10.2 µs
+=====================================  ============  =============
+
+Gather: Pallas 9.9 µs vs XLA 12.7 µs at D=100; XLA slightly ahead at D=10
+(10.4 vs 12.2 µs) where lane padding wastes 92% of the MXU work.
+
+Both kernels run in interpreter mode off-TPU so the CPU-mesh test suite
+exercises them bit-for-bit. Tile sizes respect Mosaic's block constraints:
+the id row is laid out ``(1, batch_tile)`` with ``batch_tile`` a multiple of
+128 (lane dim), and row/batch tiles are multiples of 8 (sublane dim).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+Array = jax.Array
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _tiles(R: int, B: int, row_tile: int, batch_tile: int) -> tuple[int, int]:
+    """Clamp requested tiles to the (padded) problem and Mosaic constraints:
+    row tiles are multiples of 8, batch tiles multiples of 128."""
+    row_tile = max(8, min(_round_up(row_tile, 8), _round_up(R, 8)))
+    batch_tile = max(128, min(_round_up(batch_tile, 128), _round_up(B, 128)))
+    return row_tile, batch_tile
+
+
+# ---------------------------------------------------------------------------
+# Scatter-add: table[ids] += deltas (duplicates combine, out-of-range drop)
+# ---------------------------------------------------------------------------
+
+def _scatter_kernel(ids_ref, table_ref, deltas_ref, out_ref, *, row_tile):
+    i = pl.program_id(0)  # row-tile index (slow)
+    j = pl.program_id(1)  # batch-tile index (fast: out block stays resident)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = table_ref[:]
+
+    bt = ids_ref.shape[1]
+    rows = i * row_tile + jax.lax.broadcasted_iota(
+        jnp.int32, (row_tile, bt), dimension=0
+    )
+    onehot = (ids_ref[:] == rows).astype(jnp.float32)  # (row_tile, bt)
+    acc = jnp.dot(
+        onehot,
+        deltas_ref[:].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    out_ref[:] += acc.astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("row_tile", "batch_tile", "interpret")
+)
+def scatter_add_pallas(
+    table: Array,
+    ids: Array,
+    deltas: Array,
+    *,
+    row_tile: int = 256,
+    batch_tile: int = 2048,
+    interpret: bool = False,
+):
+    """``table.at[ids].add(deltas)`` with drop semantics for ids ∉ [0, R).
+
+    ``ids (B,)`` int32, ``deltas (B, D)``. Returns the updated ``(R, D)``
+    table. Duplicate ids within the batch accumulate additively.
+    """
+    R, D = table.shape
+    B = ids.shape[0]
+    row_tile, batch_tile = _tiles(R, B, row_tile, batch_tile)
+
+    pad_b = _round_up(B, batch_tile) - B
+    ids2 = jnp.pad(ids.astype(jnp.int32), (0, pad_b), constant_values=-1)
+    deltas2 = jnp.pad(deltas, ((0, pad_b), (0, 0)))
+    ids2 = ids2.reshape(1, -1)  # 2-D for TPU layout
+
+    grid = (pl.cdiv(R, row_tile), ids2.shape[1] // batch_tile)
+    return pl.pallas_call(
+        functools.partial(_scatter_kernel, row_tile=row_tile),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, batch_tile), lambda i, j: (0, j)),
+            pl.BlockSpec((row_tile, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((batch_tile, D), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((row_tile, D), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, D), table.dtype),
+        interpret=interpret,
+    )(ids2, table, deltas2)
+
+
+# ---------------------------------------------------------------------------
+# Gather: rows = table[ids] (one-hot matmul route)
+# ---------------------------------------------------------------------------
+
+def _gather_kernel(ids_ref, table_ref, out_ref, *, row_tile, num_rows):
+    i = pl.program_id(0)  # batch-tile index (slow)
+    j = pl.program_id(1)  # row-tile index (fast: out block stays resident)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bt = ids_ref.shape[1]
+    rows = j * row_tile + jax.lax.broadcasted_iota(
+        jnp.int32, (bt, row_tile), dimension=1
+    )
+    ids_col = ids_ref[:].reshape(bt, 1)
+    onehot = (ids_col == rows).astype(jnp.float32)  # (bt, row_tile)
+    # Boundary row tiles read past the table; those rows carry garbage
+    # (NaN in interpret mode) and 0 x NaN would poison the contraction,
+    # so zero them explicitly.
+    row_ids = j * row_tile + jax.lax.broadcasted_iota(
+        jnp.int32, (row_tile, 1), dimension=0
+    )
+    tb = jnp.where(row_ids < num_rows, table_ref[:].astype(jnp.float32), 0.0)
+    acc = jnp.dot(
+        onehot,
+        tb,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    out_ref[:] += acc.astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("row_tile", "batch_tile", "interpret")
+)
+def gather_rows_pallas(
+    table: Array,
+    ids: Array,
+    *,
+    row_tile: int = 512,
+    batch_tile: int = 1024,
+    interpret: bool = False,
+):
+    """``table[ids]`` — ``(B,)`` int32 ids into a ``(R, D)`` table.
+
+    Ids outside ``[0, R)`` produce zero rows (the pull path only sends
+    in-range ids; padding uses ``-1``).
+    """
+    R, D = table.shape
+    B = ids.shape[0]
+    row_tile, batch_tile = _tiles(R, B, row_tile, batch_tile)
+
+    pad_b = _round_up(B, batch_tile) - B
+    ids2 = jnp.pad(ids.astype(jnp.int32), (0, pad_b), constant_values=-1)
+    ids2 = ids2.reshape(1, -1)
+
+    grid = (ids2.shape[1] // batch_tile, pl.cdiv(R, row_tile))
+    out = pl.pallas_call(
+        functools.partial(_gather_kernel, row_tile=row_tile, num_rows=R),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, batch_tile), lambda i, j: (0, i)),
+            pl.BlockSpec((row_tile, D), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((batch_tile, D), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((ids2.shape[1], D), table.dtype),
+        interpret=interpret,
+    )(ids2, table)
+    return out[:B]
