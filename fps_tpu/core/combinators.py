@@ -1,0 +1,148 @@
+"""WorkerLogic combinators — the reference's worker-wrapper layer.
+
+The reference's ``object WorkerLogic`` companion (SURVEY.md §2 #2; expected
+upstream ``src/main/scala/hu/sztaki/ilab/ps/WorkerLogic.scala``) ships
+wrappers that decorate a user's worker logic without changing it — most
+notably ``addPullLimiter(logic, limit)``, which caps in-flight pulls to
+bound staleness and memory.
+
+SPMD mapping of the pull limiter: in a compiled loop there are no in-flight
+messages to cap — every pull is answered within the step, and staleness is
+governed by the schedule, not by queue depths. The limiter's *purpose*
+(bounding how stale the values a worker computes with can get) is served by
+``TrainerConfig.sync_every`` (the SSP bound); its *memory* purpose is served
+by the static batch shape. What remains genuinely useful as worker wrappers
+on TPU are delta- and output-transformations, provided here in the same
+decorate-don't-touch style:
+
+* :func:`clip_pushes` — per-row L2 clip of pushed deltas (the PS-world
+  gradient-clipping knob; stabilizes Zipfian-hot rows under large batches).
+* :func:`scale_pushes` — constant scaling of pushed deltas (e.g. 1/W
+  worker-count normalization).
+* :func:`tap_outputs` — augment the ``WOut`` metrics stream with extra
+  per-step statistics (push-norm, pull-count) without touching the logic.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+
+from fps_tpu.core.api import StepOutput, WorkerLogic
+
+Array = jax.Array
+
+
+class _Wrapped(WorkerLogic):
+    """Delegates everything to the inner logic; subclasses override step()."""
+
+    def __init__(self, inner: WorkerLogic):
+        self.inner = inner
+
+    def init_local_state(self, key, num_workers):
+        return self.inner.init_local_state(key, num_workers)
+
+    def prepare(self, batch, key):
+        return self.inner.prepare(batch, key)
+
+    def pull_ids(self, batch):
+        return self.inner.pull_ids(batch)
+
+    def step(self, batch, pulled, local_state, key) -> StepOutput:
+        return self.inner.step(batch, pulled, local_state, key)
+
+
+def _map_pushes(out: StepOutput, fn) -> StepOutput:
+    pushes = {
+        name: (ids, fn(name, ids, deltas))
+        for name, (ids, deltas) in out.pushes.items()
+    }
+    return StepOutput(pushes=pushes, local_state=out.local_state, out=out.out)
+
+
+def clip_pushes(logic: WorkerLogic, max_norm: float,
+                tables: tuple[str, ...] | None = None) -> WorkerLogic:
+    """Clip each pushed row to L2 norm ``max_norm`` (per delta row).
+
+    ``tables`` limits clipping to the named tables (default: all).
+    """
+
+    class Clipped(_Wrapped):
+        def step(self, batch, pulled, local_state, key):
+            out = self.inner.step(batch, pulled, local_state, key)
+
+            def clip(name, ids, deltas):
+                if tables is not None and name not in tables:
+                    return deltas
+                norm = jnp.linalg.norm(
+                    deltas.astype(jnp.float32), axis=-1, keepdims=True
+                )
+                scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+                return (deltas * scale.astype(deltas.dtype))
+
+            return _map_pushes(out, clip)
+
+    return Clipped(logic)
+
+
+def scale_pushes(logic: WorkerLogic, scale: float,
+                 tables: tuple[str, ...] | None = None) -> WorkerLogic:
+    """Multiply pushed deltas by a constant (e.g. 1/num_workers)."""
+
+    class Scaled(_Wrapped):
+        def step(self, batch, pulled, local_state, key):
+            out = self.inner.step(batch, pulled, local_state, key)
+
+            def f(name, ids, deltas):
+                if tables is not None and name not in tables:
+                    return deltas
+                return deltas * jnp.asarray(scale, deltas.dtype)
+
+            return _map_pushes(out, f)
+
+    return Scaled(logic)
+
+
+def tap_outputs(
+    logic: WorkerLogic,
+    tap: Callable[[Mapping[str, tuple[Array, Array]]], Mapping[str, Array]]
+    | None = None,
+) -> WorkerLogic:
+    """Augment the ``WOut`` stream with per-step push statistics.
+
+    Default tap adds, per table, ``push_norm/<table>`` (L2 norm of all
+    pushed deltas) and ``push_count/<table>`` (rows actually pushed, i.e.
+    id >= 0) — the observability hook the reference gets by making metrics
+    "just another stream" (SURVEY.md §5 metrics row).
+    """
+
+    def default_tap(pushes):
+        extra = {}
+        for name, (ids, deltas) in pushes.items():
+            live = (ids >= 0).astype(jnp.float32)
+            extra[f"push_norm/{name}"] = jnp.sqrt(
+                jnp.sum((deltas.astype(jnp.float32) ** 2) * live[:, None])
+            )
+            extra[f"push_count/{name}"] = jnp.sum(live)
+        return extra
+
+    tap_fn = tap or default_tap
+
+    class Tapped(_Wrapped):
+        def step(self, batch, pulled, local_state, key):
+            out = self.inner.step(batch, pulled, local_state, key)
+            if not isinstance(out.out, Mapping):
+                raise TypeError(
+                    "tap_outputs requires the wrapped logic's StepOutput.out "
+                    f"to be a Mapping (got {type(out.out).__name__}); wrap "
+                    "your metrics in a dict or pass a custom tap"
+                )
+            merged = dict(out.out)
+            merged.update(tap_fn(out.pushes))
+            return StepOutput(
+                pushes=out.pushes, local_state=out.local_state, out=merged
+            )
+
+    return Tapped(logic)

@@ -27,6 +27,13 @@ from typing import Iterator, Mapping
 import numpy as np
 
 
+def _to_ssp_shape(chunk: dict, sync_every: int) -> dict:
+    """Reshape (T, B, ...) chunk leaves to (T//s, s, B, ...) for the SSP driver."""
+    return {
+        k: v.reshape((-1, sync_every) + v.shape[1:]) for k, v in chunk.items()
+    }
+
+
 def epoch_chunks(
     data: Mapping[str, np.ndarray],
     *,
@@ -104,9 +111,7 @@ def epoch_chunks(
         chunk = {k: np.asarray(v)[safe[sl]] for k, v in data.items()}
         chunk["weight"] = weight[sl]
         if sync_every is not None:
-            chunk = {
-                k: v.reshape((-1, sync_every) + v.shape[1:]) for k, v in chunk.items()
-            }
+            chunk = _to_ssp_shape(chunk, sync_every)
         yield chunk
 
 
@@ -115,3 +120,118 @@ def multi_epoch_chunks(data, epochs: int, *, seed: int | None = 0, **kw):
     for e in range(epochs):
         eseed = None if seed is None else seed + e
         yield from epoch_chunks(data, seed=eseed, **kw)
+
+
+def stream_chunks(
+    source,
+    *,
+    num_workers: int,
+    local_batch: int,
+    steps_per_chunk: int,
+    route_key: str | None = None,
+    sync_every: int | None = None,
+) -> Iterator[dict]:
+    """Fixed-shape chunks from an **unbounded** stream of example batches.
+
+    The reference consumes an unbounded ``DataStream[T]`` — training runs as
+    long as the source produces records, and terminates via the
+    ``iterationWaitTime`` timeout when the stream dries up. This is the
+    analog for a compiled loop: ``source`` is any iterator yielding columnar
+    dicts (arbitrary, varying lengths — e.g. a socket reader, file tailer,
+    or Kafka-style consumer poll loop), and chunks are emitted as soon as
+    enough examples have buffered; when the source is exhausted, the
+    remainder is flushed with zero-weight padding.
+
+    Routing matches :func:`epoch_chunks`: ``route_key`` pins each example to
+    worker ``value % num_workers`` (worker-local-state locality); ``None``
+    spreads round-robin. Under keyed routing a skewed stream makes some
+    workers run ahead — short queues are padded per chunk (weight 0), which
+    is exactly the reference's behavior of workers idling while others have
+    records in flight.
+    """
+    if sync_every is not None and steps_per_chunk % sync_every:
+        raise ValueError("steps_per_chunk must be a multiple of sync_every")
+    capacity = steps_per_chunk * local_batch  # per worker
+    queues: list[dict[str, list]] | None = None
+    counts = [0] * num_workers
+    columns: dict[str, tuple] = {}  # name -> (trailing shape, dtype)
+    rr = 0  # round-robin cursor
+
+    def emit():
+        out = {}
+        for k, (trail, dtype) in columns.items():
+            per_worker = []
+            for w in range(num_workers):
+                col = (
+                    np.concatenate(queues[w][k])
+                    if queues[w][k]
+                    else np.zeros((0,) + trail, dtype)
+                )
+                take, rest = col[:capacity], col[capacity:]
+                queues[w][k] = [rest] if len(rest) else []
+                pad = capacity - len(take)
+                if pad:
+                    take = np.concatenate(
+                        [take, np.zeros((pad,) + trail, dtype)]
+                    )
+                per_worker.append(
+                    take.reshape((steps_per_chunk, local_batch) + trail)
+                )
+            # (steps, num_workers*local_batch, ...), worker-major per step.
+            out[k] = np.stack(per_worker, axis=1).reshape(
+                (steps_per_chunk, num_workers * local_batch) + trail
+            )
+        weights = []
+        for w in range(num_workers):
+            n = min(counts[w], capacity)
+            wcol = np.zeros(capacity, np.float32)
+            wcol[:n] = 1.0
+            counts[w] -= n
+            weights.append(wcol.reshape(steps_per_chunk, local_batch))
+        out["weight"] = np.stack(weights, axis=1).reshape(steps_per_chunk, -1)
+        if sync_every is not None:
+            out = _to_ssp_shape(out, sync_every)
+        return out
+
+    for batch in source:
+        if "weight" in batch:
+            raise ValueError(
+                "'weight' is reserved: stream_chunks emits it as the "
+                "real-vs-padding mask; carry importance weights in a "
+                "differently-named column"
+            )
+        if queues is None:
+            columns = {
+                k: (np.asarray(v).shape[1:], np.asarray(v).dtype)
+                for k, v in batch.items()
+            }
+            queues = [{k: [] for k in columns} for _ in range(num_workers)]
+        n = len(next(iter(batch.values())))
+        arrs = {}
+        for k, (trail, dtype) in columns.items():
+            # Pin every batch to the first batch's dtype/shape so each chunk
+            # compiles to the same program (the static-shape contract).
+            a = np.asarray(batch[k]).astype(dtype, copy=False)
+            if len(a) != n or a.shape[1:] != trail:
+                raise ValueError(
+                    f"column {k!r} shape {a.shape} inconsistent with "
+                    f"batch length {n} / trailing shape {trail}"
+                )
+            arrs[k] = a
+        if route_key is not None:
+            dest = arrs[route_key] % num_workers
+        else:
+            dest = (np.arange(n) + rr) % num_workers
+            rr = (rr + n) % num_workers
+        for w in range(num_workers):
+            sel = dest == w
+            m = int(sel.sum())
+            if not m:
+                continue
+            for k in columns:
+                queues[w][k].append(arrs[k][sel])
+            counts[w] += m
+        while max(counts) >= capacity:
+            yield emit()
+    if queues is not None and any(counts):
+        yield emit()
