@@ -206,6 +206,11 @@ def stream_chunks(
                 for k, v in batch.items()
             }
             queues = [{k: [] for k in columns} for _ in range(num_workers)]
+        if set(batch.keys()) != set(columns.keys()):
+            raise ValueError(
+                f"batch columns {sorted(batch)} != first batch's schema "
+                f"{sorted(columns)} — the schema is pinned by the first batch"
+            )
         n = len(next(iter(batch.values())))
         arrs = {}
         for k, (trail, dtype) in columns.items():

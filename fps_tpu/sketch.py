@@ -1,0 +1,211 @@
+"""Streaming sketches: count-min, tug-of-war (count-sketch), Bloom filter.
+
+The upstream project family this reference forks carries a ``sketch`` module
+for estimating word co-occurrence statistics from a stream without storing
+the full matrix (SURVEY.md §2 #10 — flagged [conf: L]; bloom-filter and
+tug-of-war sketches for co-occurrence similarity). Rebuilt here TPU-first:
+
+* every sketch is **plain additive array state** — update is a masked
+  scatter-add, so a sketch can live device-side inside a compiled step, and
+  **merging across workers is just a sum** (``lax.psum`` over the mesh axes,
+  or ``+`` on host). This mirrors how the reference merges per-operator
+  sketches by reduction.
+* hashing is vectorized multiply-shift on uint32 (overflow wraps by design),
+  with per-row constants derived deterministically from the spec seed — the
+  same reproducible-under-resharding contract as the store's per-id
+  initializers.
+
+API is functional (spec + pure init/update/query), matching the framework's
+WorkerLogic style; a sketch used inside a worker is just more local state.
+
+Estimates (standard guarantees):
+
+* count-min: ``query >= true``; overestimate ≤ ``2N/width`` w.p. ``1-2^-depth``.
+* tug-of-war inner product: unbiased, variance ``O(F2(a)F2(b)/width)``,
+  median over ``depth`` rows tightens the tail — this is the co-occurrence
+  similarity estimator.
+* Bloom: no false negatives; false-positive rate ``(1-e^{-kn/m})^k``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+_PRIME = np.uint32(2654435761)  # Knuth multiplicative constant
+
+
+def _hash_constants(seed: int, depth: int) -> tuple[np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    a = (rng.integers(1, 2**31, depth, dtype=np.int64) * 2 + 1).astype(np.uint32)
+    b = rng.integers(0, 2**31, depth, dtype=np.int64).astype(np.uint32)
+    return a, b
+
+
+def _mix(h: Array) -> Array:
+    """murmur3-style 32-bit finalizer: diffuses the weak low/high bits of the
+    multiply so the full 2^32 range is usable for any width."""
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(0xC2B2AE35)
+    return h ^ (h >> np.uint32(16))
+
+
+def _bucket(ids: Array, a: Array, b: Array, width: int) -> Array:
+    """(depth, B) bucket index per hash row."""
+    x = ids.astype(jnp.uint32)[None, :] * _PRIME
+    h = _mix(a[:, None] * x + b[:, None])
+    # uint32 % width over the fully-mixed hash: modulo bias <= width/2^32.
+    return (h % np.uint32(width)).astype(jnp.int32)
+
+
+def _sign(ids: Array, a: Array, b: Array) -> Array:
+    """(depth, B) ±1 four-ish-wise-independent sign per hash row."""
+    x = ids.astype(jnp.uint32)[None, :] * _PRIME
+    h = _mix(a[:, None] * x + b[:, None])
+    return (1 - 2 * ((h >> np.uint32(31)).astype(jnp.int32))).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Count-min sketch (point frequency estimates, biased up).
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class CountMinSpec:
+    depth: int = 4
+    width: int = 1024
+    seed: int = 0
+
+    def constants(self):
+        a, b = _hash_constants(self.seed, self.depth)
+        return jnp.asarray(a), jnp.asarray(b)
+
+
+def cm_init(spec: CountMinSpec) -> Array:
+    return jnp.zeros((spec.depth, spec.width), jnp.float32)
+
+
+def cm_update(spec: CountMinSpec, sketch: Array, ids: Array,
+              values: Array | None = None) -> Array:
+    """Add ``values`` (default 1.0) at ``ids``; ids < 0 are dropped."""
+    a, b = spec.constants()
+    cols = _bucket(ids, a, b, spec.width)  # (depth, B)
+    v = jnp.ones(ids.shape, jnp.float32) if values is None else values
+    v = jnp.where(ids >= 0, v.astype(jnp.float32), 0.0)
+    rows = jnp.broadcast_to(
+        jnp.arange(spec.depth, dtype=jnp.int32)[:, None], cols.shape
+    )
+    flat = rows.reshape(-1) * spec.width + cols.reshape(-1)
+    updated = sketch.reshape(-1).at[flat].add(
+        jnp.broadcast_to(v[None, :], cols.shape).reshape(-1)
+    )
+    return updated.reshape(spec.depth, spec.width)
+
+
+def cm_query(spec: CountMinSpec, sketch: Array, ids: Array) -> Array:
+    """(B,) frequency estimates: min over depth rows."""
+    a, b = spec.constants()
+    cols = _bucket(ids, a, b, spec.width)
+    vals = jnp.take_along_axis(sketch, cols, axis=1)  # (depth, B)
+    return jnp.min(vals, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Tug-of-war / count-sketch (unbiased inner products & frequencies).
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TugOfWarSpec:
+    depth: int = 5
+    width: int = 1024
+    seed: int = 0
+
+    def constants(self):
+        a1, b1 = _hash_constants(self.seed * 2 + 1, self.depth)
+        a2, b2 = _hash_constants(self.seed * 2 + 2, self.depth)
+        return (jnp.asarray(a1), jnp.asarray(b1),
+                jnp.asarray(a2), jnp.asarray(b2))
+
+
+def tow_init(spec: TugOfWarSpec) -> Array:
+    return jnp.zeros((spec.depth, spec.width), jnp.float32)
+
+
+def tow_update(spec: TugOfWarSpec, sketch: Array, ids: Array,
+               values: Array | None = None) -> Array:
+    """Add ``values·sign(id)`` into each row's bucket; ids < 0 dropped."""
+    a1, b1, a2, b2 = spec.constants()
+    cols = _bucket(ids, a1, b1, spec.width)
+    signs = _sign(ids, a2, b2)
+    v = jnp.ones(ids.shape, jnp.float32) if values is None else values
+    v = jnp.where(ids >= 0, v.astype(jnp.float32), 0.0)
+    rows = jnp.broadcast_to(
+        jnp.arange(spec.depth, dtype=jnp.int32)[:, None], cols.shape
+    )
+    flat = rows.reshape(-1) * spec.width + cols.reshape(-1)
+    updated = sketch.reshape(-1).at[flat].add((signs * v[None, :]).reshape(-1))
+    return updated.reshape(spec.depth, spec.width)
+
+
+def tow_inner(s1: Array, s2: Array) -> Array:
+    """Unbiased estimate of the inner product of the two sketched frequency
+    vectors — the co-occurrence-similarity estimator (median over rows)."""
+    return jnp.median(jnp.sum(s1 * s2, axis=1))
+
+
+def tow_query(spec: TugOfWarSpec, sketch: Array, ids: Array) -> Array:
+    """(B,) unbiased point-frequency estimates (median over rows)."""
+    a1, b1, a2, b2 = spec.constants()
+    cols = _bucket(ids, a1, b1, spec.width)
+    signs = _sign(ids, a2, b2)
+    vals = jnp.take_along_axis(sketch, cols, axis=1) * signs
+    return jnp.median(vals, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Bloom filter (set membership, no false negatives).
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class BloomSpec:
+    num_hashes: int = 4
+    num_bits: int = 8192
+    seed: int = 0
+
+    def constants(self):
+        a, b = _hash_constants(self.seed + 17, self.num_hashes)
+        return jnp.asarray(a), jnp.asarray(b)
+
+
+def bloom_init(spec: BloomSpec) -> Array:
+    return jnp.zeros((spec.num_bits,), jnp.uint8)
+
+
+def bloom_add(spec: BloomSpec, bits: Array, ids: Array) -> Array:
+    a, b = spec.constants()
+    pos = _bucket(ids, a, b, spec.num_bits).reshape(-1)
+    live = jnp.broadcast_to((ids >= 0)[None, :], (spec.num_hashes,) + ids.shape)
+    pos = jnp.where(live.reshape(-1), pos, spec.num_bits)  # dropped
+    return bits.at[pos].max(jnp.uint8(1), mode="drop")
+
+
+def bloom_contains(spec: BloomSpec, bits: Array, ids: Array) -> Array:
+    """(B,) bool — True may be a false positive; False is definite."""
+    a, b = spec.constants()
+    pos = _bucket(ids, a, b, spec.num_bits)  # (k, B)
+    return jnp.all(jnp.take(bits, pos, axis=0) > 0, axis=0)
+
+
+def merge(*sketches: Array) -> Array:
+    """Merge sketches built over disjoint substreams (any of the three kinds
+    — they are all additive; for Bloom this is saturating max)."""
+    out = sketches[0]
+    for s in sketches[1:]:
+        out = jnp.maximum(out, s) if out.dtype == jnp.uint8 else out + s
+    return out

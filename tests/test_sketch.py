@@ -1,0 +1,138 @@
+"""Sketch module tests: estimator guarantees + device-side/mergeable use.
+
+Mirrors the upstream sketch module's purpose (co-occurrence similarity from
+a stream) with convergence-style assertions, per the test strategy of
+asserting invariants rather than exact values (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fps_tpu import sketch as sk
+
+
+def zipf_stream(n, vocab, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.zipf(1.3, n) % vocab).astype(np.int32)
+
+
+def test_count_min_overestimates_and_is_accurate_for_heavy_hitters():
+    spec = sk.CountMinSpec(depth=4, width=2048, seed=1)
+    ids = zipf_stream(20_000, 500)
+    s = sk.cm_update(spec, sk.cm_init(spec), jnp.asarray(ids))
+    true = np.bincount(ids, minlength=500).astype(np.float32)
+    probe = np.arange(500, dtype=np.int32)
+    est = np.asarray(sk.cm_query(spec, s, jnp.asarray(probe)))
+    assert np.all(est >= true - 1e-4)  # never underestimates
+    heavy = np.argsort(-true)[:20]
+    np.testing.assert_allclose(est[heavy], true[heavy], rtol=0.05)
+
+
+def test_count_min_drops_negative_ids_and_merges():
+    spec = sk.CountMinSpec(depth=3, width=256, seed=2)
+    ids = np.array([5, -1, 5, 7, -1], np.int32)
+    s = sk.cm_update(spec, sk.cm_init(spec), jnp.asarray(ids))
+    est = np.asarray(sk.cm_query(spec, s, jnp.asarray(np.array([5, 7], np.int32))))
+    assert est[0] == 2.0 and est[1] == 1.0
+    # merge of two half-streams == one full stream
+    s1 = sk.cm_update(spec, sk.cm_init(spec), jnp.asarray(ids[:3]))
+    s2 = sk.cm_update(spec, sk.cm_init(spec), jnp.asarray(ids[3:]))
+    np.testing.assert_allclose(np.asarray(sk.merge(s1, s2)), np.asarray(s))
+
+
+def test_tug_of_war_inner_product_estimates_cooccurrence_similarity():
+    """Two context-frequency vectors; the sketch inner product must track the
+    true inner product — the co-occurrence similarity use case."""
+    spec = sk.TugOfWarSpec(depth=9, width=4096, seed=3)
+    rng = np.random.default_rng(4)
+    vocab = 1000
+    # word A and word B share contexts; word C does not.
+    base = (rng.zipf(1.4, 8000) % vocab).astype(np.int32)
+    ctx_a = base[:6000]
+    ctx_b = np.concatenate([base[2000:6000], (rng.zipf(1.4, 2000) % vocab).astype(np.int32)])
+    ctx_c = ((rng.zipf(1.4, 6000) + 350) % vocab).astype(np.int32)
+
+    sketches = {}
+    for name, ctx in [("a", ctx_a), ("b", ctx_b), ("c", ctx_c)]:
+        sketches[name] = sk.tow_update(spec, sk.tow_init(spec), jnp.asarray(ctx))
+
+    def true_inner(x, y):
+        fx = np.bincount(x, minlength=vocab).astype(np.float64)
+        fy = np.bincount(y, minlength=vocab).astype(np.float64)
+        return float(fx @ fy)
+
+    est_ab = float(sk.tow_inner(sketches["a"], sketches["b"]))
+    est_ac = float(sk.tow_inner(sketches["a"], sketches["c"]))
+    true_ab = true_inner(ctx_a, ctx_b)
+    true_ac = true_inner(ctx_a, ctx_c)
+    assert abs(est_ab - true_ab) / true_ab < 0.15
+    assert est_ab > est_ac  # similar words stay more similar than dissimilar
+
+
+def test_tug_of_war_point_query_unbiased():
+    spec = sk.TugOfWarSpec(depth=7, width=2048, seed=5)
+    ids = zipf_stream(10_000, 300, seed=6)
+    s = sk.tow_update(spec, sk.tow_init(spec), jnp.asarray(ids))
+    true = np.bincount(ids, minlength=300).astype(np.float32)
+    heavy = np.argsort(-true)[:10].astype(np.int32)
+    est = np.asarray(sk.tow_query(spec, s, jnp.asarray(heavy)))
+    np.testing.assert_allclose(est, true[heavy], rtol=0.1, atol=5)
+
+
+def test_bucket_hash_covers_large_widths():
+    """Widths above 2^16 must actually use the full table (regression: a
+    fixed 16-bit shift once capped every sketch at 65536 slots)."""
+    from fps_tpu.sketch import _bucket, _hash_constants
+
+    a, b = _hash_constants(0, 2)
+    ids = jnp.asarray(np.arange(200_000, dtype=np.int32))
+    cols = np.asarray(_bucket(ids, jnp.asarray(a), jnp.asarray(b), 1 << 20))
+    assert cols.max() >= (1 << 16), "buckets capped below width"
+    # occupancy close to the balls-in-bins expectation (~17.4% for 2e5 balls
+    # into 2^20 bins per row)
+    frac = len(np.unique(cols[0])) / (1 << 20)
+    assert 0.12 < frac < 0.25
+
+
+def test_bloom_filter_no_false_negatives():
+    spec = sk.BloomSpec(num_hashes=4, num_bits=1 << 14, seed=7)
+    rng = np.random.default_rng(8)
+    members = rng.choice(100_000, 500, replace=False).astype(np.int32)
+    bits = sk.bloom_add(spec, sk.bloom_init(spec), jnp.asarray(members))
+    assert bool(np.all(sk.bloom_contains(spec, bits, jnp.asarray(members))))
+    # false positive rate is low at this load factor
+    non = np.setdiff1d(np.arange(100_000, 200_000), members)[:5000].astype(np.int32)
+    fp = float(np.mean(np.asarray(sk.bloom_contains(spec, bits, jnp.asarray(non)))))
+    assert fp < 0.02
+    # negative ids are dropped, not inserted
+    bits2 = sk.bloom_add(spec, sk.bloom_init(spec), jnp.asarray(np.array([-1], np.int32)))
+    assert float(jnp.sum(bits2)) == 0.0
+
+
+def test_sketch_inside_compiled_step_and_psum_merge(devices8):
+    """Sketches are device state: update inside a jitted shard_map step and
+    merge across workers with psum — the distributed substream pattern."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS, make_ps_mesh
+
+    mesh = make_ps_mesh(num_shards=4, num_data=2, devices=devices8)
+    spec = sk.CountMinSpec(depth=3, width=512, seed=9)
+    ids = zipf_stream(8 * 1000, 200, seed=10)
+
+    def device_fn(local_ids):
+        s = sk.cm_update(spec, sk.cm_init(spec), local_ids)
+        return jax.lax.psum(jax.lax.psum(s, SHARD_AXIS), DATA_AXIS)
+
+    fn = jax.jit(jax.shard_map(
+        device_fn, mesh=mesh,
+        in_specs=P((DATA_AXIS, SHARD_AXIS)), out_specs=P(),
+        check_vma=False,
+    ))
+    merged = fn(jax.device_put(
+        jnp.asarray(ids), NamedSharding(mesh, P((DATA_AXIS, SHARD_AXIS)))
+    ))
+    single = sk.cm_update(spec, sk.cm_init(spec), jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(single))
