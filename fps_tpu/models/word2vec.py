@@ -174,6 +174,7 @@ def skipgram_chunks(
     sync_every: int | None = None,
     seed: int = 0,
     segment_tokens: int = 1 << 20,
+    use_native: bool | None = None,
 ) -> Iterator[dict]:
     """Stream ``(center, context, weight)`` chunks over one pass of ``tokens``.
 
@@ -181,9 +182,25 @@ def skipgram_chunks(
     materializes. Applies frequent-word subsampling (prob. 1 - sqrt(t/f))
     and a dynamic window (per-position half-width uniform in 1..window),
     both matching word2vec's reference implementation.
+
+    ``use_native`` selects the C++ pair generator (``fps_tpu.native``):
+    ``None`` (default) uses it when available, ``True`` requires it,
+    ``False`` forces the numpy path. Both paths implement the same sampling
+    scheme; streams differ only in RNG draws.
     """
+    from fps_tpu import native
+
+    if use_native is None:
+        use_native = native.available()
+    elif use_native and not native.available():
+        raise RuntimeError("use_native=True but fps_tpu.native is unavailable")
     rng = np.random.default_rng(seed)
     n = len(tokens)
+    if n and int(np.max(tokens)) >= len(unigram_counts):
+        raise ValueError(
+            f"token id {int(np.max(tokens))} >= vocab "
+            f"{len(unigram_counts)} (unigram_counts too small)"
+        )
     counts = np.asarray(unigram_counts, np.float64)
     freq = counts / max(1.0, counts.sum())
     if cfg.subsample_t is not None:
@@ -201,6 +218,9 @@ def skipgram_chunks(
     buf_c: list[np.ndarray] = []
     buf_x: list[np.ndarray] = []
     buffered = 0
+    native_kp = (
+        keep_p.astype(np.float32) if cfg.subsample_t is not None else None
+    )
 
     def emit(c, x, wgt):
         chunk = {
@@ -216,23 +236,38 @@ def skipgram_chunks(
 
     # Segments are disjoint: cross-boundary pairs (at most window per
     # ~million-token segment) are dropped rather than double-counted.
-    for start in range(0, n, segment_tokens):
+    for si, start in enumerate(range(0, n, segment_tokens)):
         seg = tokens[start : start + segment_tokens]
-        # subsample frequent words (drop positions entirely, like word2vec).
-        keep = rng.random(len(seg)) < keep_p[seg]
-        seg = seg[keep]
-        if len(seg) < 2:
-            continue
-        m = len(seg)
-        half = rng.integers(1, cfg.window + 1, m)  # dynamic window
-        for d in range(1, cfg.window + 1):
-            ok = (half >= d)[: m - d]
-            c = seg[: m - d][ok]
-            x = seg[d:][ok]
-            # both directions: (center, context) and (context, center)
-            buf_c.append(np.concatenate([c, x]))
-            buf_x.append(np.concatenate([x, c]))
-            buffered += 2 * len(c)
+        if use_native:
+            pair = native.skipgram_pairs(
+                seg, cfg.window, seed=(seed << 20) ^ si, keep_p=native_kp
+            )
+            if pair is None:  # native failure mid-stream (e.g. OOM)
+                raise RuntimeError(
+                    "native skipgram_pairs failed mid-stream; rerun with "
+                    "use_native=False"
+                )
+            c, x = pair
+            if len(c):
+                buf_c.append(c)
+                buf_x.append(x)
+                buffered += len(c)
+        else:
+            # subsample frequent words (drop positions entirely, like word2vec).
+            keep = rng.random(len(seg)) < keep_p[seg]
+            seg = seg[keep]
+            if len(seg) < 2:
+                continue
+            m = len(seg)
+            half = rng.integers(1, cfg.window + 1, m)  # dynamic window
+            for d in range(1, cfg.window + 1):
+                ok = (half >= d)[: m - d]
+                c = seg[: m - d][ok]
+                x = seg[d:][ok]
+                # both directions: (center, context) and (context, center)
+                buf_c.append(np.concatenate([c, x]))
+                buf_x.append(np.concatenate([x, c]))
+                buffered += 2 * len(c)
 
         while buffered >= stride:
             cs = np.concatenate(buf_c)

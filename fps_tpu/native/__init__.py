@@ -1,0 +1,200 @@
+"""Native (C++) host-ingest acceleration, built on demand, numpy fallback.
+
+The TPU compute path is JAX/XLA/Pallas; the *host* side of ingest (file
+parsing, skip-gram pair generation) is plain CPU work on the TPU VM, and the
+reference's equivalent layer runs as compiled JVM operators inside Flink.
+This package gives the rebuild a comparable native layer without adding
+dependencies: ``src/fps_native.cc`` is compiled with ``g++ -O3`` the first
+time it's needed (result cached next to the source, rebuilt when the source
+changes) and bound via ctypes. Everything degrades gracefully: if no
+compiler is available, callers use the numpy implementations.
+
+API:
+
+* :func:`available` — True if the shared library could be built/loaded.
+* :func:`parse_ratings` — single-pass scanner for MovieLens-style rating
+  files (tab/comma/space separated, headers skipped, int or decimal
+  ratings). ~10M rows/s, measured ~1.5x ``np.loadtxt`` on ML-20M-sized
+  files — and unlike a fixed-dtype ``loadtxt`` call it handles both the
+  ML-100K tab format and the ML-20M csv-with-header format.
+* :func:`skipgram_pairs` — subsampled dynamic-window skip-gram pairs for a
+  token segment (word2vec ingest), deterministic per seed; ~33M pairs/s,
+  replacing the numpy per-segment vectorized loop.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "fps_native.cc")
+_LIB = os.path.join(_DIR, "_fps_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    # Compile to a unique temp path then rename: concurrent processes must
+    # never dlopen a half-written .so (the failure would be cached for the
+    # process lifetime).
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _LIB)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        stale = (
+            not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        )
+        if stale and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.fps_parse_ratings.restype = ctypes.c_long
+        lib.fps_parse_ratings.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.fps_skipgram_pairs.restype = ctypes.c_long
+        lib.fps_skipgram_pairs.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_long,
+            ctypes.c_int,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_long,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def parse_ratings(path: str, max_rows: int | None = None):
+    """Parse a ratings file into ``(users, items, ratings)`` int32/float32.
+
+    Returns ``None`` if the native library is unavailable (caller falls back
+    to numpy) or the file cannot be read. Raises ``ValueError`` if any
+    data-looking line fails to parse — a corrupted file must not silently
+    yield a truncated dataset. Ids are returned verbatim (1-based in
+    MovieLens files; the caller re-indexes).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    if max_rows is None:
+        # Upper bound: number of newlines (cheap single pass in Python).
+        try:
+            with open(path, "rb") as f:
+                max_rows = sum(chunk.count(b"\n") for chunk in iter(
+                    lambda: f.read(1 << 20), b"")) + 1
+        except OSError:
+            return None
+    users = np.empty(max_rows, np.int32)
+    items = np.empty(max_rows, np.int32)
+    ratings = np.empty(max_rows, np.float32)
+    malformed = ctypes.c_long(0)
+    n = lib.fps_parse_ratings(
+        path.encode(),
+        _ptr(users, ctypes.c_int32),
+        _ptr(items, ctypes.c_int32),
+        _ptr(ratings, ctypes.c_float),
+        max_rows,
+        ctypes.byref(malformed),
+    )
+    if n < 0:
+        return None
+    if malformed.value:
+        raise ValueError(
+            f"{path}: {malformed.value} malformed data line(s) — refusing "
+            "to return a silently-truncated dataset"
+        )
+    return users[:n], items[:n], ratings[:n]
+
+
+def skipgram_pairs(
+    tokens: np.ndarray,
+    window: int,
+    seed: int,
+    keep_p: np.ndarray | None = None,
+):
+    """Generate (centers, contexts) for one token segment.
+
+    Subsampling keeps position ``t`` with probability ``keep_p[token[t]]``;
+    each kept position draws a half-width in ``1..window`` and emits both
+    pair directions (matching the numpy implementation in
+    ``fps_tpu/models/word2vec.py``). Deterministic per seed. Returns
+    ``None`` when the native library is unavailable.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    tokens = np.ascontiguousarray(tokens, np.int32)
+    n = len(tokens)
+    cap = 2 * window * max(n, 1)
+    centers = np.empty(cap, np.int32)
+    contexts = np.empty(cap, np.int32)
+    if keep_p is not None:
+        keep_p = np.ascontiguousarray(keep_p, np.float32)
+        vocab = len(keep_p)
+        kp_ptr = _ptr(keep_p, ctypes.c_float)
+    else:
+        vocab = 0
+        kp_ptr = ctypes.POINTER(ctypes.c_float)()
+    m = lib.fps_skipgram_pairs(
+        _ptr(tokens, ctypes.c_int32),
+        n,
+        window,
+        seed & 0xFFFFFFFFFFFFFFFF,
+        kp_ptr,
+        vocab,
+        _ptr(centers, ctypes.c_int32),
+        _ptr(contexts, ctypes.c_int32),
+        cap,
+    )
+    if m < 0:
+        return None
+    return centers[:m], contexts[:m]

@@ -30,6 +30,7 @@ Estimates (standard guarantees):
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +41,7 @@ Array = jax.Array
 _PRIME = np.uint32(2654435761)  # Knuth multiplicative constant
 
 
+@functools.lru_cache(maxsize=None)
 def _hash_constants(seed: int, depth: int) -> tuple[np.ndarray, np.ndarray]:
     rng = np.random.default_rng(seed)
     a = (rng.integers(1, 2**31, depth, dtype=np.int64) * 2 + 1).astype(np.uint32)

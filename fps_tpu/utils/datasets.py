@@ -61,14 +61,19 @@ def load_movielens(path: str | None = None, scale: str = "100k"):
     20m -> (138_493, 26_744, 20_000_263) like ML-20M.
     """
     if path and os.path.exists(path):
-        raw = np.loadtxt(path, dtype=np.int64)
-        users = raw[:, 0].astype(np.int32) - 1
-        items = raw[:, 1].astype(np.int32) - 1
-        data = {
-            "user": users,
-            "item": items,
-            "rating": raw[:, 2].astype(np.float32),
-        }
+        from fps_tpu import native
+
+        parsed = native.parse_ratings(path)
+        if parsed is not None:
+            users, items, ratings = parsed
+            users = users - 1
+            items = items - 1
+        else:  # no compiler on this host: numpy fallback
+            raw = np.loadtxt(path, dtype=np.int64)
+            users = raw[:, 0].astype(np.int32) - 1
+            items = raw[:, 1].astype(np.int32) - 1
+            ratings = raw[:, 2].astype(np.float32)
+        data = {"user": users, "item": items, "rating": ratings}
         return data, int(users.max()) + 1, int(items.max()) + 1
     sizes = {
         "100k": (943, 1682, 100_000),
