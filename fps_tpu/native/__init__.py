@@ -80,6 +80,8 @@ def _load():
             lib = ctypes.CDLL(_LIB)
         except OSError:
             return None
+        lib.fps_count_lines.restype = ctypes.c_long
+        lib.fps_count_lines.argtypes = [ctypes.c_char_p]
         lib.fps_parse_ratings.restype = ctypes.c_long
         lib.fps_parse_ratings.argtypes = [
             ctypes.c_char_p,
@@ -126,13 +128,12 @@ def parse_ratings(path: str, max_rows: int | None = None):
     if lib is None:
         return None
     if max_rows is None:
-        # Upper bound: number of newlines (cheap single pass in Python).
-        try:
-            with open(path, "rb") as f:
-                max_rows = sum(chunk.count(b"\n") for chunk in iter(
-                    lambda: f.read(1 << 20), b"")) + 1
-        except OSError:
+        # Upper bound: line count, native single pass (the parse pass that
+        # follows then reads a page-cache-warm file).
+        max_rows = lib.fps_count_lines(path.encode())
+        if max_rows < 0:
             return None
+        max_rows = max(int(max_rows), 1)
     users = np.empty(max_rows, np.int32)
     items = np.empty(max_rows, np.int32)
     ratings = np.empty(max_rows, np.float32)

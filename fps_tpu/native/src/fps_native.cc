@@ -78,14 +78,38 @@ inline void skip_line(const char*& p, const char* end) {
 
 }  // namespace
 
+// Count newline-terminated lines in a file (capacity sizing for
+// fps_parse_ratings — keeps the whole "how many rows might this file have"
+// question on the native side, one warm-cache read instead of a Python
+// chunk loop). Returns -1 if the file cannot be read.
+long fps_count_lines(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  char buf[1 << 20];
+  long lines = 0;
+  size_t got;
+  char last = '\n';
+  while ((got = fread(buf, 1, sizeof(buf), f)) > 0) {
+    for (size_t i = 0; i < got; ++i)
+      if (buf[i] == '\n') ++lines;
+    last = buf[got - 1];
+  }
+  fclose(f);
+  if (last != '\n') ++lines;  // unterminated final line
+  return lines;
+}
+
 // Parse a ratings file: lines of "user sep item sep rating [sep extra...]"
-// with sep in {tab, comma, space}; lines not starting with a digit (headers,
-// comments) are skipped without being counted as errors. Lines that START
-// like data but fail mid-parse are counted in *malformed so the caller can
-// refuse silently-truncated datasets. user/item are written verbatim
+// with sep in {tab, comma, space}. '#'-leading lines are comments and are
+// skipped anywhere (np.loadtxt convention). Other non-digit-leading lines
+// are treated as skippable headers ONLY before the first data row; after
+// data has started they count in *malformed, as do lines that start like
+// data but fail mid-parse. A file that yields ZERO data rows but had
+// header-skipped lines also reports them as malformed — a quoted-field csv
+// must error, not parse to an empty dataset. user/item are written verbatim
 // (caller re-indexes). Returns rows written, or -1 if the file cannot be
 // read. Writes at most cap rows. Whole-file buffered manual scanner —
-// per-line stdio + strtol measured ~7x slower on ML-20M-sized files.
+// per-line stdio + strtol measured ~7x slower on ML-20M files.
 long fps_parse_ratings(const char* path, int32_t* users, int32_t* items,
                        float* ratings, long cap, long* malformed) {
   FILE* f = fopen(path, "rb");
@@ -102,6 +126,7 @@ long fps_parse_ratings(const char* path, int32_t* users, int32_t* items,
   fclose(f);
   const char* p = buf;
   const char* end = buf + got;
+  long headers = 0;
   long n = 0;
   long bad = 0;
   while (n < cap && p < end) {
@@ -111,7 +136,16 @@ long fps_parse_ratings(const char* path, int32_t* users, int32_t* items,
       ++p;
       continue;
     }
-    if (!is_digit(*p)) {  // header / comment line
+    if (*p == '#') {  // comment line, valid anywhere
+      skip_line(p, end);
+      continue;
+    }
+    if (!is_digit(*p)) {
+      if (n == 0) {
+        ++headers;  // header line before any data
+      } else {
+        ++bad;  // non-data line mid-file: corrupt, not a header
+      }
       skip_line(p, end);
       continue;
     }
@@ -132,6 +166,7 @@ long fps_parse_ratings(const char* path, int32_t* users, int32_t* items,
     skip_line(p, end);
   }
   free(buf);
+  if (n == 0 && headers > 0) bad += headers;  // all-header file: not data
   if (malformed) *malformed = bad;
   return n;
 }

@@ -1,0 +1,55 @@
+"""Host-environment helpers for multi-device CPU meshes.
+
+This container's sitecustomize (on PYTHONPATH) eagerly registers the
+single-chip TPU backend at interpreter start, before any user code can
+choose a platform. Running anything that needs an n-device mesh (tests,
+the driver's multi-chip dryrun) therefore requires a fresh process with a
+cleaned environment. This is the single home for that recipe — both
+tests/conftest.py and __graft_entry__.py use it.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+# Marker set in child processes spawned with cpu_mesh_env(); holds the
+# device count the child was spawned with, so callers can tell "already
+# re-exec'd at this count — spawning again would loop" apart from "re-exec'd
+# for a smaller mesh — spawning with a larger count is fine".
+REEXEC_MARK = "_FPS_TPU_CPU_MESH_REEXEC"
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def cpu_mesh_env(n_devices: int, env: dict | None = None) -> dict:
+    """Return a copy of ``env`` cleaned for an ``n_devices`` CPU mesh.
+
+    Strips the sitecustomize dir from PYTHONPATH, forces JAX_PLATFORMS=cpu,
+    drops the TPU pool variable, and force-sets (not merely appends) the
+    host-platform device count — a pre-existing count of the wrong size must
+    not win.
+    """
+    env = dict(os.environ if env is None else env)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = re.sub(rf"{_COUNT_FLAG}=\d+", "", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = f"{flags} {_COUNT_FLAG}={n_devices}".strip()
+    env[REEXEC_MARK] = str(n_devices)
+    return env
+
+
+def in_reexec() -> bool:
+    return REEXEC_MARK in os.environ
+
+
+def reexec_count() -> int:
+    """Device count of the cleaned re-exec this process runs in (0 if none)."""
+    try:
+        return int(os.environ.get(REEXEC_MARK, "0"))
+    except ValueError:
+        return 0

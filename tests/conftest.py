@@ -12,31 +12,35 @@ CPU platform from inside this process. So on first import we re-exec pytest
 in a cleaned environment (no sitecustomize on PYTHONPATH, JAX_PLATFORMS=cpu).
 """
 
+import importlib.util
 import os
 import sys
 
 import pytest
 
-_MARK = "_FPS_TPU_TEST_REEXEC"
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Repo root on sys.path so `import fps_tpu` works without an install step.
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, _ROOT)
+
+
+def _hostenv():
+    # Load by file path, NOT `import fps_tpu...`: the package __init__ pulls
+    # in jax, and the whole point of the re-exec is that jax must not be
+    # imported in this dirty (sitecustomize'd) parent process.
+    spec = importlib.util.spec_from_file_location(
+        "_fps_hostenv", os.path.join(_ROOT, "fps_tpu", "utils", "hostenv.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def pytest_configure(config):
-    if os.environ.get(_MARK) == "1":
+    hostenv = _hostenv()
+    if hostenv.in_reexec():
         return
-    env = dict(os.environ)
-    env[_MARK] = "1"
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and "axon" not in p
-    )
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env = hostenv.cpu_mesh_env(8)
     # Restore the real stdout/stderr fds before exec'ing, otherwise the new
     # process inherits pytest's capture temp-files and all output is lost.
     capman = config.pluginmanager.getplugin("capturemanager")

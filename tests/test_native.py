@@ -42,6 +42,28 @@ def test_parse_ratings_formats(lib, tmp_path):
     with pytest.raises(ValueError, match="malformed"):
         lib.parse_ratings(str(p3))
 
+    # A quoted-field csv must raise too — every line is non-digit-leading,
+    # so nothing may be silently skipped as a "header".
+    p4 = tmp_path / "quoted.csv"
+    p4.write_text('"userId","movieId","rating"\n' + "".join(
+        f'"{k}","{k+1}","3.5"\n' for k in range(20)))
+    with pytest.raises(ValueError, match="malformed"):
+        lib.parse_ratings(str(p4))
+
+    # Non-digit garbage after data has started is malformed, not a header.
+    p5 = tmp_path / "midfile.data"
+    p5.write_text("1\t2\t3\noops line\n5\t6\t1\n")
+    with pytest.raises(ValueError, match="malformed"):
+        lib.parse_ratings(str(p5))
+
+    # '#' comments are valid anywhere, including a long preamble.
+    p6 = tmp_path / "commented.data"
+    p6.write_text("".join(f"# preamble {k}\n" for k in range(10))
+                  + "1\t2\t3\n# interlude\n4\t5\t2\n")
+    u, i, r = lib.parse_ratings(str(p6))
+    np.testing.assert_array_equal(u, [1, 4])
+    np.testing.assert_allclose(r, [3.0, 2.0])
+
 
 def test_parse_ratings_matches_loadtxt(lib, tmp_path):
     rng = np.random.default_rng(0)
