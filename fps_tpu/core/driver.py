@@ -251,6 +251,122 @@ class Trainer:
             self._compiled[key] = self._build_chunk_fn(mode)
         return self._compiled[key]
 
+    # -- index-fed epochs (ingest fused into the compiled loop) -----------
+
+    def _build_indexed_fn(self, plan, mode: str):
+        """One jitted program running a FULL epoch: per-step batches are
+        gathered from the device-resident dataset inside the scan, so an
+        epoch costs a single dispatch and zero host↔device traffic
+        (:class:`fps_tpu.core.device_ingest.DeviceEpochPlan`)."""
+        T = plan.steps_per_epoch
+        s = self.config.sync_every
+
+        def epoch_device(tables, local_state, iargs, key):
+            widx = worker_index()
+            key = jax.random.fold_in(key, widx)
+
+            def step_t(carry, t, snapshot=None):
+                tables, local_state, key = carry
+                key, sub = jax.random.split(key)
+                batch = plan.local_batch_at(iargs, widx, t)
+                if snapshot is None:
+                    tables, local_state, out = self._sync_step(
+                        tables, local_state, batch, sub
+                    )
+                else:
+                    tables, local_state, out = self._snapshot_step(
+                        tables, snapshot, local_state, batch, sub
+                    )
+                out = jax.tree.map(
+                    lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
+                )
+                return (tables, local_state, key), out
+
+            if mode == "sync":
+                (tables, local_state, _), outs = lax.scan(
+                    step_t, (tables, local_state, key),
+                    jnp.arange(T, dtype=jnp.int32),
+                )
+                return tables, local_state, outs
+
+            def round_body(carry, r):
+                tables, local_state, key = carry
+                snapshot = {
+                    name: lax.all_gather(tb, SHARD_AXIS, tiled=True)
+                    for name, tb in tables.items()
+                }
+                (tables, local_state, key), outs = lax.scan(
+                    lambda c, t: step_t(c, t, snapshot),
+                    (tables, local_state, key),
+                    r * s + jnp.arange(s, dtype=jnp.int32),
+                )
+                return (tables, local_state, key), outs
+
+            (tables, local_state, _), outs = lax.scan(
+                round_body, (tables, local_state, key),
+                jnp.arange(T // s, dtype=jnp.int32),
+            )
+            outs = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), outs)
+            return tables, local_state, outs
+
+        table_specs = {name: P(SHARD_AXIS, None) for name in self.store.specs}
+        ls_spec = P(WORKER_AXES)
+
+        def run(tables, local_state, iargs, key):
+            shmapped = jax.shard_map(
+                epoch_device,
+                mesh=self.mesh,
+                in_specs=(
+                    table_specs,
+                    jax.tree.map(lambda _: ls_spec, local_state),
+                    jax.tree.map(lambda _: P(), iargs),
+                    P(),
+                ),
+                out_specs=(
+                    table_specs,
+                    jax.tree.map(lambda _: ls_spec, local_state),
+                    P(),
+                ),
+                check_vma=False,
+            )
+            return shmapped(tables, local_state, iargs, key)
+
+        donate = (0, 1) if self.config.donate else ()
+        return jax.jit(run, donate_argnums=donate)
+
+    def run_indexed(self, tables, local_state, plan, key, *, epochs: int = 1,
+                    on_epoch=None):
+        """Run ``epochs`` full passes with ingest fused into the jit.
+
+        ``plan.sync_every`` must match the trainer's config. Returns
+        (tables, local_state, per-epoch host metrics list).
+        """
+        mode = "sync" if self.config.sync_every is None else "ssp"
+        if (self.config.sync_every or None) != (plan.sync_every or None):
+            raise ValueError("plan.sync_every must match TrainerConfig")
+        # Keyed on the plan object itself (its geometry is baked into the
+        # compiled program as constants, so identity is the correct key).
+        ck = ("indexed", mode, plan, ops.get_backend())
+        if ck not in self._compiled:
+            self._compiled[ck] = self._build_indexed_fn(plan, mode)
+        fn = self._compiled[ck]
+        all_metrics = []
+        for e in range(epochs):
+            iargs = plan.epoch_args(e)
+            ekey = jax.device_put(
+                jax.random.fold_in(key, e), self._replicated
+            )
+            tables, local_state, metrics = fn(tables, local_state, iargs, ekey)
+            all_metrics.append(metrics)
+            if on_epoch is not None:
+                host = jax.tree.map(np.asarray, metrics)
+                all_metrics[-1] = host
+                on_epoch(e, host)
+        self.store.tables = dict(tables)
+        if on_epoch is None:
+            all_metrics = [jax.tree.map(np.asarray, m) for m in all_metrics]
+        return tables, local_state, all_metrics
+
     # -- host API ---------------------------------------------------------
 
     def run_chunk(self, tables, local_state, batches, key):
@@ -318,7 +434,10 @@ class Trainer:
         ``on_chunk(step, metrics)`` is called after every chunk with the
         host-side metrics pytree — the live tap on the reference's ``WOut``
         observability stream (per-chunk progress reporting, early stopping
-        via raising, etc.).
+        via raising, etc.). When no ``on_chunk`` is given, metrics stay on
+        device until the stream ends so the host never blocks mid-stream
+        and chunk dispatch pipelines (device-resident ingest then runs the
+        whole epoch without a single host↔device round trip).
         """
         all_metrics = []
         i = start_step - 1
@@ -327,10 +446,20 @@ class Trainer:
             tables, local_state, metrics = self.run_chunk(
                 tables, local_state, chunk, ckey
             )
-            host_metrics = jax.tree.map(np.asarray, metrics)
-            all_metrics.append(host_metrics)
             if on_chunk is not None:
+                host_metrics = jax.tree.map(np.asarray, metrics)
+                all_metrics.append(host_metrics)
                 on_chunk(i, host_metrics)
+            else:
+                # Deferred conversion keeps the dispatch pipeline full, but
+                # an unbounded stream must not accumulate device buffers (or
+                # run the host arbitrarily far ahead of the device): drain
+                # to host every few chunks.
+                all_metrics.append(metrics)
+                if (i - start_step) % 8 == 7:
+                    all_metrics[-8:] = [
+                        jax.tree.map(np.asarray, m) for m in all_metrics[-8:]
+                    ]
             if checkpointer is not None and checkpoint_every > 0 and (
                 (i + 1) % checkpoint_every == 0
             ):
@@ -339,6 +468,8 @@ class Trainer:
             checkpoint_every <= 0 or (i + 1) % checkpoint_every != 0
         ):
             checkpointer.save(i + 1, self.store, local_state)
+        if on_chunk is None:
+            all_metrics = [jax.tree.map(np.asarray, m) for m in all_metrics]
         if metrics_reduce is not None and all_metrics:
             return tables, local_state, metrics_reduce(all_metrics)
         return tables, local_state, all_metrics

@@ -85,15 +85,21 @@ inline void skip_line(const char*& p, const char* end) {
 long fps_count_lines(const char* path) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
-  char buf[1 << 20];
+  const size_t bufsz = 1 << 18;  // heap: callers may run on small-stack threads
+  char* buf = static_cast<char*>(malloc(bufsz));
+  if (!buf) {
+    fclose(f);
+    return -1;
+  }
   long lines = 0;
   size_t got;
   char last = '\n';
-  while ((got = fread(buf, 1, sizeof(buf), f)) > 0) {
+  while ((got = fread(buf, 1, bufsz, f)) > 0) {
     for (size_t i = 0; i < got; ++i)
       if (buf[i] == '\n') ++lines;
     last = buf[got - 1];
   }
+  free(buf);
   fclose(f);
   if (last != '\n') ++lines;  // unterminated final line
   return lines;
@@ -132,8 +138,8 @@ long fps_parse_ratings(const char* path, int32_t* users, int32_t* items,
   while (n < cap && p < end) {
     while (p < end && *p == ' ') ++p;
     if (p >= end) break;
-    if (*p == '\n') {  // empty line
-      ++p;
+    if (*p == '\n' || (*p == '\r' && (p + 1 >= end || p[1] == '\n'))) {
+      skip_line(p, end);  // empty line (LF or CRLF)
       continue;
     }
     if (*p == '#') {  // comment line, valid anywhere
