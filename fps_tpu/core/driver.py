@@ -64,6 +64,13 @@ class TrainerConfig:
 
     sync_every: int | None = None  # None => fully synchronous mode
     donate: bool = True
+    # Upper bound on scan steps per compiled call in run_indexed. A single
+    # device program must not run for minutes (the TPU runtime enforces a
+    # per-dispatch execution deadline — observed ~45s on tunneled chips,
+    # killing the worker process); epochs longer than this are split into
+    # several dispatches of one compiled program (trailing steps past the
+    # epoch are weight-0 no-ops, so every call has identical static shape).
+    max_steps_per_call: int | None = None
 
 
 class Trainer:
@@ -253,15 +260,27 @@ class Trainer:
 
     # -- index-fed epochs (ingest fused into the compiled loop) -----------
 
-    def _build_indexed_fn(self, plan, mode: str):
-        """One jitted program running a FULL epoch: per-step batches are
-        gathered from the device-resident dataset inside the scan, so an
-        epoch costs a single dispatch and zero host↔device traffic
-        (:class:`fps_tpu.core.device_ingest.DeviceEpochPlan`)."""
+    def _indexed_call_steps(self, plan) -> int:
+        """Steps per compiled call: the whole epoch, capped by
+        ``max_steps_per_call`` (rounded to a sync_every multiple)."""
         T = plan.steps_per_epoch
+        cap = self.config.max_steps_per_call
+        if cap is None or cap >= T:
+            return T
+        s = self.config.sync_every
+        if s:
+            cap = max(s, (cap // s) * s)
+        return cap
+
+    def _build_indexed_fn(self, plan, mode: str):
+        """One jitted program running (a slice of) an epoch: per-step
+        batches are gathered from the device-resident dataset inside the
+        scan, so an epoch costs a handful of dispatches and zero host↔device
+        traffic (:class:`fps_tpu.core.device_ingest.DeviceEpochPlan`)."""
+        T = self._indexed_call_steps(plan)
         s = self.config.sync_every
 
-        def epoch_device(tables, local_state, iargs, key):
+        def epoch_device(tables, local_state, iargs, start, key):
             widx = worker_index()
             key = jax.random.fold_in(key, widx)
 
@@ -285,7 +304,7 @@ class Trainer:
             if mode == "sync":
                 (tables, local_state, _), outs = lax.scan(
                     step_t, (tables, local_state, key),
-                    jnp.arange(T, dtype=jnp.int32),
+                    start + jnp.arange(T, dtype=jnp.int32),
                 )
                 return tables, local_state, outs
 
@@ -298,7 +317,7 @@ class Trainer:
                 (tables, local_state, key), outs = lax.scan(
                     lambda c, t: step_t(c, t, snapshot),
                     (tables, local_state, key),
-                    r * s + jnp.arange(s, dtype=jnp.int32),
+                    start + r * s + jnp.arange(s, dtype=jnp.int32),
                 )
                 return (tables, local_state, key), outs
 
@@ -312,7 +331,7 @@ class Trainer:
         table_specs = {name: P(SHARD_AXIS, None) for name in self.store.specs}
         ls_spec = P(WORKER_AXES)
 
-        def run(tables, local_state, iargs, key):
+        def run(tables, local_state, iargs, start, key):
             shmapped = jax.shard_map(
                 epoch_device,
                 mesh=self.mesh,
@@ -320,6 +339,7 @@ class Trainer:
                     table_specs,
                     jax.tree.map(lambda _: ls_spec, local_state),
                     jax.tree.map(lambda _: P(), iargs),
+                    P(),
                     P(),
                 ),
                 out_specs=(
@@ -329,7 +349,7 @@ class Trainer:
                 ),
                 check_vma=False,
             )
-            return shmapped(tables, local_state, iargs, key)
+            return shmapped(tables, local_state, iargs, start, key)
 
         donate = (0, 1) if self.config.donate else ()
         return jax.jit(run, donate_argnums=donate)
@@ -350,13 +370,26 @@ class Trainer:
         if ck not in self._compiled:
             self._compiled[ck] = self._build_indexed_fn(plan, mode)
         fn = self._compiled[ck]
+        T = plan.steps_per_epoch
+        T_call = self._indexed_call_steps(plan)
+        n_calls = -(-T // T_call)
         all_metrics = []
         for e in range(epochs):
             iargs = plan.epoch_args(e)
-            ekey = jax.device_put(
-                jax.random.fold_in(key, e), self._replicated
+            parts = []
+            for ci in range(n_calls):
+                ckey = jax.device_put(
+                    jax.random.fold_in(jax.random.fold_in(key, e), ci),
+                    self._replicated,
+                )
+                start = jnp.int32(ci * T_call)
+                tables, local_state, metrics = fn(
+                    tables, local_state, iargs, start, ckey
+                )
+                parts.append(metrics)
+            metrics = parts[0] if len(parts) == 1 else jax.tree.map(
+                lambda *xs: jnp.concatenate(xs), *parts
             )
-            tables, local_state, metrics = fn(tables, local_state, iargs, ekey)
             all_metrics.append(metrics)
             if on_epoch is not None:
                 host = jax.tree.map(np.asarray, metrics)

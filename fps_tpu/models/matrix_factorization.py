@@ -124,7 +124,7 @@ def make_store(mesh, cfg: MFConfig) -> ParamStore:
 
 
 def online_mf(mesh, cfg: MFConfig, *, sync_every: int | None = None,
-              donate: bool = True):
+              donate: bool = True, max_steps_per_call: int | None = None):
     """Construct (trainer, store) for online MF — the analog of
     ``PSOnlineMatrixFactorization.psOnlineMF(...)``."""
     from fps_tpu.core.driver import Trainer, TrainerConfig, num_workers_of
@@ -133,7 +133,8 @@ def online_mf(mesh, cfg: MFConfig, *, sync_every: int | None = None,
     worker = MatrixFactorizationWorker(cfg, num_workers_of(mesh))
     trainer = Trainer(
         mesh, store, worker,
-        config=TrainerConfig(sync_every=sync_every, donate=donate),
+        config=TrainerConfig(sync_every=sync_every, donate=donate,
+                             max_steps_per_call=max_steps_per_call),
     )
     return trainer, store
 

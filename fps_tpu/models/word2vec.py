@@ -42,6 +42,41 @@ IN_TABLE = "in_embeddings"
 OUT_TABLE = "out_embeddings"
 
 
+def _keep_probs(cfg: W2VConfig, unigram_counts: np.ndarray) -> np.ndarray:
+    """Per-token keep probability min(1, sqrt(t/f)) — word2vec's frequent-word
+    subsampling; ones when ``cfg.subsample_t`` is None. Single source of
+    truth for the host ingest and device-plan paths."""
+    counts = np.asarray(unigram_counts, np.float64)
+    freq = counts / max(1.0, counts.sum())
+    if cfg.subsample_t is None:
+        return np.ones_like(freq)
+    return np.minimum(1.0, np.sqrt(cfg.subsample_t / np.maximum(freq, 1e-12)))
+
+
+def _build_alias(p: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Vose alias tables for a discrete distribution ``p`` (sums to 1).
+
+    Returns (prob, alias): draw ``j ~ U{0..V-1}``, ``u ~ U[0,1)``; the
+    sample is ``j`` if ``u < prob[j]`` else ``alias[j]``.
+    """
+    V = len(p)
+    prob = np.zeros(V)
+    alias = np.zeros(V, np.int64)
+    scaled = np.asarray(p, np.float64) * V
+    small = [i for i in range(V) if scaled[i] < 1.0]
+    large = [i for i in range(V) if scaled[i] >= 1.0]
+    while small and large:
+        s = small.pop()
+        l = large.pop()
+        prob[s] = scaled[s]
+        alias[s] = l
+        scaled[l] = scaled[l] - (1.0 - scaled[s])
+        (small if scaled[l] < 1.0 else large).append(l)
+    for i in large + small:
+        prob[i] = 1.0
+    return prob, alias
+
+
 @dataclasses.dataclass
 class W2VConfig:
     vocab_size: int
@@ -62,13 +97,22 @@ class Word2VecWorker(WorkerLogic):
         self.cfg = cfg
         p = np.asarray(unigram_counts, np.float64) ** cfg.neg_power
         p /= p.sum()
-        self._neg_cdf = jnp.asarray(np.cumsum(p), jnp.float32)
+        # Alias-method tables (Vose): O(1) per draw on device — two gathers
+        # and a compare. searchsorted over the CDF measured ~27ms per 200k
+        # draws on TPU; the alias sampler is ~100x cheaper.
+        prob, alias = _build_alias(p)
+        self._alias_prob = jnp.asarray(prob, jnp.float32)
+        self._alias_idx = jnp.asarray(alias, jnp.int32)
 
     def prepare(self, batch, key):
         B = batch["center"].shape[0]
-        u = jax.random.uniform(key, (B, self.cfg.negatives))
-        negs = jnp.searchsorted(self._neg_cdf, u).astype(jnp.int32)
-        negs = jnp.minimum(negs, self.cfg.vocab_size - 1)
+        k1, k2 = jax.random.split(key)
+        j = jax.random.randint(
+            k1, (B, self.cfg.negatives), 0, self.cfg.vocab_size, jnp.int32
+        )
+        u = jax.random.uniform(k2, (B, self.cfg.negatives))
+        negs = jnp.where(u < jnp.take(self._alias_prob, j),
+                         j, jnp.take(self._alias_idx, j))
         return dict(batch, negatives=negs)
 
     def pull_ids(self, batch) -> Mapping[str, Array]:
@@ -142,7 +186,8 @@ def make_store(mesh, cfg: W2VConfig) -> ParamStore:
 
 
 def word2vec(mesh, cfg: W2VConfig, unigram_counts: np.ndarray, *,
-             sync_every: int | None = None, donate: bool = True):
+             sync_every: int | None = None, donate: bool = True,
+             max_steps_per_call: int | None = None):
     """(trainer, store) — the analog of the reference's word2vec transform."""
     from fps_tpu.core.api import MEAN_COMBINE
     from fps_tpu.core.driver import Trainer, TrainerConfig
@@ -154,7 +199,8 @@ def word2vec(mesh, cfg: W2VConfig, unigram_counts: np.ndarray, *,
     # each touched row one stable step per batch (NuPS-style skew handling).
     trainer = Trainer(
         mesh, store, worker, server_logic=MEAN_COMBINE,
-        config=TrainerConfig(sync_every=sync_every, donate=donate),
+        config=TrainerConfig(sync_every=sync_every, donate=donate,
+                             max_steps_per_call=max_steps_per_call),
     )
     return trainer, store
 
@@ -201,14 +247,7 @@ def skipgram_chunks(
             f"token id {int(np.max(tokens))} >= vocab "
             f"{len(unigram_counts)} (unigram_counts too small)"
         )
-    counts = np.asarray(unigram_counts, np.float64)
-    freq = counts / max(1.0, counts.sum())
-    if cfg.subsample_t is not None:
-        keep_p = np.minimum(
-            1.0, np.sqrt(cfg.subsample_t / np.maximum(freq, 1e-12))
-        )
-    else:
-        keep_p = np.ones_like(freq)
+    keep_p = _keep_probs(cfg, unigram_counts)
 
     B = num_workers * local_batch
     stride = steps_per_chunk * B
@@ -305,3 +344,117 @@ def nearest_neighbors(store: ParamStore, word_ids: np.ndarray, k: int = 5,
     sims = q @ emb.T
     order = np.argsort(-sims, axis=1)
     return order[:, 1 : k + 1], np.take_along_axis(sims, order, 1)[:, 1 : k + 1]
+
+
+# ---------------------------------------------------------------------------
+# Device-resident SGNS epochs: pair generation fused into the compiled loop.
+# ---------------------------------------------------------------------------
+
+class Word2VecDevicePlan:
+    """Epoch plan generating skip-gram pairs ON DEVICE for ``run_indexed``.
+
+    The host streaming path (:func:`skipgram_chunks`) materializes and
+    uploads every (center, context) chunk — dominated by the host→device
+    link on a TPU VM. Here the raw token stream is uploaded once; each
+    epoch then runs as ONE compiled program that:
+
+    1. **subsamples + compacts** the stream on device (uniform-vs-keep_p
+       mask → cumsum → scatter), exactly word2vec's semantics where
+       dropped tokens vanish from the stream *before* windows apply;
+    2. **generates pairs inside the training scan**: worker ``w``'s step
+       ``t`` takes a block of ``block_len`` compacted tokens, draws a
+       dynamic half-window ``U{1..window}`` per center, and emits the
+       ``2 * window * block_len`` candidate pairs (both orientations per
+       ordered adjacency, like the host path) with validity weights;
+    3. trains the usual SGNS step (negatives drawn in ``prepare``).
+
+    The per-epoch kept-token count is random on device, so the epoch is
+    sized from its host-computable expectation ``sum(keep_p[tokens])``
+    plus a generous slack; the overflow probability is negligible and any
+    overflow tokens are dropped (one-pass streaming semantics).
+    """
+
+    def __init__(self, dataset_tokens: np.ndarray, unigram_counts: np.ndarray,
+                 cfg: W2VConfig, mesh, *, num_workers: int,
+                 block_len: int = 8192, seed: int = 0,
+                 sync_every: int | None = None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.cfg = cfg
+        self.num_workers = num_workers
+        self.block_len = block_len
+        self.local_batch = 2 * cfg.window * block_len  # pairs per step
+        self.seed = seed
+        self.sync_every = sync_every
+        self.num_tokens = int(len(dataset_tokens))
+
+        replicated = NamedSharding(mesh, P())
+        self._tokens = jax.device_put(
+            np.asarray(dataset_tokens, np.int32), replicated
+        )
+        keep_p = _keep_probs(cfg, unigram_counts)
+        self._keep_p = jax.device_put(keep_p.astype(np.float32), replicated)
+
+        expected_kept = float(keep_p[np.asarray(dataset_tokens)].sum())
+        bound = int(expected_kept + 8.0 * np.sqrt(expected_kept + 1.0) + 1024)
+        bound = min(bound, self.num_tokens)
+        per_worker = -(-bound // (block_len * num_workers))
+        steps = max(1, per_worker)
+        if sync_every:
+            steps = -(-steps // sync_every) * sync_every
+        self.steps_per_epoch = steps
+        # Compacted buffer: every block slice (+ window lookahead) in range.
+        self._buf_len = steps * block_len * num_workers + cfg.window
+
+        W = cfg.window
+        buf_len = self._buf_len
+
+        def compact(key):
+            toks = self._tokens
+            keep = (jax.random.uniform(key, toks.shape)
+                    < jnp.take(self._keep_p, toks))
+            dest = jnp.cumsum(keep.astype(jnp.int32)) - 1
+            kept = dest[-1] + 1
+            dest = jnp.where(keep, jnp.minimum(dest, buf_len - 1), buf_len)
+            compacted = jnp.zeros((buf_len + 1,), jnp.int32)
+            compacted = compacted.at[dest].set(toks, mode="drop")
+            return compacted[:buf_len], jnp.minimum(kept, buf_len)
+
+        self._compact_jit = jax.jit(compact)
+        self._replicated = replicated
+
+    def epoch_args(self, epoch: int):
+        ekey = jax.random.fold_in(jax.random.key(self.seed), epoch)
+        ck, wk = jax.random.split(ekey)
+        compacted, kept = self._compact_jit(ck)
+        return {
+            # Placed on the replicated sharding up front so run_indexed's
+            # dispatches don't re-broadcast the (tokens,)-sized buffer.
+            "compacted": jax.device_put(compacted, self._replicated),
+            "kept": jax.device_put(kept, self._replicated),
+            "wkey": jax.device_put(wk, self._replicated),
+        }
+
+    def local_batch_at(self, args, w, t):
+        """(center, context, weight) pairs for worker ``w``, step ``t``."""
+        L, W = self.block_len, self.cfg.window
+        base = (t * self.num_workers + w) * L
+        block = jax.lax.dynamic_slice(args["compacted"], (base,), (L + W,))
+        key = jax.random.fold_in(args["wkey"], t * self.num_workers + w)
+        half = jax.random.randint(key, (L,), 1, W + 1, dtype=jnp.int32)
+        pos = jnp.arange(L, dtype=jnp.int32)
+
+        centers, contexts, valids = [], [], []
+        for d in range(1, W + 1):
+            c = block[:L]
+            x = jax.lax.dynamic_slice(block, (d,), (L,))
+            ok = (half >= d) & (base + pos + d < args["kept"])
+            # both orientations of each ordered adjacency, like word2vec
+            centers += [c, x]
+            contexts += [x, c]
+            valids += [ok, ok]
+        return {
+            "center": jnp.concatenate(centers),
+            "context": jnp.concatenate(contexts),
+            "weight": jnp.concatenate(valids).astype(jnp.float32),
+        }
