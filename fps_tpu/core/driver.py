@@ -269,6 +269,16 @@ class Trainer:
             return T
         s = self.config.sync_every
         if s:
+            if s > cap:
+                import warnings
+
+                warnings.warn(
+                    f"sync_every={s} exceeds max_steps_per_call={cap}; "
+                    "dispatches must contain whole SSP rounds, so each call "
+                    f"runs {s} steps — lower sync_every if this risks the "
+                    "per-dispatch execution deadline",
+                    stacklevel=3,
+                )
             cap = max(s, (cap // s) * s)
         return cap
 
@@ -390,6 +400,10 @@ class Trainer:
             metrics = parts[0] if len(parts) == 1 else jax.tree.map(
                 lambda *xs: jnp.concatenate(xs), *parts
             )
+            # Drop phantom trailing steps from the last (padded) call so
+            # metrics always have exactly steps_per_epoch rows.
+            if n_calls * T_call > T:
+                metrics = jax.tree.map(lambda x: x[:T], metrics)
             all_metrics.append(metrics)
             if on_epoch is not None:
                 host = jax.tree.map(np.asarray, metrics)

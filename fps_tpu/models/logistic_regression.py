@@ -85,14 +85,16 @@ def make_store(mesh, cfg: LogRegConfig) -> ParamStore:
 
 
 def logistic_regression(mesh, cfg: LogRegConfig, *,
-                        sync_every: int | None = None, donate: bool = True):
+                        sync_every: int | None = None, donate: bool = True,
+                        max_steps_per_call: int | None = None):
     """(trainer, store); pass ``sync_every=s`` for SSP bounded staleness."""
     from fps_tpu.core.driver import Trainer, TrainerConfig
 
     store = make_store(mesh, cfg)
     trainer = Trainer(
         mesh, store, LogisticRegressionWorker(cfg),
-        config=TrainerConfig(sync_every=sync_every, donate=donate),
+        config=TrainerConfig(sync_every=sync_every, donate=donate,
+                             max_steps_per_call=max_steps_per_call),
     )
     return trainer, store
 

@@ -196,7 +196,8 @@ def make_store(mesh, cfg: PAConfig) -> ParamStore:
 
 
 def passive_aggressive(mesh, cfg: PAConfig, *, sync_every: int | None = None,
-                       donate: bool = True):
+                       donate: bool = True,
+                       max_steps_per_call: int | None = None):
     """(trainer, store) — the analog of
     ``PassiveAggressiveParameterServer.transformBinary/transformMulticlass``."""
     from fps_tpu.core.driver import Trainer, TrainerConfig
@@ -209,7 +210,8 @@ def passive_aggressive(mesh, cfg: PAConfig, *, sync_every: int | None = None,
     )
     trainer = Trainer(
         mesh, store, worker,
-        config=TrainerConfig(sync_every=sync_every, donate=donate),
+        config=TrainerConfig(sync_every=sync_every, donate=donate,
+                             max_steps_per_call=max_steps_per_call),
     )
     return trainer, store
 

@@ -168,14 +168,18 @@ def synthetic_corpus(
 
 
 def load_text8(path: str | None = None, vocab_size: int = 50_000,
-               num_tokens: int = 2_000_000, seed: int = 0):
+               num_tokens: int | None = 2_000_000, seed: int = 0):
     """Load and tokenize text8 if present, else synthesize a Zipfian stream.
 
-    Returns (tokens int32 array, vocab_size, unigram_counts).
+    ``num_tokens`` sizes the synthetic stream and truncates a real file's
+    token stream (``None`` = use the whole file). Returns
+    (tokens int32 array, vocab_size, unigram_counts).
     """
     if path and os.path.exists(path):
         with open(path) as f:
             words = f.read().split()
+        if num_tokens is not None:
+            words = words[:num_tokens]
         from collections import Counter
 
         counts = Counter(words)
@@ -184,7 +188,7 @@ def load_text8(path: str | None = None, vocab_size: int = 50_000,
         tokens = np.fromiter((w2i.get(w, 0) for w in words), np.int32, len(words))
         uni = np.bincount(tokens, minlength=vocab_size).astype(np.float64)
         return tokens, vocab_size, uni
-    tokens = synthetic_corpus(vocab_size, num_tokens, seed=seed)
+    tokens = synthetic_corpus(vocab_size, num_tokens or 2_000_000, seed=seed)
     uni = np.bincount(tokens, minlength=vocab_size).astype(np.float64)
     return tokens, vocab_size, uni
 

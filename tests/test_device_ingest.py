@@ -140,3 +140,42 @@ def test_indexed_multi_epoch_converges(mesh, dataset):
     t, l, metrics = tr.run_indexed(t, l, plan, jax.random.key(1), epochs=4)
     rmse = [float(np.sqrt(m["se"].sum() / m["n"].sum())) for m in metrics]
     assert rmse[-1] < rmse[0] * 0.9, rmse
+
+
+def test_indexed_sparse_workload_ssp(mesh):
+    """DeviceEpochPlan handles 2-D columns (sparse feat_ids/feat_vals) and
+    the SSP indexed runner: Criteo-style logreg trains through run_indexed
+    with multi-call epochs."""
+    from fps_tpu.models.logistic_regression import (
+        LogRegConfig,
+        logistic_regression,
+        predict_proba_host,
+    )
+    from fps_tpu.utils.datasets import (
+        synthetic_sparse_classification,
+        train_test_split,
+    )
+
+    NF = 400
+    W = num_workers_of(mesh)
+    d = synthetic_sparse_classification(6000, NF, 8, seed=7, noise=0.05)
+    d = dict(d, label=(d["label"] > 0).astype(np.float32))
+    train, test = train_test_split(d)
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainer, store = logistic_regression(
+        mesh, cfg, sync_every=4, max_steps_per_call=8
+    )
+    tables, ls = trainer.init_state(jax.random.key(0))
+    ds = DeviceDataset(mesh, train)
+    plan = DeviceEpochPlan(
+        ds, num_workers=W, local_batch=32, sync_every=4, seed=3
+    )
+    assert plan.steps_per_epoch > 8  # multi-call epochs exercised
+    tables, ls, m = trainer.run_indexed(
+        tables, ls, plan, jax.random.key(1), epochs=6
+    )
+    # metrics sized exactly to the epoch, no phantom padded-call rows
+    assert m[0]["n"].shape[0] == plan.steps_per_epoch
+    p = predict_proba_host(store, test["feat_ids"], test["feat_vals"])
+    acc = float(np.mean((p > 0.5) == (test["label"] > 0.5)))
+    assert acc > 0.78, acc
