@@ -365,11 +365,15 @@ class Trainer:
         return jax.jit(run, donate_argnums=donate)
 
     def run_indexed(self, tables, local_state, plan, key, *, epochs: int = 1,
-                    on_epoch=None):
+                    on_epoch=None, checkpointer=None,
+                    checkpoint_every: int = 0):
         """Run ``epochs`` full passes with ingest fused into the jit.
 
-        ``plan.sync_every`` must match the trainer's config. Returns
-        (tables, local_state, per-epoch host metrics list).
+        ``plan.sync_every`` must match the trainer's config. Pass a
+        ``Checkpointer`` (+ ``checkpoint_every=k`` epochs) to snapshot
+        tables and local state every k epochs and once at the end, like
+        ``fit_stream`` does per chunk. Returns (tables, local_state,
+        per-epoch host metrics list).
         """
         mode = "sync" if self.config.sync_every is None else "ssp"
         if (self.config.sync_every or None) != (plan.sync_every or None):
@@ -409,7 +413,16 @@ class Trainer:
                 host = jax.tree.map(np.asarray, metrics)
                 all_metrics[-1] = host
                 on_epoch(e, host)
+            if checkpointer is not None and checkpoint_every > 0 and (
+                (e + 1) % checkpoint_every == 0
+            ):
+                self.store.tables = dict(tables)
+                checkpointer.save(e + 1, self.store, local_state)
         self.store.tables = dict(tables)
+        if checkpointer is not None and epochs > 0 and (
+            checkpoint_every <= 0 or epochs % checkpoint_every != 0
+        ):
+            checkpointer.save(epochs, self.store, local_state)
         if on_epoch is None:
             all_metrics = [jax.tree.map(np.asarray, m) for m in all_metrics]
         return tables, local_state, all_metrics

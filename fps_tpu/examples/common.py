@@ -34,7 +34,42 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     ap.add_argument("--warm-start", default=None,
                     help="initialize tables from a saved model .npz "
                          "(reference: transformWithModelLoad)")
+    ap.add_argument("--ingest", default="device", choices=["device", "host"],
+                    help="'device' keeps the dataset resident on the mesh "
+                         "and builds chunks with on-device gathers (fast "
+                         "path on TPU VMs); 'host' regenerates and uploads "
+                         "every chunk (the unbounded-stream shape)")
     return ap
+
+
+def make_chunks(args, mesh, data, *, route_key=None):
+    """Epoch chunk iterator honoring --ingest/--epochs/--local-batch/...
+
+    Both paths yield the same chunk contract, so fit_stream (with its
+    checkpointing and per-chunk reporting) drives either.
+    """
+    from fps_tpu.core.driver import num_workers_of
+
+    W = num_workers_of(mesh)
+    if args.ingest == "device":
+        from fps_tpu.core.device_ingest import (
+            DeviceDataset,
+            device_epoch_chunks,
+        )
+
+        return device_epoch_chunks(
+            DeviceDataset(mesh, data), num_workers=W,
+            local_batch=args.local_batch,
+            steps_per_chunk=args.steps_per_chunk, route_key=route_key,
+            sync_every=args.sync_every, seed=args.seed, epochs=args.epochs,
+        )
+    from fps_tpu.core.ingest import multi_epoch_chunks
+
+    return multi_epoch_chunks(
+        data, args.epochs, num_workers=W, local_batch=args.local_batch,
+        steps_per_chunk=args.steps_per_chunk, route_key=route_key,
+        sync_every=args.sync_every, seed=args.seed,
+    )
 
 
 def make_mesh(args):

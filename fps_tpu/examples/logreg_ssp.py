@@ -13,6 +13,7 @@ import numpy as np
 
 from fps_tpu.examples.common import (
     base_parser,
+    make_chunks,
     emit,
     finish,
     make_mesh,
@@ -34,7 +35,6 @@ def main(argv=None) -> int:
         args.sync_every = 8  # this entrypoint exists to exercise SSP
 
     from fps_tpu.core.driver import num_workers_of
-    from fps_tpu.core.ingest import multi_epoch_chunks
     from fps_tpu.models.logistic_regression import (
         LogRegConfig,
         logistic_regression,
@@ -62,11 +62,7 @@ def main(argv=None) -> int:
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
 
-    chunks = multi_epoch_chunks(
-        train, epochs=args.epochs, num_workers=W, local_batch=args.local_batch,
-        steps_per_chunk=args.steps_per_chunk, sync_every=args.sync_every,
-        seed=args.seed,
-    )
+    chunks = make_chunks(args, mesh, train)
     def report(i, m):
         n = max(1.0, float(np.sum(m["n"])))
         emit({"event": "chunk", "i": i,

@@ -16,6 +16,7 @@ from fps_tpu.examples.common import (
     base_parser,
     emit,
     finish,
+    make_chunks,
     make_mesh,
     maybe_checkpointer,
     maybe_warm_start,
@@ -34,7 +35,6 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from fps_tpu.core.driver import num_workers_of
-    from fps_tpu.core.ingest import multi_epoch_chunks
     from fps_tpu.models.matrix_factorization import (
         MFConfig,
         online_mf,
@@ -56,11 +56,7 @@ def main(argv=None) -> int:
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
 
-    chunks = multi_epoch_chunks(
-        train, epochs=args.epochs, num_workers=W, local_batch=args.local_batch,
-        steps_per_chunk=args.steps_per_chunk, route_key="user",
-        sync_every=args.sync_every, seed=args.seed,
-    )
+    chunks = make_chunks(args, mesh, train, route_key="user")
 
     def report(i, m):
         se, n = np.sum(m["se"]), max(1.0, np.sum(m["n"]))

@@ -27,8 +27,10 @@ from fps_tpu.examples.common import (
 def main(argv=None) -> int:
     ap = base_parser("word2vec SGNS on the TPU PS")
     ap.add_argument("--vocab-size", type=int, default=50_000)
-    ap.add_argument("--num-tokens", type=int, default=2_000_000,
-                    help="synthetic corpus length when no --input is given")
+    ap.add_argument("--num-tokens", type=int, default=None,
+                    help="truncate the corpus to this many tokens; sizes "
+                         "the synthetic stream when no --input is given "
+                         "(default: whole file / 2M synthetic)")
     ap.add_argument("--dim", type=int, default=100)
     ap.add_argument("--window", type=int, default=5)
     ap.add_argument("--negatives", type=int, default=5)
@@ -38,6 +40,7 @@ def main(argv=None) -> int:
     from fps_tpu.core.driver import num_workers_of
     from fps_tpu.models.word2vec import (
         W2VConfig,
+        Word2VecDevicePlan,
         nearest_neighbors,
         skipgram_chunks,
         word2vec,
@@ -53,7 +56,8 @@ def main(argv=None) -> int:
 
     cfg = W2VConfig(vocab_size=vocab, dim=args.dim, window=args.window,
                     negatives=args.negatives, learning_rate=args.learning_rate)
-    trainer, store = word2vec(mesh, cfg, uni, sync_every=args.sync_every)
+    trainer, store = word2vec(mesh, cfg, uni, sync_every=args.sync_every,
+                              max_steps_per_call=256)
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
 
@@ -66,23 +70,42 @@ def main(argv=None) -> int:
         emit({"event": "chunk", "i": i,
               "sgns_loss": float(np.sum(m["loss"]) / n)})
 
-    def all_epochs():
-        for epoch in range(args.epochs):
-            yield from skipgram_chunks(
-                tokens, uni, cfg, num_workers=W, local_batch=args.local_batch,
-                steps_per_chunk=args.steps_per_chunk,
-                sync_every=args.sync_every, seed=args.seed + epoch,
-            )
-
     t0 = time.perf_counter()
-    tables, local_state, _ = trainer.fit_stream(
-        tables, local_state, all_epochs(), jax.random.key(args.seed),
-        checkpointer=maybe_checkpointer(args),
-        checkpoint_every=args.checkpoint_every,
-        on_chunk=report,
-    )
+    if args.ingest == "device":
+        # Fused path: tokens resident on device, subsampling/compaction and
+        # pair generation inside the compiled epoch.
+        plan = Word2VecDevicePlan(
+            tokens, uni, cfg, mesh, num_workers=W,
+            block_len=max(64, args.local_batch // (2 * cfg.window)),
+            seed=args.seed, sync_every=args.sync_every,
+        )
+        tables, local_state, _ = trainer.run_indexed(
+            tables, local_state, plan, jax.random.key(args.seed),
+            epochs=args.epochs, on_epoch=report,
+            checkpointer=maybe_checkpointer(args),
+            # --checkpoint-every counts chunks on the host path; the fused
+            # path snapshots at epoch granularity when it is enabled at all.
+            checkpoint_every=1 if args.checkpoint_every > 0 else 0,
+        )
+    else:
+        def all_epochs():
+            for epoch in range(args.epochs):
+                yield from skipgram_chunks(
+                    tokens, uni, cfg, num_workers=W,
+                    local_batch=args.local_batch,
+                    steps_per_chunk=args.steps_per_chunk,
+                    sync_every=args.sync_every, seed=args.seed + epoch,
+                )
+
+        tables, local_state, _ = trainer.fit_stream(
+            tables, local_state, all_epochs(), jax.random.key(args.seed),
+            checkpointer=maybe_checkpointer(args),
+            checkpoint_every=args.checkpoint_every,
+            on_chunk=report,
+        )
     dt = time.perf_counter() - t0
     emit({"event": "done", "pairs_per_sec": total_pairs / max(dt, 1e-9),
+          "words_per_sec": args.epochs * len(tokens) / max(dt, 1e-9),
           "seconds": dt})
 
     # Qualitative: neighbors of a few frequent words (ids 1..4; 0 may be UNK).
