@@ -287,6 +287,7 @@ def device_epoch_chunks(
     sync_every: int | None = None,
     seed: int = 0,
     epochs: int = 1,
+    start_epoch: int = 0,
     shuffle: str | None = "interleave",
     plan: DeviceEpochPlan | None = None,
 ) -> Iterator[dict]:
@@ -297,7 +298,9 @@ def device_epoch_chunks(
     ``weight`` mask column, batch dim worker-major and sharded over the
     worker axes — but every leaf is already a committed jax array on the
     mesh, so the driver moves no bytes. Pass an existing ``plan`` to reuse
-    its compiled chunk builder across calls.
+    its compiled chunk builder across calls, with ``start_epoch`` selecting
+    which epoch's shuffle the pass replays (epoch identity is
+    ``fold_in(key(plan.seed), epoch)``, so restarts are reproducible).
     """
     if sync_every is not None and steps_per_chunk % sync_every:
         raise ValueError("steps_per_chunk must be a multiple of sync_every")
@@ -309,7 +312,7 @@ def device_epoch_chunks(
         )
     build = plan._chunk_builder(steps_per_chunk)
     steps_total = -(-plan.steps_per_epoch // steps_per_chunk) * steps_per_chunk
-    for epoch in range(epochs):
+    for epoch in range(start_epoch, start_epoch + epochs):
         args = plan.epoch_args(epoch)
         for start in range(0, steps_total, steps_per_chunk):
             yield build(args, jnp.int32(start))

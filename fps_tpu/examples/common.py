@@ -42,33 +42,60 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     return ap
 
 
-def make_chunks(args, mesh, data, *, route_key=None):
-    """Epoch chunk iterator honoring --ingest/--epochs/--local-batch/...
+def make_epoch_source(args, mesh, data, *, route_key=None, num_workers=None):
+    """Restartable chunk source honoring --ingest and the batching flags.
 
-    Both paths yield the same chunk contract, so fit_stream (with its
-    checkpointing and per-chunk reporting) drives either.
+    Returns ``source(start_epoch=0, epochs=1) -> chunk iterator``. The
+    device path builds the dataset and epoch plan ONCE, so repeated calls
+    (e.g. iALS consuming the stream twice per epoch) reuse the compiled
+    chunk builder instead of retracing it.
     """
     from fps_tpu.core.driver import num_workers_of
 
-    W = num_workers_of(mesh)
+    W = num_workers_of(mesh) if num_workers is None else num_workers
     if args.ingest == "device":
         from fps_tpu.core.device_ingest import (
             DeviceDataset,
+            DeviceEpochPlan,
             device_epoch_chunks,
         )
 
-        return device_epoch_chunks(
-            DeviceDataset(mesh, data), num_workers=W,
-            local_batch=args.local_batch,
-            steps_per_chunk=args.steps_per_chunk, route_key=route_key,
-            sync_every=args.sync_every, seed=args.seed, epochs=args.epochs,
+        ds = DeviceDataset(mesh, data)
+        plan = DeviceEpochPlan(
+            ds, num_workers=W, local_batch=args.local_batch,
+            route_key=route_key, sync_every=args.sync_every, seed=args.seed,
         )
-    from fps_tpu.core.ingest import multi_epoch_chunks
 
-    return multi_epoch_chunks(
-        data, args.epochs, num_workers=W, local_batch=args.local_batch,
-        steps_per_chunk=args.steps_per_chunk, route_key=route_key,
-        sync_every=args.sync_every, seed=args.seed,
+        def source(start_epoch=0, epochs=1):
+            return device_epoch_chunks(
+                ds, num_workers=W, local_batch=args.local_batch,
+                steps_per_chunk=args.steps_per_chunk, route_key=route_key,
+                sync_every=args.sync_every, seed=args.seed,
+                start_epoch=start_epoch, epochs=epochs, plan=plan,
+            )
+    else:
+        from fps_tpu.core.ingest import epoch_chunks
+
+        def source(start_epoch=0, epochs=1):
+            def it():
+                for e in range(start_epoch, start_epoch + epochs):
+                    yield from epoch_chunks(
+                        data, num_workers=W, local_batch=args.local_batch,
+                        steps_per_chunk=args.steps_per_chunk,
+                        route_key=route_key, sync_every=args.sync_every,
+                        seed=None if args.seed is None else args.seed + e,
+                    )
+
+            return it()
+
+    return source
+
+
+def make_chunks(args, mesh, data, *, route_key=None):
+    """Chunk iterator over --epochs passes (one-shot form of
+    :func:`make_epoch_source`)."""
+    return make_epoch_source(args, mesh, data, route_key=route_key)(
+        0, args.epochs
     )
 
 

@@ -36,7 +36,6 @@ def main(argv=None) -> int:
     from fps_tpu.models.ials import (
         IALSConfig,
         IALSSolver,
-        interaction_chunks,
         recall_at_k,
     )
     from fps_tpu.utils.datasets import synthetic_implicit, train_test_split
@@ -63,11 +62,14 @@ def main(argv=None) -> int:
     maybe_warm_start(args, solver.store, None)
     ckpt = maybe_checkpointer(args)
 
+    from fps_tpu.examples.common import make_epoch_source
+
+    # iALS has no worker-local state to route for and uses the shard axis
+    # only; the source is consumed twice per epoch (one pass per side).
+    source = make_epoch_source(args, mesh, train, num_workers=S)
+
     for epoch in range(args.epochs):
-        solver.epoch(lambda: interaction_chunks(
-            train, num_shards=S, local_batch=args.local_batch,
-            steps_per_chunk=args.steps_per_chunk, seed=args.seed + epoch,
-        ))
+        solver.epoch(lambda: source(epoch, 1))
         loss = solver.weighted_loss(train["user"], train["item"],
                                     train["rating"])
         emit({"event": "epoch", "epoch": epoch, "weighted_loss": loss})

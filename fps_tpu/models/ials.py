@@ -271,20 +271,23 @@ class IALSSolver:
         acc = self._compiled_acc.get(solve)
         if acc is None:
             acc = self._compiled_acc[solve] = self._accumulate_fn()
+        sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
+
+        def to_dev(x):
+            # Device-resident chunks (fps_tpu.core.device_ingest) reshard
+            # on device; host chunks upload. Either way no host round trip
+            # for data already on the mesh.
+            if not isinstance(x, jax.Array):
+                x = jnp.asarray(np.asarray(x))
+            return jax.device_put(x, sharding)
+
         for chunk in chunks:
             dev_chunk = {
-                "solve_ids": np.asarray(chunk[solve_col]),
-                "fixed_ids": np.asarray(chunk[fixed_col]),
-                "rating": np.asarray(chunk["rating"]),
-                "weight": np.asarray(chunk["weight"]),
+                "solve_ids": to_dev(chunk[solve_col]),
+                "fixed_ids": to_dev(chunk[fixed_col]),
+                "rating": to_dev(chunk["rating"]),
+                "weight": to_dev(chunk["weight"]),
             }
-            dev_chunk = jax.tree.map(
-                lambda x: jax.device_put(
-                    jnp.asarray(x),
-                    NamedSharding(self.mesh, P(None, SHARD_AXIS)),
-                ),
-                dev_chunk,
-            )
             A, b = acc(self.store.tables[fixed_name], A, b, dev_chunk)
 
         if solve_name not in self._compiled_solve:
