@@ -366,14 +366,17 @@ class Trainer:
 
     def run_indexed(self, tables, local_state, plan, key, *, epochs: int = 1,
                     on_epoch=None, checkpointer=None,
-                    checkpoint_every: int = 0):
+                    checkpoint_every: int = 0, start_epoch: int = 0):
         """Run ``epochs`` full passes with ingest fused into the jit.
 
         ``plan.sync_every`` must match the trainer's config. Pass a
         ``Checkpointer`` (+ ``checkpoint_every=k`` epochs) to snapshot
         tables and local state every k epochs and once at the end, like
-        ``fit_stream`` does per chunk. Returns (tables, local_state,
-        per-epoch host metrics list).
+        ``fit_stream`` does per chunk. To resume, restore from the
+        checkpointer and pass ``start_epoch=<restored epoch>`` — both the
+        per-epoch shuffles (``plan.epoch_args(e)``) and the PRNG stream
+        (``fold_in(key, e)``) continue where the interrupted run left off.
+        Returns (tables, local_state, per-epoch host metrics list).
         """
         mode = "sync" if self.config.sync_every is None else "ssp"
         if (self.config.sync_every or None) != (plan.sync_every or None):
@@ -388,7 +391,8 @@ class Trainer:
         T_call = self._indexed_call_steps(plan)
         n_calls = -(-T // T_call)
         all_metrics = []
-        for e in range(epochs):
+        end_epoch = start_epoch + epochs
+        for e in range(start_epoch, end_epoch):
             iargs = plan.epoch_args(e)
             parts = []
             for ci in range(n_calls):
@@ -420,9 +424,9 @@ class Trainer:
                 checkpointer.save(e + 1, self.store, local_state)
         self.store.tables = dict(tables)
         if checkpointer is not None and epochs > 0 and (
-            checkpoint_every <= 0 or epochs % checkpoint_every != 0
+            checkpoint_every <= 0 or end_epoch % checkpoint_every != 0
         ):
-            checkpointer.save(epochs, self.store, local_state)
+            checkpointer.save(end_epoch, self.store, local_state)
         if on_epoch is None:
             all_metrics = [jax.tree.map(np.asarray, m) for m in all_metrics]
         return tables, local_state, all_metrics

@@ -1,0 +1,102 @@
+"""Unbounded-stream online MF — the reference's core execution model, live.
+
+The reference is a *streaming* system: training runs for as long as the
+``DataStream`` produces records and stops via the ``iterationWaitTime``
+timeout when it dries up (SURVEY.md §0, §2.3). This entrypoint demonstrates
+the TPU-native analog end to end:
+
+* an **unbounded source** (here a synthetic rating generator; swap in a
+  socket reader / file tailer — anything yielding columnar batches),
+* :func:`fps_tpu.core.ingest.stream_chunks` framing it into static-shape
+  chunks as records buffer (keyed routing preserved),
+* ``fit_stream`` training on each chunk as it arrives, with the ``WOut``
+  metrics stream reported live,
+* **termination** by data-driven stop: ``--max-records`` bounds the source
+  (the analog of the stream drying up), or ``--target-rmse`` stops early by
+  raising from the ``on_chunk`` tap — a *stronger* facility than the
+  reference's timeout, which could only detect silence, not convergence.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from fps_tpu.examples.common import base_parser, emit, finish, make_mesh
+
+
+class _TargetReached(Exception):
+    pass
+
+
+def main(argv=None) -> int:
+    ap = base_parser("Unbounded-stream online MF")
+    ap.add_argument("--num-users", type=int, default=500)
+    ap.add_argument("--num-items", type=int, default=300)
+    ap.add_argument("--rank", type=int, default=8)
+    ap.add_argument("--learning-rate", type=float, default=0.1)
+    ap.add_argument("--max-records", type=int, default=500_000,
+                    help="stop when the source has produced this many "
+                         "records (None-like 0 = run until --target-rmse)")
+    ap.add_argument("--target-rmse", type=float, default=None,
+                    help="stop as soon as a chunk's train RMSE falls below")
+    ap.add_argument("--source-batch", type=int, default=4096)
+    args = ap.parse_args(argv)
+    if args.max_records <= 0 and args.target_rmse is None:
+        ap.error("an unbounded source (--max-records 0) needs --target-rmse "
+                 "as its stop condition")
+
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.core.ingest import stream_chunks
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.utils.datasets import streaming_rating_batches
+
+    mesh = make_mesh(args)
+    W = num_workers_of(mesh)
+    emit({"event": "start", "workload": "streaming_mf",
+          "mesh": dict(mesh.shape)})
+
+    cfg = MFConfig(num_users=args.num_users, num_items=args.num_items,
+                   rank=args.rank, learning_rate=args.learning_rate)
+    trainer, store = online_mf(mesh, cfg, sync_every=args.sync_every)
+    tables, local_state = trainer.init_state(jax.random.key(args.seed))
+
+    source = streaming_rating_batches(
+        args.num_users, args.num_items, rank=args.rank, seed=args.seed,
+        batch=args.source_batch,
+        max_records=args.max_records if args.max_records > 0 else None,
+    )
+    chunks = stream_chunks(
+        source, num_workers=W, local_batch=args.local_batch,
+        steps_per_chunk=args.steps_per_chunk, route_key="user",
+        sync_every=args.sync_every,
+    )
+
+    seen = 0.0
+
+    def on_chunk(i, m):
+        nonlocal seen
+        n = max(1.0, float(np.sum(m["n"])))
+        seen += n
+        train_rmse = float(np.sqrt(np.sum(m["se"]) / n))
+        emit({"event": "chunk", "i": i, "train_rmse": train_rmse,
+              "records_seen": seen})
+        if args.target_rmse is not None and train_rmse < args.target_rmse:
+            raise _TargetReached
+
+    try:
+        tables, local_state, _ = trainer.fit_stream(
+            tables, local_state, chunks, jax.random.key(args.seed),
+            on_chunk=on_chunk,
+        )
+        stopped = "stream_exhausted"
+    except _TargetReached:
+        stopped = "target_rmse"
+
+    emit({"event": "done", "stopped_by": stopped, "records_seen": seen})
+    finish(args, store)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

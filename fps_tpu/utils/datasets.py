@@ -251,3 +251,38 @@ def synthetic_sparse_multiclass(
         "feat_vals": vals,
         "label": label.astype(np.int32),
     }
+
+
+def streaming_rating_batches(
+    num_users: int,
+    num_items: int,
+    *,
+    rank: int = 6,
+    noise: float = 0.05,
+    seed: int = 0,
+    batch: int = 4096,
+    max_records: int | None = None,
+):
+    """Unbounded-style generator of rating batches from one planted model.
+
+    The streaming analog of :func:`synthetic_ratings` — same planted
+    low-rank structure and Zipfian item popularity, yielded as an endless
+    (or ``max_records``-bounded) sequence of columnar batches for
+    :func:`fps_tpu.core.ingest.stream_chunks`.
+    """
+    rng = np.random.default_rng(seed)
+    p = rng.normal(0, 1.0 / np.sqrt(rank), (num_users, rank))
+    q = rng.normal(0, 1.0 / np.sqrt(rank), (num_items, rank))
+    item_pop = 1.0 / np.arange(1, num_items + 1) ** 0.8
+    item_pop /= item_pop.sum()
+    produced = 0
+    while max_records is None or produced < max_records:
+        n = batch if max_records is None else min(batch, max_records - produced)
+        users = rng.integers(0, num_users, n)
+        items = rng.choice(num_items, n, p=item_pop)
+        ratings = (np.sum(p[users] * q[items], -1)
+                   + rng.normal(0, noise, n)).astype(np.float32)
+        produced += n
+        yield {"user": users.astype(np.int32),
+               "item": items.astype(np.int32),
+               "rating": ratings}

@@ -102,3 +102,32 @@ def test_ials_entrypoint(devices8, capsys):
         capsys,
     )
     assert ev["done"][0]["recall_at_10"] > 0.0
+
+
+def test_streaming_mf_entrypoint(devices8, capsys):
+    from fps_tpu.examples import streaming_mf
+
+    # bounded source: stops by exhaustion
+    ev = run_main(
+        streaming_mf,
+        ["--local-batch", "32", "--steps-per-chunk", "4",
+         "--num-users", "60", "--num-items", "40", "--rank", "4",
+         "--max-records", "20000", "--source-batch", "1024"],
+        capsys,
+    )
+    assert ev["done"][0]["stopped_by"] == "stream_exhausted"
+    assert ev["done"][0]["records_seen"] == 20000.0
+    # chunk RMSE falls over the stream
+    rmses = [c["train_rmse"] for c in ev["chunk"]]
+    assert rmses[-1] < rmses[0]
+
+    # unbounded source: stops by convergence target
+    ev = run_main(
+        streaming_mf,
+        ["--local-batch", "32", "--steps-per-chunk", "4",
+         "--num-users", "60", "--num-items", "40", "--rank", "4",
+         "--max-records", "0", "--target-rmse", "0.3",
+         "--source-batch", "1024"],
+        capsys,
+    )
+    assert ev["done"][0]["stopped_by"] == "target_rmse"
