@@ -107,9 +107,16 @@ def load_rows(
         host = np.zeros(table.shape, dtype)
         host[phys] = values.astype(dtype)
     else:
-        host = np.array(table)
+        host = store._host_table(name).astype(dtype, copy=True)
         host[phys] = values.astype(dtype)
-    store.tables[name] = jax.device_put(host, store.sharding)
+    if store.sharding.is_fully_addressable:
+        store.tables[name] = jax.device_put(host, store.sharding)
+    else:
+        # Multi-controller: materialize only this process's shards — no
+        # cross-process equality collective on the full host table.
+        store.tables[name] = jax.make_array_from_callback(
+            host.shape, store.sharding, lambda idx: host[idx]
+        )
 
 
 def load_model(

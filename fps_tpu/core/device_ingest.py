@@ -51,7 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
+from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS, host_to_replicated
 
 Array = jax.Array
 
@@ -72,15 +72,13 @@ class DeviceDataset:
 
     def __init__(self, mesh, data: Mapping[str, np.ndarray]):
         self.mesh = mesh
-        self.replicated = NamedSharding(mesh, P())
         lengths = {k: len(v) for k, v in data.items()}
         if len(set(lengths.values())) != 1:
             raise ValueError(f"column lengths differ: {lengths}")
         self.n = next(iter(lengths.values()))
         self._host_data = {k: np.asarray(v) for k, v in data.items()}
         self.columns = {
-            k: jax.device_put(v, self.replicated)
-            for k, v in self._host_data.items()
+            k: host_to_replicated(v, mesh) for k, v in self._host_data.items()
         }
         self._queues: dict[tuple[str | None, int], tuple[Array, np.ndarray]] = {}
 
@@ -111,7 +109,7 @@ class DeviceDataset:
                     q[w, : counts[w]] = order[start : start + counts[w]]
                     start += counts[w]
             self._queues[ck] = (
-                jax.device_put(q, self.replicated),
+                host_to_replicated(q, self.mesh),
                 counts.astype(np.int64),
             )
         return self._queues[ck]
@@ -172,7 +170,8 @@ class DeviceEpochPlan:
         if shuffle == "sort":
             maxq, counts, W = self.maxq, jnp.asarray(self.counts), num_workers
 
-            def mk_perm(key):
+            def mk_perm(key_data):
+                key = jax.random.wrap_key_data(key_data)
                 keys = jax.random.split(key, W)
                 u = jax.vmap(lambda k: jax.random.uniform(k, (maxq,)))(keys)
                 u = jnp.where(jnp.arange(maxq)[None, :] < counts[:, None],
@@ -180,13 +179,18 @@ class DeviceEpochPlan:
                 return jnp.argsort(u, axis=1).astype(jnp.int32)
 
             # jitted ONCE per plan — a fresh jit per epoch would recompile
-            # the (W, maxq) argsort program every epoch.
-            self._perm_jit = jax.jit(mk_perm)
+            # the (W, maxq) argsort program every epoch. Takes raw key data
+            # (a plain numpy array, implicitly replicated) so the path works
+            # under multi-controller JAX too.
+            self._perm_jit = jax.jit(
+                mk_perm,
+                out_shardings=NamedSharding(dataset.mesh, P()),
+            )
 
     def epoch_args(self, epoch: int):
         """Device operands for one epoch (replicated pytree)."""
         ekey = jax.random.fold_in(jax.random.key(self.seed), epoch)
-        rep = self.dataset.replicated
+        mesh = self.dataset.mesh
         off_w = np.zeros(self.num_workers, np.int32)
         perm = None
         if self.shuffle == "interleave":
@@ -195,13 +199,13 @@ class DeviceEpochPlan:
             ))
             off_w = (off % self.grid_m.astype(np.int64)).astype(np.int32)
         elif self.shuffle == "sort":
-            perm = jax.device_put(self._perm_jit(ekey), rep)
+            perm = self._perm_jit(np.asarray(jax.random.key_data(ekey)))
         if perm is None:
-            perm = jax.device_put(np.zeros((1, 1), np.int32), rep)
+            perm = host_to_replicated(np.zeros((1, 1), np.int32), mesh)
         return {
             "columns": self.dataset.columns,
             "queues": self._queues,
-            "off_w": jax.device_put(off_w, rep),
+            "off_w": host_to_replicated(off_w, mesh),
             "perm": perm,
         }
 
@@ -315,4 +319,4 @@ def device_epoch_chunks(
     for epoch in range(start_epoch, start_epoch + epochs):
         args = plan.epoch_args(epoch)
         for start in range(0, steps_total, steps_per_chunk):
-            yield build(args, jnp.int32(start))
+            yield build(args, np.int32(start))

@@ -36,7 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from fps_tpu import ops
 from fps_tpu.core.api import ServerLogic, WorkerLogic
 from fps_tpu.core.store import ParamStore, id_to_phys, pull, pull_local, push
-from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
+from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS, key_to_replicated
 
 Array = jax.Array
 Pytree = Any
@@ -396,11 +396,11 @@ class Trainer:
             iargs = plan.epoch_args(e)
             parts = []
             for ci in range(n_calls):
-                ckey = jax.device_put(
+                ckey = key_to_replicated(
                     jax.random.fold_in(jax.random.fold_in(key, e), ci),
-                    self._replicated,
+                    self.mesh,
                 )
-                start = jnp.int32(ci * T_call)
+                start = np.int32(ci * T_call)
                 tables, local_state, metrics = fn(
                     tables, local_state, iargs, start, ckey
                 )
@@ -454,7 +454,7 @@ class Trainer:
             lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding_for(mode)),
             batches,
         )
-        key = jax.device_put(key, self._replicated)
+        key = key_to_replicated(key, self.mesh)
         tables, local_state, metrics = self._get_compiled(mode)(
             tables, local_state, batches, key
         )

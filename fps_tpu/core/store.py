@@ -311,6 +311,15 @@ class ParamStore:
             for name, spec in self.specs.items()
         }
 
+    def _host_table(self, name: str) -> np.ndarray:
+        """Full table as numpy; multi-controller safe (a process can only
+        read addressable shards, so cross-host tables are first replicated
+        through a jitted identity, cached per mesh)."""
+        table = self.tables[name]
+        if not table.sharding.is_fully_addressable:
+            table = _replicate_fn(self.mesh)(table)
+        return np.asarray(table)
+
     def lookup_host(self, name: str, ids: np.ndarray) -> np.ndarray:
         """Host-side (numpy) read of current values — for eval / model dump.
 
@@ -319,7 +328,7 @@ class ParamStore:
         """
         spec = self.specs[name]
         rps = rows_per_shard(spec.num_ids, self.num_shards)
-        table = np.asarray(self.tables[name])
+        table = self._host_table(name)
         phys = np.asarray(id_to_phys(np.asarray(ids), self.num_shards, rps))
         return table[phys]
 
@@ -328,6 +337,20 @@ class ParamStore:
         spec = self.specs[name]
         ids = np.arange(spec.num_ids)
         return ids, self.lookup_host(name, ids)
+
+
+_REPLICATE_CACHE: dict = {}
+
+
+def _replicate_fn(mesh: Mesh):
+    """Jitted identity with replicated output, cached per mesh (a fresh
+    jit per call would retrace/recompile the all-gather every time)."""
+    fn = _REPLICATE_CACHE.get(mesh)
+    if fn is None:
+        fn = _REPLICATE_CACHE[mesh] = jax.jit(
+            lambda x: x, out_shardings=NamedSharding(mesh, P())
+        )
+    return fn
 
 
 def _stable_hash(s: str) -> int:

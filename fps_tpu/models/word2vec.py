@@ -36,6 +36,7 @@ import numpy as np
 
 from fps_tpu.core.api import StepOutput, WorkerLogic
 from fps_tpu.core.store import ParamStore, TableSpec, ranged_uniform_init
+from fps_tpu.parallel.mesh import host_to_replicated, key_to_replicated
 
 Array = jax.Array
 
@@ -390,11 +391,11 @@ class Word2VecDevicePlan:
         self.num_tokens = int(len(dataset_tokens))
 
         replicated = NamedSharding(mesh, P())
-        self._tokens = jax.device_put(
-            np.asarray(dataset_tokens, np.int32), replicated
+        self._tokens = host_to_replicated(
+            np.asarray(dataset_tokens, np.int32), mesh
         )
         keep_p = _keep_probs(cfg, unigram_counts)
-        self._keep_p = jax.device_put(keep_p.astype(np.float32), replicated)
+        self._keep_p = host_to_replicated(keep_p.astype(np.float32), mesh)
 
         expected_kept = float(keep_p[np.asarray(dataset_tokens)].sum())
         bound = int(expected_kept + 8.0 * np.sqrt(expected_kept + 1.0) + 1024)
@@ -410,7 +411,8 @@ class Word2VecDevicePlan:
         W = cfg.window
         buf_len = self._buf_len
 
-        def compact(key):
+        def compact(key_data):
+            key = jax.random.wrap_key_data(key_data)
             toks = self._tokens
             keep = (jax.random.uniform(key, toks.shape)
                     < jnp.take(self._keep_p, toks))
@@ -421,19 +423,25 @@ class Word2VecDevicePlan:
             compacted = compacted.at[dest].set(toks, mode="drop")
             return compacted[:buf_len], jnp.minimum(kept, buf_len)
 
-        self._compact_jit = jax.jit(compact)
-        self._replicated = replicated
+        # Takes raw key data (plain numpy, implicitly replicated) and pins
+        # replicated outputs so the path works under multi-controller JAX.
+        self._compact_jit = jax.jit(
+            compact, out_shardings=(replicated, replicated)
+        )
+        self._mesh = mesh
 
     def epoch_args(self, epoch: int):
         ekey = jax.random.fold_in(jax.random.key(self.seed), epoch)
         ck, wk = jax.random.split(ekey)
-        compacted, kept = self._compact_jit(ck)
+        # _compact_jit pins replicated outputs, so the (tokens,)-sized
+        # buffer is placed once and never re-broadcast by the dispatches.
+        compacted, kept = self._compact_jit(
+            np.asarray(jax.random.key_data(ck))
+        )
         return {
-            # Placed on the replicated sharding up front so run_indexed's
-            # dispatches don't re-broadcast the (tokens,)-sized buffer.
-            "compacted": jax.device_put(compacted, self._replicated),
-            "kept": jax.device_put(kept, self._replicated),
-            "wkey": jax.device_put(wk, self._replicated),
+            "compacted": compacted,
+            "kept": kept,
+            "wkey": key_to_replicated(wk, self._mesh),
         }
 
     def local_batch_at(self, args, w, t):

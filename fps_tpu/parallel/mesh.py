@@ -78,3 +78,71 @@ def default_mesh_shape(n_devices: int) -> tuple[int, int]:
             break
         d -= 1
     return best
+
+
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None,
+                     local_device_ids=None) -> None:
+    """Initialize multi-host JAX (call once per process, before any jax use).
+
+    Thin wrapper over ``jax.distributed.initialize``: on TPU pods the
+    arguments are auto-detected from the environment; on CPU/GPU fleets pass
+    the coordinator address and process topology explicitly. After this,
+    ``jax.devices()`` spans every host and :func:`make_ps_mesh` builds a
+    global mesh — the framework's collectives then ride ICI within a slice
+    and DCN across hosts, replacing the reference's Netty/Akka fabric for
+    the multi-node case.
+    """
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+
+
+def host_to_replicated(x, mesh: Mesh):
+    """Place a host array replicated over ``mesh``, multi-controller safe.
+
+    Single-process: a plain ``device_put``. Multi-process (mesh spans
+    non-addressable devices): every process supplies its identical local
+    copy via ``make_array_from_process_local_data``.
+    """
+    import numpy as np
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = NamedSharding(mesh, PartitionSpec())
+    if sh.is_fully_addressable:
+        return jax.device_put(x, sh)
+    return jax.make_array_from_process_local_data(sh, np.asarray(x))
+
+
+_KEY_PUT_CACHE: dict = {}
+
+
+def key_to_replicated(key, mesh: Mesh):
+    """Place a PRNG key replicated over ``mesh``, multi-controller safe.
+
+    Key arrays have an extended dtype numpy can't hold, so the key *data*
+    (identical in every process) rides through a jitted re-wrap with
+    replicated output sharding.
+    """
+    import numpy as np
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = NamedSharding(mesh, PartitionSpec())
+    if sh.is_fully_addressable:
+        return jax.device_put(key, sh)
+    fn = _KEY_PUT_CACHE.get(mesh)
+    if fn is None:
+        fn = _KEY_PUT_CACHE[mesh] = jax.jit(
+            jax.random.wrap_key_data, out_shardings=sh
+        )
+    return fn(np.asarray(jax.random.key_data(key)))

@@ -1,0 +1,61 @@
+"""Subprocess body for the multi-process distributed test.
+
+Usage: python _mp_worker.py <process_id> <num_processes> <port> <out_npz>
+
+Initializes multi-controller JAX over a local gloo coordinator, trains the
+standard tiny MF workload through the full framework path (device-resident
+ingest + fused indexed epochs over a (2, 4) global mesh), and has process 0
+write the final item-factor table for the parent test to compare.
+"""
+
+import sys
+
+
+def main() -> int:
+    pid, nproc, port, out = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+
+    from fps_tpu.parallel.mesh import init_distributed
+
+    init_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+
+    import numpy as np
+
+    import jax
+
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.parallel.mesh import make_ps_mesh
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    mesh = make_ps_mesh(num_shards=4, num_data=2)
+    W = num_workers_of(mesh)
+    data = synthetic_ratings(57, 31, 2000, seed=0)
+    ds = DeviceDataset(mesh, data)
+    cfg = MFConfig(num_users=57, num_items=31, rank=4, learning_rate=0.1)
+    trainer, store = online_mf(mesh, cfg)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    plan = DeviceEpochPlan(
+        ds, num_workers=W, local_batch=32, route_key="user", seed=5
+    )
+    tables, ls, metrics = trainer.run_indexed(
+        tables, ls, plan, jax.random.key(1), epochs=2
+    )
+    n = sum(float(m["n"].sum()) for m in metrics)
+    assert n == 2 * 2000, n
+
+    if pid == 0:
+        # Sharded across processes: read through the replication fallback.
+        ids, values = store.dump_model("item_factors")
+        np.savez(out, item_factors=values)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
