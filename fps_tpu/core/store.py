@@ -312,12 +312,18 @@ class ParamStore:
         }
 
     def _host_table(self, name: str) -> np.ndarray:
-        """Full table as numpy; multi-controller safe (a process can only
-        read addressable shards, so cross-host tables are first replicated
-        through a jitted identity, cached per mesh)."""
+        """Full table as numpy.
+
+        Multi-controller: cross-host tables are first replicated through a
+        jitted identity — a COLLECTIVE, so every process must make this
+        call (via ``lookup_host``/``dump_model``/checkpoint save) together;
+        a subset of processes calling alone blocks on the others' shards.
+        """
         table = self.tables[name]
         if not table.sharding.is_fully_addressable:
-            table = _replicate_fn(self.mesh)(table)
+            from fps_tpu.parallel.mesh import replicate_to_mesh
+
+            table = replicate_to_mesh(table, self.mesh)
         return np.asarray(table)
 
     def lookup_host(self, name: str, ids: np.ndarray) -> np.ndarray:
@@ -337,20 +343,6 @@ class ParamStore:
         spec = self.specs[name]
         ids = np.arange(spec.num_ids)
         return ids, self.lookup_host(name, ids)
-
-
-_REPLICATE_CACHE: dict = {}
-
-
-def _replicate_fn(mesh: Mesh):
-    """Jitted identity with replicated output, cached per mesh (a fresh
-    jit per call would retrace/recompile the all-gather every time)."""
-    fn = _REPLICATE_CACHE.get(mesh)
-    if fn is None:
-        fn = _REPLICATE_CACHE[mesh] = jax.jit(
-            lambda x: x, out_shardings=NamedSharding(mesh, P())
-        )
-    return fn
 
 
 def _stable_hash(s: str) -> int:

@@ -23,7 +23,8 @@ from __future__ import annotations
 import math
 
 import jax
-from jax.sharding import Mesh
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 # Canonical axis names used throughout the framework.
 DATA_AXIS = "data"
@@ -94,8 +95,6 @@ def init_distributed(coordinator_address: str | None = None,
     and DCN across hosts, replacing the reference's Netty/Akka fabric for
     the multi-node case.
     """
-    import jax
-
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -111,11 +110,6 @@ def host_to_replicated(x, mesh: Mesh):
     non-addressable devices): every process supplies its identical local
     copy via ``make_array_from_process_local_data``.
     """
-    import numpy as np
-
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec
-
     sh = NamedSharding(mesh, PartitionSpec())
     if sh.is_fully_addressable:
         return jax.device_put(x, sh)
@@ -132,11 +126,6 @@ def key_to_replicated(key, mesh: Mesh):
     (identical in every process) rides through a jitted re-wrap with
     replicated output sharding.
     """
-    import numpy as np
-
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec
-
     sh = NamedSharding(mesh, PartitionSpec())
     if sh.is_fully_addressable:
         return jax.device_put(key, sh)
@@ -146,3 +135,23 @@ def key_to_replicated(key, mesh: Mesh):
             jax.random.wrap_key_data, out_shardings=sh
         )
     return fn(np.asarray(jax.random.key_data(key)))
+
+
+_REPLICATE_CACHE: dict = {}
+
+
+def replicate_to_mesh(x, mesh: Mesh):
+    """Replicate a (possibly sharded) device array over ``mesh`` through a
+    per-mesh cached jitted identity.
+
+    NOTE: in multi-controller runs this is a COLLECTIVE — every process of
+    the mesh must call it (a lone process blocks forever waiting for the
+    others' shards). Host-read helpers built on it (``ParamStore``'s
+    ``lookup_host``/``dump_model``) inherit that contract.
+    """
+    fn = _REPLICATE_CACHE.get(mesh)
+    if fn is None:
+        fn = _REPLICATE_CACHE[mesh] = jax.jit(
+            lambda a: a, out_shardings=NamedSharding(mesh, PartitionSpec())
+        )
+    return fn(x)

@@ -46,11 +46,16 @@ def test_two_process_training_matches_single_process(devices8, tmp_path):
                 [sys.executable, worker, str(pid), "2", str(port), out],
                 env=env, cwd=_ROOT, stdout=logf, stderr=subprocess.STDOUT,
             ))
-    for p, log in zip(procs, logs):
-        rc = p.wait(timeout=300)
-        with open(log) as f:
-            text = f.read()
-        assert rc == 0, f"worker failed:\n{text[-3000:]}"
+    try:
+        for p, log in zip(procs, logs):
+            rc = p.wait(timeout=300)
+            with open(log) as f:
+                text = f.read()
+            assert rc == 0, f"worker failed:\n{text[-3000:]}"
+    finally:
+        for p in procs:  # don't orphan a worker blocked in a collective
+            if p.poll() is None:
+                p.kill()
     assert os.path.exists(out)
     mp_values = np.load(out)["item_factors"]
 
