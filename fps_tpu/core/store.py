@@ -48,7 +48,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fps_tpu import ops
-from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
+from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS, replicate_to_mesh
 
 Array = jax.Array
 
@@ -321,8 +321,6 @@ class ParamStore:
         """
         table = self.tables[name]
         if not table.sharding.is_fully_addressable:
-            from fps_tpu.parallel.mesh import replicate_to_mesh
-
             table = replicate_to_mesh(table, self.mesh)
         return np.asarray(table)
 

@@ -224,3 +224,23 @@ def test_run_indexed_checkpoint_resume_bit_exact(mesh, dataset, tmp_path):
     _, v_resumed = store3.dump_model("item_factors")
     np.testing.assert_array_equal(v_full, v_resumed)
     np.testing.assert_array_equal(np.asarray(l_full), np.asarray(l4))
+
+
+def test_packed_blowup_guard_falls_back(mesh):
+    """Extreme routing skew (every example keyed to one worker) must skip
+    the packed fast path (HBM blowup) and still train correctly."""
+    W = num_workers_of(mesh)
+    n = 257
+    d = {"user": np.full(n, 0, np.int32),  # all route to worker 0
+         "item": np.arange(n, dtype=np.int32) % 31,
+         "rating": np.linspace(0, 1, n).astype(np.float32)}
+    ds = DeviceDataset(mesh, d)
+    assert ds.packed("user", W) is None  # blowup W*maxq/n = W > 2
+    plan = DeviceEpochPlan(ds, num_workers=W, local_batch=16,
+                           route_key="user", seed=0)
+    assert "packed" not in plan.epoch_args(0)
+    cfg = MFConfig(num_users=1, num_items=31, rank=4)
+    tr, _ = online_mf(mesh, cfg)
+    t, l = tr.init_state(jax.random.key(0))
+    t, l, m = tr.run_indexed(t, l, plan, jax.random.key(1))
+    assert sum(float(x["n"].sum()) for x in m) == n

@@ -159,3 +159,15 @@ def test_skipgram_chunks_native_vs_numpy_stream(devices8):
         counts[mode] = sum(float(ch["weight"].sum()) for ch in chunks)
     # Same sampling scheme, different RNG draws: totals within 10%.
     assert abs(counts[True] - counts[False]) / counts[False] < 0.1
+
+
+def test_parse_ratings_crlf_and_blank_lines(lib, tmp_path):
+    """Windows line endings and blank lines (including mid-file and
+    trailing) parse cleanly — a bare CR blank line must not count as
+    malformed."""
+    p = tmp_path / "crlf.csv"
+    p.write_bytes(b"userId,movieId,rating\r\n1,2,3.5\r\n\r\n4,5,2.0\r\n\r\n")
+    u, i, r = lib.parse_ratings(str(p))
+    np.testing.assert_array_equal(u, [1, 4])
+    np.testing.assert_array_equal(i, [2, 5])
+    np.testing.assert_allclose(r, [3.5, 2.0])
