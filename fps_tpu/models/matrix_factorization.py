@@ -124,15 +124,25 @@ def make_store(mesh, cfg: MFConfig) -> ParamStore:
 
 
 def online_mf(mesh, cfg: MFConfig, *, sync_every: int | None = None,
-              donate: bool = True, max_steps_per_call: int | None = None):
+              donate: bool = True, max_steps_per_call: int | None = None,
+              combine: str = "sum"):
     """Construct (trainer, store) for online MF — the analog of
-    ``PSOnlineMatrixFactorization.psOnlineMF(...)``."""
+    ``PSOnlineMatrixFactorization.psOnlineMF(...)``.
+
+    ``combine``: how duplicate item ids within one batch merge — ``"sum"``
+    (the reference's per-message fold; faithful, but at very large batches
+    Zipfian-hot items receive hundreds of summed steps per batch and SGD
+    diverges) or ``"mean"`` (one averaged step per touched item per batch,
+    the analog of the reference's combining senders — stable at any batch
+    size)."""
+    from fps_tpu.core.api import ServerLogic
     from fps_tpu.core.driver import Trainer, TrainerConfig, num_workers_of
 
     store = make_store(mesh, cfg)
     worker = MatrixFactorizationWorker(cfg, num_workers_of(mesh))
     trainer = Trainer(
         mesh, store, worker,
+        server_logic=ServerLogic(combine=combine),
         config=TrainerConfig(sync_every=sync_every, donate=donate,
                              max_steps_per_call=max_steps_per_call),
     )
