@@ -28,6 +28,16 @@ import time
 import numpy as np
 
 
+def first_last_real_step(metrics, key):
+    """Per-example metric value at the first and last non-padding step of
+    one epoch's metrics dict (trailing steps are weight-0 padding)."""
+    vals = np.asarray(metrics[key])
+    counts = np.asarray(metrics["n"])
+    real = np.flatnonzero(counts > 0)
+    return (vals[real[0]] / counts[real[0]],
+            vals[real[-1]] / counts[real[-1]])
+
+
 def emulated_flink_cpu_w2v_per_pair_s(uni, dim, negatives,
                                       sample_pairs=8_000, jvm_speedup=10.0):
     """Seconds per (center, context) pair for an emulated per-pair SGNS
@@ -98,6 +108,14 @@ def run_w2v(args):
     )
     epoch_s = time.perf_counter() - t0
     words_s = len(tokens) / epoch_s / len(devs)  # per chip
+
+    per0, per1 = first_last_real_step(metrics[0], "loss")
+    print(
+        f"quality: SGNS loss/pair step0 {per0:.4f} -> last-real-step "
+        f"{per1:.4f} (epoch 2; init loss = (1+K)*log2 = "
+        f"{0.6931 * (1 + cfg.negatives):.3f})",
+        file=sys.stderr,
+    )
 
     pairs = float(metrics[0]["n"].sum())
     per_pair_s = emulated_flink_cpu_w2v_per_pair_s(
@@ -203,6 +221,13 @@ def run_logreg(args):
     epoch_s = time.perf_counter() - t0
     ex_s = NEX / epoch_s / len(devs)
 
+    per0, per1 = first_last_real_step(metrics[0], "logloss")
+    print(
+        f"quality: logloss step0 {per0:.4f} -> last-real-step {per1:.4f} "
+        f"(epoch 2; chance = 0.693)",
+        file=sys.stderr,
+    )
+
     per_ex = emulated_flink_cpu_logreg_per_example_s(NF, NNZ)
     print(json.dumps({
         "metric": "criteo_ssp_logreg_examples_per_sec_per_chip",
@@ -281,12 +306,10 @@ def main():
     # Quality evidence on stderr (stdout stays one JSON line): per-step
     # train RMSE across the timed epoch — the fast path must also be the
     # learning path.
-    se = np.asarray(metrics[0]["se"])
-    n = np.maximum(np.asarray(metrics[0]["n"]), 1)
-    rmse_steps = np.sqrt(se / n)
+    mse0, mse1 = first_last_real_step(metrics[0], "se")
     print(
-        f"quality: train RMSE step0 {rmse_steps[0]:.4f} -> "
-        f"last-step {rmse_steps[-1]:.4f} (epoch 2 of training)",
+        f"quality: train RMSE step0 {np.sqrt(mse0):.4f} -> "
+        f"last-real-step {np.sqrt(mse1):.4f} (epoch 2 of training)",
         file=sys.stderr,
     )
 
