@@ -34,6 +34,8 @@ def first_last_real_step(metrics, key):
     vals = np.asarray(metrics[key])
     counts = np.asarray(metrics["n"])
     real = np.flatnonzero(counts > 0)
+    if len(real) == 0:  # degenerate shard: every step was padding
+        return float("nan"), float("nan")
     return (vals[real[0]] / counts[real[0]],
             vals[real[-1]] / counts[real[-1]])
 

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import sys
 
@@ -34,6 +35,9 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     ap.add_argument("--warm-start", default=None,
                     help="initialize tables from a saved model .npz "
                          "(reference: transformWithModelLoad)")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="write a jax.profiler device trace of the training "
+                         "region under DIR (view with XProf/Perfetto)")
     ap.add_argument("--ingest", default="device", choices=["device", "host"],
                     help="'device' keeps the dataset resident on the mesh "
                          "and builds chunks with on-device gathers (fast "
@@ -144,3 +148,13 @@ def maybe_warm_start(args, store, key) -> None:
 
         load_model(store, args.warm_start)
         emit({"event": "warm_start", "path": args.warm_start})
+
+
+def maybe_profile(args):
+    """Context manager tracing the training region when --profile is set."""
+    if getattr(args, "profile", None):
+        from fps_tpu.utils.profiling import trace
+
+        emit({"event": "profile", "dir": args.profile})
+        return trace(args.profile)
+    return contextlib.nullcontext()

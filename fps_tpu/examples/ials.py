@@ -8,6 +8,8 @@ solves; see ``fps_tpu.models.ials``.
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import numpy as np
 
@@ -17,6 +19,7 @@ from fps_tpu.examples.common import (
     finish,
     make_mesh,
     maybe_checkpointer,
+    maybe_profile,
     maybe_warm_start,
 )
 
@@ -69,7 +72,11 @@ def main(argv=None) -> int:
     source = make_epoch_source(args, mesh, train, num_workers=S)
 
     for epoch in range(args.epochs):
-        solver.epoch(lambda: source(epoch, 1))
+        # --profile traces the first epoch only (one epoch is representative
+        # and keeps the trace small).
+        cm = maybe_profile(args) if epoch == 0 else contextlib.nullcontext()
+        with cm:
+            solver.epoch(lambda: source(epoch, 1))
         loss = solver.weighted_loss(train["user"], train["item"],
                                     train["rating"])
         emit({"event": "epoch", "epoch": epoch, "weighted_loss": loss})

@@ -14,6 +14,7 @@ import numpy as np
 from fps_tpu.examples.common import (
     base_parser,
     make_chunks,
+    maybe_profile,
     emit,
     finish,
     make_mesh,
@@ -69,12 +70,13 @@ def main(argv=None) -> int:
               "logloss": float(np.sum(m["logloss"]) / n),
               "error_rate": float(np.sum(m["mistakes"]) / n)})
 
-    tables, local_state, _ = trainer.fit_stream(
-        tables, local_state, chunks, jax.random.key(args.seed),
-        checkpointer=maybe_checkpointer(args),
-        checkpoint_every=args.checkpoint_every,
-        on_chunk=report,
-    )
+    with maybe_profile(args):
+        tables, local_state, _ = trainer.fit_stream(
+            tables, local_state, chunks, jax.random.key(args.seed),
+            checkpointer=maybe_checkpointer(args),
+            checkpoint_every=args.checkpoint_every,
+            on_chunk=report,
+        )
 
     p = predict_proba_host(store, test["feat_ids"], test["feat_vals"])
     acc = float(np.mean((p > 0.5) == (test["label"] > 0.5)))

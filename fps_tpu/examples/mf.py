@@ -19,6 +19,7 @@ from fps_tpu.examples.common import (
     make_chunks,
     make_mesh,
     maybe_checkpointer,
+    maybe_profile,
     maybe_warm_start,
 )
 
@@ -63,12 +64,13 @@ def main(argv=None) -> int:
         emit({"event": "chunk", "i": i, "train_rmse": float(np.sqrt(se / n)),
               "examples": float(n)})
 
-    tables, local_state, _ = trainer.fit_stream(
-        tables, local_state, chunks, jax.random.key(args.seed),
-        checkpointer=maybe_checkpointer(args),
-        checkpoint_every=args.checkpoint_every,
-        on_chunk=report,
-    )
+    with maybe_profile(args):
+        tables, local_state, _ = trainer.fit_stream(
+            tables, local_state, chunks, jax.random.key(args.seed),
+            checkpointer=maybe_checkpointer(args),
+            checkpoint_every=args.checkpoint_every,
+            on_chunk=report,
+        )
 
     uf = np.asarray(local_state)
     pred = predict_host(store, uf, W, test["user"], test["item"])

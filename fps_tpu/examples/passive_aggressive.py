@@ -14,6 +14,7 @@ import numpy as np
 from fps_tpu.examples.common import (
     base_parser,
     make_chunks,
+    maybe_profile,
     emit,
     finish,
     make_mesh,
@@ -74,12 +75,13 @@ def main(argv=None) -> int:
               "error_rate": float(np.sum(m["mistakes"]) / n),
               "hinge_loss": float(np.sum(m["loss"]) / n)})
 
-    tables, local_state, _ = trainer.fit_stream(
-        tables, local_state, chunks, jax.random.key(args.seed),
-        checkpointer=maybe_checkpointer(args),
-        checkpoint_every=args.checkpoint_every,
-        on_chunk=report,
-    )
+    with maybe_profile(args):
+        tables, local_state, _ = trainer.fit_stream(
+            tables, local_state, chunks, jax.random.key(args.seed),
+            checkpointer=maybe_checkpointer(args),
+            checkpoint_every=args.checkpoint_every,
+            on_chunk=report,
+        )
 
     pred = predict_host(store, test["feat_ids"], test["feat_vals"],
                         num_classes=args.num_classes)

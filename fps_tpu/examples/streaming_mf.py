@@ -22,7 +22,8 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from fps_tpu.examples.common import base_parser, emit, finish, make_mesh
+from fps_tpu.examples.common import (base_parser, emit, finish,
+                                     make_mesh, maybe_profile)
 
 
 class _TargetReached(Exception):
@@ -85,10 +86,11 @@ def main(argv=None) -> int:
             raise _TargetReached
 
     try:
-        tables, local_state, _ = trainer.fit_stream(
-            tables, local_state, chunks, jax.random.key(args.seed),
-            on_chunk=on_chunk,
-        )
+        with maybe_profile(args):
+            tables, local_state, _ = trainer.fit_stream(
+                tables, local_state, chunks, jax.random.key(args.seed),
+                on_chunk=on_chunk,
+            )
         stopped = "stream_exhausted"
     except _TargetReached:
         stopped = "target_rmse"

@@ -20,6 +20,7 @@ from fps_tpu.examples.common import (
     finish,
     make_mesh,
     maybe_checkpointer,
+    maybe_profile,
     maybe_warm_start,
 )
 
@@ -71,38 +72,39 @@ def main(argv=None) -> int:
               "sgns_loss": float(np.sum(m["loss"]) / n)})
 
     t0 = time.perf_counter()
-    if args.ingest == "device":
-        # Fused path: tokens resident on device, subsampling/compaction and
-        # pair generation inside the compiled epoch.
-        plan = Word2VecDevicePlan(
-            tokens, uni, cfg, mesh, num_workers=W,
-            block_len=max(64, args.local_batch // (2 * cfg.window)),
-            seed=args.seed, sync_every=args.sync_every,
-        )
-        tables, local_state, _ = trainer.run_indexed(
-            tables, local_state, plan, jax.random.key(args.seed),
-            epochs=args.epochs, on_epoch=report,
-            checkpointer=maybe_checkpointer(args),
-            # --checkpoint-every counts chunks on the host path; the fused
-            # path snapshots at epoch granularity when it is enabled at all.
-            checkpoint_every=1 if args.checkpoint_every > 0 else 0,
-        )
-    else:
-        def all_epochs():
-            for epoch in range(args.epochs):
-                yield from skipgram_chunks(
-                    tokens, uni, cfg, num_workers=W,
-                    local_batch=args.local_batch,
-                    steps_per_chunk=args.steps_per_chunk,
-                    sync_every=args.sync_every, seed=args.seed + epoch,
-                )
+    with maybe_profile(args):
+        if args.ingest == "device":
+            # Fused path: tokens resident on device, subsampling/compaction
+            # and pair generation inside the compiled epoch.
+            plan = Word2VecDevicePlan(
+                tokens, uni, cfg, mesh, num_workers=W,
+                block_len=max(64, args.local_batch // (2 * cfg.window)),
+                seed=args.seed, sync_every=args.sync_every,
+            )
+            tables, local_state, _ = trainer.run_indexed(
+                tables, local_state, plan, jax.random.key(args.seed),
+                epochs=args.epochs, on_epoch=report,
+                checkpointer=maybe_checkpointer(args),
+                # --checkpoint-every counts chunks on the host path; the
+                # fused path snapshots per epoch when it is enabled at all.
+                checkpoint_every=1 if args.checkpoint_every > 0 else 0,
+            )
+        else:
+            def all_epochs():
+                for epoch in range(args.epochs):
+                    yield from skipgram_chunks(
+                        tokens, uni, cfg, num_workers=W,
+                        local_batch=args.local_batch,
+                        steps_per_chunk=args.steps_per_chunk,
+                        sync_every=args.sync_every, seed=args.seed + epoch,
+                    )
 
-        tables, local_state, _ = trainer.fit_stream(
-            tables, local_state, all_epochs(), jax.random.key(args.seed),
-            checkpointer=maybe_checkpointer(args),
-            checkpoint_every=args.checkpoint_every,
-            on_chunk=report,
-        )
+            tables, local_state, _ = trainer.fit_stream(
+                tables, local_state, all_epochs(), jax.random.key(args.seed),
+                checkpointer=maybe_checkpointer(args),
+                checkpoint_every=args.checkpoint_every,
+                on_chunk=report,
+            )
     dt = time.perf_counter() - t0
     emit({"event": "done", "pairs_per_sec": total_pairs / max(dt, 1e-9),
           "words_per_sec": args.epochs * len(tokens) / max(dt, 1e-9),
