@@ -19,8 +19,10 @@ The user contract mirrors the reference's two-trait API (``WorkerLogic`` /
 """
 
 from fps_tpu.core.api import ServerLogic, WorkerLogic, StepOutput
+from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+from fps_tpu.core.driver import Trainer, TrainerConfig, num_workers_of
 from fps_tpu.core.store import TableSpec, ParamStore
-from fps_tpu.parallel.mesh import make_ps_mesh
+from fps_tpu.parallel.mesh import init_distributed, make_ps_mesh
 
 __version__ = "0.1.0"
 
@@ -30,6 +32,12 @@ __all__ = [
     "StepOutput",
     "TableSpec",
     "ParamStore",
+    "Trainer",
+    "TrainerConfig",
+    "num_workers_of",
+    "DeviceDataset",
+    "DeviceEpochPlan",
     "make_ps_mesh",
+    "init_distributed",
     "__version__",
 ]
