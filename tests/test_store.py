@@ -197,3 +197,73 @@ def test_param_store_init_deterministic_across_shardings(devices8):
         vals[S] = store.lookup_host("t", ids)
     np.testing.assert_allclose(vals[1], vals[2], rtol=1e-6)
     np.testing.assert_allclose(vals[1], vals[8], rtol=1e-6)
+
+
+@pytest.mark.parametrize("trial", range(6))
+def test_pull_push_matches_numpy_model_randomized(devices8, trial):
+    """Property test: for random table/mesh/batch geometries (duplicates,
+    padding ids, both combine modes), a pull followed by a push through the
+    collective path matches a pure-numpy model of the PS semantics."""
+    rng = np.random.default_rng(100 + trial)
+    nd, ns = [(1, 8), (2, 4), (4, 2), (1, 4), (2, 2), (8, 1)][trial]
+    devs = jax.devices()[: nd * ns]
+    mesh = make_ps_mesh(num_shards=ns, num_data=nd, devices=devs)
+    num_ids = int(rng.integers(3, 200))
+    dim = int(rng.integers(1, 17))
+    B_local = int(rng.integers(1, 33))
+    combine = ["sum", "mean"][trial % 2]
+    W = nd * ns
+
+    rps = rows_per_shard(num_ids, ns)
+    vals, _ = reference_table(num_ids, dim, ns)
+    # ~20% padding ids (-1) for the push; pulls use valid ids only.
+    pull_ids_h = rng.integers(0, num_ids, (W, B_local)).astype(np.int32)
+    push_ids_h = pull_ids_h.copy()
+    drop = rng.random((W, B_local)) < 0.2
+    push_ids_h[drop] = -1
+    deltas_h = rng.normal(0, 1, (W, B_local, dim)).astype(np.float32)
+
+    table = jax.device_put(
+        jnp.asarray(vals), NamedSharding(mesh, P(SHARD_AXIS, None))
+    )
+    bsh = NamedSharding(mesh, P((DATA_AXIS, SHARD_AXIS)))
+    pids = jax.device_put(pull_ids_h.reshape(-1), bsh)
+    qids = jax.device_put(push_ids_h.reshape(-1), bsh)
+    dls = jax.device_put(
+        deltas_h.reshape(-1, dim),
+        NamedSharding(mesh, P((DATA_AXIS, SHARD_AXIS), None)),
+    )
+
+    def dev(table, pids, qids, dls):
+        got = pull(table, pids, num_shards=ns)
+        new = push(table, qids, dls, num_shards=ns,
+                   data_axis=DATA_AXIS if nd > 1 else None,
+                   combine=combine,
+                   apply_fn=None if combine == "sum" else lambda r, d: r + d)
+        return got, new
+
+    got, new = jax.jit(jax.shard_map(
+        dev, mesh=mesh,
+        in_specs=(P(SHARD_AXIS, None), P((DATA_AXIS, SHARD_AXIS)),
+                  P((DATA_AXIS, SHARD_AXIS)),
+                  P((DATA_AXIS, SHARD_AXIS), None)),
+        out_specs=(P((DATA_AXIS, SHARD_AXIS), None), P(SHARD_AXIS, None)),
+        check_vma=False,
+    ))(table, pids, qids, dls)
+
+    # numpy model: pull = row lookup; push = per-id combined fold.
+    phys = np.asarray(id_to_phys(pull_ids_h.reshape(-1), ns, rps))
+    np.testing.assert_allclose(np.asarray(got), vals[phys], atol=1e-5)
+
+    expect = vals.copy()
+    flat_ids = push_ids_h.reshape(-1)
+    flat_d = deltas_h.reshape(-1, dim)
+    for i in np.unique(flat_ids):
+        if i < 0:
+            continue
+        sel = flat_ids == i
+        agg = flat_d[sel].sum(0)
+        if combine == "mean":
+            agg = agg / sel.sum()
+        expect[np.asarray(id_to_phys(np.int64(i), ns, rps))] += agg
+    np.testing.assert_allclose(np.asarray(new), expect, atol=1e-4)
