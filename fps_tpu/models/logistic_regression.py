@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fps_tpu.core.api import StepOutput, WorkerLogic
+from fps_tpu.core.api import ServerLogic, StepOutput, WorkerLogic
 from fps_tpu.core.store import ParamStore, TableSpec
 
 Array = jax.Array
@@ -35,7 +35,24 @@ class LogRegConfig:
     learning_rate: float = 0.1
     l2: float = 0.0
     batch_average: bool = True  # average grads over the local batch
+    # "sgd": worker pushes lr-scaled deltas, server fold is additive (the
+    # reference's SimplePSLogic semantics). "adagrad": worker pushes raw
+    # [grad, grad^2] pairs and the server fold keeps a per-coordinate
+    # accumulator IN the sharded table (column 1) — per-coordinate adaptive
+    # rates tame Zipfian-hot features with no framework changes, showing
+    # the ServerLogic fold is general enough to host optimizer state.
+    optimizer: str = "sgd"
+    adagrad_eps: float = 1e-6
     dtype: object = jnp.float32
+
+    def __post_init__(self):
+        if self.optimizer not in ("sgd", "adagrad"):
+            raise ValueError(f"unknown optimizer {self.optimizer!r}")
+
+    @property
+    def table_width(self) -> int:
+        """Columns per feature row: weight (+ AdaGrad accumulator)."""
+        return 2 if self.optimizer == "adagrad" else 1
 
 
 class LogisticRegressionWorker(WorkerLogic):
@@ -52,14 +69,20 @@ class LogisticRegressionWorker(WorkerLogic):
         y = batch["label"].astype(cfg.dtype)  # {0,1}
         w = batch["weight"].astype(cfg.dtype)
 
-        wrows = pulled[WEIGHT_TABLE].reshape(B, nnz)
+        width = cfg.table_width
+        wrows = pulled[WEIGHT_TABLE].reshape(B, nnz, width)[:, :, 0]
         logit = jnp.sum(wrows * x, axis=-1)
         p = jax.nn.sigmoid(logit)
         g = (p - y) * w  # dL/dlogit, zeroed for padding
 
         n_real = jnp.maximum(jnp.sum(w), 1.0)
-        scale = cfg.learning_rate / (n_real if cfg.batch_average else 1.0)
-        deltas = -scale * (g[:, None] * x + cfg.l2 * wrows * w[:, None])
+        norm = n_real if cfg.batch_average else 1.0
+        grads = (g[:, None] * x + cfg.l2 * wrows * w[:, None]) / norm
+        if cfg.optimizer == "adagrad":
+            # raw gradient + its square; lr is applied by the server fold.
+            deltas = jnp.stack([grads, grads * grads], axis=-1)
+        else:
+            deltas = (-cfg.learning_rate * grads)[:, :, None]
 
         active = (x != 0.0) & (w[:, None] > 0)
         push_ids = jnp.where(active, batch["feat_ids"].astype(jnp.int32), -1)
@@ -73,15 +96,33 @@ class LogisticRegressionWorker(WorkerLogic):
             "mistakes": mistakes.astype(jnp.float32),
             "n": jnp.sum(w).astype(jnp.float32),
         }
-        pushes = {WEIGHT_TABLE: (push_ids.reshape(-1), deltas.reshape(-1, 1))}
+        pushes = {
+            WEIGHT_TABLE: (push_ids.reshape(-1), deltas.reshape(-1, width))
+        }
         return StepOutput(pushes=pushes, local_state=local_state, out=out)
 
 
 def make_store(mesh, cfg: LogRegConfig) -> ParamStore:
     spec = TableSpec(
-        name=WEIGHT_TABLE, num_ids=cfg.num_features, dim=1, dtype=cfg.dtype
+        name=WEIGHT_TABLE, num_ids=cfg.num_features, dim=cfg.table_width,
+        dtype=cfg.dtype,
     ).zeros_init()
     return ParamStore(mesh, [spec])
+
+
+def adagrad_fold(lr: float, eps: float):
+    """Server fold holding AdaGrad state in the table: column 0 = weight,
+    column 1 = squared-gradient accumulator. The combined push delta is
+    [sum g, sum g^2] per touched id."""
+
+    def apply_fn(rows, delta):
+        wcol, acc = rows[:, 0], rows[:, 1]
+        gsum, g2sum = delta[:, 0], delta[:, 1]
+        acc_new = acc + g2sum
+        w_new = wcol - lr * gsum / (jnp.sqrt(acc_new) + eps)
+        return jnp.stack([w_new, acc_new], axis=-1)
+
+    return apply_fn
 
 
 def logistic_regression(mesh, cfg: LogRegConfig, *,
@@ -91,8 +132,14 @@ def logistic_regression(mesh, cfg: LogRegConfig, *,
     from fps_tpu.core.driver import Trainer, TrainerConfig
 
     store = make_store(mesh, cfg)
+    server_logic = (
+        ServerLogic(apply_fn=adagrad_fold(cfg.learning_rate, cfg.adagrad_eps))
+        if cfg.optimizer == "adagrad"
+        else ServerLogic()
+    )
     trainer = Trainer(
         mesh, store, LogisticRegressionWorker(cfg),
+        server_logic=server_logic,
         config=TrainerConfig(sync_every=sync_every, donate=donate,
                              max_steps_per_call=max_steps_per_call),
     )
@@ -103,5 +150,6 @@ def predict_proba_host(store: ParamStore, feat_ids: np.ndarray,
                        feat_vals: np.ndarray) -> np.ndarray:
     rows = store.lookup_host(WEIGHT_TABLE, feat_ids.reshape(-1))
     B, nnz = feat_ids.shape
-    logit = np.sum(rows.reshape(B, nnz) * feat_vals, axis=-1)
+    weights = rows[:, 0]  # column 0 is the weight for every optimizer
+    logit = np.sum(weights.reshape(B, nnz) * feat_vals, axis=-1)
     return 1.0 / (1.0 + np.exp(-logit))

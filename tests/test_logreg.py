@@ -65,3 +65,46 @@ def test_ssp_staleness_changes_trajectory(devices8):
     w_ssp = store_ssp.lookup_host("weights", np.arange(NF))
     assert not np.allclose(w_sync, w_ssp)
     assert acc_sync > 0.72 and acc_ssp > 0.72
+
+
+def test_logreg_adagrad_converges_and_keeps_state_in_table(devices8):
+    """optimizer='adagrad': the server fold keeps per-coordinate accumulator
+    state in table column 1; training converges and the accumulator is
+    non-negative and grows only for touched features."""
+    import jax
+    import numpy as np
+
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.models.logistic_regression import (
+        LogRegConfig,
+        logistic_regression,
+        predict_proba_host,
+    )
+    from fps_tpu.parallel.mesh import make_ps_mesh
+    from fps_tpu.utils.datasets import (
+        synthetic_sparse_classification,
+        train_test_split,
+    )
+
+    mesh = make_ps_mesh(num_shards=8, num_data=1)
+    W = num_workers_of(mesh)
+    data = synthetic_sparse_classification(6000, NF, NNZ, seed=7, noise=0.05)
+    data = dict(data, label=(data["label"] > 0).astype(np.float32))
+    train, test = train_test_split(data)
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.3,
+                       optimizer="adagrad")
+    trainer, store = logistic_regression(mesh, cfg)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    ds = DeviceDataset(mesh, train)
+    plan = DeviceEpochPlan(ds, num_workers=W, local_batch=32, seed=3)
+    tables, ls, m = trainer.run_indexed(
+        tables, ls, plan, jax.random.key(1), epochs=4
+    )
+    p = predict_proba_host(store, test["feat_ids"], test["feat_vals"])
+    acc = float(np.mean((p > 0.5) == (test["label"] > 0.5)))
+    assert acc > 0.8, acc
+    rows = store.lookup_host("weights", np.arange(NF))
+    assert rows.shape == (NF, 2)
+    assert (rows[:, 1] >= 0).all()  # accumulator is a sum of squares
+    assert (rows[:, 1] > 0).sum() > NF // 2  # most features were touched
