@@ -379,9 +379,41 @@ def device_epoch_chunks(
             route_key=route_key, shuffle=shuffle, seed=seed,
             sync_every=sync_every,
         )
-    build = plan._chunk_builder(steps_per_chunk)
-    steps_total = -(-plan.steps_per_epoch // steps_per_chunk) * steps_per_chunk
-    for epoch in range(start_epoch, start_epoch + epochs):
-        args = plan.epoch_args(epoch)
-        for start in range(0, steps_total, steps_per_chunk):
-            yield build(args, np.int32(start))
+    else:
+        # An explicit plan carries its own geometry; silently ignoring
+        # disagreeing kwargs would hand the caller the plan's geometry with
+        # no warning (mirrors run_indexed's sync_every consistency check).
+        # sync_every is truthiness-normalized like the driver does (0 and
+        # None both mean fully synchronous).
+        mismatches = {
+            k: (got, want)
+            for k, got, want in (
+                ("num_workers", num_workers, plan.num_workers),
+                ("local_batch", local_batch, plan.local_batch),
+                ("route_key", route_key, plan.route_key),
+                ("shuffle", shuffle, plan.shuffle),
+                ("seed", seed, plan.seed),
+                ("sync_every", sync_every or None, plan.sync_every or None),
+            )
+            if got != want
+        }
+        if mismatches:
+            raise ValueError(
+                "explicit plan disagrees with kwargs: "
+                + ", ".join(
+                    f"{k}={got!r} but plan.{k}={want!r}"
+                    for k, (got, want) in mismatches.items()
+                )
+            )
+
+    def _chunks():
+        build = plan._chunk_builder(steps_per_chunk)
+        steps_total = (
+            -(-plan.steps_per_epoch // steps_per_chunk) * steps_per_chunk
+        )
+        for epoch in range(start_epoch, start_epoch + epochs):
+            args = plan.epoch_args(epoch)
+            for start in range(0, steps_total, steps_per_chunk):
+                yield build(args, np.int32(start))
+
+    return _chunks()

@@ -413,6 +413,12 @@ class Trainer:
             if n_calls * T_call > T:
                 metrics = jax.tree.map(lambda x: x[:T], metrics)
             all_metrics.append(metrics)
+            # The donated pre-call buffers are dead; repoint the store's
+            # host-side view (lookup_host / predict_*_host) at the live
+            # arrays BEFORE any callback runs — per-epoch validation via the
+            # store is the natural on_epoch pattern, and doing it here also
+            # leaves the store consistent if on_epoch raises (early stop).
+            self.store.tables = dict(tables)
             if on_epoch is not None:
                 host = jax.tree.map(np.asarray, metrics)
                 all_metrics[-1] = host
@@ -420,9 +426,8 @@ class Trainer:
             if checkpointer is not None and checkpoint_every > 0 and (
                 (e + 1) % checkpoint_every == 0
             ):
-                self.store.tables = dict(tables)
                 checkpointer.save(e + 1, self.store, local_state)
-        self.store.tables = dict(tables)
+        self.store.tables = dict(tables)  # epochs == 0: loop never ran
         if checkpointer is not None and epochs > 0 and (
             checkpoint_every <= 0 or end_epoch % checkpoint_every != 0
         ):

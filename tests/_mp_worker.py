@@ -50,9 +50,12 @@ def main() -> int:
     n = sum(float(m["n"].sum()) for m in metrics)
     assert n == 2 * 2000, n
 
+    # dump_model replicates cross-host shards through a jitted identity — a
+    # COLLECTIVE, so EVERY process must call it (on a topology where the
+    # shard axis spans processes, a rank-0-only call deadlocks waiting for
+    # the other processes' shards). Rank 0 alone writes the file.
+    ids, values = store.dump_model("item_factors")
     if pid == 0:
-        # Sharded across processes: read through the replication fallback.
-        ids, values = store.dump_model("item_factors")
         np.savez(out, item_factors=values)
     return 0
 

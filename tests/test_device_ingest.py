@@ -226,6 +226,53 @@ def test_run_indexed_checkpoint_resume_bit_exact(mesh, dataset, tmp_path):
     np.testing.assert_array_equal(np.asarray(l_full), np.asarray(l4))
 
 
+def test_explicit_plan_kwarg_mismatch_raises(dataset):
+    """Passing a plan plus disagreeing geometry kwargs must raise, not
+    silently use the plan's geometry."""
+    W = 8
+    plan = DeviceEpochPlan(
+        dataset, num_workers=W, local_batch=LOCAL_BATCH, route_key="user",
+        seed=3,
+    )
+    # Validation is eager — it must fire at call time, not at first next().
+    with pytest.raises(ValueError, match="local_batch"):
+        device_epoch_chunks(
+            dataset, num_workers=W, local_batch=LOCAL_BATCH * 2,
+            steps_per_chunk=4, route_key="user", seed=3, plan=plan,
+        )
+    with pytest.raises(ValueError, match="route_key"):
+        device_epoch_chunks(
+            dataset, num_workers=W, local_batch=LOCAL_BATCH,
+            steps_per_chunk=4, route_key=None, seed=3, plan=plan,
+        )
+
+
+def test_on_epoch_sees_live_store(mesh, dataset):
+    """Under donate=True the pre-call table buffers are invalidated; the
+    store must be repointed at the live arrays before on_epoch runs so
+    per-epoch validation via store.lookup_host works."""
+    W = num_workers_of(mesh)
+    cfg = MFConfig(num_users=57, num_items=31, rank=4, learning_rate=0.1)
+    tr, store = online_mf(mesh, cfg)  # donate=True default
+    t, l = tr.init_state(jax.random.key(0))
+    plan = DeviceEpochPlan(
+        dataset, num_workers=W, local_batch=32, route_key="user", seed=5
+    )
+    seen = []
+
+    def on_epoch(e, metrics):
+        # The natural per-epoch validation pattern: host read of the live
+        # tables. Raises "array deleted" if the store still points at the
+        # donated pre-call buffers.
+        vals = store.lookup_host("item_factors", np.arange(5))
+        assert np.isfinite(vals).all()
+        seen.append(e)
+
+    tr.run_indexed(t, l, plan, jax.random.key(1), epochs=2,
+                   on_epoch=on_epoch)
+    assert seen == [0, 1]
+
+
 def test_packed_blowup_guard_falls_back(mesh):
     """Extreme routing skew (every example keyed to one worker) must skip
     the packed fast path (HBM blowup) and still train correctly."""
