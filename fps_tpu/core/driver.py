@@ -127,6 +127,10 @@ class Trainer:
     def _apply_pushes(self, tables, pushes):
         new_tables = dict(tables)
         for name, (pids, pdeltas) in pushes.items():
+            spec = self.store.specs[name]
+            # Global hot ids [0, H) sit in local rows [0, ceil(H/S)) on
+            # every shard under the owner-major cyclic layout.
+            hot_local = -(-spec.hot_ids // self.num_shards) if spec.hot_ids else 0
             new_tables[name] = push(
                 tables[name],
                 pids,
@@ -136,6 +140,7 @@ class Trainer:
                 data_axis=DATA_AXIS if self.mesh.shape[DATA_AXIS] > 1 else None,
                 apply_fn=self.server_logic[name].apply_fn,
                 combine=self.server_logic[name].combine,
+                hot_rows=hot_local,
             )
         return new_tables
 

@@ -138,6 +138,7 @@ def push(
     data_axis: str | None = DATA_AXIS,
     apply_fn: Callable[[Array, Array], Array] | None = None,
     combine: str = "sum",
+    hot_rows: int = 0,
 ) -> Array:
     """Scatter-add ``deltas`` for ``ids`` into the sharded table.
 
@@ -160,6 +161,10 @@ def push(
         push, which keeps hot Zipfian ids stable under large batches —
         the analog of the reference's batching senders combining pushes
         to the same id, expected upstream ``.../ps/client/sender/``).
+      hot_rows: number of LOCAL leading rows of this shard treated as
+        write-hot (see :func:`fps_tpu.ops.scatter_add`); under the
+        owner-major cyclic layout, global hot ids ``[0, H)`` land exactly
+        in local rows ``[0, ceil(H / num_shards))`` on every shard.
 
     Returns:
       Updated ``(rps, dim)`` local block.
@@ -183,21 +188,31 @@ def push(
         raise ValueError(f"unknown combine mode {combine!r}")
 
     if apply_fn is None and combine == "sum":
-        return ops.scatter_add(local_shard, local_idx, masked)
+        return ops.scatter_add(local_shard, local_idx, masked,
+                               hot_rows=hot_rows)
 
-    # Combine duplicate ids first, then apply once per touched row.
-    summed = jax.ops.segment_sum(masked, local_idx, num_segments=rps + 1)[:rps]
-    counts = jax.ops.segment_sum(
-        owned.astype(jnp.int32), local_idx, num_segments=rps + 1
-    )[:rps]
+    # Combine duplicate ids first, then apply once per touched row. The
+    # per-id sums and counts ride ONE scatter (counts as an appended ones
+    # column) — the scatter is per-row-transaction bound on TPU, so a second
+    # scatter for counts would double its cost.
+    dim = masked.shape[1]
+    withcnt = jnp.concatenate(
+        [masked.astype(jnp.float32), owned.astype(jnp.float32)[:, None]],
+        axis=1,
+    )
+    acc = ops.scatter_add(
+        jnp.zeros((rps, dim + 1), jnp.float32), local_idx, withcnt,
+        hot_rows=hot_rows,
+    )
+    summed, counts = acc[:, :dim], acc[:, dim]
     if combine == "mean":
-        summed = summed / jnp.maximum(counts, 1)[:, None].astype(summed.dtype)
-    touched = counts > 0
+        summed = summed * (1.0 / jnp.maximum(counts, 1.0))[:, None]
     if apply_fn is None:
-        new_rows = local_shard + summed.astype(local_shard.dtype)
-    else:
-        new_rows = apply_fn(local_shard, summed.astype(local_shard.dtype))
-    return jnp.where(touched[:, None], new_rows, local_shard)
+        # Additive fold: untouched rows receive exactly zero, so no mask is
+        # needed (a full-table where() is a measurable per-step cost).
+        return local_shard + summed.astype(local_shard.dtype)
+    new_rows = apply_fn(local_shard, summed.astype(local_shard.dtype))
+    return jnp.where((counts > 0)[:, None], new_rows, local_shard)
 
 
 # ---------------------------------------------------------------------------
@@ -220,6 +235,13 @@ class TableSpec:
     dim: int
     init_fn: Callable[[Array, Array], Array] = None  # (key, ids) -> values
     dtype: Any = jnp.float32
+    # Number of leading GLOBAL ids treated as write-hot (NuPS-style hot/cold
+    # split, :func:`fps_tpu.ops.scatter_add`). Meaningful when ids are
+    # frequency-ranked (hottest first) — the shipped loaders and synthetic
+    # generators lay ids out that way — but semantics are exact for any
+    # distribution; a wrong guess costs only MXU work, capped by the
+    # dispatcher's SCATTER_FLOP_BUDGET fallback.
+    hot_ids: int = 0
 
     def zeros_init(self) -> "TableSpec":
         return dataclasses.replace(

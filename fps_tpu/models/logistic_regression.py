@@ -43,6 +43,10 @@ class LogRegConfig:
     # the ServerLogic fold is general enough to host optimizer state.
     optimizer: str = "sgd"
     adagrad_eps: float = 1e-6
+    # Feature ids [0, hot_features) are write-hot (NuPS-style hot/cold push
+    # split, fps_tpu.ops.scatter_add); effective with frequency-ranked ids
+    # and a small per-shard table slice. Default 0 — see MFConfig.hot_items.
+    hot_features: int = 0
     dtype: object = jnp.float32
 
     def __post_init__(self):
@@ -105,7 +109,7 @@ class LogisticRegressionWorker(WorkerLogic):
 def make_store(mesh, cfg: LogRegConfig) -> ParamStore:
     spec = TableSpec(
         name=WEIGHT_TABLE, num_ids=cfg.num_features, dim=cfg.table_width,
-        dtype=cfg.dtype,
+        dtype=cfg.dtype, hot_ids=min(cfg.hot_features, cfg.num_features),
     ).zeros_init()
     return ParamStore(mesh, [spec])
 

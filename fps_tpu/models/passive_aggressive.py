@@ -60,6 +60,10 @@ class PAConfig:
     variant: str = "PA-I"  # "PA" | "PA-I" | "PA-II"
     C: float = 1.0
     batch_average: bool = True
+    # Feature ids [0, hot_features) are write-hot (NuPS-style hot/cold push
+    # split, fps_tpu.ops.scatter_add); effective with frequency-ranked ids
+    # and a small per-shard table slice. Default 0 — see MFConfig.hot_items.
+    hot_features: int = 0
     dtype: object = jnp.float32
 
     @property
@@ -191,6 +195,7 @@ def make_store(mesh, cfg: PAConfig) -> ParamStore:
         num_ids=cfg.num_features,
         dim=cfg.table_dim,
         dtype=cfg.dtype,
+        hot_ids=min(cfg.hot_features, cfg.num_features),
     ).zeros_init()  # reference: paramInit = 0.0 per feature
     return ParamStore(mesh, [spec])
 

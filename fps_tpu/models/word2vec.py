@@ -88,6 +88,11 @@ class W2VConfig:
     learning_rate: float = 0.025
     subsample_t: float | None = 1e-4  # None disables frequent-word subsampling
     neg_power: float = 0.75
+    # Word ids [0, hot_words) are write-hot (NuPS-style hot/cold push split,
+    # fps_tpu.ops.scatter_add); vocabulary ids are frequency-ranked by every
+    # loader (most_common order), so the Zipf head sits exactly there.
+    # Default 0 — see MFConfig.hot_items for when enabling it pays.
+    hot_words: int = 0
     dtype: object = jnp.float32
 
 
@@ -173,16 +178,19 @@ class Word2VecWorker(WorkerLogic):
 
 def make_store(mesh, cfg: W2VConfig) -> ParamStore:
     half = 0.5 / cfg.dim
+    hot = min(cfg.hot_words, cfg.vocab_size)
     in_spec = TableSpec(
         name=IN_TABLE,
         num_ids=cfg.vocab_size,
         dim=cfg.dim,
         init_fn=ranged_uniform_init(-half, half, cfg.dim, cfg.dtype),
         dtype=cfg.dtype,
+        hot_ids=hot,
     )
     # word2vec initializes the output matrix to zeros.
     out_spec = TableSpec(
-        name=OUT_TABLE, num_ids=cfg.vocab_size, dim=cfg.dim, dtype=cfg.dtype
+        name=OUT_TABLE, num_ids=cfg.vocab_size, dim=cfg.dim, dtype=cfg.dtype,
+        hot_ids=hot,
     ).zeros_init()
     return ParamStore(mesh, [in_spec, out_spec])
 

@@ -60,6 +60,52 @@ def test_scatter_add_parity(R, D, B, row_tile, batch_tile):
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("R,D,B,hot", [(64, 8, 100, 16), (130, 3, 513, 7),
+                                       (57, 200, 64, 8)])
+def test_scatter_add_hot_cold_split_parity(pallas_backend, R, D, B, hot):
+    """scatter_add with hot_rows>0 (head via the lane-packed one-hot kernel,
+    tail via XLA) must match the plain scatter semantics exactly: drops,
+    duplicates, and head/tail boundary ids."""
+    rng = np.random.default_rng(7)
+    table = rng.normal(0, 1, (R, D)).astype(np.float32)
+    ids = (rng.zipf(1.5, B) % R).astype(np.int32)  # heavy head duplication
+    ids[::9] = -1
+    ids[4::13] = R
+    ids[1::17] = hot - 1  # boundary: last head row
+    ids[2::17] = hot      # boundary: first tail row
+    deltas = rng.normal(0, 1, (B, D)).astype(np.float32)
+
+    got = np.asarray(ops.scatter_add(
+        jnp.asarray(table), jnp.asarray(ids), jnp.asarray(deltas),
+        hot_rows=hot,
+    ))
+    want = table.astype(np.float64).copy()
+    keep = (ids >= 0) & (ids < R)
+    np.add.at(want, ids[keep], deltas[keep].astype(np.float64))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_packed_scatter_parity():
+    """The lane-packed kernel alone (pack = 128 // D logical rows per lane
+    row, hi/lo bf16 split) vs the numpy oracle."""
+    from fps_tpu.ops.pallas_kernels import scatter_add_packed_pallas
+
+    rng = np.random.default_rng(8)
+    for R, D, B in [(64, 8, 100), (53, 11, 513), (16, 130, 64), (512, 1, 700)]:
+        table = rng.normal(0, 1, (R, D)).astype(np.float32)
+        ids = (rng.zipf(1.5, B) % (R + 8) - 2).astype(np.int32)  # some oob
+        deltas = rng.normal(0, 1, (B, D)).astype(np.float32)
+        got = np.asarray(scatter_add_packed_pallas(
+            jnp.asarray(table), jnp.asarray(ids), jnp.asarray(deltas),
+            interpret=True,
+        ))
+        want = table.astype(np.float64).copy()
+        keep = (ids >= 0) & (ids < R)
+        np.add.at(want, ids[keep], deltas[keep].astype(np.float64))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"R={R} D={D} B={B}")
+
+
 def test_dispatcher_backends():
     with pytest.raises(ValueError):
         ops.set_backend("cuda")

@@ -1,0 +1,182 @@
+"""Ablation profile of the ML-20M MF hot step on the real chip.
+
+Times a scan of T steps with components knocked out one at a time to see
+where the per-step milliseconds go. Run from /root/repo:
+    python scratch/prof_mf.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from fps_tpu import ops
+
+R_ITEMS = 26744
+R_USERS = 138496
+RANK = 10
+B = 32768
+T = 512
+N = 20_000_263
+
+
+def _fence(out):
+    """Force completion with a host read of one element of every leaf."""
+    leaves = jax.tree.leaves(out)
+    for leaf in leaves:
+        a = leaf
+        while getattr(a, "ndim", 0) > 0:
+            a = a[0]
+        np.asarray(a)
+
+
+def bench(name, fn, *args):
+    # Warm-up (compile) + fence.
+    _fence(fn(*args))
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _fence(fn(*args))
+        times.append(time.perf_counter() - t0)
+    per_step = min(times) / T * 1e6
+    print(f"{name:40s} {per_step:9.1f} us/step")
+
+
+def main():
+    rng = np.random.default_rng(0)
+    key = jax.random.key(0)
+    items = jnp.asarray(rng.integers(0, R_ITEMS, (T, B)), jnp.int32)
+    users = jnp.asarray(rng.integers(0, R_USERS, (T, B)), jnp.int32)
+    ratings = jnp.asarray(rng.normal(0, 1, (T, B)), jnp.float32)
+    qtab = jnp.asarray(rng.normal(0, 0.1, (R_ITEMS, RANK)), jnp.float32)
+    ptab = jnp.asarray(rng.normal(0, 0.1, (R_USERS, RANK)), jnp.float32)
+    packed = jnp.asarray(rng.integers(0, 2**30, (N, 3)), jnp.int32)
+    queue_slots = jnp.asarray(rng.integers(0, N, (T, B)), jnp.int32)
+
+    # 1. batch-build gather only: (N,3) packed matrix gather
+    @jax.jit
+    def build_only(packed, slots):
+        def body(c, s):
+            rows = jnp.take(packed, s, axis=0)
+            return c + rows.sum(), None
+        return lax.scan(body, jnp.int32(0), slots)[0]
+
+    bench("batch build gather (N,3)", build_only, packed, queue_slots)
+
+    # 2. pull gather only
+    @jax.jit
+    def pull_only(qtab, items):
+        def body(c, ids):
+            v = ops.gather_rows(qtab, ids)
+            return c + v.sum(), None
+        return lax.scan(body, jnp.float32(0), items)[0]
+
+    bench("item gather (B,10)", pull_only, qtab, items)
+
+    # 3. scatter-add only (sum combine)
+    @jax.jit
+    def scatter_only(qtab, items, ratings):
+        def body(tab, x):
+            ids, r = x
+            tab = ops.scatter_add(tab, ids, r[:, None] * jnp.ones((1, RANK)))
+            return tab, None
+        return lax.scan(body, qtab, (items, ratings))[0]
+
+    bench("item scatter-add sum (B,10)", scatter_only, qtab, items, ratings)
+
+    # 4. mean-combine push path (segment_sum x2 + div + where)
+    def mean_push(tab, ids, deltas):
+        rps = tab.shape[0]
+        summed = jax.ops.segment_sum(deltas, ids, num_segments=rps + 1)[:rps]
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(ids, jnp.int32), ids, num_segments=rps + 1)[:rps]
+        summed = summed / jnp.maximum(counts, 1)[:, None].astype(jnp.float32)
+        touched = counts > 0
+        return jnp.where(touched[:, None], tab + summed, tab)
+
+    @jax.jit
+    def mean_only(qtab, items, ratings):
+        def body(tab, x):
+            ids, r = x
+            tab = mean_push(tab, ids, r[:, None] * jnp.ones((1, RANK)))
+            return tab, None
+        return lax.scan(body, qtab, (items, ratings))[0]
+
+    bench("item mean-combine push (B,10)", mean_only, qtab, items, ratings)
+
+    # 5. dedup (sort) + scatter unique
+    @jax.jit
+    def dedup_scatter(qtab, items, ratings):
+        def body(tab, x):
+            ids, r = x
+            deltas = r[:, None] * jnp.ones((1, RANK))
+            order = jnp.argsort(ids)
+            sids = ids[order]
+            sdel = deltas[order]
+            seg_start = jnp.concatenate(
+                [jnp.ones((1,), jnp.bool_), sids[1:] != sids[:-1]])
+            seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+            summed = jax.ops.segment_sum(sdel, seg_id, num_segments=B)
+            uids = jnp.where(seg_start, sids, -1)
+            u_first = jax.ops.segment_max(
+                jnp.where(seg_start, sids, -1), seg_id, num_segments=B)
+            tab = ops.scatter_add(tab, u_first, summed)
+            return tab, None
+        return lax.scan(body, qtab, (items, ratings))[0]
+
+    bench("dedup(sort)+scatter (B,10)", dedup_scatter, qtab, items, ratings)
+
+    # 6. user local: gather + scatter into (138k,10)
+    @jax.jit
+    def user_path(ptab, users, ratings):
+        def body(tab, x):
+            ids, r = x
+            p = jnp.take(tab, ids, axis=0)
+            tab = tab.at[ids].add(r[:, None] * p)
+            return tab, None
+        return lax.scan(body, ptab, (users, ratings))[0]
+
+    bench("user gather+scatter (B,10)", user_path, ptab, users, ratings)
+
+    # 7. dense math only
+    @jax.jit
+    def math_only(qtab, items, ratings, users):
+        def body(c, x):
+            ids, r, u = x
+            q = jnp.take(qtab, ids, axis=0)
+            p = jnp.take(qtab, jnp.minimum(u, R_ITEMS - 1), axis=0)
+            pred = jnp.sum(p * q, axis=-1)
+            err = (r - pred)
+            dp = 0.05 * (err[:, None] * q - 0.01 * p)
+            dq = 0.05 * (err[:, None] * p - 0.01 * q)
+            return c + dp.sum() + dq.sum(), None
+        return lax.scan(body, jnp.float32(0), (items, ratings, users))[0]
+
+    bench("2 gathers + SGD math", math_only, qtab, items, ratings, users)
+
+    # 8. full composite analog of the real step
+    @jax.jit
+    def full(qtab, ptab, items, users, ratings):
+        def body(carry, x):
+            qtab, ptab = carry
+            ids, u, r = x
+            q = ops.gather_rows(qtab, ids)
+            p = jnp.take(ptab, u, axis=0)
+            pred = jnp.sum(p * q, axis=-1)
+            err = r - pred
+            dp = 0.05 * (err[:, None] * q - 0.01 * p)
+            dq = 0.05 * (err[:, None] * p - 0.01 * q)
+            ptab = ptab.at[u].add(dp)
+            qtab = mean_push(qtab, ids, dq)
+            return (qtab, ptab), (jnp.sum(err * err), jnp.float32(B))
+        (qtab, ptab), outs = lax.scan(body, (qtab, ptab),
+                                      (items, users, ratings))
+        return qtab, ptab, outs
+
+    bench("full step analog", full, qtab, ptab, items, users, ratings)
+
+
+if __name__ == "__main__":
+    main()
