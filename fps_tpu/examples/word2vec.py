@@ -45,6 +45,7 @@ def main(argv=None) -> int:
         nearest_neighbors,
         skipgram_chunks,
         word2vec,
+        word2vec_block,
     )
     from fps_tpu.utils.datasets import load_text8
 
@@ -57,8 +58,17 @@ def main(argv=None) -> int:
 
     cfg = W2VConfig(vocab_size=vocab, dim=args.dim, window=args.window,
                     negatives=args.negatives, learning_rate=args.learning_rate)
-    trainer, store = word2vec(mesh, cfg, uni, sync_every=args.sync_every,
-                              max_steps_per_call=256)
+    block_len = max(64, args.local_batch // (2 * cfg.window))
+    if args.ingest == "device":
+        # Block-granularity worker: one pull/push row per block position
+        # (~10x fewer sparse row transactions than per-pair pull/push).
+        trainer, store = word2vec_block(
+            mesh, cfg, uni, block_len, sync_every=args.sync_every,
+            max_steps_per_call=256,
+        )
+    else:
+        trainer, store = word2vec(mesh, cfg, uni, sync_every=args.sync_every,
+                                  max_steps_per_call=256)
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
 
@@ -77,9 +87,8 @@ def main(argv=None) -> int:
             # Fused path: tokens resident on device, subsampling/compaction
             # and pair generation inside the compiled epoch.
             plan = Word2VecDevicePlan(
-                tokens, uni, cfg, mesh, num_workers=W,
-                block_len=max(64, args.local_batch // (2 * cfg.window)),
-                seed=args.seed, sync_every=args.sync_every,
+                tokens, uni, cfg, mesh, num_workers=W, block_len=block_len,
+                seed=args.seed, sync_every=args.sync_every, mode="block",
             )
             tables, local_state, _ = trainer.run_indexed(
                 tables, local_state, plan, jax.random.key(args.seed),

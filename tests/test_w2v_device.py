@@ -18,6 +18,7 @@ from fps_tpu.models.word2vec import (
     Word2VecWorker,
     _build_alias,
     word2vec,
+    word2vec_block,
 )
 from fps_tpu.parallel.mesh import make_ps_mesh
 from fps_tpu.utils.datasets import synthetic_corpus
@@ -100,3 +101,67 @@ def test_fused_w2v_learns(mesh):
     assert losses[-1] < losses[0] * 0.85, losses
     # multi-call splitting exercised: steps_per_epoch > max_steps_per_call
     assert plan.steps_per_epoch > 32
+
+
+def test_block_worker_learns_and_tracks_pair_worker(mesh):
+    """The block-granularity worker (one pull/push row per block position,
+    group-shared negatives) must learn the same task with a comparable
+    per-pair loss trajectory to the pair worker."""
+    W = num_workers_of(mesh)
+    tokens = synthetic_corpus(V, 60_000, num_topics=8, seed=0)
+    uni = np.bincount(tokens, minlength=V).astype(np.float64)
+    cfg = W2VConfig(vocab_size=V, dim=16, window=3, negatives=4,
+                    learning_rate=0.05, subsample_t=None)
+
+    def run(block):
+        factory = (lambda: word2vec_block(mesh, cfg, uni, 64)) if block \
+            else (lambda: word2vec(mesh, cfg, uni))
+        trainer, store = factory()
+        tables, ls = trainer.init_state(jax.random.key(0))
+        plan = Word2VecDevicePlan(
+            tokens, uni, cfg, mesh, num_workers=W, block_len=64, seed=0,
+            mode="block" if block else "pairs",
+        )
+        tables, ls, metrics = trainer.run_indexed(
+            tables, ls, plan, jax.random.key(1), epochs=3
+        )
+        return [float(m["loss"].sum() / m["n"].sum()) for m in metrics]
+
+    block_losses = run(True)
+    pair_losses = run(False)
+    assert block_losses[-1] < block_losses[0] * 0.85, block_losses
+    # Same objective, same data: trajectories track within a loose band
+    # (different negative-sampling coupling and combine granularity).
+    for b, p in zip(block_losses, pair_losses):
+        assert abs(b - p) < 0.35 * max(p, 1e-6), (block_losses, pair_losses)
+
+
+def test_block_worker_pair_accounting(mesh):
+    """Block mode counts exactly the pairs the pair mode emits (same
+    blocks, same half-window draws -> identical weighted pair counts)."""
+    W = num_workers_of(mesh)
+    tokens = np.arange(1000, dtype=np.int32) % 97
+    uni = np.bincount(tokens, minlength=97).astype(np.float64)
+    cfg = W2VConfig(vocab_size=97, window=3, negatives=2, subsample_t=None,
+                    neg_group_size=8)
+    counts = {}
+    for mode in ("pairs", "block"):
+        plan = Word2VecDevicePlan(tokens, uni, cfg, mesh, num_workers=W,
+                                  block_len=16, seed=0, mode=mode)
+        args = plan.epoch_args(0)
+        batch_at = jax.jit(plan.local_batch_at)
+        total = 0.0
+        for t in range(plan.steps_per_epoch):
+            for w in range(W):
+                b = batch_at(args, jnp.int32(w), jnp.int32(t))
+                if mode == "pairs":
+                    total += float(np.asarray(b["weight"]).sum())
+                else:
+                    half = np.asarray(b["half"]).astype(int)
+                    vlen = int(b["valid_len"])
+                    L = len(half)
+                    for d in range(1, cfg.window + 1):
+                        ok = (half >= d) & (np.arange(L) + d < vlen)
+                        total += 2.0 * ok.sum()
+        counts[mode] = total
+    assert counts["pairs"] == counts["block"], counts
