@@ -200,10 +200,17 @@ def run_logreg(args):
         LogRegConfig, logistic_regression,
     )
     from fps_tpu.parallel.mesh import default_mesh_shape, make_ps_mesh
-    from fps_tpu.utils.datasets import synthetic_sparse_classification
+    from fps_tpu.utils.datasets import (
+        load_sparse, synthetic_sparse_classification,
+    )
 
     NF, NNZ, NEX = 1_000_000, 39, 4_000_000  # Criteo-ish shape
-    data = synthetic_sparse_classification(NEX, NF, NNZ, seed=0, noise=0.05)
+    if args.input:
+        data, NF = load_sparse(args.input, num_features=NF)
+        NEX, NNZ = data["feat_ids"].shape
+    else:
+        data = synthetic_sparse_classification(NEX, NF, NNZ, seed=0,
+                                               noise=0.05)
     data = dict(data, label=(data["label"] > 0).astype(np.float32))
 
     devs = jax.devices()
@@ -252,6 +259,9 @@ def main():
     ap.add_argument("--local-batch", type=int, default=32768)
     ap.add_argument("--movielens-path", default=None)
     ap.add_argument("--text8-path", default=None)
+    ap.add_argument("--input", default=None,
+                    help="real dataset file for --workload logreg "
+                         "(Criteo TSV or svmlight; default: synthetic)")
     ap.add_argument("--num-tokens", type=int, default=17_000_000)
     ap.add_argument("--dim", type=int, default=100)
     ap.add_argument("--block-len", type=int, default=8192)

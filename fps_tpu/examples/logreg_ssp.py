@@ -31,6 +31,17 @@ def main(argv=None) -> int:
     ap.add_argument("--nnz", type=int, default=32)
     ap.add_argument("--learning-rate", type=float, default=0.1)
     ap.add_argument("--l2", type=float, default=0.0)
+    ap.add_argument("--optimizer", default="sgd", choices=["sgd", "adagrad"],
+                    help="adagrad keeps per-coordinate state in the sharded "
+                         "table — strongly recommended with --input files "
+                         "whose dense columns (e.g. Criteo numerics) make "
+                         "plain SGD oscillate under SSP staleness")
+    ap.add_argument("--input-format", default="auto",
+                    choices=["auto", "svmlight", "criteo"],
+                    help="--input file format (Criteo TSV or RCV1 svmlight)")
+    ap.add_argument("--nnz-cap", type=int, default=None,
+                    help="svmlight rows keep at most this many features "
+                         "(default: the file's max row length)")
     args = ap.parse_args(argv)
     if args.sync_every is None:
         args.sync_every = 8  # this entrypoint exists to exercise SSP
@@ -42,13 +53,26 @@ def main(argv=None) -> int:
         predict_proba_host,
     )
     from fps_tpu.utils.datasets import (
+        load_sparse,
+        sniff_sparse_format,
         synthetic_sparse_classification,
         train_test_split,
     )
 
-    data = synthetic_sparse_classification(
-        args.num_examples, args.num_features, args.nnz, seed=args.seed
-    )
+    if args.input:
+        # Real dataset (Criteo TSV with hashed categoricals, or svmlight).
+        fmt = args.input_format
+        if fmt == "auto":
+            fmt = sniff_sparse_format(args.input)
+        data, args.num_features = load_sparse(
+            args.input, fmt=fmt,
+            num_features=args.num_features if fmt == "criteo" else None,
+            nnz_cap=args.nnz_cap,
+        )
+    else:
+        data = synthetic_sparse_classification(
+            args.num_examples, args.num_features, args.nnz, seed=args.seed
+        )
     data["label"] = (data["label"] > 0).astype(np.float32)  # {0,1}
     train, test = train_test_split(data, test_frac=0.1, seed=args.seed + 1)
 
@@ -58,7 +82,8 @@ def main(argv=None) -> int:
           "sync_every": args.sync_every, "mesh": dict(mesh.shape)})
 
     cfg = LogRegConfig(num_features=args.num_features,
-                       learning_rate=args.learning_rate, l2=args.l2)
+                       learning_rate=args.learning_rate, l2=args.l2,
+                       optimizer=args.optimizer)
     trainer, store = logistic_regression(mesh, cfg, sync_every=args.sync_every)
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)

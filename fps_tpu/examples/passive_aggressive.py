@@ -31,6 +31,12 @@ def main(argv=None) -> int:
     ap.add_argument("--nnz", type=int, default=16)
     ap.add_argument("--variant", default="PA-I", choices=["PA", "PA-I", "PA-II"])
     ap.add_argument("--C", type=float, default=1.0)
+    ap.add_argument("--input-format", default="auto",
+                    choices=["auto", "svmlight", "criteo"],
+                    help="--input file format (RCV1 svmlight or Criteo TSV)")
+    ap.add_argument("--nnz-cap", type=int, default=None,
+                    help="svmlight rows keep at most this many features "
+                         "(default: the file's max row length)")
     args = ap.parse_args(argv)
 
     from fps_tpu.core.driver import num_workers_of
@@ -40,12 +46,29 @@ def main(argv=None) -> int:
         predict_host,
     )
     from fps_tpu.utils.datasets import (
+        load_sparse,
         synthetic_sparse_classification,
         synthetic_sparse_multiclass,
         train_test_split,
     )
 
-    if args.num_classes == 2:
+    if args.input:
+        # Real dataset (RCV1-style svmlight or Criteo TSV); binary {-1,+1}.
+        # svmlight: the feature space comes from the file (ids verbatim);
+        # criteo: the hashed space size is the --num-features knob.
+        from fps_tpu.utils.datasets import sniff_sparse_format
+
+        fmt = args.input_format
+        if fmt == "auto":
+            fmt = sniff_sparse_format(args.input)
+        data, args.num_features = load_sparse(
+            args.input, fmt=fmt,
+            num_features=args.num_features if fmt == "criteo" else None,
+            nnz_cap=args.nnz_cap,
+        )
+        if args.num_classes != 2:
+            raise SystemExit("--input provides binary labels; --num-classes must be 2")
+    elif args.num_classes == 2:
         data = synthetic_sparse_classification(
             args.num_examples, args.num_features, args.nnz, seed=args.seed
         )

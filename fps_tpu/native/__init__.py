@@ -103,6 +103,32 @@ def _load():
             ctypes.POINTER(ctypes.c_int32),
             ctypes.c_long,
         ]
+        lib.fps_svmlight_dims.restype = ctypes.c_long
+        lib.fps_svmlight_dims.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.fps_parse_svmlight.restype = ctypes.c_long
+        lib.fps_parse_svmlight.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.fps_parse_criteo.restype = ctypes.c_long
+        lib.fps_parse_criteo.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),
+        ]
         _lib = lib
         return _lib
 
@@ -154,6 +180,100 @@ def parse_ratings(path: str, max_rows: int | None = None):
             "to return a silently-truncated dataset"
         )
     return users[:n], items[:n], ratings[:n]
+
+
+def parse_svmlight(path: str, nnz_cap: int | None = None):
+    """Parse an svmlight/RCV1 file into padded dense batch arrays.
+
+    Returns ``(labels (N,) f32, ids (N, nnz) i32, vals (N, nnz) f32,
+    truncated)`` with pad slots id 0 / value 0 (inactive by the models'
+    ``x != 0`` convention), or ``None`` if the native library is
+    unavailable. ``nnz_cap`` pads/truncates each row (default: the file's
+    max row length); truncated rows keep their FIRST ``nnz_cap`` features
+    and are counted in ``truncated``. Raises ``ValueError`` on malformed
+    data lines — a corrupted file must not silently shrink. Feature ids
+    are verbatim (svmlight is conventionally 1-based; callers re-index).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    max_nnz = ctypes.c_long(0)
+    rows = lib.fps_svmlight_dims(path.encode(), ctypes.byref(max_nnz))
+    if rows < 0:
+        return None
+    rows = max(int(rows), 1)
+    nnz = int(nnz_cap) if nnz_cap else max(int(max_nnz.value), 1)
+    labels = np.zeros(rows, np.float32)
+    ids = np.zeros((rows, nnz), np.int32)
+    vals = np.zeros((rows, nnz), np.float32)
+    truncated = ctypes.c_long(0)
+    malformed = ctypes.c_long(0)
+    n = lib.fps_parse_svmlight(
+        path.encode(),
+        _ptr(labels, ctypes.c_float),
+        _ptr(ids, ctypes.c_int32),
+        _ptr(vals, ctypes.c_float),
+        rows,
+        nnz,
+        ctypes.byref(truncated),
+        ctypes.byref(malformed),
+    )
+    if n < 0:
+        return None
+    if malformed.value:
+        raise ValueError(
+            f"{path}: {malformed.value} malformed svmlight line(s) — "
+            "refusing to return a silently-truncated dataset"
+        )
+    return labels[:n], ids[:n], vals[:n], int(truncated.value)
+
+
+CRITEO_NUM_COLS = 13
+CRITEO_CAT_COLS = 26
+CRITEO_NNZ = CRITEO_NUM_COLS + CRITEO_CAT_COLS
+
+
+def parse_criteo(path: str, num_features: int):
+    """Parse a Criteo click-log TSV into padded dense batch arrays.
+
+    Returns ``(labels (N,) f32 in {0,1}, ids (N, 39) i32, vals (N, 39)
+    f32)`` or ``None`` if the native library is unavailable. Numeric column
+    j with value x >= 0 becomes id j / value log1p(x); categorical column j
+    becomes id ``13 + hash(j, token) % (num_features - 13)`` / value 1.0
+    (FNV-1a + splitmix64 — the numpy fallback in utils.datasets matches it
+    bit-for-bit). Missing fields stay inactive. Raises ``ValueError`` on
+    malformed lines.
+    """
+    if num_features <= CRITEO_NUM_COLS:
+        raise ValueError("num_features must exceed 13 (the numeric columns)")
+    lib = _load()
+    if lib is None:
+        return None
+    rows = lib.fps_count_lines(path.encode())
+    if rows < 0:
+        return None
+    rows = max(int(rows), 1)
+    labels = np.zeros(rows, np.float32)
+    ids = np.zeros((rows, CRITEO_NNZ), np.int32)
+    vals = np.zeros((rows, CRITEO_NNZ), np.float32)
+    malformed = ctypes.c_long(0)
+    n = lib.fps_parse_criteo(
+        path.encode(),
+        _ptr(labels, ctypes.c_float),
+        _ptr(ids, ctypes.c_int32),
+        _ptr(vals, ctypes.c_float),
+        rows,
+        num_features,
+        ctypes.byref(malformed),
+    )
+    if n < 0:
+        return None
+    if malformed.value:
+        raise ValueError(
+            f"{path}: {malformed.value} malformed Criteo line(s) — "
+            "refusing to return a silently-truncated dataset"
+        )
+    return labels[:n], ids[:n], vals[:n]
 
 
 def skipgram_pairs(

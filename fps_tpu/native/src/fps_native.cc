@@ -177,6 +177,287 @@ long fps_parse_ratings(const char* path, int32_t* users, int32_t* items,
   return n;
 }
 
+namespace {
+
+// Signed decimal with optional exponent ("-1", "+0.25", "1e-3"); advances p.
+// Returns false if nothing parseable at p.
+inline bool parse_signed(const char*& p, const char* end, double* out) {
+  double sign = 1.0;
+  if (p < end && (*p == '+' || *p == '-')) {
+    if (*p == '-') sign = -1.0;
+    ++p;
+  }
+  if (p >= end || (!is_digit(*p) && *p != '.')) return false;
+  double v = 0.0;
+  while (p < end && is_digit(*p)) v = v * 10.0 + (*p++ - '0');
+  if (p < end && *p == '.') {
+    ++p;
+    double scale = 0.1;
+    while (p < end && is_digit(*p)) {
+      v += (*p++ - '0') * scale;
+      scale *= 0.1;
+    }
+  }
+  if (p < end && (*p == 'e' || *p == 'E')) {
+    ++p;
+    double esign = 1.0;
+    if (p < end && (*p == '+' || *p == '-')) {
+      if (*p == '-') esign = -1.0;
+      ++p;
+    }
+    if (p >= end || !is_digit(*p)) return false;
+    double e = 0.0;
+    while (p < end && is_digit(*p)) e = e * 10.0 + (*p++ - '0');
+    // Cap: beyond ~1e310 the scale is inf/0 anyway, and an O(e) loop on a
+    // hostile exponent ("1e2000000000") must not hang the parser.
+    long ecap = e > 310.0 ? 310 : static_cast<long>(e);
+    double scale = 1.0;
+    for (long k = 0; k < ecap; ++k) scale *= 10.0;
+    v = esign > 0 ? v * scale : v / scale;
+  }
+  *out = sign * v;
+  return true;
+}
+
+// FNV-1a 64-bit over bytes, finalized with splitmix64 — the categorical
+// feature hash. fps_tpu/utils/datasets.py's fallback reimplements this
+// bit-for-bit; the two must stay in sync.
+inline uint64_t hash_bytes(uint64_t seed, const char* s, long len) {
+  uint64_t h = 1469598103934665603ULL ^ seed;
+  for (long i = 0; i < len; ++i) {
+    h ^= static_cast<unsigned char>(s[i]);
+    h *= 1099511628211ULL;
+  }
+  uint64_t z = h + 0x9E3779B97F4A7C15ULL;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+inline char* read_whole_file(const char* path, long* out_len) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = static_cast<char*>(malloc(size + 1));
+  if (!buf) {
+    fclose(f);
+    return nullptr;
+  }
+  *out_len = static_cast<long>(fread(buf, 1, size, f));
+  fclose(f);
+  return buf;
+}
+
+}  // namespace
+
+// svmlight/RCV1 scanner, pass 1: rows and the max feature count of any
+// line, so the caller can size the padded (rows, nnz) arrays. Lines:
+//   <label> <idx>:<val> <idx>:<val> ... [# comment]
+// '#'-leading lines are comments; blank lines skipped. Returns data-looking
+// row count, or -1 if the file cannot be read.
+long fps_svmlight_dims(const char* path, long* max_nnz) {
+  long size = 0;
+  char* buf = read_whole_file(path, &size);
+  if (!buf) return -1;
+  const char* p = buf;
+  const char* end = buf + size;
+  long rows = 0, mx = 0;
+  while (p < end) {
+    while (p < end && (*p == ' ' || *p == '\r')) ++p;
+    if (p >= end) break;
+    if (*p == '\n' || *p == '#') {
+      skip_line(p, end);
+      continue;
+    }
+    long nnz = 0;
+    const char* q = p;
+    while (q < end && *q != '\n' && *q != '#') {
+      if (*q == ':') ++nnz;
+      ++q;
+    }
+    if (nnz > mx) mx = nnz;
+    ++rows;
+    skip_line(p, end);
+  }
+  free(buf);
+  *max_nnz = mx;
+  return rows;
+}
+
+// svmlight/RCV1 scanner, pass 2: fill CALLER-ZEROED padded row-major
+// (cap_rows x nnz_cap) id/value arrays (pad slots stay id 0 / value 0 —
+// inactive by the models' x != 0 convention) plus float labels. Feature
+// ids are written verbatim (svmlight is conventionally 1-based; the caller
+// re-indexes). Rows with more than nnz_cap features keep the FIRST nnz_cap
+// and count in *truncated. Structurally malformed data lines (unparseable
+// label, broken idx:val token) count in *malformed — callers refuse the
+// file rather than silently drop lines, matching fps_parse_ratings.
+long fps_parse_svmlight(const char* path, float* labels, int32_t* ids,
+                        float* vals, long cap_rows, long nnz_cap,
+                        long* truncated, long* malformed) {
+  long size = 0;
+  char* buf = read_whole_file(path, &size);
+  if (!buf) return -1;
+  const char* p = buf;
+  const char* end = buf + size;
+  long n = 0, bad = 0, trunc = 0;
+  while (n < cap_rows && p < end) {
+    while (p < end && (*p == ' ' || *p == '\r')) ++p;
+    if (p >= end) break;
+    if (*p == '\n' || *p == '#') {
+      skip_line(p, end);
+      continue;
+    }
+    double label;
+    if (!parse_signed(p, end, &label)) {
+      ++bad;
+      skip_line(p, end);
+      continue;
+    }
+    long nnz = 0;
+    bool ok = true;
+    while (p < end && *p != '\n' && *p != '#' && *p != '\r') {
+      while (p < end && *p == ' ') ++p;
+      if (p >= end || *p == '\n' || *p == '#' || *p == '\r') break;
+      long idx = parse_uint(p, end);
+      if (idx < 0 || p >= end || *p != ':') {
+        ok = false;
+        break;
+      }
+      ++p;  // ':'
+      double v;
+      if (!parse_signed(p, end, &v)) {
+        ok = false;
+        break;
+      }
+      if (nnz < nnz_cap) {
+        ids[n * nnz_cap + nnz] = static_cast<int32_t>(idx);
+        vals[n * nnz_cap + nnz] = static_cast<float>(v);
+        ++nnz;
+      } else {
+        ++trunc;
+        // keep scanning to validate the rest of the line
+      }
+    }
+    if (!ok) {
+      ++bad;
+      // wipe any partial row
+      for (long k = 0; k < nnz_cap; ++k) {
+        ids[n * nnz_cap + k] = 0;
+        vals[n * nnz_cap + k] = 0.0f;
+      }
+      skip_line(p, end);
+      continue;
+    }
+    labels[n] = static_cast<float>(label);
+    ++n;
+    skip_line(p, end);
+  }
+  free(buf);
+  if (truncated) *truncated = trunc;
+  if (malformed) *malformed = bad;
+  return n;
+}
+
+// Criteo click-logs scanner: one example per line,
+//   <label> \t I1..I13 (ints, may be empty/negative) \t C1..C26 (hex tokens,
+//   may be empty)
+// Numeric column j (0-based) with value x >= 0 becomes feature id j with
+// value log1p(x); negative or empty numerics are treated as missing.
+// Categorical column j with token s becomes feature id
+//   13 + hash(j, s) % (num_features - 13)      (FNV-1a + splitmix64)
+// with value 1.0. Output is CALLER-ZEROED row-major (cap_rows x 39); missing
+// fields leave inactive pad slots. Lines with a non-0/1 label or a wrong
+// field count are malformed. Returns rows, or -1 on IO error.
+long fps_parse_criteo(const char* path, float* labels, int32_t* ids,
+                      float* vals, long cap_rows, long num_features,
+                      long* malformed) {
+  long size = 0;
+  char* buf = read_whole_file(path, &size);
+  if (!buf) return -1;
+  const long kNum = 13, kCat = 26, kNnz = kNum + kCat;
+  const long cat_space = num_features - kNum;
+  const char* p = buf;
+  const char* end = buf + size;
+  long n = 0, bad = 0;
+  while (n < cap_rows && p < end) {
+    if (*p == '\n') {
+      ++p;
+      continue;
+    }
+    if (*p == '\r' && (p + 1 >= end || p[1] == '\n')) {
+      p += (p + 1 < end) ? 2 : 1;  // blank CRLF line, skip like the fallback
+      continue;
+    }
+    const char* line_end = p;
+    while (line_end < end && *line_end != '\n') ++line_end;
+    const char* le = line_end;
+    if (le > p && le[-1] == '\r') --le;
+
+    bool ok = true;
+    // label
+    long label = parse_uint(p, le);
+    if (label != 0 && label != 1) ok = false;
+    long nnz = 0;
+    long field = 0;
+    while (ok && field < kNnz) {
+      if (p >= le || *p != '\t') {
+        ok = false;
+        break;
+      }
+      ++p;  // tab
+      const char* fs = p;
+      while (p < le && *p != '\t') ++p;
+      long flen = p - fs;
+      if (flen == 0) {
+        ++field;
+        continue;  // missing value
+      }
+      if (field < kNum) {
+        const char* q = fs;
+        double v;
+        if (!parse_signed(q, fs + flen, &v) || q != fs + flen) {
+          ok = false;
+          break;
+        }
+        if (v >= 0.0) {
+          // log1p, cheap enough inline
+          double x = v, r = 0.0;
+          r = __builtin_log1p(x);
+          ids[n * kNnz + nnz] = static_cast<int32_t>(field);
+          vals[n * kNnz + nnz] = static_cast<float>(r);
+          ++nnz;
+        }
+      } else {
+        uint64_t h = hash_bytes(static_cast<uint64_t>(field), fs, flen);
+        ids[n * kNnz + nnz] =
+            static_cast<int32_t>(kNum + static_cast<long>(h % cat_space));
+        vals[n * kNnz + nnz] = 1.0f;
+        ++nnz;
+      }
+      ++field;
+    }
+    if (ok && (field != kNnz || p != le)) ok = false;
+    if (!ok) {
+      ++bad;
+      for (long k = 0; k < kNnz; ++k) {
+        ids[n * kNnz + k] = 0;
+        vals[n * kNnz + k] = 0.0f;
+      }
+      p = line_end < end ? line_end + 1 : end;
+      continue;
+    }
+    labels[n] = static_cast<float>(label);
+    ++n;
+    p = line_end < end ? line_end + 1 : end;
+  }
+  free(buf);
+  if (malformed) *malformed = bad;
+  return n;
+}
+
 // Skip-gram pair generation over a token segment, mirroring
 // fps_tpu/models/word2vec.py's skipgram_chunks inner loop:
 //   1. drop position t with probability 1 - keep_p[token[t]]  (subsampling)

