@@ -63,6 +63,17 @@ class TrainerConfig:
     """
 
     sync_every: int | None = None  # None => fully synchronous mode
+    # Emulates the reference's in-flight pushes: a worker's pushes reach the
+    # authoritative tables ``push_delay`` steps after they were computed
+    # (0 = immediately, the sync/SSP default). Worker-LOCAL state updates
+    # stay immediate — in the reference, too, only PS traffic rides the
+    # network while worker operator state is updated in place. Combined
+    # with ``sync_every`` this brackets the reference's free-running
+    # asynchrony from both sides: stale reads AND delayed writes. Delayed
+    # pushes ride a ring buffer in the compiled loop's carry; whatever is
+    # still in flight when a compiled call ends is flushed then (a chunk /
+    # dispatch boundary acts as a quiesce point).
+    push_delay: int = 0
     donate: bool = True
     # Upper bound on scan steps per compiled call in run_indexed. A single
     # device program must not run for minutes (the TPU runtime enforces a
@@ -144,89 +155,155 @@ class Trainer:
             )
         return new_tables
 
-    def _sync_step(self, tables, local_state, batch, key):
+    def _compute_step(self, tables, snapshot, local_state, batch, key):
+        """Pull (from live tables, or the SSP ``snapshot`` when given), run
+        the worker step, and return its pushes WITHOUT applying them."""
         key, prep_key = jax.random.split(key)
         batch = self.logic.prepare(batch, prep_key)
         ids = self.logic.pull_ids(batch)
-        pulled = {
-            name: pull(tables[name], tids, num_shards=self.num_shards)
-            for name, tids in ids.items()
-        }
+        if snapshot is None:
+            pulled = {
+                name: pull(tables[name], tids, num_shards=self.num_shards)
+                for name, tids in ids.items()
+            }
+        else:
+            pulled = {}
+            for name, tids in ids.items():
+                rps = tables[name].shape[0]
+                phys = id_to_phys(tids, self.num_shards, rps)
+                pulled[name] = jnp.take(snapshot[name], phys, axis=0)
         out = self.logic.step(batch, pulled, local_state, key)
-        tables = self._apply_pushes(tables, out.pushes)
-        return tables, out.local_state, out.out
+        return out.pushes, out.local_state, out.out
 
-    def _snapshot_step(self, tables, snapshot, local_state, batch, key):
-        """SSP inner step: read from the replicated snapshot, push live."""
-        key, prep_key = jax.random.split(key)
-        batch = self.logic.prepare(batch, prep_key)
-        ids = self.logic.pull_ids(batch)
-        pulled = {}
-        for name, tids in ids.items():
-            rps = tables[name].shape[0]
-            phys = id_to_phys(tids, self.num_shards, rps)
-            pulled[name] = jnp.take(snapshot[name], phys, axis=0)
-        out = self.logic.step(batch, pulled, local_state, key)
-        tables = self._apply_pushes(tables, out.pushes)
-        return tables, out.local_state, out.out
+    # -- delayed pushes (async in-flight emulation) ------------------------
+
+    def _init_push_bufs(self, tables, local_state, batch_like, key):
+        """Ring buffers of the last ``push_delay`` steps' pushes per table.
+
+        Shapes come from a collective-free ``eval_shape`` probe of the
+        worker logic. Slots start as dropped pushes (ids ``-1``), so the
+        first ``push_delay`` steps deliver nothing — a cold asynchronous
+        start, like the reference's empty network queues.
+        """
+        d = self.config.push_delay
+
+        def probe(batch, local_state, key):
+            key, prep_key = jax.random.split(key)
+            b = self.logic.prepare(batch, prep_key)
+            ids = self.logic.pull_ids(b)
+            pulled = {
+                name: jnp.zeros(
+                    tids.shape + (tables[name].shape[-1],),
+                    tables[name].dtype,
+                )
+                for name, tids in ids.items()
+            }
+            return self.logic.step(b, pulled, local_state, key).pushes
+
+        shapes = jax.eval_shape(probe, batch_like, local_state, key)
+        return {
+            name: (
+                jnp.full((d,) + ids_s.shape, -1, ids_s.dtype),
+                jnp.zeros((d,) + del_s.shape, del_s.dtype),
+            )
+            for name, (ids_s, del_s) in shapes.items()
+        }
+
+    def _apply_or_buffer(self, tables, bufs, t, pushes):
+        """Apply ``pushes`` now (push_delay 0) or deliver the pushes from
+        ``push_delay`` steps ago and enqueue the new ones in their slot."""
+        d = self.config.push_delay
+        if not d:
+            return self._apply_pushes(tables, pushes), bufs
+        slot = t % d
+        new_bufs = {}
+        delayed = {}
+        for name, (ids, deltas) in pushes.items():
+            bids, bdel = bufs[name]
+            delayed[name] = (
+                lax.dynamic_index_in_dim(bids, slot, 0, keepdims=False),
+                lax.dynamic_index_in_dim(bdel, slot, 0, keepdims=False),
+            )
+            new_bufs[name] = (
+                lax.dynamic_update_index_in_dim(bids, ids, slot, 0),
+                lax.dynamic_update_index_in_dim(bdel, deltas, slot, 0),
+            )
+        return self._apply_pushes(tables, delayed), new_bufs
+
+    def _flush_push_bufs(self, tables, bufs, t):
+        """Deliver everything still in flight, oldest first (end of call)."""
+        d = self.config.push_delay
+        if not d:
+            return tables
+
+        def body(k, tables):
+            slot = (t + k) % d
+            pending = {
+                name: (
+                    lax.dynamic_index_in_dim(bids, slot, 0, keepdims=False),
+                    lax.dynamic_index_in_dim(bdel, slot, 0, keepdims=False),
+                )
+                for name, (bids, bdel) in bufs.items()
+            }
+            return self._apply_pushes(tables, pending)
+
+        return lax.fori_loop(0, d, body, tables)
 
     # -- compiled chunk runners ------------------------------------------
 
     def _build_chunk_fn(self, mode: str):
+        nbatch_dims = 1 if mode == "sync" else 2
+
         def chunk_device(tables, local_state, batches, key):
             # Per-device key stream, decorrelated across workers.
             key = jax.random.fold_in(key, worker_index())
+            bufs = None
+            if self.config.push_delay:
+                batch0 = jax.tree.map(
+                    lambda x: x[(0,) * nbatch_dims], batches
+                )
+                bufs = self._init_push_bufs(tables, local_state, batch0, key)
 
+            def step_fn(carry, batch_t, snapshot=None):
+                tables, bufs, local_state, key, t = carry
+                key, sub = jax.random.split(key)
+                pushes, local_state, out = self._compute_step(
+                    tables, snapshot, local_state, batch_t, sub
+                )
+                tables, bufs = self._apply_or_buffer(tables, bufs, t, pushes)
+                out = jax.tree.map(
+                    lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
+                )
+                return (tables, bufs, local_state, key, t + 1), out
+
+            carry0 = (tables, bufs, local_state, key, jnp.int32(0))
             if mode == "sync":
-                def body(carry, batch_t):
-                    tables, local_state, key = carry
-                    key, sub = jax.random.split(key)
-                    tables, local_state, out = self._sync_step(
-                        tables, local_state, batch_t, sub
-                    )
-                    out = jax.tree.map(
-                        lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
-                    )
-                    return (tables, local_state, key), out
-
-                (tables, local_state, _), outs = lax.scan(
-                    body, (tables, local_state, key), batches
+                (tables, bufs, local_state, _, t), outs = lax.scan(
+                    step_fn, carry0, batches
                 )
-                return tables, local_state, outs
-
-            # SSP: batches leaves are (R, s, B_local, ...).
-            def round_body(carry, batches_r):
-                tables, local_state, key = carry
-                snapshot = {
-                    name: lax.all_gather(t, SHARD_AXIS, tiled=True)
-                    for name, t in tables.items()
-                }
-
-                def body(c2, batch_t):
-                    tables, local_state, key = c2
-                    key, sub = jax.random.split(key)
-                    tables, local_state, out = self._snapshot_step(
-                        tables, snapshot, local_state, batch_t, sub
+            else:
+                # SSP: batches leaves are (R, s, B_local, ...).
+                def round_body(carry, batches_r):
+                    tables = carry[0]
+                    snapshot = {
+                        name: lax.all_gather(tb, SHARD_AXIS, tiled=True)
+                        for name, tb in tables.items()
+                    }
+                    return lax.scan(
+                        lambda c, b: step_fn(c, b, snapshot), carry, batches_r
                     )
-                    out = jax.tree.map(
-                        lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
-                    )
-                    return (tables, local_state, key), out
 
-                (tables, local_state, key), outs = lax.scan(
-                    body, (tables, local_state, key), batches_r
+                (tables, bufs, local_state, _, t), outs = lax.scan(
+                    round_body, carry0, batches
                 )
-                return (tables, local_state, key), outs
-
-            (tables, local_state, _), outs = lax.scan(
-                round_body, (tables, local_state, key), batches
-            )
-            outs = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), outs)
+                outs = jax.tree.map(
+                    lambda x: x.reshape((-1,) + x.shape[2:]), outs
+                )
+            tables = self._flush_push_bufs(tables, bufs, t)
             return tables, local_state, outs
 
         table_specs = {name: P(SHARD_AXIS, None) for name in self.store.specs}
         ls_spec = P(WORKER_AXES)
-        nbatch_dims = 1 if mode == "sync" else 2
 
         def specs_for_batches(batches):
             return jax.tree.map(
@@ -256,9 +333,10 @@ class Trainer:
         return jax.jit(run, donate_argnums=donate)
 
     def _get_compiled(self, mode: str):
-        # Keyed on the ops backend too: set_backend() after a compile must
-        # take effect on the next chunk, not be shadowed by the jit cache.
-        key = (mode, ops.get_backend())
+        # Keyed on the ops backend and push_delay too: set_backend() or a
+        # config change after a compile must take effect on the next chunk,
+        # not be shadowed by the jit cache.
+        key = (mode, ops.get_backend(), self.config.push_delay)
         if key not in self._compiled:
             self._compiled[key] = self._build_chunk_fn(mode)
         return self._compiled[key]
@@ -298,48 +376,48 @@ class Trainer:
         def epoch_device(tables, local_state, iargs, start, key):
             widx = worker_index()
             key = jax.random.fold_in(key, widx)
+            bufs = None
+            if self.config.push_delay:
+                # Probe batch for push shapes (unused value, DCE'd by XLA).
+                batch0 = plan.local_batch_at(iargs, widx, start)
+                bufs = self._init_push_bufs(tables, local_state, batch0, key)
 
             def step_t(carry, t, snapshot=None):
-                tables, local_state, key = carry
+                tables, bufs, local_state, key = carry
                 key, sub = jax.random.split(key)
                 batch = plan.local_batch_at(iargs, widx, t)
-                if snapshot is None:
-                    tables, local_state, out = self._sync_step(
-                        tables, local_state, batch, sub
-                    )
-                else:
-                    tables, local_state, out = self._snapshot_step(
-                        tables, snapshot, local_state, batch, sub
-                    )
+                pushes, local_state, out = self._compute_step(
+                    tables, snapshot, local_state, batch, sub
+                )
+                tables, bufs = self._apply_or_buffer(tables, bufs, t, pushes)
                 out = jax.tree.map(
                     lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
                 )
-                return (tables, local_state, key), out
+                return (tables, bufs, local_state, key), out
 
+            carry0 = (tables, bufs, local_state, key)
             if mode == "sync":
-                (tables, local_state, _), outs = lax.scan(
-                    step_t, (tables, local_state, key),
-                    start + jnp.arange(T, dtype=jnp.int32),
+                (tables, bufs, local_state, _), outs = lax.scan(
+                    step_t, carry0, start + jnp.arange(T, dtype=jnp.int32),
                 )
+                tables = self._flush_push_bufs(tables, bufs, start + T)
                 return tables, local_state, outs
 
             def round_body(carry, r):
-                tables, local_state, key = carry
+                tables = carry[0]
                 snapshot = {
                     name: lax.all_gather(tb, SHARD_AXIS, tiled=True)
                     for name, tb in tables.items()
                 }
-                (tables, local_state, key), outs = lax.scan(
-                    lambda c, t: step_t(c, t, snapshot),
-                    (tables, local_state, key),
+                return lax.scan(
+                    lambda c, t: step_t(c, t, snapshot), carry,
                     start + r * s + jnp.arange(s, dtype=jnp.int32),
                 )
-                return (tables, local_state, key), outs
 
-            (tables, local_state, _), outs = lax.scan(
-                round_body, (tables, local_state, key),
-                jnp.arange(T // s, dtype=jnp.int32),
+            (tables, bufs, local_state, _), outs = lax.scan(
+                round_body, carry0, jnp.arange(T // s, dtype=jnp.int32),
             )
+            tables = self._flush_push_bufs(tables, bufs, start + T)
             outs = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), outs)
             return tables, local_state, outs
 
@@ -388,7 +466,8 @@ class Trainer:
             raise ValueError("plan.sync_every must match TrainerConfig")
         # Keyed on the plan object itself (its geometry is baked into the
         # compiled program as constants, so identity is the correct key).
-        ck = ("indexed", mode, plan, ops.get_backend())
+        ck = ("indexed", mode, plan, ops.get_backend(),
+              self.config.push_delay)
         if ck not in self._compiled:
             self._compiled[ck] = self._build_indexed_fn(plan, mode)
         fn = self._compiled[ck]

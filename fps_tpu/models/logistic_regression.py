@@ -130,7 +130,8 @@ def adagrad_fold(lr: float, eps: float):
 
 
 def logistic_regression(mesh, cfg: LogRegConfig, *,
-                        sync_every: int | None = None, donate: bool = True,
+                        sync_every: int | None = None, push_delay: int = 0,
+                        donate: bool = True,
                         max_steps_per_call: int | None = None):
     """(trainer, store); pass ``sync_every=s`` for SSP bounded staleness."""
     from fps_tpu.core.driver import Trainer, TrainerConfig
@@ -144,7 +145,8 @@ def logistic_regression(mesh, cfg: LogRegConfig, *,
     trainer = Trainer(
         mesh, store, LogisticRegressionWorker(cfg),
         server_logic=server_logic,
-        config=TrainerConfig(sync_every=sync_every, donate=donate,
+        config=TrainerConfig(sync_every=sync_every, push_delay=push_delay,
+                             donate=donate,
                              max_steps_per_call=max_steps_per_call),
     )
     return trainer, store

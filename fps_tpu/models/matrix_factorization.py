@@ -133,7 +133,8 @@ def make_store(mesh, cfg: MFConfig) -> ParamStore:
 
 
 def online_mf(mesh, cfg: MFConfig, *, sync_every: int | None = None,
-              donate: bool = True, max_steps_per_call: int | None = None,
+              push_delay: int = 0, donate: bool = True,
+              max_steps_per_call: int | None = None,
               combine: str = "sum"):
     """Construct (trainer, store) for online MF — the analog of
     ``PSOnlineMatrixFactorization.psOnlineMF(...)``.
@@ -152,7 +153,8 @@ def online_mf(mesh, cfg: MFConfig, *, sync_every: int | None = None,
     trainer = Trainer(
         mesh, store, worker,
         server_logic=ServerLogic(combine=combine),
-        config=TrainerConfig(sync_every=sync_every, donate=donate,
+        config=TrainerConfig(sync_every=sync_every, push_delay=push_delay,
+                             donate=donate,
                              max_steps_per_call=max_steps_per_call),
     )
     return trainer, store

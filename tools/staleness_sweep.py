@@ -1,0 +1,116 @@
+"""Staleness sweep: convergence vs (sync_every s, push_delay d) on MF and
+SSP logreg, over an 8-worker mesh. Generates the table in docs/STALENESS.md.
+
+Run (CPU mesh, like the test suite):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  PYTHONPATH=/root/repo python tools/staleness_sweep.py
+"""
+
+import sys
+
+import numpy as np
+
+import jax
+
+from fps_tpu.core.driver import num_workers_of
+from fps_tpu.core.ingest import multi_epoch_chunks
+from fps_tpu.models.logistic_regression import (
+    LogRegConfig,
+    logistic_regression,
+    predict_proba_host,
+)
+from fps_tpu.models.matrix_factorization import (
+    MFConfig,
+    online_mf,
+    predict_host,
+    rmse,
+)
+from fps_tpu.parallel.mesh import make_ps_mesh
+from fps_tpu.utils.datasets import (
+    synthetic_ratings,
+    synthetic_sparse_classification,
+    train_test_split,
+)
+
+
+def mf_run(mesh, train, test, nu, ni, *, s, d, lr, epochs):
+    W = num_workers_of(mesh)
+    cfg = MFConfig(num_users=nu, num_items=ni, rank=4, learning_rate=lr,
+                   reg=0.005)
+    trainer, store = online_mf(mesh, cfg, sync_every=s, push_delay=d)
+    t, l = trainer.init_state(jax.random.key(0))
+    chunks = multi_epoch_chunks(
+        train, epochs, num_workers=W, local_batch=32,
+        steps_per_chunk=max(8, s or 0), route_key="user", sync_every=s,
+        seed=11,
+    )
+    t, l, _ = trainer.fit_stream(t, l, chunks, jax.random.key(1))
+    pred = predict_host(store, np.asarray(l), W, test["user"], test["item"])
+    return rmse(pred, test["rating"])
+
+
+def logreg_run(mesh, train, test, nf, *, s, d, lr, epochs):
+    W = num_workers_of(mesh)
+    cfg = LogRegConfig(num_features=nf, learning_rate=lr)
+    trainer, store = logistic_regression(mesh, cfg, sync_every=s,
+                                         push_delay=d)
+    t, l = trainer.init_state(jax.random.key(0))
+    chunks = multi_epoch_chunks(
+        train, epochs, num_workers=W, local_batch=32,
+        steps_per_chunk=max(8, s or 0), sync_every=s, seed=11,
+    )
+    t, l, _ = trainer.fit_stream(t, l, chunks, jax.random.key(1))
+    p = predict_proba_host(store, test["feat_ids"], test["feat_vals"])
+    return float(np.mean((p > 0.5) == (test["label"] > 0.5)))
+
+
+def main():
+    mesh = make_ps_mesh(num_shards=8, num_data=1)
+
+    NU, NI = 96, 64
+    mf_data = synthetic_ratings(NU, NI, 6000, rank=3, noise=0.05, seed=3)
+    mf_train, mf_test = train_test_split(mf_data)
+
+    NF = 4000
+    lg_data = synthetic_sparse_classification(8000, NF, 8, seed=7,
+                                              noise=0.05)
+    lg_data["label"] = (lg_data["label"] > 0).astype(np.float32)
+    lg_train, lg_test = train_test_split(lg_data)
+
+    # (s, d, lr multiplier, epoch multiplier): the async-SGD recipe — scale
+    # the learning rate down and the steps up with the TOTAL staleness.
+    grid = [
+        (None, 0, 1.0, 1),
+        (1, 0, 1.0, 1),
+        (4, 0, 1.0, 1),
+        (4, 4, 0.5, 2),
+        (16, 0, 0.5, 2),
+        (16, 16, 0.25, 2),
+        (64, 0, 0.25, 4),
+        (64, 64, 1 / 16, 4),
+    ]
+    mf_lr0, mf_ep0 = 0.08, 3
+    lg_lr0, lg_ep0 = 0.5, 3
+
+    rows = []
+    for s, d, lrm, epm in grid:
+        r = mf_run(mesh, mf_train, mf_test, NU, NI, s=s, d=d,
+                   lr=mf_lr0 * lrm, epochs=mf_ep0 * epm)
+        a = logreg_run(mesh, lg_train, lg_test, NF, s=s, d=d,
+                       lr=lg_lr0 * lrm, epochs=lg_ep0 * epm)
+        tag = "sync" if s is None else f"s={s}"
+        rows.append((tag, d, lrm, epm, r, a))
+        print(f"{tag:6s} d={d:3d} lr x{lrm:<5g} ep x{epm}: "
+              f"MF test RMSE {r:.4f}   logreg test acc {a:.4f}",
+              flush=True)
+
+    print("\n| reads | push delay | lr scale | epochs scale | "
+          "MF test RMSE | logreg test acc |")
+    print("|---|---|---|---|---|---|")
+    for tag, d, lrm, epm, r, a in rows:
+        print(f"| {tag} | {d} | x{lrm:g} | x{epm} | {r:.4f} | {a:.4f} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
