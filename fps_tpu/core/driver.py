@@ -25,7 +25,7 @@ by a host-side ingest loop. Two execution modes:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Iterable, Mapping
+from typing import Any, Callable, Iterable, Mapping
 
 import jax
 import jax.numpy as jnp
@@ -74,6 +74,20 @@ class TrainerConfig:
     # still in flight when a compiled call ends is flushed then (a chunk /
     # dispatch boundary acts as a quiesce point).
     push_delay: int = 0
+    # Optional per-step tap with TABLE access, traced into the compiled
+    # loop: ``tap(tables, batch, local_state, t) -> pytree``. Unlike the
+    # worker's ``out`` channel (global sums), tap outputs are all-gathered
+    # across the worker axes — metrics gain a ``"tap"`` entry whose leaves
+    # carry a leading per-worker axis ``(T, W, ...)``. This is how
+    # per-worker emissions that need the live tables ride the output
+    # stream — e.g. online top-K recommendations interleaved with training
+    # (the reference's ``...AndTopK`` jobs emit exactly such records on
+    # WOut; see fps_tpu.models.recommendation.make_online_topk_tap).
+    # ``batch`` is the raw (pre-``prepare``) batch; ``tables`` are the
+    # values after this step's push DELIVERY — with ``push_delay > 0``
+    # that is the push from ``push_delay`` steps ago, not this step's
+    # (in-flight pushes are invisible, exactly like the async reference).
+    step_tap: Callable[..., Any] | None = None
     donate: bool = True
     # Upper bound on scan steps per compiled call in run_indexed. A single
     # device program must not run for minutes (the TPU runtime enforces a
@@ -209,6 +223,29 @@ class Trainer:
             for name, (ids_s, del_s) in shapes.items()
         }
 
+    def _gather_workers(self, x):
+        """Stack a per-worker leaf into (W, ...) in worker_index order."""
+        x = lax.all_gather(x, SHARD_AXIS)  # (S, ...)
+        x = lax.all_gather(x, DATA_AXIS)  # (D, S, ...)
+        return x.reshape((self.num_workers,) + x.shape[2:])
+
+    def _run_tap(self, out, tables, batch, local_state, t):
+        tap = self.config.step_tap
+        if tap is None:
+            return out
+        if not isinstance(out, dict):
+            raise TypeError(
+                "step_tap requires the worker's out channel to be a dict "
+                f"(got {type(out).__name__})"
+            )
+        if "tap" in out:
+            raise ValueError(
+                "the worker's out channel already has a 'tap' key — it "
+                "would be silently clobbered by the step_tap output"
+            )
+        tapped = tap(tables, batch, local_state, t)
+        return dict(out, tap=jax.tree.map(self._gather_workers, tapped))
+
     def _apply_or_buffer(self, tables, bufs, t, pushes):
         """Apply ``pushes`` now (push_delay 0) or deliver the pushes from
         ``push_delay`` steps ago and enqueue the new ones in their slot."""
@@ -274,6 +311,7 @@ class Trainer:
                 out = jax.tree.map(
                     lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
                 )
+                out = self._run_tap(out, tables, batch_t, local_state, t)
                 return (tables, bufs, local_state, key, t + 1), out
 
             carry0 = (tables, bufs, local_state, key, jnp.int32(0))
@@ -336,7 +374,8 @@ class Trainer:
         # Keyed on the ops backend and push_delay too: set_backend() or a
         # config change after a compile must take effect on the next chunk,
         # not be shadowed by the jit cache.
-        key = (mode, ops.get_backend(), self.config.push_delay)
+        key = (mode, ops.get_backend(), self.config.push_delay,
+               self.config.step_tap)
         if key not in self._compiled:
             self._compiled[key] = self._build_chunk_fn(mode)
         return self._compiled[key]
@@ -393,6 +432,7 @@ class Trainer:
                 out = jax.tree.map(
                     lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
                 )
+                out = self._run_tap(out, tables, batch, local_state, t)
                 return (tables, bufs, local_state, key), out
 
             carry0 = (tables, bufs, local_state, key)
@@ -467,7 +507,7 @@ class Trainer:
         # Keyed on the plan object itself (its geometry is baked into the
         # compiled program as constants, so identity is the correct key).
         ck = ("indexed", mode, plan, ops.get_backend(),
-              self.config.push_delay)
+              self.config.push_delay, self.config.step_tap)
         if ck not in self._compiled:
             self._compiled[ck] = self._build_indexed_fn(plan, mode)
         fn = self._compiled[ck]

@@ -33,7 +33,18 @@ def main(argv=None) -> int:
     ap.add_argument("--reg", type=float, default=0.01)
     ap.add_argument("--topk", type=int, default=0,
                     help="after training, print top-K items for sample users")
+    ap.add_argument("--topk-every", type=int, default=0,
+                    help="emit top-K (per worker, for users being trained) "
+                         "every N steps FROM INSIDE the compiled loop — the "
+                         "reference's streaming ...AndTopK shape; requires "
+                         "--topk")
+    ap.add_argument("--negative-samples", type=int, default=0,
+                    help="sample this many unrated items per rating as "
+                         "weighted pseudo-negatives (implicit feedback)")
+    ap.add_argument("--negative-weight", type=float, default=0.5)
     args = ap.parse_args(argv)
+    if args.topk_every and not args.topk:
+        raise SystemExit("--topk-every requires --topk")
 
     from fps_tpu.core.driver import num_workers_of
     from fps_tpu.models.matrix_factorization import (
@@ -52,8 +63,25 @@ def main(argv=None) -> int:
           "num_ratings": len(data["user"]), "mesh": dict(mesh.shape)})
 
     cfg = MFConfig(num_users=nu, num_items=ni, rank=args.rank,
-                   learning_rate=args.learning_rate, reg=args.reg)
+                   learning_rate=args.learning_rate, reg=args.reg,
+                   negative_samples=args.negative_samples,
+                   negative_weight=args.negative_weight)
     trainer, store = online_mf(mesh, cfg, sync_every=args.sync_every)
+    if args.topk_every:
+        import dataclasses
+
+        from fps_tpu.models.recommendation import (
+            make_online_topk_tap,
+            mf_topk_query_fn,
+        )
+
+        trainer.config = dataclasses.replace(
+            trainer.config,
+            step_tap=make_online_topk_tap(
+                store, "item_factors", args.topk, every=args.topk_every,
+                query_fn=mf_topk_query_fn(W, num_queries=2),
+            ),
+        )
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
 
@@ -63,6 +91,14 @@ def main(argv=None) -> int:
         se, n = np.sum(m["se"]), max(1.0, np.sum(m["n"]))
         emit({"event": "chunk", "i": i, "train_rmse": float(np.sqrt(se / n)),
               "examples": float(n)})
+        if "tap" in m:
+            # Streaming AndTopK records: one event per emission step.
+            users = np.asarray(m["tap"]["topk_query"])  # (T, W, q)
+            items = np.asarray(m["tap"]["topk_ids"])  # (T, W, q, k)
+            for t in np.flatnonzero((users >= 0).any(axis=(1, 2))):
+                emit({"event": "topk_online", "chunk": i, "step": int(t),
+                      "users": users[t].reshape(-1),
+                      "items": items[t].reshape(users[t].size, -1)})
 
     with maybe_profile(args):
         tables, local_state, _ = trainer.fit_stream(

@@ -63,6 +63,17 @@ class MFConfig:
     # only pays off when the per-shard table slice is small (large shard
     # axis) — enable it there.
     hot_items: int = 0
+    # Negative sampling of unrated items (the reference MF's optional knob,
+    # SURVEY.md §2 #8): each rating additionally samples this many random
+    # items, treated as pseudo-ratings of ``negative_target`` with weight
+    # ``negative_weight`` in the same SGD step. Sharpens ranking on
+    # implicit/positive-only feedback; 0 disables. Sampling is uniform over
+    # items — with realistic catalog sizes the collision probability with
+    # the user's true positives is negligible, matching the reference's
+    # "sample unrated" intent without a per-user seen-set.
+    negative_samples: int = 0
+    negative_target: float = 0.0
+    negative_weight: float = 1.0
     dtype: object = jnp.float32
 
 
@@ -87,36 +98,78 @@ class MatrixFactorizationWorker(WorkerLogic):
             self.cfg.dtype,
         )
 
+    def prepare(self, batch, key):
+        n = self.cfg.negative_samples
+        if not n:
+            return batch
+        B = batch["item"].shape[0]
+        negs = jax.random.randint(
+            key, (B, n), 0, self.cfg.num_items, jnp.int32
+        )
+        # Single source of truth for the [positive, negatives] column
+        # layout: pull_ids and step both consume this (B, 1+n) matrix, so
+        # their orderings cannot drift apart.
+        all_items = jnp.concatenate(
+            [batch["item"].astype(jnp.int32)[:, None], negs], axis=1
+        )
+        return dict(batch, all_items=all_items)
+
     def pull_ids(self, batch) -> Mapping[str, Array]:
+        if self.cfg.negative_samples:
+            return {ITEM_TABLE: batch["all_items"].reshape(-1)}
         return {ITEM_TABLE: batch["item"].astype(jnp.int32)}
 
     def step(self, batch, pulled, local_state, key) -> StepOutput:
         cfg = self.cfg
+        n = cfg.negative_samples
         user_factors = local_state
         u = batch["user"].astype(jnp.int32)
         w = batch["weight"].astype(cfg.dtype)
         r = batch["rating"].astype(cfg.dtype)
-        q = pulled[ITEM_TABLE]  # (B, rank)
+        B = u.shape[0]
+        if n:
+            # Column 0 is the real rating; columns 1.. are sampled unrated
+            # items with target negative_target and weight negative_weight
+            # (layout defined once by prepare()'s all_items).
+            q = pulled[ITEM_TABLE].reshape(B, 1 + n, -1)
+            items = batch["all_items"]  # (B, 1+n)
+            targets = jnp.concatenate(
+                [r[:, None],
+                 jnp.full((B, n), cfg.negative_target, cfg.dtype)], axis=1)
+            wts = jnp.concatenate(
+                [w[:, None],
+                 w[:, None] * jnp.full((B, n), cfg.negative_weight,
+                                       cfg.dtype)], axis=1)
+        else:
+            q = pulled[ITEM_TABLE][:, None, :]  # (B, 1, rank)
+            items = batch["item"].astype(jnp.int32)[:, None]
+            targets = r[:, None]
+            wts = w[:, None]
 
         uidx = u // self.num_workers  # local row (ingest routes u % W == me)
         p = pull_local(user_factors, u, num_shards=self.num_workers)
 
-        pred = jnp.sum(p * q, axis=-1)
-        err = (r - pred) * w
+        pred = jnp.einsum("bd,bkd->bk", p, q)  # (B, 1+n)
+        err = (targets - pred) * wts
         lr = cfg.learning_rate
         # Reference SGDUpdater: d_p = lr*(err*q - reg*p), d_q = lr*(err*p - reg*q).
-        dp = lr * (err[:, None] * q - cfg.reg * w[:, None] * p)
-        dq = lr * (err[:, None] * p - cfg.reg * w[:, None] * q)
+        dp = lr * (jnp.einsum("bk,bkd->bd", err, q)
+                   - cfg.reg * w[:, None] * p)
+        dq = lr * (err[:, :, None] * p[:, None, :]
+                   - cfg.reg * wts[:, :, None] * q)
 
         user_factors = user_factors.at[uidx].add(dp.astype(cfg.dtype))
 
         out = {
-            "se": jnp.sum(err * err).astype(jnp.float32),
+            # Quality metrics track the REAL ratings only (column 0), so
+            # the train-RMSE line is comparable with negatives on or off.
+            "se": jnp.sum(err[:, 0] * err[:, 0]).astype(jnp.float32),
             "n": jnp.sum(w).astype(jnp.float32),
         }
         # Padding rows push id -1 so the store drops them outright.
-        push_ids = jnp.where(w > 0, batch["item"].astype(jnp.int32), -1)
-        pushes = {ITEM_TABLE: (push_ids, dq)}
+        push_ids = jnp.where(wts > 0, items, -1)
+        pushes = {ITEM_TABLE: (push_ids.reshape(-1),
+                               dq.reshape(B * (1 + n), -1))}
         return StepOutput(pushes=pushes, local_state=user_factors, out=out)
 
 

@@ -141,6 +141,98 @@ def recommend_topk(
     return np.asarray(ids), np.asarray(scores)
 
 
+def _topk_local_queries(local, queries, *, num_shards, num_ids, k):
+    """Device-side top-k for PER-WORKER queries (inside shard_map).
+
+    Unlike :func:`build_topk_fn` (replicated queries), every worker here
+    ranks its OWN ``(q, dim)`` queries: queries are all-gathered across the
+    shard axis so each shard scores its rows against everyone's queries,
+    local candidates are exchanged, and each worker merges the slice
+    belonging to its queries. Candidate traffic only — the table never
+    moves.
+    """
+    rps = local.shape[0]
+    me = lax.axis_index(SHARD_AXIS)
+    phys = me * rps + jnp.arange(rps, dtype=jnp.int32)
+    ids = phys_to_id(phys, num_shards, rps)
+
+    q = queries.shape[0]
+    q_all = lax.all_gather(queries, SHARD_AXIS, tiled=True)  # (S*q, dim)
+    scores = q_all.astype(jnp.float32) @ local.astype(jnp.float32).T
+    scores = jnp.where((ids < num_ids)[None, :], scores, NEG_INF)
+
+    n_local = min(k, rps)
+    top_s, top_i = lax.top_k(scores, n_local)  # (S*q, n_local)
+    top_ids = jnp.take(ids, top_i)
+
+    all_s = lax.all_gather(top_s, SHARD_AXIS)  # (S, S*q, n_local)
+    all_i = lax.all_gather(top_ids, SHARD_AXIS)
+    mine_s = lax.dynamic_slice_in_dim(all_s, me * q, q, axis=1)  # (S, q, n)
+    mine_i = lax.dynamic_slice_in_dim(all_i, me * q, q, axis=1)
+    mine_s = mine_s.transpose(1, 0, 2).reshape(q, -1)  # (q, S*n_local)
+    mine_i = mine_i.transpose(1, 0, 2).reshape(q, -1)
+
+    out_s, out_j = lax.top_k(mine_s, k)
+    out_i = jnp.take_along_axis(mine_i, out_j, axis=1)
+    return out_i.astype(jnp.int32), out_s
+
+
+def make_online_topk_tap(store: ParamStore, table: str, k: int, *,
+                         every: int, query_fn):
+    """Build a ``TrainerConfig.step_tap`` emitting top-K INSIDE the loop.
+
+    The reference's ``...AndTopK`` jobs emit the current top-K items for
+    the users being trained, interleaved with training on the output
+    stream. This tap reproduces that shape: every ``every`` steps each
+    worker ranks ``query_fn``'s queries against the live sharded table and
+    the results ride the metrics stream (leaves ``(T, W, q, k)`` after the
+    driver's per-worker gather); off-cadence steps emit ``-1`` ids and
+    ``NEG_INF`` scores and skip the ranking work entirely (``lax.cond``).
+
+    ``query_fn(batch, local_state) -> (query_ids (q,) int32,
+    queries (q, dim))`` — e.g. the first q users of the worker's current
+    batch with their local factor rows (:func:`mf_topk_query_fn`).
+    """
+    num_shards = store.num_shards
+    num_ids = store.specs[table].num_ids
+
+    def tap(tables, batch, local_state, t):
+        qids, queries = query_fn(batch, local_state)
+        q = queries.shape[0]
+
+        def emit(_):
+            return _topk_local_queries(
+                tables[table], queries,
+                num_shards=num_shards, num_ids=num_ids, k=k,
+            )
+
+        def skip(_):
+            return (jnp.full((q, k), -1, jnp.int32),
+                    jnp.full((q, k), NEG_INF))
+
+        on = (t % every) == 0
+        ids, scores = lax.cond(on, emit, skip, None)
+        return {
+            "topk_query": jnp.where(on, qids.astype(jnp.int32), -1),
+            "topk_ids": ids,
+            "topk_scores": scores,
+        }
+
+    return tap
+
+
+def mf_topk_query_fn(num_workers: int, num_queries: int):
+    """Query fn for MF: the first ``num_queries`` users of the worker's
+    batch, with their worker-local factor rows (no communication)."""
+    from fps_tpu.core.store import pull_local
+
+    def query_fn(batch, local_state):
+        users = batch["user"][:num_queries].astype(jnp.int32)
+        return users, pull_local(local_state, users, num_shards=num_workers)
+
+    return query_fn
+
+
 def mf_user_vectors(
     user_factors_global: np.ndarray, num_workers: int, users: np.ndarray
 ) -> np.ndarray:

@@ -182,10 +182,10 @@ def test_set_backend_takes_effect_on_compiled_trainer(devices8):
     try:
         ops_mod.set_backend("xla")
         trainer.run_chunk(tables, ls, chunk, jax.random.key(1))
-        assert ("sync", "xla") in trainer._compiled
+        assert any(k[:2] == ("sync", "xla") for k in trainer._compiled)
         ops_mod.set_backend("pallas")
         trainer.run_chunk(tables, ls, chunk, jax.random.key(1))
-        assert ("sync", "pallas") in trainer._compiled
+        assert any(k[:2] == ("sync", "pallas") for k in trainer._compiled)
     finally:
         ops_mod.set_backend(prev)
 
