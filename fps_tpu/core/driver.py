@@ -36,7 +36,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from fps_tpu import ops
 from fps_tpu.core.api import ServerLogic, WorkerLogic
 from fps_tpu.core.store import ParamStore, id_to_phys, pull, pull_local, push
-from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS, key_to_replicated
+from fps_tpu.parallel.mesh import (
+    DATA_AXIS,
+    SHARD_AXIS,
+    host_to_sharded,
+    key_to_replicated,
+)
 
 Array = jax.Array
 Pytree = Any
@@ -579,10 +584,16 @@ class Trainer:
           equal to the number of steps in the chunk (global sums per step).
         """
         mode = "sync" if self.config.sync_every is None else "ssp"
-        batches = jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding_for(mode)),
-            batches,
-        )
+        sharding = self._batch_sharding_for(mode)
+
+        def place(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                # Device-ingest chunks are already global arrays on the
+                # mesh (multi-controller); leave them where they are.
+                return x
+            return host_to_sharded(x, sharding)
+
+        batches = jax.tree.map(place, batches)
         key = key_to_replicated(key, self.mesh)
         tables, local_state, metrics = self._get_compiled(mode)(
             tables, local_state, batches, key

@@ -67,18 +67,10 @@ def build_topk_fn(store: ParamStore, table: str, k: int,
 
     def device_fn(tables, queries, exclude):
         local = tables[table]  # (rps, dim) this shard's block
-        rps = local.shape[0]
-        me = lax.axis_index(SHARD_AXIS)
-        phys = me * rps + jnp.arange(rps, dtype=jnp.int32)
-        ids = phys_to_id(phys, num_shards, rps)
-
-        # MXU: score every owned row against every query.
-        scores = queries.astype(jnp.float32) @ local.astype(jnp.float32).T
-        scores = jnp.where((ids < spec.num_ids)[None, :], scores, NEG_INF)
-
-        n_local = min(cand, rps)
-        top_s, top_i = lax.top_k(scores, n_local)  # (B, n_local)
-        top_ids = jnp.take(ids, top_i)  # (B, n_local) logical ids
+        top_s, top_ids = _score_and_local_topk(
+            local, queries, num_shards=num_shards, num_ids=spec.num_ids,
+            n=cand,
+        )  # (B, n_local)
 
         # Merge: gather every shard's candidates (concat along axis 1).
         all_s = lax.all_gather(top_s, SHARD_AXIS, axis=1, tiled=True)
@@ -141,6 +133,25 @@ def recommend_topk(
     return np.asarray(ids), np.asarray(scores)
 
 
+def _score_and_local_topk(local, queries, *, num_shards, num_ids, n):
+    """Shared per-shard scoring block: score ``queries`` against this
+    shard's rows (MXU matmul), mask padding rows, and take the local
+    top-``n`` with logical ids. Used by both the replicated-query ranking
+    (:func:`build_topk_fn`) and the per-worker tap path, so masking /
+    id-translation fixes cannot drift between them."""
+    rps = local.shape[0]
+    me = lax.axis_index(SHARD_AXIS)
+    phys = me * rps + jnp.arange(rps, dtype=jnp.int32)
+    ids = phys_to_id(phys, num_shards, rps)
+
+    scores = queries.astype(jnp.float32) @ local.astype(jnp.float32).T
+    scores = jnp.where((ids < num_ids)[None, :], scores, NEG_INF)
+
+    n_local = min(n, rps)
+    top_s, top_i = lax.top_k(scores, n_local)
+    return top_s, jnp.take(ids, top_i)
+
+
 def _topk_local_queries(local, queries, *, num_shards, num_ids, k):
     """Device-side top-k for PER-WORKER queries (inside shard_map).
 
@@ -151,19 +162,13 @@ def _topk_local_queries(local, queries, *, num_shards, num_ids, k):
     belonging to its queries. Candidate traffic only — the table never
     moves.
     """
-    rps = local.shape[0]
     me = lax.axis_index(SHARD_AXIS)
-    phys = me * rps + jnp.arange(rps, dtype=jnp.int32)
-    ids = phys_to_id(phys, num_shards, rps)
-
     q = queries.shape[0]
     q_all = lax.all_gather(queries, SHARD_AXIS, tiled=True)  # (S*q, dim)
-    scores = q_all.astype(jnp.float32) @ local.astype(jnp.float32).T
-    scores = jnp.where((ids < num_ids)[None, :], scores, NEG_INF)
-
-    n_local = min(k, rps)
-    top_s, top_i = lax.top_k(scores, n_local)  # (S*q, n_local)
-    top_ids = jnp.take(ids, top_i)
+    top_s, top_ids = _score_and_local_topk(
+        local, q_all, num_shards=num_shards, num_ids=num_ids, n=k
+    )  # (S*q, n_local)
+    n_local = top_s.shape[1]
 
     all_s = lax.all_gather(top_s, SHARD_AXIS)  # (S, S*q, n_local)
     all_i = lax.all_gather(top_ids, SHARD_AXIS)
@@ -223,12 +228,21 @@ def make_online_topk_tap(store: ParamStore, table: str, k: int, *,
 
 def mf_topk_query_fn(num_workers: int, num_queries: int):
     """Query fn for MF: the first ``num_queries`` users of the worker's
-    batch, with their worker-local factor rows (no communication)."""
+    batch, with their worker-local factor rows (no communication).
+
+    Padding rows (``weight == 0``) emit query id ``-1``: a padded slot's
+    user id belongs to ANOTHER worker's routing domain, so its local
+    factor-row lookup would silently rank with a different user's vector
+    — consumers must skip ``-1`` queries (their ranking rows are
+    meaningless)."""
     from fps_tpu.core.store import pull_local
 
     def query_fn(batch, local_state):
         users = batch["user"][:num_queries].astype(jnp.int32)
-        return users, pull_local(local_state, users, num_shards=num_workers)
+        valid = batch["weight"][:num_queries] > 0
+        qids = jnp.where(valid, users, -1)
+        qvecs = pull_local(local_state, users, num_shards=num_workers)
+        return qids, qvecs
 
     return query_fn
 

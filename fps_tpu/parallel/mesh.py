@@ -116,6 +116,23 @@ def host_to_replicated(x, mesh: Mesh):
     return jax.make_array_from_process_local_data(sh, np.asarray(x))
 
 
+def host_to_sharded(x, sharding: NamedSharding):
+    """Place a GLOBAL host array onto a (possibly multi-process) sharding.
+
+    Single-process: a plain ``device_put``. Multi-process: every process
+    passes the identical full array and
+    ``make_array_from_process_local_data`` slices out each process's
+    addressable portion (the documented ``global_shape == data.shape``
+    mode, which requires the data to be identical across hosts — exactly
+    the host-ingest contract: every process runs the same deterministic
+    chunk iterator).
+    """
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    return jax.make_array_from_process_local_data(sharding, x, x.shape)
+
+
 _KEY_PUT_CACHE: dict = {}
 
 

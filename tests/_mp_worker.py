@@ -1,11 +1,20 @@
-"""Subprocess body for the multi-process distributed test.
+"""Subprocess body for the multi-process distributed tests.
 
 Usage: python _mp_worker.py <process_id> <num_processes> <port> <out_npz>
+                            [scenario]
 
-Initializes multi-controller JAX over a local gloo coordinator, trains the
-standard tiny MF workload through the full framework path (device-resident
-ingest + fused indexed epochs over a (2, 4) global mesh), and has process 0
-write the final item-factor table for the parent test to compare.
+Initializes multi-controller JAX over a local gloo coordinator and trains
+the standard tiny MF workload through the full framework path on a (2, 4)
+global mesh. Scenarios:
+
+* ``indexed``  (default) — device-resident ingest, fused indexed epochs,
+  synchronous.
+* ``host_sync`` — HOST ingest (`fit_stream` over numpy chunks placed via
+  ``make_array_from_process_local_data``), synchronous.
+* ``host_ssp``  — host ingest, SSP bounded staleness (sync_every=2).
+
+Every rank calls `dump_model` (a collective); rank 0 writes the table for
+the parent test to compare against a single-process run.
 """
 
 import sys
@@ -15,6 +24,7 @@ def main() -> int:
     pid, nproc, port, out = (
         int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
     )
+    scenario = sys.argv[5] if len(sys.argv) > 5 else "indexed"
 
     from fps_tpu.parallel.mesh import init_distributed
 
@@ -30,6 +40,7 @@ def main() -> int:
 
     from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
     from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.core.ingest import multi_epoch_chunks
     from fps_tpu.models.matrix_factorization import MFConfig, online_mf
     from fps_tpu.parallel.mesh import make_ps_mesh
     from fps_tpu.utils.datasets import synthetic_ratings
@@ -37,17 +48,33 @@ def main() -> int:
     mesh = make_ps_mesh(num_shards=4, num_data=2)
     W = num_workers_of(mesh)
     data = synthetic_ratings(57, 31, 2000, seed=0)
-    ds = DeviceDataset(mesh, data)
     cfg = MFConfig(num_users=57, num_items=31, rank=4, learning_rate=0.1)
-    trainer, store = online_mf(mesh, cfg)
+    sync_every = 2 if scenario == "host_ssp" else None
+    trainer, store = online_mf(mesh, cfg, sync_every=sync_every)
     tables, ls = trainer.init_state(jax.random.key(0))
-    plan = DeviceEpochPlan(
-        ds, num_workers=W, local_batch=32, route_key="user", seed=5
-    )
-    tables, ls, metrics = trainer.run_indexed(
-        tables, ls, plan, jax.random.key(1), epochs=2
-    )
-    n = sum(float(m["n"].sum()) for m in metrics)
+
+    if scenario == "indexed":
+        ds = DeviceDataset(mesh, data)
+        plan = DeviceEpochPlan(
+            ds, num_workers=W, local_batch=32, route_key="user", seed=5
+        )
+        tables, ls, metrics = trainer.run_indexed(
+            tables, ls, plan, jax.random.key(1), epochs=2
+        )
+        n = sum(float(m["n"].sum()) for m in metrics)
+    elif scenario in ("host_sync", "host_ssp"):
+        # Host ingest: every process runs the identical deterministic chunk
+        # iterator; run_chunk places the numpy leaves onto the global mesh.
+        chunks = multi_epoch_chunks(
+            data, 2, num_workers=W, local_batch=32, steps_per_chunk=4,
+            route_key="user", sync_every=sync_every, seed=5,
+        )
+        tables, ls, metrics = trainer.fit_stream(
+            tables, ls, chunks, jax.random.key(1)
+        )
+        n = sum(float(np.asarray(m["n"]).sum()) for m in metrics)
+    else:
+        raise SystemExit(f"unknown scenario {scenario!r}")
     assert n == 2 * 2000, n
 
     # dump_model replicates cross-host shards through a jitted identity — a

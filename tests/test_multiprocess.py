@@ -2,12 +2,14 @@
 
 The reference scales across TaskManagers over Flink's Netty fabric; the
 TPU-native replacement is multi-controller JAX (`jax.distributed`) with XLA
-collectives spanning hosts. This test runs the FULL framework path (device
-ingest, fused indexed epochs, collective pull/push, sharded-table dump) as
-TWO OS processes of 4 CPU devices each over a local gloo coordinator, and
-asserts the result is bit-identical to the same global (2, 4) mesh driven
+collectives spanning hosts. These tests run the FULL framework path as TWO
+OS processes of 4 CPU devices each over a local gloo coordinator, and
+assert the result is bit-identical to the same global (2, 4) mesh driven
 by one process — proving the programs, shardings, and placements carry
-across process topologies unchanged.
+across process topologies unchanged. Covered paths: device-resident ingest
+with fused indexed epochs (sync), and HOST ingest through ``fit_stream``
+(numpy chunks placed via ``make_array_from_process_local_data``) in both
+sync and SSP modes.
 """
 
 import os
@@ -27,9 +29,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_training_matches_single_process(devices8, tmp_path):
+def _run_two_processes(tmp_path, scenario: str) -> np.ndarray:
     port = _free_port()
-    out = str(tmp_path / "mp.npz")
+    out = str(tmp_path / f"mp_{scenario}.npz")
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -38,12 +40,13 @@ def test_two_process_training_matches_single_process(devices8, tmp_path):
     # Workers write to files, not pipes: the two processes rendezvous in
     # cross-process collectives, so a full OS pipe buffer on one would
     # deadlock the other.
-    logs = [str(tmp_path / f"worker{pid}.log") for pid in range(2)]
+    logs = [str(tmp_path / f"worker{pid}_{scenario}.log") for pid in range(2)]
     procs = []
     for pid in range(2):
         with open(logs[pid], "w") as logf:
             procs.append(subprocess.Popen(
-                [sys.executable, worker, str(pid), "2", str(port), out],
+                [sys.executable, worker, str(pid), "2", str(port), out,
+                 scenario],
                 env=env, cwd=_ROOT, stdout=logf, stderr=subprocess.STDOUT,
             ))
     try:
@@ -57,13 +60,15 @@ def test_two_process_training_matches_single_process(devices8, tmp_path):
             if p.poll() is None:
                 p.kill()
     assert os.path.exists(out)
-    mp_values = np.load(out)["item_factors"]
+    return np.load(out)["item_factors"]
 
-    # Same workload, one process, 8 local devices, same (2, 4) global mesh.
+
+def _single_process_reference(devices8, scenario: str) -> np.ndarray:
     import jax
 
     from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
     from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.core.ingest import multi_epoch_chunks
     from fps_tpu.models.matrix_factorization import MFConfig, online_mf
     from fps_tpu.parallel.mesh import make_ps_mesh
     from fps_tpu.utils.datasets import synthetic_ratings
@@ -71,15 +76,31 @@ def test_two_process_training_matches_single_process(devices8, tmp_path):
     mesh = make_ps_mesh(num_shards=4, num_data=2, devices=devices8[:8])
     W = num_workers_of(mesh)
     data = synthetic_ratings(57, 31, 2000, seed=0)
-    ds = DeviceDataset(mesh, data)
     cfg = MFConfig(num_users=57, num_items=31, rank=4, learning_rate=0.1)
-    trainer, store = online_mf(mesh, cfg)
+    sync_every = 2 if scenario == "host_ssp" else None
+    trainer, store = online_mf(mesh, cfg, sync_every=sync_every)
     tables, ls = trainer.init_state(jax.random.key(0))
-    plan = DeviceEpochPlan(
-        ds, num_workers=W, local_batch=32, route_key="user", seed=5
-    )
-    tables, ls, _ = trainer.run_indexed(
-        tables, ls, plan, jax.random.key(1), epochs=2
-    )
-    _, sp_values = store.dump_model("item_factors")
+    if scenario == "indexed":
+        ds = DeviceDataset(mesh, data)
+        plan = DeviceEpochPlan(
+            ds, num_workers=W, local_batch=32, route_key="user", seed=5
+        )
+        tables, ls, _ = trainer.run_indexed(
+            tables, ls, plan, jax.random.key(1), epochs=2
+        )
+    else:
+        chunks = multi_epoch_chunks(
+            data, 2, num_workers=W, local_batch=32, steps_per_chunk=4,
+            route_key="user", sync_every=sync_every, seed=5,
+        )
+        tables, ls, _ = trainer.fit_stream(tables, ls, chunks,
+                                           jax.random.key(1))
+    return store.dump_model("item_factors")[1]
+
+
+@pytest.mark.parametrize("scenario", ["indexed", "host_sync", "host_ssp"])
+def test_two_process_training_matches_single_process(devices8, tmp_path,
+                                                     scenario):
+    mp_values = _run_two_processes(tmp_path, scenario)
+    sp_values = _single_process_reference(devices8, scenario)
     np.testing.assert_array_equal(sp_values, mp_values)
