@@ -89,6 +89,22 @@ class WorkerLogic:
         """Fused onRecv/onPullRecv body — must be jit-traceable."""
         raise NotImplementedError
 
+    # -- checkpoint portability (optional overrides) -----------------------
+
+    def export_local_state(self, local_state: Pytree) -> Pytree:
+        """Host-side, worker-count-INDEPENDENT form of the local state for
+        checkpointing (e.g. MF re-orders its worker-sharded user table to
+        logical user order). Default: the raw pytree — restorable only at
+        the same worker count."""
+        return local_state
+
+    def import_local_state(self, leaves: list, num_workers: int):
+        """Inverse of :meth:`export_local_state`: rebuild the device-layout
+        local-state pytree (host numpy) for ``num_workers`` workers from
+        the exported leaves. Return ``NotImplemented`` (the default) to
+        keep the raw same-worker-count restore path."""
+        return NotImplemented
+
 
 @dataclasses.dataclass(frozen=True)
 class ServerLogic:

@@ -160,8 +160,11 @@ class Checkpointer:
 
     Restore re-lays-out *tables* onto the current mesh, so a checkpoint taken
     on one shard count resumes on another (the reference could not even
-    save). Worker-local state is saved with worker-count-dependent shapes —
-    resuming it requires the same worker count (or ``local_state=None``).
+    save). Worker-local state saved through the Trainer path is stored in
+    the logic's worker-count-independent export form (e.g. MF user factors
+    in logical user order) — ``Trainer.restore_checkpoint`` re-lays it out
+    for any worker count when the logic implements ``import_local_state``;
+    the raw :meth:`restore` keeps the same-worker-count contract.
     """
 
     def __init__(self, directory: str, *, keep: int = 3):
@@ -174,11 +177,20 @@ class Checkpointer:
     def _path(self, step: int) -> str:
         return os.path.join(self.dir, f"ckpt_{step:012d}.npz")
 
-    def save(self, step: int, store: ParamStore, local_state: Pytree = None) -> str:
+    def save(self, step: int, store: ParamStore, local_state: Pytree = None,
+             *, local_state_format: str = "raw") -> str:
+        """``local_state_format`` tags how the local-state leaves are laid
+        out: ``"raw"`` (device layout, restorable via :meth:`restore` at
+        the same worker count) or ``"exported"`` (the worker logic's
+        worker-count-independent form, written by the Trainer path and
+        restorable only via ``Trainer.restore_checkpoint``). The tag makes
+        a mismatched restore fail loudly instead of silently permuting
+        state when shapes happen to coincide."""
         arrays = _table_arrays(store)
         leaves, treedef = jax.tree.flatten(local_state)
         for i, leaf in enumerate(leaves):
             arrays[f"ls{_SEP}{i}"] = np.asarray(leaf)
+        arrays[f"meta{_SEP}ls_format"] = np.array(local_state_format)
         del treedef  # structure is supplied by local_state_like at restore
         path = self._path(step)
         _atomic_savez(path, arrays)
@@ -197,25 +209,19 @@ class Checkpointer:
         steps = self.steps()
         return steps[-1] if steps else None
 
-    def restore(
-        self,
-        store: ParamStore,
-        local_state_like: Pytree = None,
-        *,
-        step: int | None = None,
-    ) -> tuple[dict, Pytree, int]:
-        """Load a snapshot into ``store`` (sharded on its current mesh).
-
-        ``local_state_like`` supplies the pytree structure and shardings to
-        restore worker-local state into (pass the output of
-        ``Trainer.init_state``; pass ``None`` if there is none).
-
-        Returns ``(tables, local_state, step)``.
-        """
+    def _resolve_step(self, step: int | None) -> int:
         if step is None:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(f"no checkpoints under {self.dir}")
+        return step
+
+    def restore_tables(
+        self, store: ParamStore, *, step: int | None = None
+    ) -> tuple[dict, int]:
+        """Load a snapshot's tables into ``store`` (sharded on its current
+        mesh — any shard count). Returns ``(tables, step)``."""
+        step = self._resolve_step(step)
         with np.load(self._path(step)) as z:
             for name, spec in store.specs.items():
                 if f"table{_SEP}{name}" not in z.files:
@@ -230,11 +236,53 @@ class Checkpointer:
                         f"store spec ({spec.num_ids}, {spec.dim})"
                     )
                 load_rows(store, name, np.arange(len(values)), values)
-            ls_leaves = []
+        return dict(store.tables), step
+
+    def raw_local_state(self, step: int | None = None) -> list[np.ndarray]:
+        """The snapshot's local-state leaves as saved (flattened order)."""
+        step = self._resolve_step(step)
+        leaves = []
+        with np.load(self._path(step)) as z:
             i = 0
             while f"ls{_SEP}{i}" in z.files:
-                ls_leaves.append(z[f"ls{_SEP}{i}"])
+                leaves.append(z[f"ls{_SEP}{i}"])
                 i += 1
+        return leaves
+
+    def local_state_format(self, step: int | None = None) -> str:
+        """``"raw"`` or ``"exported"`` (pre-tag snapshots read as raw)."""
+        step = self._resolve_step(step)
+        with np.load(self._path(step)) as z:
+            key = f"meta{_SEP}ls_format"
+            return str(z[key]) if key in z.files else "raw"
+
+    def restore(
+        self,
+        store: ParamStore,
+        local_state_like: Pytree = None,
+        *,
+        step: int | None = None,
+    ) -> tuple[dict, Pytree, int]:
+        """Load a snapshot into ``store`` (sharded on its current mesh).
+
+        ``local_state_like`` supplies the pytree structure and shardings to
+        restore worker-local state into (pass the output of
+        ``Trainer.init_state``; pass ``None`` if there is none). Local
+        state is restored RAW — same worker count as the save; for
+        worker-count-elastic restores of logics that support it, use
+        ``Trainer.restore_checkpoint``.
+
+        Returns ``(tables, local_state, step)``.
+        """
+        _, step = self.restore_tables(store, step=step)
+        ls_leaves = self.raw_local_state(step)
+        if ls_leaves and self.local_state_format(step) == "exported":
+            raise ValueError(
+                f"checkpoint step {step} stores local state in the worker "
+                "logic's EXPORTED form (written by the Trainer path); "
+                "restore it with Trainer.restore_checkpoint, not the raw "
+                "Checkpointer.restore"
+            )
         like_leaves, treedef = jax.tree.flatten(local_state_like)
         if len(like_leaves) != len(ls_leaves):
             raise ValueError(

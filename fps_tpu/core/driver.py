@@ -152,6 +152,80 @@ class Trainer:
         )()
         return tables, local_state
 
+    # -- checkpoint plumbing ----------------------------------------------
+
+    def _host_local_state(self, local_state):
+        """Local state as host numpy — multi-controller safe (cross-host
+        leaves replicate through a jitted identity, a COLLECTIVE: every
+        process must reach the checkpoint boundary together, same as the
+        table dump)."""
+        from fps_tpu.parallel.mesh import replicate_to_mesh
+
+        def to_host(leaf):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                leaf = replicate_to_mesh(leaf, self.mesh)
+            return np.asarray(leaf)
+
+        return jax.tree.map(to_host, local_state)
+
+    def _save_checkpoint(self, checkpointer, step: int, local_state) -> None:
+        """Snapshot tables + local state, with the local state in the
+        logic's worker-count-independent export form (default: the raw
+        layout, tagged either way so a mismatched restore fails loudly)."""
+        checkpointer.save(
+            step, self.store,
+            self.logic.export_local_state(
+                self._host_local_state(local_state)
+            ),
+            local_state_format="exported",
+        )
+
+    def restore_checkpoint(self, checkpointer, local_state_like, *,
+                           step: int | None = None):
+        """Restore a snapshot onto THIS trainer's mesh — elastic across
+        shard counts (tables always) and worker counts (for "exported"
+        snapshots whose logic implements ``import_local_state``; raw
+        leaves must match ``local_state_like``'s shapes, i.e. same worker
+        count).
+
+        ``local_state_like`` supplies structure/shardings — pass the
+        local state from :meth:`init_state`. Returns
+        ``(tables, local_state, step)``.
+        """
+        tables, step = checkpointer.restore_tables(self.store, step=step)
+        leaves = checkpointer.raw_local_state(step)
+        imported = NotImplemented
+        if checkpointer.local_state_format(step) == "exported":
+            imported = self.logic.import_local_state(
+                leaves, self.num_workers
+            )
+        if imported is NotImplemented:
+            # Raw device layout (or an identity-export logic): shapes must
+            # match the current worker count's local state exactly.
+            like_leaves, treedef = jax.tree.flatten(local_state_like)
+            if len(like_leaves) != len(leaves):
+                raise ValueError(
+                    f"checkpoint step {step} has {len(leaves)} local-state "
+                    f"leaves, local_state_like has {len(like_leaves)}"
+                )
+            for saved, like in zip(leaves, like_leaves):
+                if hasattr(like, "shape") and saved.shape != like.shape:
+                    raise ValueError(
+                        f"checkpoint local-state leaf shape {saved.shape} "
+                        f"!= expected {like.shape} — was the snapshot taken "
+                        "at a different worker count with a logic that has "
+                        "no import_local_state?"
+                    )
+            imported = jax.tree.unflatten(treedef, leaves)
+        placed = jax.tree.map(
+            lambda leaf, like: host_to_sharded(
+                np.asarray(leaf, getattr(like, "dtype", None)), like.sharding
+            ) if isinstance(like, jax.Array) else leaf,
+            imported,
+            local_state_like,
+        )
+        return tables, placed, step
+
     # -- device-side bodies ----------------------------------------------
 
     def _apply_pushes(self, tables, pushes):
@@ -555,12 +629,12 @@ class Trainer:
             if checkpointer is not None and checkpoint_every > 0 and (
                 (e + 1) % checkpoint_every == 0
             ):
-                checkpointer.save(e + 1, self.store, local_state)
+                self._save_checkpoint(checkpointer, e + 1, local_state)
         self.store.tables = dict(tables)  # epochs == 0: loop never ran
         if checkpointer is not None and epochs > 0 and (
             checkpoint_every <= 0 or end_epoch % checkpoint_every != 0
         ):
-            checkpointer.save(end_epoch, self.store, local_state)
+            self._save_checkpoint(checkpointer, end_epoch, local_state)
         if on_epoch is None:
             all_metrics = [jax.tree.map(np.asarray, m) for m in all_metrics]
         return tables, local_state, all_metrics
@@ -667,11 +741,11 @@ class Trainer:
             if checkpointer is not None and checkpoint_every > 0 and (
                 (i + 1) % checkpoint_every == 0
             ):
-                checkpointer.save(i + 1, self.store, local_state)
+                self._save_checkpoint(checkpointer, i + 1, local_state)
         if checkpointer is not None and i >= start_step and (
             checkpoint_every <= 0 or (i + 1) % checkpoint_every != 0
         ):
-            checkpointer.save(i + 1, self.store, local_state)
+            self._save_checkpoint(checkpointer, i + 1, local_state)
         if on_chunk is None:
             all_metrics = [jax.tree.map(np.asarray, m) for m in all_metrics]
         if metrics_reduce is not None and all_metrics:

@@ -98,6 +98,30 @@ class MatrixFactorizationWorker(WorkerLogic):
             self.cfg.dtype,
         )
 
+    def export_local_state(self, local_state):
+        """User factors in LOGICAL user order (padding stripped) — the same
+        worker-count-independent convention the store's tables use, so a
+        checkpoint taken at one worker count restores at any other."""
+        table = np.asarray(local_state)
+        W = self.num_workers
+        rps = table.shape[0] // W
+        u = np.arange(self.cfg.num_users)
+        return table[(u % W) * rps + u // W]
+
+    def import_local_state(self, leaves, num_workers):
+        (logical,) = leaves
+        nu, rank = self.cfg.num_users, self.cfg.rank
+        if logical.shape != (nu, rank):
+            raise ValueError(
+                f"checkpointed user factors shape {logical.shape} != "
+                f"({nu}, {rank})"
+            )
+        rps = -(-nu // num_workers)
+        table = np.zeros((rps * num_workers, rank), logical.dtype)
+        u = np.arange(nu)
+        table[(u % num_workers) * rps + u // num_workers] = logical
+        return table
+
     def prepare(self, batch, key):
         n = self.cfg.negative_samples
         if not n:

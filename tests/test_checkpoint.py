@@ -182,7 +182,9 @@ def test_fit_stream_resume_matches_straight_run(tmp_path, jaxmods, devices8):
     _, _, trainerC, storeC = _mf(jaxmods, num_shards=4)
     tabC, lsC = trainerC.init_state(jax.random.key(77))
     storeC.tables = tabC
-    tabC, lsC, step = ckpt.restore(storeC, lsC)
+    # Trainer-level restore: fit_stream saved the logic's EXPORTED (logical
+    # user order) local state, which import_local_state re-lays-out.
+    tabC, lsC, step = trainerC.restore_checkpoint(ckpt, lsC)
     assert step == 2
     trainerC.fit_stream(tabC, lsC, chunks[2:], key,
                         checkpointer=ckpt, checkpoint_every=2,
@@ -206,3 +208,65 @@ def test_fit_stream_checkpoints(tmp_path, jaxmods, devices8):
     trainer.fit_stream(tables, ls, chunks, jax.random.key(2),
                        checkpointer=ckpt, checkpoint_every=2)
     assert ckpt.latest_step() == len(chunks)
+
+
+def test_elastic_worker_count_restore(tmp_path, jaxmods, devices8):
+    """A checkpoint taken on an 8-worker mesh resumes on a 4-worker mesh:
+    tables reshard (as before) AND the MF user factors re-lay-out through
+    the logic's export/import (logical user order), closing the round-1
+    worker-count pinning. The restored model must predict identically."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    from fps_tpu.models.matrix_factorization import predict_host
+    from fps_tpu.parallel.mesh import make_ps_mesh
+
+    data = jaxmods["synthetic_ratings"](32, 24, 4 * 8 * 8, seed=3)
+    chunks8 = _chunks(jaxmods, data, 8)[:2]
+
+    # Train at W=8 (1x8 mesh) and snapshot through the trainer path.
+    _, cfgA, trainerA, storeA = _mf(jaxmods, num_shards=8)
+    tabA, lsA = trainerA.init_state(jax.random.key(1))
+    tabA, lsA, _ = trainerA.fit_stream(
+        tabA, lsA, chunks8, jax.random.key(5),
+        checkpointer=ck.Checkpointer(str(tmp_path / "el")),
+        checkpoint_every=2,
+    )
+    ckpt = ck.Checkpointer(str(tmp_path / "el"))
+    predA = predict_host(storeA, np.asarray(lsA), 8, data["user"],
+                         data["item"])
+
+    # Resume at W=4 (1x4 mesh over half the devices).
+    mesh4 = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    from fps_tpu.models.matrix_factorization import online_mf
+
+    trainerB, storeB = online_mf(mesh4, cfgA)
+    tabB, lsB = trainerB.init_state(jax.random.key(999))  # different init
+    storeB.tables = tabB
+    tabB, lsB, step = trainerB.restore_checkpoint(ckpt, lsB)
+    assert step == 2
+    predB = predict_host(storeB, np.asarray(lsB), 4, data["user"],
+                         data["item"])
+    np.testing.assert_allclose(predA, predB, rtol=1e-6, atol=1e-6)
+
+    # And training continues from the restored state without error.
+    chunks4 = _chunks(jaxmods, data, 4)[:1]
+    tabB, lsB, m = trainerB.fit_stream(tabB, lsB, chunks4, jax.random.key(6))
+    assert float(np.asarray(m[0]["n"]).sum()) > 0
+
+
+def test_raw_restore_of_exported_snapshot_fails_loudly(tmp_path, jaxmods,
+                                                       devices8):
+    """Trainer-path snapshots tag local state as 'exported'; the raw
+    Checkpointer.restore must refuse them rather than silently permuting
+    state when shapes coincide (nu divisible by W makes logical and device
+    layouts the same shape)."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    data = jaxmods["synthetic_ratings"](32, 24, 4 * 4 * 8, seed=3)
+    chunks = _chunks(jaxmods, data, 4)[:2]
+    _, _, trainer, store = _mf(jaxmods, num_shards=4)
+    tab, ls = trainer.init_state(jax.random.key(1))
+    ckpt = ck.Checkpointer(str(tmp_path / "x"))
+    trainer.fit_stream(tab, ls, chunks, jax.random.key(5),
+                       checkpointer=ckpt, checkpoint_every=2)
+    assert ckpt.local_state_format(2) == "exported"
+    with pytest.raises(ValueError, match="EXPORTED"):
+        ckpt.restore(store, ls)
