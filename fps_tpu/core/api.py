@@ -108,19 +108,25 @@ class WorkerLogic:
 
 @dataclasses.dataclass(frozen=True)
 class ServerLogic:
-    """Per-table server fold — the reference's ``SimplePSLogic``.
+    """Per-table server fold — the reference's ``SimplePSLogic`` plus its
+    pluggable combining senders.
 
     ``apply_fn(current_rows, combined_deltas) -> new_rows``; ``None`` means
     plain addition (``paramUpdate = _ + _``), which every algorithm shipped
     with the reference uses and which takes the fastest scatter-add path.
 
     ``combine`` controls how duplicate ids in one batch merge before the
-    fold: ``"sum"`` (reference semantics) or ``"mean"`` (per-id averaged
-    step — stable for Zipfian hot ids under large batches).
+    fold — the user-extensible analog of the reference's combination
+    logic (expected upstream ``.../ps/client/sender/``): ``"sum"``
+    (reference semantics), ``"mean"`` (per-id averaged step — stable for
+    Zipfian hot ids under large batches), ``"max"`` / ``"min"``
+    (elementwise extremum), or a callable ``(summed, counts) -> combined``
+    over each row's per-id delta sum and push count (see
+    :func:`fps_tpu.core.store.push`).
     """
 
     apply_fn: Callable[[Array, Array], Array] | None = None
-    combine: str = "sum"
+    combine: str | Callable[[Array, Array], Array] = "sum"
 
 
 ADDITIVE = ServerLogic(apply_fn=None)

@@ -216,45 +216,61 @@ class Checkpointer:
                 raise FileNotFoundError(f"no checkpoints under {self.dir}")
         return step
 
+    def read_snapshot(
+        self, step: int | None = None
+    ) -> tuple[int, dict, list, str]:
+        """ONE-open read of a snapshot: ``(step, {table: values},
+        local_state_leaves, local_state_format)``. The other accessors and
+        both restore paths are built on this so a restore parses the .npz
+        exactly once."""
+        step = self._resolve_step(step)
+        with np.load(self._path(step)) as z:
+            tables = {
+                k.split(_SEP, 1)[1]: z[k]
+                for k in z.files
+                if k.startswith(f"table{_SEP}")
+            }
+            leaves = []
+            i = 0
+            while f"ls{_SEP}{i}" in z.files:
+                leaves.append(z[f"ls{_SEP}{i}"])
+                i += 1
+            key = f"meta{_SEP}ls_format"
+            fmt = str(z[key]) if key in z.files else "raw"
+        return step, tables, leaves, fmt
+
+    def _load_tables(self, store: ParamStore, step: int, values_by_name: dict
+                     ) -> dict:
+        for name, spec in store.specs.items():
+            if name not in values_by_name:
+                raise ValueError(
+                    f"checkpoint step {step} has no table {name!r} — "
+                    "was it taken with an older model definition?"
+                )
+            values = values_by_name[name]
+            if values.shape != (spec.num_ids, spec.dim):
+                raise ValueError(
+                    f"checkpoint table {name!r} shape {values.shape} != "
+                    f"store spec ({spec.num_ids}, {spec.dim})"
+                )
+            load_rows(store, name, np.arange(len(values)), values)
+        return dict(store.tables)
+
     def restore_tables(
         self, store: ParamStore, *, step: int | None = None
     ) -> tuple[dict, int]:
         """Load a snapshot's tables into ``store`` (sharded on its current
         mesh — any shard count). Returns ``(tables, step)``."""
-        step = self._resolve_step(step)
-        with np.load(self._path(step)) as z:
-            for name, spec in store.specs.items():
-                if f"table{_SEP}{name}" not in z.files:
-                    raise ValueError(
-                        f"checkpoint step {step} has no table {name!r} — "
-                        "was it taken with an older model definition?"
-                    )
-                values = z[f"table{_SEP}{name}"]
-                if values.shape != (spec.num_ids, spec.dim):
-                    raise ValueError(
-                        f"checkpoint table {name!r} shape {values.shape} != "
-                        f"store spec ({spec.num_ids}, {spec.dim})"
-                    )
-                load_rows(store, name, np.arange(len(values)), values)
-        return dict(store.tables), step
+        step, values, _, _ = self.read_snapshot(step)
+        return self._load_tables(store, step, values), step
 
     def raw_local_state(self, step: int | None = None) -> list[np.ndarray]:
         """The snapshot's local-state leaves as saved (flattened order)."""
-        step = self._resolve_step(step)
-        leaves = []
-        with np.load(self._path(step)) as z:
-            i = 0
-            while f"ls{_SEP}{i}" in z.files:
-                leaves.append(z[f"ls{_SEP}{i}"])
-                i += 1
-        return leaves
+        return self.read_snapshot(step)[2]
 
     def local_state_format(self, step: int | None = None) -> str:
         """``"raw"`` or ``"exported"`` (pre-tag snapshots read as raw)."""
-        step = self._resolve_step(step)
-        with np.load(self._path(step)) as z:
-            key = f"meta{_SEP}ls_format"
-            return str(z[key]) if key in z.files else "raw"
+        return self.read_snapshot(step)[3]
 
     def restore(
         self,
@@ -274,9 +290,9 @@ class Checkpointer:
 
         Returns ``(tables, local_state, step)``.
         """
-        _, step = self.restore_tables(store, step=step)
-        ls_leaves = self.raw_local_state(step)
-        if ls_leaves and self.local_state_format(step) == "exported":
+        step, values, ls_leaves, fmt = self.read_snapshot(step)
+        self._load_tables(store, step, values)
+        if ls_leaves and fmt == "exported":
             raise ValueError(
                 f"checkpoint step {step} stores local state in the worker "
                 "logic's EXPORTED form (written by the Trainer path); "

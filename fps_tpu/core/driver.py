@@ -192,10 +192,10 @@ class Trainer:
         local state from :meth:`init_state`. Returns
         ``(tables, local_state, step)``.
         """
-        tables, step = checkpointer.restore_tables(self.store, step=step)
-        leaves = checkpointer.raw_local_state(step)
+        step, values, leaves, fmt = checkpointer.read_snapshot(step)
+        tables = checkpointer._load_tables(self.store, step, values)
         imported = NotImplemented
-        if checkpointer.local_state_format(step) == "exported":
+        if fmt == "exported":
             imported = self.logic.import_local_state(
                 leaves, self.num_workers
             )

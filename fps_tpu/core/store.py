@@ -155,12 +155,21 @@ def push(
         ``SimplePSLogic``). Non-additive folds see the batch-combined delta
         once per id (duplicates are pre-combined with ``segment_sum``) and
         are applied only to rows with at least one non-dropped push.
-      combine: how duplicate ids within one push combine — ``"sum"`` (the
-        reference's semantics: every message folds in) or ``"mean"``
-        (per-id average: each touched row takes one averaged step per
-        push, which keeps hot Zipfian ids stable under large batches —
-        the analog of the reference's batching senders combining pushes
-        to the same id, expected upstream ``.../ps/client/sender/``).
+      combine: how duplicate ids within one push combine — the analog of
+        the reference's pluggable combining senders (user-supplied
+        ``CombinationLogic``, expected upstream ``.../ps/client/sender/``):
+        * ``"sum"`` — every message folds in (reference semantics);
+        * ``"mean"`` — per-id average: one averaged step per touched row
+          per push, stable for Zipfian-hot ids under large batches;
+        * ``"max"`` / ``"min"`` — elementwise extremum of the id's deltas
+          (a native scatter-max/min, no serial fold);
+        * a callable ``(summed, counts) -> combined`` mapping each
+          shard-local row's per-id delta SUM ``(rps, dim)`` and push
+          COUNT ``(rps,)`` to the combined delta — the general
+          user-extensible strategy (count-normalized steps, clipping,
+          learning-rate-by-frequency, ...). Untouched rows (count 0) are
+          masked out after the callable, so it need not special-case
+          them.
       hot_rows: number of LOCAL leading rows of this shard treated as
         write-hot (see :func:`fps_tpu.ops.scatter_add`); under the
         owner-major cyclic layout, global hot ids ``[0, H)`` land exactly
@@ -184,34 +193,61 @@ def push(
     local_idx = jnp.where(owned, gathered_ids // num_shards, rps)
     masked = jnp.where(owned[:, None], gathered_deltas, jnp.zeros_like(gathered_deltas))
 
-    if combine not in ("sum", "mean"):
+    if not callable(combine) and combine not in ("sum", "mean", "max", "min"):
         raise ValueError(f"unknown combine mode {combine!r}")
 
     if apply_fn is None and combine == "sum":
         return ops.scatter_add(local_shard, local_idx, masked,
                                hot_rows=hot_rows)
 
-    # Combine duplicate ids first, then apply once per touched row. The
-    # per-id sums and counts ride ONE scatter (counts as an appended ones
-    # column) — the scatter is per-row-transaction bound on TPU, so a second
-    # scatter for counts would double its cost.
     dim = masked.shape[1]
-    withcnt = jnp.concatenate(
-        [masked.astype(jnp.float32), owned.astype(jnp.float32)[:, None]],
-        axis=1,
-    )
-    acc = ops.scatter_add(
-        jnp.zeros((rps, dim + 1), jnp.float32), local_idx, withcnt,
-        hot_rows=hot_rows,
-    )
-    summed, counts = acc[:, :dim], acc[:, dim]
-    if combine == "mean":
-        summed = summed * (1.0 / jnp.maximum(counts, 1.0))[:, None]
+    if combine in ("max", "min"):
+        # Extremum fold: ONE scatter-max/min of the raw deltas (duplicates
+        # combine natively, no serialized pairwise fold) with the touched
+        # indicator riding as an appended column (owned rows contribute
+        # 1.0 vs the fill sentinel — same one-scatter trick as the sum
+        # path's count column; the scatter is per-row-transaction bound).
+        fill = jnp.float32(-3.0e38 if combine == "max" else 3.0e38)
+        ind = jnp.where(owned, 1.0, fill)[:, None]
+        filled = jnp.where(
+            owned[:, None],
+            jnp.concatenate(
+                [gathered_deltas.astype(jnp.float32), ind], axis=1
+            ),
+            fill,
+        )
+        target = jnp.full((rps, dim + 1), fill, jnp.float32)
+        if combine == "max":
+            ext = target.at[local_idx].max(filled, mode="drop")
+        else:
+            ext = target.at[local_idx].min(filled, mode="drop")
+        counts = (jnp.abs(ext[:, dim]) <= 1.0).astype(jnp.float32)
+        combined = jnp.where((counts > 0)[:, None], ext[:, :dim], 0.0)
+    else:
+        # Combine duplicate ids first, then apply once per touched row. The
+        # per-id sums and counts ride ONE scatter (counts as an appended
+        # ones column) — the scatter is per-row-transaction bound on TPU,
+        # so a second scatter for counts would double its cost.
+        withcnt = jnp.concatenate(
+            [masked.astype(jnp.float32), owned.astype(jnp.float32)[:, None]],
+            axis=1,
+        )
+        acc = ops.scatter_add(
+            jnp.zeros((rps, dim + 1), jnp.float32), local_idx, withcnt,
+            hot_rows=hot_rows,
+        )
+        combined, counts = acc[:, :dim], acc[:, dim]
+        if combine == "mean":
+            combined = combined * (1.0 / jnp.maximum(counts, 1.0))[:, None]
+        elif callable(combine):
+            combined = jnp.where(
+                (counts > 0)[:, None], combine(combined, counts), 0.0
+            )
     if apply_fn is None:
         # Additive fold: untouched rows receive exactly zero, so no mask is
         # needed (a full-table where() is a measurable per-step cost).
-        return local_shard + summed.astype(local_shard.dtype)
-    new_rows = apply_fn(local_shard, summed.astype(local_shard.dtype))
+        return local_shard + combined.astype(local_shard.dtype)
+    new_rows = apply_fn(local_shard, combined.astype(local_shard.dtype))
     return jnp.where((counts > 0)[:, None], new_rows, local_shard)
 
 

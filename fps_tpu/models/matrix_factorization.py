@@ -102,11 +102,12 @@ class MatrixFactorizationWorker(WorkerLogic):
         """User factors in LOGICAL user order (padding stripped) — the same
         worker-count-independent convention the store's tables use, so a
         checkpoint taken at one worker count restores at any other."""
-        table = np.asarray(local_state)
-        W = self.num_workers
-        rps = table.shape[0] // W
-        u = np.arange(self.cfg.num_users)
-        return table[(u % W) * rps + u // W]
+        from fps_tpu.models.recommendation import mf_user_vectors
+
+        return mf_user_vectors(
+            np.asarray(local_state), self.num_workers,
+            np.arange(self.cfg.num_users),
+        )
 
     def import_local_state(self, leaves, num_workers):
         (logical,) = leaves

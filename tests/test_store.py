@@ -267,3 +267,128 @@ def test_pull_push_matches_numpy_model_randomized(devices8, trial):
             agg = agg / sel.sum()
         expect[np.asarray(id_to_phys(np.int64(i), ns, rps))] += agg
     np.testing.assert_allclose(np.asarray(new), expect, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# User-pluggable push-combine strategies (the reference's combining senders).
+# ---------------------------------------------------------------------------
+
+def test_push_combine_strategies_through_trainer(devices8):
+    """"max" and a user-supplied callable combine run through the FULL
+    Trainer path (shard_map + scan + collectives) and match a numpy oracle
+    applied per step over the global batch."""
+    import jax.numpy as jnp
+
+    from fps_tpu.core.api import ServerLogic, StepOutput, WorkerLogic
+    from fps_tpu.core.driver import Trainer, TrainerConfig, num_workers_of
+    from fps_tpu.core.ingest import epoch_chunks
+
+    class Pusher(WorkerLogic):
+        def pull_ids(self, batch):
+            return {"t": batch["id"].astype(jnp.int32)}
+
+        def step(self, batch, pulled, local_state, key):
+            ids = jnp.where(batch["weight"] > 0,
+                            batch["id"].astype(jnp.int32), -1)
+            deltas = batch["val"][:, None].astype(jnp.float32)
+            return StepOutput(pushes={"t": (ids, deltas)},
+                              local_state=local_state,
+                              out={"n": jnp.sum(batch["weight"])})
+
+    mesh = make_ps_mesh(num_shards=4, num_data=2, devices=devices8[:8])
+    W = num_workers_of(mesh)
+    R = 23
+    rng = np.random.default_rng(4)
+    n = 768
+    data = {
+        "id": rng.integers(0, R, n).astype(np.int32),  # heavy duplication
+        "val": rng.normal(0, 1, n).astype(np.float32),
+    }
+
+    def clipped_mean(summed, counts):
+        # custom strategy: count-normalized step, clipped to [-0.5, 0.5]
+        return jnp.clip(summed / jnp.maximum(counts, 1.0)[:, None],
+                        -0.5, 0.5)
+
+    def np_combine(mode, vals):
+        if mode == "max":
+            return vals.max()
+        return np.clip(vals.mean(), -0.5, 0.5)
+
+    for mode, combine in [("max", "max"), ("clip", clipped_mean)]:
+        store = ParamStore(mesh, [TableSpec("t", R, 1).zeros_init()])
+        trainer = Trainer(mesh, store, Pusher(),
+                          server_logic=ServerLogic(combine=combine),
+                          config=TrainerConfig(donate=False))
+        tables, ls = trainer.init_state(jax.random.key(0))
+        chunks = list(epoch_chunks(data, num_workers=W, local_batch=16,
+                                   steps_per_chunk=4, seed=7))
+        # Oracle: per global step, fold each id's pushes with the strategy,
+        # then add (the default apply).
+        want = np.zeros(R, np.float64)
+        for c in chunks:
+            ids_c = np.asarray(c["id"]).reshape(-1, W * 16)
+            val_c = np.asarray(c["val"]).reshape(-1, W * 16)
+            wt_c = np.asarray(c["weight"]).reshape(-1, W * 16)
+            for t in range(ids_c.shape[0]):
+                m = wt_c[t] > 0
+                for i in np.unique(ids_c[t][m]):
+                    vals = val_c[t][m][ids_c[t][m] == i]
+                    want[i] += np_combine(mode, vals.astype(np.float64))
+        tables, ls, _ = trainer.fit_stream(tables, ls, iter(chunks),
+                                           jax.random.key(1))
+        got = store.dump_model("t")[1][:, 0]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5,
+                                   err_msg=mode)
+
+
+def test_push_combine_min_and_validation(devices8):
+    """"min" fold matches its oracle; unknown modes raise at trace time."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from fps_tpu.core.store import push
+    from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
+
+    mesh = make_ps_mesh(num_shards=4, num_data=2, devices=devices8[:8])
+    R = 13
+    rng = np.random.default_rng(5)
+    B = 32  # per worker
+    ids = rng.integers(-1, R, (8, B)).astype(np.int32)  # some dropped
+    deltas = rng.normal(0, 1, (8, B, 2)).astype(np.float32)
+
+    store = ParamStore(mesh, [TableSpec("t", R, 2).zeros_init()])
+    tables = store.init(jax.random.key(0))
+
+    def dev(tab, i, d):
+        return push(tab, i, d, num_shards=4, combine="min")
+
+    f = jax.jit(jax.shard_map(
+        dev, mesh=mesh,
+        in_specs=(P(SHARD_AXIS, None), P((DATA_AXIS, SHARD_AXIS)),
+                  P((DATA_AXIS, SHARD_AXIS))),
+        out_specs=P(SHARD_AXIS, None), check_vma=False,
+    ))
+    got = np.asarray(f(tables["t"], jnp.asarray(ids.reshape(-1)),
+                       jnp.asarray(deltas.reshape(-1, 2))))
+    want = np.zeros((R, 2))
+    flat_i, flat_d = ids.reshape(-1), deltas.reshape(-1, 2)
+    for i in range(R):
+        m = flat_i == i
+        if m.any():
+            want[i] = flat_d[m].min(axis=0)
+    # physical rows: owner-major cyclic over 4 shards
+    from fps_tpu.core.store import id_to_phys, rows_per_shard
+    rps = rows_per_shard(R, 4)
+    phys = np.asarray(id_to_phys(np.arange(R), 4, rps))
+    np.testing.assert_allclose(got[phys], want, rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="combine"):
+        jax.shard_map(
+            lambda t, i, d: push(t, i, d, num_shards=4, combine="median"),
+            mesh=mesh,
+            in_specs=(P(SHARD_AXIS, None), P((DATA_AXIS, SHARD_AXIS)),
+                      P((DATA_AXIS, SHARD_AXIS))),
+            out_specs=P(SHARD_AXIS, None), check_vma=False,
+        )(tables["t"], jnp.asarray(ids.reshape(-1)),
+          jnp.asarray(deltas.reshape(-1, 2)))
