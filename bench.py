@@ -1,4 +1,4 @@
-"""Headline benchmark: MovieLens-20M-scale online MF epoch time on TPU.
+"""Headline benchmark: MovieLens-20M-scale online MF time-to-quality on TPU.
 
 BASELINE.json metric: "MovieLens-20M MF epoch time; text8 word2vec
 words/sec/chip" (the reference publishes no numbers — ``"published": {}`` —
@@ -9,13 +9,17 @@ extrapolated to the full epoch, then credited a generous JVM speedup factor
 over CPython).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N, ...}
 vs_baseline > 1 means this framework is faster than the emulated baseline.
 
-``--workload mf`` (default) reports the ML-20M MF epoch time;
-``--workload w2v`` reports text8-scale word2vec SGNS words/sec/chip;
-``--workload logreg`` reports Criteo-style SSP logistic-regression
-examples/sec/chip.
+``--workload mf`` (default) reports ML-20M MF **wall-clock to
+train-RMSE <= 0.12** on the planted-structure set (noise floor ~0.1),
+plus epoch count and the median epoch time — time-to-fixed-quality is the
+firm cross-system comparison (a raw epoch time rewards configurations
+that stream fast but learn slowly); compile time is excluded via a
+warm-up epoch on throwaway state. ``--workload w2v`` reports text8-scale
+word2vec SGNS words/sec/chip; ``--workload logreg`` reports Criteo-style
+SSP logistic-regression examples/sec/chip.
 """
 
 from __future__ import annotations
@@ -265,12 +269,18 @@ def main():
     ap.add_argument("--num-tokens", type=int, default=17_000_000)
     ap.add_argument("--dim", type=int, default=100)
     ap.add_argument("--block-len", type=int, default=8192)
+    ap.add_argument("--rmse-target", type=float, default=0.12,
+                    help="mf workload: train to this train-RMSE "
+                         "(planted-structure noise floor is ~0.1)")
+    ap.add_argument("--max-epochs", type=int, default=8)
     args = ap.parse_args()
 
     if args.workload == "w2v":
         return run_w2v(args)
     if args.workload == "logreg":
         return run_logreg(args)
+
+    import statistics
 
     import jax
 
@@ -295,8 +305,6 @@ def main():
     # mean-combine is the reference's combining-sender analog and learns
     # stably at any batch size.
     trainer, store = online_mf(mesh, cfg, combine="mean")
-    tables, local_state = trainer.init_state(jax.random.key(0))
-
     dataset = DeviceDataset(mesh, data)  # one-time upload, outside the epoch
     plan = DeviceEpochPlan(
         dataset,
@@ -306,35 +314,67 @@ def main():
         seed=1,
     )
 
-    # Warm-up: compile + one full epoch (ingest is fused into the jit, so
-    # the whole epoch — shuffle, batch gathers, training — is ONE dispatch).
-    tables, local_state, _ = trainer.run_indexed(
-        tables, local_state, plan, jax.random.key(9)
-    )
+    # Warm-up: compile + one full epoch on throwaway state (ingest is fused
+    # into the jit, so the whole epoch — shuffle, batch gathers, training —
+    # is ONE dispatch). The timed run below reuses the compiled program on
+    # FRESH state: time-to-quality excludes one-time compilation.
+    tables, local_state = trainer.init_state(jax.random.key(0))
+    trainer.run_indexed(tables, local_state, plan, jax.random.key(9))
 
-    t0 = time.perf_counter()
-    tables, local_state, metrics = trainer.run_indexed(
-        tables, local_state, plan, jax.random.key(1)
-    )
-    epoch_s = time.perf_counter() - t0
+    # Headline: wall-clock (and epochs) to train-RMSE <= target on the
+    # planted-structure set (noise floor ~0.1) — time-to-fixed-quality is
+    # the firm cross-system comparison; raw epoch time alone rewards
+    # configurations that stream fast but learn slowly.
+    target = args.rmse_target
+    tables, local_state = trainer.init_state(jax.random.key(0))
+    epoch_times, rmse_curve = [], []
+    for e in range(args.max_epochs):
+        t0 = time.perf_counter()
+        tables, local_state, m = trainer.run_indexed(
+            tables, local_state, plan, jax.random.key(1),
+            epochs=1, start_epoch=e,
+        )
+        epoch_times.append(time.perf_counter() - t0)
+        rmse_e = float(np.sqrt(np.asarray(m[0]["se"]).sum()
+                               / max(np.asarray(m[0]["n"]).sum(), 1.0)))
+        rmse_curve.append(rmse_e)
+        if rmse_e <= target:
+            break
+    total_s = sum(epoch_times)
+    epochs = len(epoch_times)
+    median_epoch = statistics.median(epoch_times)
+    reached = rmse_curve[-1] <= target
 
-    baseline_s = emulated_flink_cpu_epoch_s(data, nr, args.rank)
+    # Emulated reference cost for the SAME epoch count (the per-record
+    # sequential loop converges at least as fast per epoch, so equal-epochs
+    # is a conservative credit to the baseline).
+    baseline_epoch_s = emulated_flink_cpu_epoch_s(data, nr, args.rank)
+    baseline_total_s = baseline_epoch_s * epochs
 
-    # Quality evidence on stderr (stdout stays one JSON line): per-step
-    # train RMSE across the timed epoch — the fast path must also be the
-    # learning path.
-    mse0, mse1 = first_last_real_step(metrics[0], "se")
     print(
-        f"quality: train RMSE step0 {np.sqrt(mse0):.4f} -> "
-        f"last-real-step {np.sqrt(mse1):.4f} (epoch 2 of training)",
+        "quality: per-epoch train RMSE "
+        + " -> ".join(f"{r:.4f}" for r in rmse_curve)
+        + (f" (reached <= {target})" if reached
+           else f" (STOPPED at max_epochs={args.max_epochs} without "
+                f"reaching {target})"),
+        file=sys.stderr,
+    )
+    print(
+        f"epoch times: {[round(t, 3) for t in epoch_times]} s "
+        f"(median {median_epoch:.4f}); emulated Flink-CPU epoch "
+        f"{baseline_epoch_s:.1f}s",
         file=sys.stderr,
     )
 
     print(json.dumps({
-        "metric": f"ml{args.scale}_mf_epoch_time",
-        "value": round(epoch_s, 4),
+        "metric": f"ml{args.scale}_mf_time_to_rmse_{target}",
+        "value": round(total_s, 4),
         "unit": "s",
-        "vs_baseline": round(baseline_s / epoch_s, 2),
+        "vs_baseline": round(baseline_total_s / total_s, 2),
+        "epochs": epochs,
+        "median_epoch_s": round(median_epoch, 4),
+        "final_train_rmse": round(rmse_curve[-1], 4),
+        "reached": reached,
     }))
 
 

@@ -239,8 +239,11 @@ class Checkpointer:
             fmt = str(z[key]) if key in z.files else "raw"
         return step, tables, leaves, fmt
 
-    def _load_tables(self, store: ParamStore, step: int, values_by_name: dict
-                     ) -> dict:
+    def load_tables(self, store: ParamStore, step: int, values_by_name: dict
+                    ) -> dict:
+        """Validate and load pre-read table arrays (from
+        :meth:`read_snapshot`) into ``store`` — public because
+        ``Trainer.restore_checkpoint`` builds on it."""
         for name, spec in store.specs.items():
             if name not in values_by_name:
                 raise ValueError(
@@ -262,15 +265,29 @@ class Checkpointer:
         """Load a snapshot's tables into ``store`` (sharded on its current
         mesh — any shard count). Returns ``(tables, step)``."""
         step, values, _, _ = self.read_snapshot(step)
-        return self._load_tables(store, step, values), step
+        return self.load_tables(store, step, values), step
 
     def raw_local_state(self, step: int | None = None) -> list[np.ndarray]:
-        """The snapshot's local-state leaves as saved (flattened order)."""
-        return self.read_snapshot(step)[2]
+        """The snapshot's local-state leaves as saved (flattened order).
+
+        Touches only the ``ls::`` keys (np.load decompresses lazily per
+        access — no full-table decompress just for metadata)."""
+        step = self._resolve_step(step)
+        leaves = []
+        with np.load(self._path(step)) as z:
+            i = 0
+            while f"ls{_SEP}{i}" in z.files:
+                leaves.append(z[f"ls{_SEP}{i}"])
+                i += 1
+        return leaves
 
     def local_state_format(self, step: int | None = None) -> str:
-        """``"raw"`` or ``"exported"`` (pre-tag snapshots read as raw)."""
-        return self.read_snapshot(step)[3]
+        """``"raw"`` or ``"exported"`` (pre-tag snapshots read as raw);
+        touches only the metadata key."""
+        step = self._resolve_step(step)
+        with np.load(self._path(step)) as z:
+            key = f"meta{_SEP}ls_format"
+            return str(z[key]) if key in z.files else "raw"
 
     def restore(
         self,
@@ -291,7 +308,7 @@ class Checkpointer:
         Returns ``(tables, local_state, step)``.
         """
         step, values, ls_leaves, fmt = self.read_snapshot(step)
-        self._load_tables(store, step, values)
+        self.load_tables(store, step, values)
         if ls_leaves and fmt == "exported":
             raise ValueError(
                 f"checkpoint step {step} stores local state in the worker "

@@ -193,7 +193,7 @@ class Trainer:
         ``(tables, local_state, step)``.
         """
         step, values, leaves, fmt = checkpointer.read_snapshot(step)
-        tables = checkpointer._load_tables(self.store, step, values)
+        tables = checkpointer.load_tables(self.store, step, values)
         imported = NotImplemented
         if fmt == "exported":
             imported = self.logic.import_local_state(

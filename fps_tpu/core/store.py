@@ -137,7 +137,7 @@ def push(
     shard_axis: str = SHARD_AXIS,
     data_axis: str | None = DATA_AXIS,
     apply_fn: Callable[[Array, Array], Array] | None = None,
-    combine: str = "sum",
+    combine: str | Callable[[Array, Array], Array] = "sum",
     hot_rows: int = 0,
 ) -> Array:
     """Scatter-add ``deltas`` for ``ids`` into the sharded table.

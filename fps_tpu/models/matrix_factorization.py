@@ -213,7 +213,7 @@ def make_store(mesh, cfg: MFConfig) -> ParamStore:
 def online_mf(mesh, cfg: MFConfig, *, sync_every: int | None = None,
               push_delay: int = 0, donate: bool = True,
               max_steps_per_call: int | None = None,
-              combine: str = "sum"):
+              combine="sum"):
     """Construct (trainer, store) for online MF — the analog of
     ``PSOnlineMatrixFactorization.psOnlineMF(...)``.
 
