@@ -40,6 +40,21 @@ Pytree = Any
 _SEP = "::"  # npz key separator: kind::name
 
 
+def _ls_leaves(z) -> list:
+    """Local-state leaves from an open npz (touches only ls:: keys)."""
+    leaves = []
+    i = 0
+    while f"ls{_SEP}{i}" in z.files:
+        leaves.append(z[f"ls{_SEP}{i}"])
+        i += 1
+    return leaves
+
+
+def _ls_format(z) -> str:
+    key = f"meta{_SEP}ls_format"
+    return str(z[key]) if key in z.files else "raw"
+
+
 # ---------------------------------------------------------------------------
 # Model export (the reference's close()-time (id, param) stream).
 # ---------------------------------------------------------------------------
@@ -230,13 +245,8 @@ class Checkpointer:
                 for k in z.files
                 if k.startswith(f"table{_SEP}")
             }
-            leaves = []
-            i = 0
-            while f"ls{_SEP}{i}" in z.files:
-                leaves.append(z[f"ls{_SEP}{i}"])
-                i += 1
-            key = f"meta{_SEP}ls_format"
-            fmt = str(z[key]) if key in z.files else "raw"
+            leaves = _ls_leaves(z)
+            fmt = _ls_format(z)
         return step, tables, leaves, fmt
 
     def load_tables(self, store: ParamStore, step: int, values_by_name: dict
@@ -273,21 +283,15 @@ class Checkpointer:
         Touches only the ``ls::`` keys (np.load decompresses lazily per
         access — no full-table decompress just for metadata)."""
         step = self._resolve_step(step)
-        leaves = []
         with np.load(self._path(step)) as z:
-            i = 0
-            while f"ls{_SEP}{i}" in z.files:
-                leaves.append(z[f"ls{_SEP}{i}"])
-                i += 1
-        return leaves
+            return _ls_leaves(z)
 
     def local_state_format(self, step: int | None = None) -> str:
         """``"raw"`` or ``"exported"`` (pre-tag snapshots read as raw);
         touches only the metadata key."""
         step = self._resolve_step(step)
         with np.load(self._path(step)) as z:
-            key = f"meta{_SEP}ls_format"
-            return str(z[key]) if key in z.files else "raw"
+            return _ls_format(z)
 
     def restore(
         self,

@@ -54,7 +54,6 @@ def main(argv=None) -> int:
     train, test = train_test_split(data, test_frac=0.1, seed=args.seed + 1)
 
     mesh = make_mesh(args)
-    S = mesh.shape["shard"]
     emit({"event": "start", "workload": "ials", "num_users": nu,
           "num_items": ni, "mesh": dict(mesh.shape)})
 
@@ -67,9 +66,10 @@ def main(argv=None) -> int:
 
     from fps_tpu.examples.common import make_epoch_source
 
-    # iALS has no worker-local state to route for and uses the shard axis
-    # only; the source is consumed twice per epoch (one pass per side).
-    source = make_epoch_source(args, mesh, train, num_workers=S)
+    # iALS has no worker-local state to route for; the interaction stream
+    # splits over ALL devices (the source's default worker count) and is
+    # consumed twice per epoch (one pass per side).
+    source = make_epoch_source(args, mesh, train)
 
     for epoch in range(args.epochs):
         # --profile traces the first epoch only (one epoch is representative

@@ -89,11 +89,12 @@ class IALSSolver:
         self.mesh = mesh
         self.cfg = cfg
         self.num_shards = mesh.shape[SHARD_AXIS]
-        if mesh.shape.get(DATA_AXIS, 1) != 1:
-            # The accumulate pass uses the shard axis both for table shards
-            # and for splitting the interaction stream; a data axis would
-            # double-count pushes. Keep iALS meshes 1 x shards.
-            raise ValueError("IALSSolver expects a mesh with data axis of size 1")
+        self.num_data = mesh.shape.get(DATA_AXIS, 1)
+        # Workers = ALL devices: the interaction stream splits over both
+        # mesh axes; pushes gather across the data axis (like the Trainer's)
+        # so the replicated accumulators fold every worker's contributions
+        # exactly once.
+        self.num_workers = self.num_data * self.num_shards
         init = ranged_uniform_init(-cfg.init_scale, cfg.init_scale, cfg.rank,
                                    cfg.dtype)
         self.store = ParamStore(
@@ -157,8 +158,10 @@ class IALSSolver:
     def _accumulate_fn(self):
         """jit: stream one chunk of interactions into (A, b) accumulators.
 
-        Chunk leaves are (T, B) with B split over the shard axis (workers ==
-        shards here): ``solve_ids``, ``fixed_ids``, ``rating``, ``weight``.
+        Chunk leaves are (T, B) with B split over ALL devices (the data AND
+        shard axes): ``solve_ids``, ``fixed_ids``, ``rating``, ``weight``.
+        With a data axis, pushes gather across it so the replicated
+        accumulators fold every worker's contributions exactly once.
         """
         cfg = self.cfg
         k = cfg.rank
@@ -177,10 +180,11 @@ class IALSSolver:
                 vec = ((1.0 + cfg.alpha * r) * w)[:, None] * y
 
                 ids = jnp.where(w > 0, solve_ids, -1)
+                data_axis = DATA_AXIS if self.num_data > 1 else None
                 A = push(A, ids, outer.reshape(-1, k * k),
-                         num_shards=self.num_shards, data_axis=None)
+                         num_shards=self.num_shards, data_axis=data_axis)
                 b = push(b, ids, vec,
-                         num_shards=self.num_shards, data_axis=None)
+                         num_shards=self.num_shards, data_axis=data_axis)
                 return (A, b), None
 
             (A, b), _ = lax.scan(body, (A, b), chunk)
@@ -194,7 +198,9 @@ class IALSSolver:
                     P(SHARD_AXIS, None),
                     P(SHARD_AXIS, None),
                     P(SHARD_AXIS, None),
-                    jax.tree.map(lambda _: P(None, SHARD_AXIS), chunk),
+                    jax.tree.map(
+                        lambda _: P(None, (DATA_AXIS, SHARD_AXIS)), chunk
+                    ),
                 ),
                 out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None)),
                 check_vma=False,
@@ -242,8 +248,8 @@ class IALSSolver:
 
         ``chunks`` yield dicts with (T, B) arrays ``user``, ``item``,
         ``rating``, ``weight`` (as produced by
-        :func:`fps_tpu.core.ingest.epoch_chunks`; B must be divisible by the
-        shard count).
+        :func:`fps_tpu.core.ingest.epoch_chunks`; B must be divisible by
+        ``num_workers`` = data * shard, the full device count).
         """
         cfg = self.cfg
         if solve == "user":
@@ -271,7 +277,7 @@ class IALSSolver:
         acc = self._compiled_acc.get(solve)
         if acc is None:
             acc = self._compiled_acc[solve] = self._accumulate_fn()
-        sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
+        sharding = NamedSharding(self.mesh, P(None, (DATA_AXIS, SHARD_AXIS)))
 
         def to_dev(x):
             # Device-resident chunks (fps_tpu.core.device_ingest) reshard
@@ -332,7 +338,7 @@ class IALSSolver:
 def interaction_chunks(
     data: dict,
     *,
-    num_shards: int,
+    num_workers: int,
     local_batch: int,
     steps_per_chunk: int,
     seed: int | None = 0,
@@ -341,12 +347,14 @@ def interaction_chunks(
 
     Thin wrapper over :func:`fps_tpu.core.ingest.epoch_chunks` with
     round-robin placement (iALS has no worker-local state to route for).
+    ``num_workers`` is ALL mesh devices (``IALSSolver.num_workers``) — the
+    stream splits over the data AND shard axes.
     """
     from fps_tpu.core.ingest import epoch_chunks
 
     return epoch_chunks(
         data,
-        num_workers=num_shards,
+        num_workers=num_workers,
         local_batch=local_batch,
         steps_per_chunk=steps_per_chunk,
         seed=seed,

@@ -64,7 +64,7 @@ def test_half_epoch_matches_numpy_oracle(mods, devices8):
 
     solver.half_epoch(
         "user",
-        ials.interaction_chunks(data, num_shards=4, local_batch=4,
+        ials.interaction_chunks(data, num_workers=4, local_batch=4,
                                 steps_per_chunk=2, seed=None),
     )
     U1, _ = solver.factors()
@@ -85,7 +85,7 @@ def test_item_half_epoch_matches_numpy_oracle(mods, devices8):
     )
     solver.half_epoch(
         "item",
-        ials.interaction_chunks(data, num_shards=4, local_batch=4,
+        ials.interaction_chunks(data, num_workers=4, local_batch=4,
                                 steps_per_chunk=2, seed=None),
     )
     _, V1 = solver.factors()
@@ -99,7 +99,7 @@ def test_objective_decreases_over_epochs(mods, devices8):
     data = mods["synthetic_implicit"](nu, ni, 12, rank=3, seed=3)
 
     def chunks():
-        return ials.interaction_chunks(data, num_shards=8, local_batch=8,
+        return ials.interaction_chunks(data, num_workers=8, local_batch=8,
                                        steps_per_chunk=2, seed=0)
 
     losses = [solver.weighted_loss(data["user"], data["item"], data["rating"])]
@@ -130,7 +130,7 @@ def test_recall_beats_random(mods, devices8):
     solver = _solver(mods, 8, nu, ni, rank=8, alpha=10.0, reg=0.5)
 
     def chunks():
-        return ials.interaction_chunks(train, num_shards=8, local_batch=8,
+        return ials.interaction_chunks(train, num_workers=8, local_batch=8,
                                        steps_per_chunk=2, seed=0)
 
     for _ in range(3):
@@ -141,9 +141,39 @@ def test_recall_beats_random(mods, devices8):
     assert rec > 0.35, rec
 
 
-def test_rejects_data_axis(mods, devices8):
+def test_full_mesh_matches_shard_only_mesh(mods, devices8):
+    """iALS over a (2, 4) data x shard mesh (stream split over ALL devices,
+    pushes psum'd across the data axis) must solve the same factors as the
+    1 x 8 shard-only mesh — closing the round-1 restriction that refused
+    data-parallel meshes."""
     jax, ials = mods["jax"], mods["ials"]
-    mesh = mods["make_ps_mesh"](num_shards=4, num_data=2,
-                                devices=jax.devices()[:8])
-    with pytest.raises(ValueError):
-        ials.IALSSolver(mesh, ials.IALSConfig(num_users=4, num_items=4))
+    nu, ni, rank = 24, 18, 4
+    data = mods["synthetic_implicit"](nu, ni, 9, rank=2, seed=6)
+
+    def run(num_data, num_shards):
+        mesh = mods["make_ps_mesh"](
+            num_shards=num_shards, num_data=num_data,
+            devices=jax.devices()[: num_data * num_shards],
+        )
+        cfg = ials.IALSConfig(num_users=nu, num_items=ni, rank=rank,
+                              alpha=5.0, reg=0.3)
+        solver = ials.IALSSolver(mesh, cfg)
+        solver.init(jax.random.key(0))
+        assert solver.num_workers == num_data * num_shards
+
+        def chunks():
+            return ials.interaction_chunks(
+                data, num_workers=solver.num_workers, local_batch=4,
+                steps_per_chunk=2, seed=0,
+            )
+
+        for _ in range(2):
+            solver.epoch(chunks)
+        return solver.factors()
+
+    U_a, V_a = run(1, 8)
+    U_b, V_b = run(2, 4)
+    # Same normal equations accumulated in a different order: equal up to
+    # float32 reassociation.
+    np.testing.assert_allclose(U_a, U_b, rtol=5e-4, atol=5e-5)
+    np.testing.assert_allclose(V_a, V_b, rtol=5e-4, atol=5e-5)
