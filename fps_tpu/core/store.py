@@ -201,27 +201,35 @@ def push(
                                hot_rows=hot_rows)
 
     dim = masked.shape[1]
+    # Accumulate in at least f32, but never BELOW the table's own precision:
+    # a float64 table must fold its duplicates in float64 (hard-coding f32
+    # here would silently shave 29 mantissa bits off every non-"sum" push).
+    acc_dt = jnp.promote_types(local_shard.dtype, jnp.float32)
     if combine in ("max", "min"):
         # Extremum fold: ONE scatter-max/min of the raw deltas (duplicates
         # combine natively, no serialized pairwise fold) with the touched
         # indicator riding as an appended column (owned rows contribute
         # 1.0 vs the fill sentinel — same one-scatter trick as the sum
         # path's count column; the scatter is per-row-transaction bound).
-        fill = jnp.float32(-3.0e38 if combine == "max" else 3.0e38)
+        # Sentinel beyond any representable delta IN THE ACCUMULATOR dtype —
+        # a hard-coded f32-range constant would silently clamp f64 deltas of
+        # magnitude > 3e38 to the sentinel.
+        lim = jnp.finfo(acc_dt).max
+        fill = jnp.asarray(-lim if combine == "max" else lim, acc_dt)
         ind = jnp.where(owned, 1.0, fill)[:, None]
         filled = jnp.where(
             owned[:, None],
             jnp.concatenate(
-                [gathered_deltas.astype(jnp.float32), ind], axis=1
+                [gathered_deltas.astype(acc_dt), ind], axis=1
             ),
             fill,
         )
-        target = jnp.full((rps, dim + 1), fill, jnp.float32)
+        target = jnp.full((rps, dim + 1), fill, acc_dt)
         if combine == "max":
             ext = target.at[local_idx].max(filled, mode="drop")
         else:
             ext = target.at[local_idx].min(filled, mode="drop")
-        counts = (jnp.abs(ext[:, dim]) <= 1.0).astype(jnp.float32)
+        counts = (jnp.abs(ext[:, dim]) <= 1.0).astype(acc_dt)
         combined = jnp.where((counts > 0)[:, None], ext[:, :dim], 0.0)
     else:
         # Combine duplicate ids first, then apply once per touched row. The
@@ -229,11 +237,11 @@ def push(
         # ones column) — the scatter is per-row-transaction bound on TPU,
         # so a second scatter for counts would double its cost.
         withcnt = jnp.concatenate(
-            [masked.astype(jnp.float32), owned.astype(jnp.float32)[:, None]],
+            [masked.astype(acc_dt), owned.astype(acc_dt)[:, None]],
             axis=1,
         )
         acc = ops.scatter_add(
-            jnp.zeros((rps, dim + 1), jnp.float32), local_idx, withcnt,
+            jnp.zeros((rps, dim + 1), acc_dt), local_idx, withcnt,
             hot_rows=hot_rows,
         )
         combined, counts = acc[:, :dim], acc[:, dim]

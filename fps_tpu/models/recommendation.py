@@ -82,9 +82,7 @@ def build_topk_fn(store: ParamStore, table: str, k: int,
             )  # (B, S*n_local)
             all_s = jnp.where(hit, NEG_INF, all_s)
 
-        out_s, out_j = lax.top_k(all_s, k)
-        out_i = jnp.take_along_axis(all_i, out_j, axis=1)
-        return out_i.astype(jnp.int32), out_s
+        return _merge_topk(all_s, all_i, k)
 
     shmapped = jax.shard_map(
         device_fn,
@@ -152,6 +150,24 @@ def _score_and_local_topk(local, queries, *, num_shards, num_ids, n):
     return top_s, jnp.take(ids, top_i)
 
 
+def _merge_topk(scores, ids, k):
+    """Final cross-shard merge: top-``k`` of the ``(B, S*n_local)`` candidate
+    pool. On a small table (rows_per_shard < k/S) the pool can undershoot
+    ``k``, and ``lax.top_k(x, k)`` with ``k > x.shape[-1]`` fails at trace
+    time with an opaque XLA error — clamp, then pad back out to the (B, k)
+    contract with -1 ids / NEG_INF scores (the same "no candidate" sentinels
+    the off-cadence tap path emits). Shared by :func:`build_topk_fn` and
+    :func:`_topk_local_queries` so the clamp cannot drift between them."""
+    k_eff = min(k, scores.shape[1])
+    out_s, out_j = lax.top_k(scores, k_eff)
+    out_i = jnp.take_along_axis(ids, out_j, axis=1)
+    if k_eff < k:
+        pad = ((0, 0), (0, k - k_eff))
+        out_s = jnp.pad(out_s, pad, constant_values=NEG_INF)
+        out_i = jnp.pad(out_i, pad, constant_values=-1)
+    return out_i.astype(jnp.int32), out_s
+
+
 def _topk_local_queries(local, queries, *, num_shards, num_ids, k):
     """Device-side top-k for PER-WORKER queries (inside shard_map).
 
@@ -177,9 +193,7 @@ def _topk_local_queries(local, queries, *, num_shards, num_ids, k):
     mine_s = mine_s.transpose(1, 0, 2).reshape(q, -1)  # (q, S*n_local)
     mine_i = mine_i.transpose(1, 0, 2).reshape(q, -1)
 
-    out_s, out_j = lax.top_k(mine_s, k)
-    out_i = jnp.take_along_axis(mine_i, out_j, axis=1)
-    return out_i.astype(jnp.int32), out_s
+    return _merge_topk(mine_s, mine_i, k)
 
 
 def make_online_topk_tap(store: ParamStore, table: str, k: int, *,

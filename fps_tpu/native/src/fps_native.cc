@@ -120,9 +120,15 @@ long fps_parse_ratings(const char* path, int32_t* users, int32_t* items,
                        float* ratings, long cap, long* malformed) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
-  fseek(f, 0, SEEK_END);
+  if (fseek(f, 0, SEEK_END) != 0) {
+    fclose(f);
+    return -1;
+  }
   long size = ftell(f);
-  fseek(f, 0, SEEK_SET);
+  if (size < 0 || fseek(f, 0, SEEK_SET) != 0) {
+    fclose(f);
+    return -1;
+  }
   char* buf = static_cast<char*>(malloc(size + 1));
   if (!buf) {
     fclose(f);
@@ -189,15 +195,23 @@ inline bool parse_signed(const char*& p, const char* end, double* out) {
   }
   if (p >= end || (!is_digit(*p) && *p != '.')) return false;
   double v = 0.0;
-  while (p < end && is_digit(*p)) v = v * 10.0 + (*p++ - '0');
+  bool digits = false;  // "." / "-." must fail like Python float("."), not
+                        // parse as 0.0 — native and fallback loaders must
+                        // classify degenerate tokens identically.
+  while (p < end && is_digit(*p)) {
+    v = v * 10.0 + (*p++ - '0');
+    digits = true;
+  }
   if (p < end && *p == '.') {
     ++p;
     double scale = 0.1;
     while (p < end && is_digit(*p)) {
       v += (*p++ - '0') * scale;
       scale *= 0.1;
+      digits = true;
     }
   }
+  if (!digits) return false;
   if (p < end && (*p == 'e' || *p == 'E')) {
     ++p;
     double esign = 1.0;
@@ -237,9 +251,17 @@ inline uint64_t hash_bytes(uint64_t seed, const char* s, long len) {
 inline char* read_whole_file(const char* path, long* out_len) {
   FILE* f = fopen(path, "rb");
   if (!f) return nullptr;
-  fseek(f, 0, SEEK_END);
+  // An unseekable path (pipe, directory) must surface as an I/O error, not
+  // as a valid empty dataset: ftell returns -1 there.
+  if (fseek(f, 0, SEEK_END) != 0) {
+    fclose(f);
+    return nullptr;
+  }
   long size = ftell(f);
-  fseek(f, 0, SEEK_SET);
+  if (size < 0 || fseek(f, 0, SEEK_SET) != 0) {
+    fclose(f);
+    return nullptr;
+  }
   char* buf = static_cast<char*>(malloc(size + 1));
   if (!buf) {
     fclose(f);

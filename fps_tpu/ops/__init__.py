@@ -121,14 +121,25 @@ def scatter_add(
     out with frequency-ranked ids put the Zipfian head there): pushes to
     them are accumulated by a dense lane-packed MXU contraction with zero
     update serialization, and only the (low-duplication) tail pays the XLA
-    scatter. Semantics are identical either way — splitting is purely a
-    performance routing decision, exact for any id distribution — and the
+    scatter. The split preserves drop/duplicate semantics for any id
+    distribution, but the head contraction carries f32 deltas as a hi+lo
+    bf16 pair (~16 of 24 mantissa bits — see
+    :func:`fps_tpu.ops.pallas_kernels.scatter_add_packed_pallas`), so
+    head-row sums can differ from the XLA scatter in the low mantissa
+    bits; SGD-style updates are insensitive to this, bit-exact
+    reproducibility across ``hot_rows`` settings is not promised. The
     head contraction is cost-capped by :data:`SCATTER_FLOP_BUDGET`: an
     oversized ``hot_rows`` silently falls back to the plain XLA scatter
     instead of burning unbounded MXU time per push.
     """
     use, interpret = _use_pallas()
     R, D = table.shape
+
+    # Every Pallas scatter variant accumulates in f32 (the packed head path
+    # in bf16 hi+lo); a table wider than f32 (f64) must take the XLA
+    # scatter, which adds in the table's native dtype.
+    if jnp.dtype(table.dtype).itemsize > 4:
+        return _xla_scatter_add(table, ids, deltas)
 
     if use and 0 < hot_rows < R:
         pack = max(1, 128 // D)

@@ -13,6 +13,7 @@ convergence tests can assert learning actually happens.
 from __future__ import annotations
 
 import os
+import re
 
 import numpy as np
 
@@ -217,10 +218,18 @@ def _criteo_hash(col: int, token: bytes) -> int:
     return z ^ (z >> 31)
 
 
+_SVM_NUM = re.compile(rb"^[+-]?(\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?$")
+_SVM_IDX = re.compile(rb"^\d+$")
+
+
 def _parse_svmlight_py(path: str, nnz_cap: int | None):
     """Pure-python svmlight parse (fallback). Same conventions as the
     native scanner: malformed data lines raise; rows longer than nnz_cap
-    keep their first nnz_cap features (count returned as ``truncated``)."""
+    keep their first nnz_cap features (count returned as ``truncated``).
+    Tokens are validated against the exact grammar the native scanner
+    accepts (``_SVM_NUM``/``_SVM_IDX``) BEFORE float()/int() — Python's
+    conversions are more permissive ("1_0", "inf", "+5" as an index) and
+    the two loaders must classify every token identically."""
     rows = []
     malformed = 0
     with open(path, "rb") as f:
@@ -230,13 +239,15 @@ def _parse_svmlight_py(path: str, nnz_cap: int | None):
                 continue
             parts = line.split()
             try:
+                if not _SVM_NUM.match(parts[0]):
+                    raise ValueError
                 label = float(parts[0])
                 feats = []
                 for tok in parts[1:]:
                     idx, val = tok.split(b":", 1)
-                    feats.append((int(idx), float(val)))
-                    if int(idx) < 0:
+                    if not _SVM_IDX.match(idx) or not _SVM_NUM.match(val):
                         raise ValueError
+                    feats.append((int(idx), float(val)))
             except (ValueError, IndexError):
                 malformed += 1
                 continue
@@ -326,11 +337,12 @@ def _parse_criteo_py(path: str, num_features: int):
                 for j, tok in enumerate(fields[1 : 1 + CRITEO_NUM_COLS]):
                     if not tok:
                         continue
-                    try:
-                        v = float(tok)
-                    except ValueError:
+                    # Same strict grammar as the native parse_signed —
+                    # float() alone would admit "1_0"/"inf"/"nan".
+                    if not _SVM_NUM.match(tok):
                         ok = False
                         break
+                    v = float(tok)
                     if v >= 0:
                         row_ids[nnz] = j
                         row_vals[nnz] = np.log1p(v)

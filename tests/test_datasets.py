@@ -210,3 +210,46 @@ def test_sniff_and_dispatch(tmp_path):
     d1, _ = load_sparse(str(svm))
     d2, _ = load_sparse(str(tsv), num_features=1 << 15)
     assert set(d1) == set(d2) == {"feat_ids", "feat_vals", "label"}
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_degenerate_tokens_classified_identically(tmp_path, use_native):
+    """Native scanner and Python fallback must agree on every degenerate
+    token: digit-less decimals ("."), Python-only float spellings
+    ("1_0", "inf", "nan"), and signed indices ("+5"). All are malformed
+    in BOTH loaders — a file must never parse differently depending on
+    which parser happened to be available."""
+    if use_native and not native.available():
+        pytest.skip("native unavailable")
+    cases = [
+        "1 5:. 6:2\n",        # digit-less value token
+        ". 5:1\n",            # digit-less label
+        "-. 5:1\n",           # sign-only label
+        "1 5:1_0\n",          # Python float() underscore extension
+        "1 5:inf\n",          # Python float() inf spelling
+        "1 5:nan\n",          # Python float() nan spelling
+        "+1 +5:1\n",          # signed feature index
+    ]
+    for k, text in enumerate(cases):
+        bad = tmp_path / f"deg{k}.svm"
+        bad.write_text("+1 1:1\n" + text)
+        with pytest.raises(ValueError, match="malformed"):
+            load_svmlight(str(bad), use_native=use_native)
+    # ...while native-accepted shapes stay accepted by both: "1." and "+.5".
+    ok = tmp_path / "ok.svm"
+    ok.write_text("+1 1:1. 2:+.5 3:-2.e1\n")
+    data, _ = load_svmlight(str(ok), use_native=use_native)
+    np.testing.assert_allclose(data["feat_vals"][0], [1.0, 0.5, -20.0])
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_criteo_degenerate_numeric_tokens(tmp_path, use_native):
+    """Criteo numeric columns: same strict grammar in both loaders."""
+    if use_native and not native.available():
+        pytest.skip("native unavailable")
+    for tok in ["1_0", "inf", "."]:
+        p = tmp_path / f"bad_{tok.replace('.', 'dot')}.tsv"
+        nums = [tok] + [1] * 12
+        p.write_text(_criteo_line(1, nums, ["aa"] * 26) + "\n")
+        with pytest.raises(ValueError, match="malformed"):
+            load_criteo(str(p), num_features=1 << 16, use_native=use_native)

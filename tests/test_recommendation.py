@@ -219,3 +219,63 @@ def test_mf_negative_sampling_improves_implicit_ranking(devices8):
     assert auc4 > auc0 + 0.02, (auc0, auc4)
     assert margin4 > margin0 * 1.5, (margin0, margin4)
     assert auc4 > 0.6, auc4
+
+
+def test_online_topk_tap_k_exceeds_candidates(devices8):
+    """k larger than the merged candidate pool (S * min(k, rows_per_shard))
+    must not fail at trace time; emitted slots beyond the real item count
+    are -1 ids / NEG_INF scores and the real prefix matches brute force.
+    Regression for the unclamped final lax.top_k (round-2 advice)."""
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.core.ingest import epoch_chunks
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.models.recommendation import (
+        NEG_INF,
+        make_online_topk_tap,
+        mf_topk_query_fn,
+        mf_user_vectors,
+    )
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    mesh = make_ps_mesh(num_shards=4, num_data=2, devices=devices8[:8])
+    W = num_workers_of(mesh)
+    # NI=6 over 4 shards -> rows_per_shard=2 -> merged pool 4*2=8 < K=10.
+    NU, NI, K, Q = 16, 6, 10, 2
+    cfg = MFConfig(num_users=NU, num_items=NI, rank=4, learning_rate=0.0,
+                   reg=0.0)
+    trainer, store = online_mf(mesh, cfg, donate=False)
+    trainer.config = __import__("dataclasses").replace(
+        trainer.config,
+        step_tap=make_online_topk_tap(
+            store, "item_factors", K, every=1,
+            query_fn=mf_topk_query_fn(W, Q),
+        ),
+    )
+    tables, ls = trainer.init_state(jax.random.key(0))
+    data = synthetic_ratings(NU, NI, 4 * 4 * W, seed=0)
+    chunk = next(epoch_chunks(data, num_workers=W, local_batch=4,
+                              steps_per_chunk=4, route_key="user"))
+    tables, ls, m = trainer.run_chunk(tables, ls, chunk, jax.random.key(1))
+
+    tap = {k2: np.asarray(v) for k2, v in m["tap"].items()}
+    assert tap["topk_ids"].shape == (4, W, Q, K)
+
+    items = store.lookup_host("item_factors", np.arange(NI))
+    ls_host = np.asarray(ls)
+    checked = 0
+    for t in range(4):
+        for w in range(W):
+            users = tap["topk_query"][t, w]
+            valid = users >= 0
+            if not valid.any():
+                continue
+            ids_tw = tap["topk_ids"][t, w][valid]
+            scores_tw = tap["topk_scores"][t, w][valid]
+            # Real prefix: all NI items ranked exactly as brute force.
+            qvecs = mf_user_vectors(ls_host, W, users[valid])
+            want = np.argsort(-(qvecs @ items.T), axis=1)[:, :NI]
+            np.testing.assert_array_equal(ids_tw[:, :NI], want)
+            # Beyond the pool: sentinel slots only.
+            assert (scores_tw[:, NI:] <= float(NEG_INF)).all()
+            checked += int(valid.sum())
+    assert checked > 0

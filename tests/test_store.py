@@ -392,3 +392,72 @@ def test_push_combine_min_and_validation(devices8):
             out_specs=P(SHARD_AXIS, None), check_vma=False,
         )(tables["t"], jnp.asarray(ids.reshape(-1)),
           jnp.asarray(deltas.reshape(-1, 2)))
+
+
+def test_push_combine_mean_float64_precision(devices8):
+    """A float64 table must fold duplicate pushes in float64: deltas that
+    differ only below f32 precision (2^-40) must survive a mean-combine.
+    Regression for the hard-coded f32 accumulator (round-2 advice)."""
+    import contextlib
+
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from fps_tpu.core.store import id_to_phys, push, rows_per_shard
+    from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
+
+    @contextlib.contextmanager
+    def x64():
+        old = jax.config.jax_enable_x64
+        jax.config.update("jax_enable_x64", True)
+        try:
+            yield
+        finally:
+            jax.config.update("jax_enable_x64", old)
+
+    with x64():
+        mesh = make_ps_mesh(num_shards=2, num_data=1, devices=devices8[:2])
+        R = 4
+        eps = 2.0 ** -40  # representable in f64, vanishes in f32 (1+eps==1)
+        ids = np.array([1, 1, 1, 1], np.int32)
+        deltas = np.array(
+            [[1.0], [1.0 + eps], [1.0 + 2 * eps], [1.0 + 3 * eps]],
+            np.float64,
+        )
+        store = ParamStore(
+            mesh, [TableSpec("t", R, 1, dtype=jnp.float64).zeros_init()]
+        )
+        tables = store.init(jax.random.key(0))
+
+        f = jax.jit(jax.shard_map(
+            lambda t, i, d: push(t, i, d, num_shards=2, combine="mean"),
+            mesh=mesh,
+            in_specs=(P(SHARD_AXIS, None), P((DATA_AXIS, SHARD_AXIS)),
+                      P((DATA_AXIS, SHARD_AXIS))),
+            out_specs=P(SHARD_AXIS, None), check_vma=False,
+        ))
+        got = np.asarray(f(tables["t"], jnp.asarray(ids),
+                           jnp.asarray(deltas)))
+        assert got.dtype == np.float64
+        rps = rows_per_shard(R, 2)
+        phys = int(np.asarray(id_to_phys(np.array([1]), 2, rps))[0])
+        want = 1.0 + 1.5 * eps  # exact f64 mean of the four deltas
+        # An f32 accumulator would return exactly 1.0 here.
+        assert got[phys, 0] == pytest.approx(want, abs=eps / 8)
+        assert got[phys, 0] != 1.0
+
+        # Extremum fold sentinel must sit beyond the ACCUMULATOR dtype's
+        # range: an f32-range fill (-3e38) would swallow an f64 delta of
+        # -1e39 (max(-3e38, -1e39) = -3e38 — wrong value committed).
+        g = jax.jit(jax.shard_map(
+            lambda t, i, d: push(t, i, d, num_shards=2, combine="max"),
+            mesh=mesh,
+            in_specs=(P(SHARD_AXIS, None), P((DATA_AXIS, SHARD_AXIS)),
+                      P((DATA_AXIS, SHARD_AXIS))),
+            out_specs=P(SHARD_AXIS, None), check_vma=False,
+        ))
+        big = np.array([[-1.0e39], [-2.0e39], [0.0], [0.0]], np.float64)
+        ids2 = np.array([1, 1, -1, -1], np.int32)  # two dropped slots
+        got2 = np.asarray(g(tables["t"], jnp.asarray(ids2),
+                            jnp.asarray(big)))
+        assert got2[phys, 0] == pytest.approx(-1.0e39, rel=1e-12)
