@@ -1,25 +1,45 @@
-"""Headline benchmark: MovieLens-20M-scale online MF time-to-quality on TPU.
+"""Headline benchmarks vs a MEASURED sequential-baseline, on the real TPU.
 
 BASELINE.json metric: "MovieLens-20M MF epoch time; text8 word2vec
-words/sec/chip" (the reference publishes no numbers — ``"published": {}`` —
-so the baseline here is an *emulated* Flink-CPU parameter server: a
-per-record pull/update/push loop in the style of the reference's
-``WorkerCoFlatMap``/``PSFlatMap`` hot path, measured on a sample and
-extrapolated to the full epoch, then credited a generous JVM speedup factor
-over CPython).
+words/sec/chip". The reference publishes no numbers (``"published": {}``)
+and its Flink/JVM stack cannot run in this image, so every ``vs_baseline``
+here is computed against a *measured, compiled* stand-in rather than a
+guessed constant: ``fps_tpu/native/src/fps_native.cc`` implements the
+reference's sequential per-record parameter-server hot loops (MF
+pull→SGD→push, per-pair SGNS, per-feature sparse logreg) in C++ in two
+modes, both strictly generous to the reference:
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N, ...}
-vs_baseline > 1 means this framework is faster than the emulated baseline.
+* ``ps``    — every pull request / pull response / push delta pays a real
+  message hop (noinline memcpy through a bounded ring), the cheapest
+  possible model of the reference's Flink operator hops (no JVM, no
+  serialization framework, no network). ``vs_baseline`` is measured
+  against THIS mode: same architecture, zero framework overhead.
+* ``ideal`` — the fused sequential loop with direct array access, a floor
+  no real deployment reaches. Reported alongside (``baseline`` field) for
+  full honesty; on transaction-bound single-chip workloads (rank-10 MF,
+  scalar-table logreg) it is genuinely competitive — see BASELINE.md's
+  roofline discussion.
 
-``--workload mf`` (default) reports ML-20M MF **wall-clock to
-train-RMSE <= 0.12** on the planted-structure set (noise floor ~0.1),
-plus epoch count and the median epoch time — time-to-fixed-quality is the
-firm cross-system comparison (a raw epoch time rewards configurations
-that stream fast but learn slowly); compile time is excluded via a
-warm-up epoch on throwaway state. ``--workload w2v`` reports text8-scale
-word2vec SGNS words/sec/chip; ``--workload logreg`` reports Criteo-style
-SSP logistic-regression examples/sec/chip.
+Default (no args) runs ALL workloads and prints one JSON line per
+workload — w2v, logreg, ials first, the headline MF line LAST:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+vs_baseline > 1 means this framework is faster than the measured baseline.
+
+* ``mf``     — ML-20M-scale MF **wall-clock to train-RMSE <= 0.12**
+  (planted-structure noise floor ~0.1) vs the native loop's OWN measured
+  time-to-the-same-target (it converges in fewer epochs — sequential SGD
+  is the per-epoch gold standard — and pays that credit honestly).
+* ``w2v``    — text8-scale SGNS words/sec/chip vs the native per-pair
+  loop's words/sec on the same pair distribution.
+* ``logreg`` — Criteo-scale SSP logreg examples/sec/chip vs the native
+  per-example fan-out loop.
+* ``ials``   — planted-implicit time to recall@20 >= 0.35 (plateau ~0.39;
+  no reference baseline exists: iALS is a required extension the
+  reference lacks).
+
+Compile time is excluded everywhere via a warm-up pass on throwaway
+state; each workload also prints a learning-evidence line on stderr
+(NaN/flat = diverged — treat as failure regardless of speed).
 """
 
 from __future__ import annotations
@@ -44,46 +64,170 @@ def first_last_real_step(metrics, key):
             vals[real[-1]] / counts[real[-1]])
 
 
-def emulated_flink_cpu_w2v_per_pair_s(uni, dim, negatives,
-                                      sample_pairs=8_000, jvm_speedup=10.0):
-    """Seconds per (center, context) pair for an emulated per-pair SGNS
-    pull/update/push loop in CPython (credited a JVM speedup); the caller
-    converts to words/sec via its own pair count."""
-    V = len(uni)
-    rng = np.random.default_rng(0)
-    IN = rng.uniform(-0.5 / dim, 0.5 / dim, (V, dim))
-    OUT = np.zeros((V, dim))
-    p = uni.astype(np.float64) ** 0.75
-    p /= p.sum()
-    cdf = np.cumsum(p)
-    centers = rng.integers(0, V, sample_pairs)
-    contexts = rng.integers(0, V, sample_pairs)
-    lr = 0.025
-    t0 = time.perf_counter()
-    for k in range(sample_pairs):
-        c, x = centers[k], contexts[k]
-        ids = [x] + list(np.searchsorted(cdf, rng.random(negatives)))
-        v = IN[c]  # pull center
-        dv = np.zeros(dim)
-        for j, o in enumerate(ids):
-            u = OUT[o]  # pull context/negative
-            g = 1.0 / (1.0 + np.exp(-v @ u)) - (1.0 if j == 0 else 0.0)
-            dv -= lr * g * u
-            OUT[o] = u - lr * g * v  # push
-        IN[c] = v + dv  # push
-    per_pair = (time.perf_counter() - t0) / sample_pairs / jvm_speedup
-    # pairs per epoch ~ 2 * E[half] * kept tokens; with subsample t=1e-4
-    # and dynamic window this matches the TPU path's own pair count, so
-    # compare on raw-token throughput instead of per-pair rates.
-    return per_pair
+def _time_to_target(per_epoch_s, curve, target):
+    """Baseline time-to-target: median epoch seconds x epochs needed.
+    The median (not the raw cumsum) makes the BASELINE's number robust to
+    transient host contention from the preceding TPU workload — raw first
+    -epoch spikes would inflate the baseline and flatter ``vs_baseline``.
+    (Our own side always reports its raw measured wall-clock.) Returns
+    ``(seconds, epochs)`` or ``(None, None)`` if the target is never hit."""
+    import statistics
 
+    for e, v in enumerate(curve):
+        if v <= target:
+            return statistics.median(per_epoch_s) * (e + 1), e + 1
+    return None, None
+
+
+def _measure_native_modes(thunk):
+    """Yield ``(label, result)`` for the ``ps`` then ``ideal`` native
+    baseline modes, best-of-2 each: transient host contention from the
+    preceding TPU dispatch must not inflate the baseline (min = least
+    -contended, i.e. most favorable to the reference). Stops silently if
+    the native library is unavailable (result None)."""
+    for label, ps_mode in (("ps", True), ("ideal", False)):
+        res = min((thunk(ps_mode) for _ in range(2)),
+                  key=lambda r: r[0] if r else float("inf"))
+        if res is None:
+            return
+        yield label, res
+
+
+# ---------------------------------------------------------------------------
+# Matrix factorization (headline)
+# ---------------------------------------------------------------------------
+
+def run_mf(args):
+    import statistics
+
+    import jax
+
+    from fps_tpu import native
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.parallel.mesh import default_mesh_shape, make_ps_mesh
+    from fps_tpu.utils.datasets import load_movielens
+
+    data, nu, ni = load_movielens(args.movielens_path, args.scale)
+    nr = len(data["user"])
+
+    devs = jax.devices()
+    nd, ns = default_mesh_shape(len(devs))
+    mesh = make_ps_mesh(num_shards=ns, num_data=nd)
+    W = num_workers_of(mesh)
+
+    LR, REG = 0.05, 0.01
+    cfg = MFConfig(num_users=nu, num_items=ni, rank=args.rank,
+                   learning_rate=LR, reg=REG)
+    # Per-id mean combine: at this batch size summed duplicate updates on
+    # Zipfian-hot items diverge (the quality line below would show NaN);
+    # mean-combine is the reference's combining-sender analog and learns
+    # stably at any batch size.
+    trainer, store = online_mf(mesh, cfg, combine="mean")
+    dataset = DeviceDataset(mesh, data)  # one-time upload, outside the epoch
+    plan = DeviceEpochPlan(
+        dataset,
+        num_workers=W,
+        local_batch=args.local_batch,
+        route_key="user",
+        seed=1,
+    )
+
+    # Warm-up: compile + one full epoch on throwaway state (ingest is fused
+    # into the jit, so the whole epoch — shuffle, batch gathers, training —
+    # is ONE dispatch). The timed run below reuses the compiled program on
+    # FRESH state: time-to-quality excludes one-time compilation.
+    tables, local_state = trainer.init_state(jax.random.key(0))
+    trainer.run_indexed(tables, local_state, plan, jax.random.key(9))
+
+    target = args.rmse_target
+    tables, local_state = trainer.init_state(jax.random.key(0))
+    epoch_times, rmse_curve = [], []
+    for e in range(args.max_epochs):
+        t0 = time.perf_counter()
+        tables, local_state, m = trainer.run_indexed(
+            tables, local_state, plan, jax.random.key(1),
+            epochs=1, start_epoch=e,
+        )
+        epoch_times.append(time.perf_counter() - t0)
+        rmse_e = float(np.sqrt(np.asarray(m[0]["se"]).sum()
+                               / max(np.asarray(m[0]["n"]).sum(), 1.0)))
+        rmse_curve.append(rmse_e)
+        if rmse_e <= target:
+            break
+    total_s = sum(epoch_times)
+    epochs = len(epoch_times)
+    median_epoch = statistics.median(epoch_times)
+    reached = rmse_curve[-1] <= target
+
+    # MEASURED baseline: the native sequential per-record loop on the SAME
+    # ratings with the SAME hyperparameters, run to the SAME target on its
+    # own online-RMSE curve (each system pays its own epochs-to-target).
+    baseline = {"kind": "unavailable"}
+    vs = None
+    for label, ps_mode in (("ps", True), ("ideal", False)):
+        res = native.baseline_mf(
+            data["user"], data["item"], data["rating"], nu, ni,
+            rank=args.rank, lr=LR, reg=REG, seed=0,
+            epochs=args.max_epochs, ps_mode=ps_mode,
+        )
+        if res is None:
+            break
+        secs, mses = res
+        curve = [m ** 0.5 for m in mses]
+        tt, _ = _time_to_target(secs, curve, target)
+        if label == "ps":
+            baseline = {
+                "kind": "measured native sequential PS loop (message-hop "
+                        "mode); 'ideal' = fused-loop floor",
+                "ps_time_to_target_s": round(tt, 3) if tt else None,
+                "ps_epoch_s": round(float(np.median(secs)), 4),
+            }
+            if tt is not None and reached:
+                vs = round(tt / total_s, 2)
+        else:
+            baseline["ideal_time_to_target_s"] = round(tt, 3) if tt else None
+            baseline["ideal_epoch_s"] = round(float(np.median(secs)), 4)
+        print(f"native baseline [{label}]: epoch_s="
+              f"{[round(s, 3) for s in secs]} rmse="
+              f"{[round(r, 4) for r in curve]}", file=sys.stderr)
+
+    print(
+        "quality: per-epoch train RMSE "
+        + " -> ".join(f"{r:.4f}" for r in rmse_curve)
+        + (f" (reached <= {target})" if reached
+           else f" (STOPPED at max_epochs={args.max_epochs} without "
+                f"reaching {target})"),
+        file=sys.stderr,
+    )
+    print(f"epoch times: {[round(t, 3) for t in epoch_times]} s "
+          f"(median {median_epoch:.4f})", file=sys.stderr)
+
+    return {
+        "metric": f"ml{args.scale}_mf_time_to_rmse_{target}",
+        "value": round(total_s, 4),
+        "unit": "s",
+        "vs_baseline": vs,
+        "epochs": epochs,
+        "median_epoch_s": round(median_epoch, 4),
+        "final_train_rmse": round(rmse_curve[-1], 4),
+        "reached": reached,
+        "baseline": baseline,
+    }
+
+
+# ---------------------------------------------------------------------------
+# word2vec SGNS
+# ---------------------------------------------------------------------------
 
 def run_w2v(args):
     import jax
 
+    from fps_tpu import native
     from fps_tpu.core.driver import num_workers_of
     from fps_tpu.models.word2vec import (
-        W2VConfig, Word2VecDevicePlan, word2vec_block,
+        W2VConfig, Word2VecDevicePlan, _keep_probs, word2vec_block,
     )
     from fps_tpu.parallel.mesh import default_mesh_shape, make_ps_mesh
     from fps_tpu.utils.datasets import load_text8
@@ -128,76 +272,62 @@ def run_w2v(args):
         file=sys.stderr,
     )
 
+    # MEASURED baseline: native per-pair SGNS over a representative pair
+    # sample from the same generator/distribution, converted to words/s via
+    # this epoch's actual pair count.
+    # metrics "n" counts PAIRS (the quality line above compares loss/n to
+    # the (1+K)*log2 per-pair init loss), so no (1+K) rescale here.
     pairs = float(metrics[0]["n"].sum())
-    per_pair_s = emulated_flink_cpu_w2v_per_pair_s(
-        uni, cfg.dim, cfg.negatives
+    baseline = {"kind": "unavailable"}
+    vs = None
+    keep_p = _keep_probs(cfg, uni).astype(np.float32)
+    sample = native.skipgram_pairs(
+        np.ascontiguousarray(tokens[:2_000_000]), cfg.window, 3,
+        keep_p=keep_p,
     )
-    baseline_words_s = len(tokens) / (pairs * per_pair_s)
+    if sample is not None:
+        c, x = sample
+        m_pairs = min(len(c), 1_500_000)
+        for label, (secs, loss) in _measure_native_modes(
+            lambda m: native.baseline_w2v(
+                c[:m_pairs], x[:m_pairs], uni, dim=cfg.dim,
+                negatives=cfg.negatives, lr=cfg.learning_rate, ps_mode=m,
+            )
+        ):
+            per_pair = secs / m_pairs
+            base_words_s = len(tokens) / (pairs * per_pair)
+            if label == "ps":
+                baseline = {
+                    "kind": "measured native sequential per-pair SGNS "
+                            "(message-hop mode); 'ideal' = fused floor",
+                    "ps_words_per_s": round(base_words_s, 1),
+                }
+                vs = round(words_s / base_words_s, 2)
+            else:
+                baseline["ideal_words_per_s"] = round(base_words_s, 1)
+            print(f"native baseline [{label}]: {per_pair * 1e9:.0f} ns/pair"
+                  f" ({base_words_s / 1e3:.0f}k words/s), loss {loss:.4f}",
+                  file=sys.stderr)
 
-    print(json.dumps({
+    return {
         "metric": "text8_w2v_words_per_sec_per_chip",
         "value": round(words_s, 1),
         "unit": "words/s",
-        "vs_baseline": round(words_s / baseline_words_s, 2),
-    }))
+        "vs_baseline": vs,
+        "epoch_s": round(epoch_s, 3),
+        "baseline": baseline,
+    }
 
 
-def emulated_flink_cpu_epoch_s(data, num_ratings_full, rank, sample=60_000,
-                               jvm_speedup=10.0):
-    """Per-record PS loop (pull item vec -> SGD -> push delta), CPython,
-    extrapolated to the full epoch and divided by an assumed JVM advantage."""
-    users = data["user"][:sample]
-    items = data["item"][:sample]
-    ratings = data["rating"][:sample]
-    num_users = int(users.max()) + 1
-    num_items = int(items.max()) + 1
-    rng = np.random.default_rng(0)
-    P = rng.uniform(-0.1, 0.1, (num_users, rank))
-    Q = rng.uniform(-0.1, 0.1, (num_items, rank))
-    lr = 0.05
-    t0 = time.perf_counter()
-    for k in range(sample):
-        u, i, r = users[k], items[k], ratings[k]
-        q = Q[i]  # pull
-        p = P[u]
-        err = r - p @ q
-        P[u] = p + lr * (err * q - 0.01 * p)
-        Q[i] = q + lr * (err * p - 0.01 * q)  # push
-    dt = time.perf_counter() - t0
-    per_record = dt / sample
-    return per_record * num_ratings_full / jvm_speedup
-
-
-def emulated_flink_cpu_logreg_per_example_s(num_features, nnz,
-                                            sample=20_000, jvm_speedup=10.0):
-    """Per-example sparse-logreg PS loop (pull active features -> sigmoid ->
-    push per-feature deltas) in CPython, credited a JVM speedup."""
-    rng = np.random.default_rng(0)
-    w = np.zeros(num_features)
-    fids = rng.integers(0, num_features, (sample, nnz))
-    fvals = rng.normal(0, 1, (sample, nnz))
-    ys = rng.integers(0, 2, sample).astype(np.float64)
-    lr = 0.1
-    t0 = time.perf_counter()
-    for k in range(sample):
-        ids, x, y = fids[k], fvals[k], ys[k]
-        # One pull message per active feature (the reference's fan-out:
-        # PA/logreg workers pull each feature id individually and reassemble
-        # — SURVEY.md §3.4), then one push message per feature.
-        z = 0.0
-        for j in range(nnz):
-            z += w[ids[j]] * x[j]
-        p = 1.0 / (1.0 + np.exp(-z))
-        g = (p - y) * lr
-        for j in range(nnz):
-            w[ids[j]] -= g * x[j]
-    return (time.perf_counter() - t0) / sample / jvm_speedup
-
+# ---------------------------------------------------------------------------
+# SSP logistic regression
+# ---------------------------------------------------------------------------
 
 def run_logreg(args):
     """Criteo-style bounded-staleness (SSP) logistic regression throughput."""
     import jax
 
+    from fps_tpu import native
     from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
     from fps_tpu.core.driver import num_workers_of
     from fps_tpu.models.logistic_regression import (
@@ -221,7 +351,8 @@ def run_logreg(args):
     nd, ns = default_mesh_shape(len(devs))
     mesh = make_ps_mesh(num_shards=ns, num_data=nd)
     W = num_workers_of(mesh)
-    cfg = LogRegConfig(num_features=NF, learning_rate=0.1)
+    LR = 0.1
+    cfg = LogRegConfig(num_features=NF, learning_rate=LR)
     trainer, store = logistic_regression(
         mesh, cfg, sync_every=8, max_steps_per_call=256
     )
@@ -246,18 +377,121 @@ def run_logreg(args):
         file=sys.stderr,
     )
 
-    per_ex = emulated_flink_cpu_logreg_per_example_s(NF, NNZ)
-    print(json.dumps({
+    # MEASURED baseline: native per-example fan-out loop on a sample of the
+    # same dataset (the reference pulls/pushes each feature individually).
+    baseline = {"kind": "unavailable"}
+    vs = None
+    m_ex = min(NEX, 500_000)
+    for label, (secs, loss) in _measure_native_modes(
+        lambda m: native.baseline_logreg(
+            data["feat_ids"][:m_ex], data["feat_vals"][:m_ex],
+            data["label"][:m_ex], NF, lr=LR, ps_mode=m,
+        )
+    ):
+        base_ex_s = m_ex / secs
+        if label == "ps":
+            baseline = {
+                "kind": "measured native sequential per-feature-fan-out "
+                        "logreg (message-hop mode); 'ideal' = fused floor",
+                "ps_examples_per_s": round(base_ex_s, 1),
+            }
+            vs = round(ex_s / base_ex_s, 2)
+        else:
+            baseline["ideal_examples_per_s"] = round(base_ex_s, 1)
+        print(f"native baseline [{label}]: {secs / m_ex * 1e9:.0f} ns/ex "
+              f"({base_ex_s / 1e6:.2f}M ex/s), logloss {loss:.4f}",
+              file=sys.stderr)
+
+    return {
         "metric": "criteo_ssp_logreg_examples_per_sec_per_chip",
         "value": round(ex_s, 1),
         "unit": "examples/s",
-        "vs_baseline": round(ex_s * per_ex, 2),
-    }))
+        "vs_baseline": vs,
+        "epoch_s": round(epoch_s, 3),
+        "baseline": baseline,
+    }
+
+
+# ---------------------------------------------------------------------------
+# iALS (required extension; no reference baseline exists)
+# ---------------------------------------------------------------------------
+
+def run_ials(args):
+    import jax
+
+    from fps_tpu.models.ials import (
+        IALSConfig, IALSSolver, interaction_chunks, recall_at_k,
+    )
+    from fps_tpu.parallel.mesh import default_mesh_shape, make_ps_mesh
+    from fps_tpu.utils.datasets import synthetic_implicit, train_test_split
+
+    NU, NI, PER_USER, RANK = 32768, 16384, 64, 16
+    TARGET = args.recall_target
+    data = synthetic_implicit(NU, NI, PER_USER, rank=8, seed=0)
+    train, test = train_test_split(data, test_frac=0.1, seed=1)
+
+    devs = jax.devices()
+    # iALS uses the shard axis only: fold ALL devices into it (a (ns, 1)
+    # mesh over a subset would fail make_ps_mesh's full-cover check).
+    mesh = make_ps_mesh(num_shards=len(devs), num_data=1)
+    solver = IALSSolver(mesh, IALSConfig(num_users=NU, num_items=NI,
+                                         rank=RANK, alpha=40.0, reg=0.1))
+
+    def chunks():
+        return interaction_chunks(train, num_workers=len(devs),
+                                  local_batch=65536, steps_per_chunk=4,
+                                  seed=0)
+
+    # Warm-up epoch on throwaway state (compile), then re-init and time.
+    solver.init(jax.random.key(99))
+    solver.epoch(chunks)
+    solver.init(jax.random.key(0))
+
+    epoch_times, recalls = [], []
+    for e in range(args.max_epochs):
+        t0 = time.perf_counter()
+        solver.epoch(chunks)
+        epoch_times.append(time.perf_counter() - t0)
+        r = recall_at_k(solver, test["user"][:2000], test["item"][:2000],
+                        k=20, exclude=(train["user"], train["item"]))
+        recalls.append(float(r))
+        if r >= TARGET:
+            break
+    total_s = sum(epoch_times)
+    reached = recalls[-1] >= TARGET
+
+    print(
+        "quality: per-epoch recall@20 "
+        + " -> ".join(f"{r:.4f}" for r in recalls)
+        + (f" (reached >= {TARGET})" if reached
+           else f" (STOPPED at max_epochs={args.max_epochs})"),
+        file=sys.stderr,
+    )
+    print(f"epoch times: {[round(t, 3) for t in epoch_times]} s",
+          file=sys.stderr)
+
+    return {
+        "metric": f"implicit_ials_time_to_recall20_{TARGET}",
+        "value": round(total_s, 4),
+        "unit": "s",
+        # iALS is a required extension BEYOND the reference's algorithm set
+        # (SURVEY §6): there is no reference implementation to measure.
+        "vs_baseline": None,
+        "epochs": len(epoch_times),
+        "final_recall_at_20": round(recalls[-1], 4),
+        "reached": reached,
+        "baseline": {"kind": "none — algorithm absent from the reference"},
+    }
+
+
+RUNNERS = {"mf": run_mf, "w2v": run_w2v, "logreg": run_logreg,
+           "ials": run_ials}
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--workload", default="mf", choices=["mf", "w2v", "logreg"])
+    ap.add_argument("--workload", default="all",
+                    choices=["all", "mf", "w2v", "logreg", "ials"])
     ap.add_argument("--scale", default="20m", choices=["100k", "1m", "20m"])
     ap.add_argument("--rank", type=int, default=10)
     ap.add_argument("--local-batch", type=int, default=32768)
@@ -272,110 +506,22 @@ def main():
     ap.add_argument("--rmse-target", type=float, default=0.12,
                     help="mf workload: train to this train-RMSE "
                          "(planted-structure noise floor is ~0.1)")
+    ap.add_argument("--recall-target", type=float, default=0.35,
+                    help="ials workload: train to this recall@20 on the "
+                         "held-out planted-implicit split (plateau ~0.39, "
+                         "chance 20/16384 = 0.0012)")
     ap.add_argument("--max-epochs", type=int, default=8)
     args = ap.parse_args()
 
-    if args.workload == "w2v":
-        return run_w2v(args)
-    if args.workload == "logreg":
-        return run_logreg(args)
-
-    import statistics
-
-    import jax
-
-    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
-    from fps_tpu.core.driver import num_workers_of
-    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
-    from fps_tpu.parallel.mesh import default_mesh_shape, make_ps_mesh
-    from fps_tpu.utils.datasets import load_movielens
-
-    data, nu, ni = load_movielens(args.movielens_path, args.scale)
-    nr = len(data["user"])
-
-    devs = jax.devices()
-    nd, ns = default_mesh_shape(len(devs))
-    mesh = make_ps_mesh(num_shards=ns, num_data=nd)
-    W = num_workers_of(mesh)
-
-    cfg = MFConfig(num_users=nu, num_items=ni, rank=args.rank,
-                   learning_rate=0.05, reg=0.01)
-    # Per-id mean combine: at this batch size summed duplicate updates on
-    # Zipfian-hot items diverge (the quality line below would show NaN);
-    # mean-combine is the reference's combining-sender analog and learns
-    # stably at any batch size.
-    trainer, store = online_mf(mesh, cfg, combine="mean")
-    dataset = DeviceDataset(mesh, data)  # one-time upload, outside the epoch
-    plan = DeviceEpochPlan(
-        dataset,
-        num_workers=W,
-        local_batch=args.local_batch,
-        route_key="user",
-        seed=1,
-    )
-
-    # Warm-up: compile + one full epoch on throwaway state (ingest is fused
-    # into the jit, so the whole epoch — shuffle, batch gathers, training —
-    # is ONE dispatch). The timed run below reuses the compiled program on
-    # FRESH state: time-to-quality excludes one-time compilation.
-    tables, local_state = trainer.init_state(jax.random.key(0))
-    trainer.run_indexed(tables, local_state, plan, jax.random.key(9))
-
-    # Headline: wall-clock (and epochs) to train-RMSE <= target on the
-    # planted-structure set (noise floor ~0.1) — time-to-fixed-quality is
-    # the firm cross-system comparison; raw epoch time alone rewards
-    # configurations that stream fast but learn slowly.
-    target = args.rmse_target
-    tables, local_state = trainer.init_state(jax.random.key(0))
-    epoch_times, rmse_curve = [], []
-    for e in range(args.max_epochs):
-        t0 = time.perf_counter()
-        tables, local_state, m = trainer.run_indexed(
-            tables, local_state, plan, jax.random.key(1),
-            epochs=1, start_epoch=e,
-        )
-        epoch_times.append(time.perf_counter() - t0)
-        rmse_e = float(np.sqrt(np.asarray(m[0]["se"]).sum()
-                               / max(np.asarray(m[0]["n"]).sum(), 1.0)))
-        rmse_curve.append(rmse_e)
-        if rmse_e <= target:
-            break
-    total_s = sum(epoch_times)
-    epochs = len(epoch_times)
-    median_epoch = statistics.median(epoch_times)
-    reached = rmse_curve[-1] <= target
-
-    # Emulated reference cost for the SAME epoch count (the per-record
-    # sequential loop converges at least as fast per epoch, so equal-epochs
-    # is a conservative credit to the baseline).
-    baseline_epoch_s = emulated_flink_cpu_epoch_s(data, nr, args.rank)
-    baseline_total_s = baseline_epoch_s * epochs
-
-    print(
-        "quality: per-epoch train RMSE "
-        + " -> ".join(f"{r:.4f}" for r in rmse_curve)
-        + (f" (reached <= {target})" if reached
-           else f" (STOPPED at max_epochs={args.max_epochs} without "
-                f"reaching {target})"),
-        file=sys.stderr,
-    )
-    print(
-        f"epoch times: {[round(t, 3) for t in epoch_times]} s "
-        f"(median {median_epoch:.4f}); emulated Flink-CPU epoch "
-        f"{baseline_epoch_s:.1f}s",
-        file=sys.stderr,
-    )
-
-    print(json.dumps({
-        "metric": f"ml{args.scale}_mf_time_to_rmse_{target}",
-        "value": round(total_s, 4),
-        "unit": "s",
-        "vs_baseline": round(baseline_total_s / total_s, 2),
-        "epochs": epochs,
-        "median_epoch_s": round(median_epoch, 4),
-        "final_train_rmse": round(rmse_curve[-1], 4),
-        "reached": reached,
-    }))
+    if args.workload == "all":
+        # Headline (mf) LAST: the driver's artifact parses the final JSON
+        # line and its tail window shows the rest.
+        order = ["w2v", "logreg", "ials", "mf"]
+    else:
+        order = [args.workload]
+    for name in order:
+        print(f"--- workload: {name} ---", file=sys.stderr)
+        print(json.dumps(RUNNERS[name](args)), flush=True)
 
 
 if __name__ == "__main__":

@@ -204,6 +204,15 @@ class Checkpointer:
         arrays = _table_arrays(store)
         leaves, treedef = jax.tree.flatten(local_state)
         for i, leaf in enumerate(leaves):
+            # Multi-controller: a worker-sharded leaf spans processes, and
+            # np.asarray on a non-addressable array raises. Replicate it
+            # through the same jitted-identity collective the table dump
+            # uses (so save keeps the every-process-calls contract).
+            if (hasattr(leaf, "sharding")
+                    and not leaf.sharding.is_fully_addressable):
+                from fps_tpu.parallel.mesh import replicate_to_mesh
+
+                leaf = replicate_to_mesh(leaf, store.mesh)
             arrays[f"ls{_SEP}{i}"] = np.asarray(leaf)
         arrays[f"meta{_SEP}ls_format"] = np.array(local_state_format)
         del treedef  # structure is supplied by local_state_like at restore

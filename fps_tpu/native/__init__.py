@@ -129,6 +129,36 @@ def _load():
             ctypes.c_long,
             ctypes.POINTER(ctypes.c_long),
         ]
+        lib.fps_baseline_mf.restype = ctypes.c_double
+        lib.fps_baseline_mf.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long, ctypes.c_long, ctypes.c_long,
+            ctypes.c_int, ctypes.c_float, ctypes.c_float,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.fps_baseline_w2v.restype = ctypes.c_double
+        lib.fps_baseline_w2v.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_long, ctypes.c_int, ctypes.c_int,
+            ctypes.c_float, ctypes.c_uint64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.fps_baseline_logreg.restype = ctypes.c_double
+        lib.fps_baseline_logreg.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long, ctypes.c_long, ctypes.c_long,
+            ctypes.c_float, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double),
+        ]
         _lib = lib
         return _lib
 
@@ -319,3 +349,81 @@ def skipgram_pairs(
     if m < 0:
         return None
     return centers[:m], contexts[:m]
+
+
+def baseline_mf(users, items, ratings, num_users, num_items, *, rank,
+                lr=0.05, reg=0.01, seed=0, epochs=1, ps_mode=True):
+    """MEASURED sequential per-record MF baseline (bench.py's reference
+    stand-in — see the C++ docstring for the generosity argument).
+
+    Runs ``epochs`` passes of per-record SGD over the ratings and returns
+    ``(per_epoch_seconds, per_epoch_mse)`` (lists of length ``epochs``), or
+    ``None`` if the native library is unavailable. ``ps_mode=True`` forces
+    every pull/push through the message ring (the reference's operator-hop
+    structure); ``False`` measures the idealized fused loop."""
+    lib = _load()
+    if lib is None:
+        return None
+    users = np.ascontiguousarray(users, np.int32)
+    items = np.ascontiguousarray(items, np.int32)
+    ratings = np.ascontiguousarray(ratings, np.float32)
+    n = len(users)
+    secs = np.zeros(epochs, np.float64)
+    mses = np.zeros(epochs, np.float64)
+    total = lib.fps_baseline_mf(
+        _ptr(users, ctypes.c_int32), _ptr(items, ctypes.c_int32),
+        _ptr(ratings, ctypes.c_float), n, int(num_users), int(num_items),
+        int(rank), float(lr), float(reg), seed & 0xFFFFFFFFFFFFFFFF,
+        int(epochs), 1 if ps_mode else 0,
+        _ptr(secs, ctypes.c_double), _ptr(mses, ctypes.c_double),
+    )
+    if total < 0:
+        return None
+    return secs.tolist(), mses.tolist()
+
+
+def baseline_w2v(centers, contexts, uni, *, dim, negatives=5, lr=0.025,
+                 seed=0, ps_mode=True):
+    """MEASURED sequential per-pair SGNS baseline. One pass over the given
+    pairs; negatives drawn from the unigram^0.75 cdf. Returns
+    ``(seconds, mean_loss)`` or ``None`` if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    centers = np.ascontiguousarray(centers, np.int32)
+    contexts = np.ascontiguousarray(contexts, np.int32)
+    p = np.asarray(uni, np.float64) ** 0.75
+    cdf = np.cumsum(p / p.sum())
+    loss = ctypes.c_double(0.0)
+    secs = lib.fps_baseline_w2v(
+        _ptr(centers, ctypes.c_int32), _ptr(contexts, ctypes.c_int32),
+        len(centers), _ptr(cdf, ctypes.c_double), len(cdf), int(dim),
+        int(negatives), float(lr), seed & 0xFFFFFFFFFFFFFFFF,
+        1 if ps_mode else 0, ctypes.byref(loss),
+    )
+    if secs < 0:
+        return None
+    return float(secs), float(loss.value)
+
+
+def baseline_logreg(feat_ids, feat_vals, labels, num_features, *, lr=0.1,
+                    ps_mode=True):
+    """MEASURED sequential per-example sparse-logreg baseline (per-feature
+    pull/push fan-out, the reference's shape). One pass; returns
+    ``(seconds, mean_logloss)`` or ``None`` if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    feat_ids = np.ascontiguousarray(feat_ids, np.int32)
+    feat_vals = np.ascontiguousarray(feat_vals, np.float32)
+    labels = np.ascontiguousarray(labels, np.float32)
+    n, nnz = feat_ids.shape
+    loss = ctypes.c_double(0.0)
+    secs = lib.fps_baseline_logreg(
+        _ptr(feat_ids, ctypes.c_int32), _ptr(feat_vals, ctypes.c_float),
+        _ptr(labels, ctypes.c_float), n, nnz, int(num_features), float(lr),
+        1 if ps_mode else 0, ctypes.byref(loss),
+    )
+    if secs < 0:
+        return None
+    return float(secs), float(loss.value)

@@ -103,16 +103,32 @@ def synthetic_implicit(
     rng = np.random.default_rng(seed)
     p = rng.normal(0, 1.0, (num_users, rank))
     q = rng.normal(0, 1.0, (num_items, rank))
-    logits = p @ q.T  # (U, I)
-    probs = np.exp(logits - logits.max(axis=1, keepdims=True))
-    probs /= probs.sum(axis=1, keepdims=True)
     users = np.repeat(np.arange(num_users), interactions_per_user)
-    items = np.concatenate(
-        [
-            rng.choice(num_items, interactions_per_user, p=probs[u])
-            for u in range(num_users)
-        ]
-    )
+    # Blocked over users: the dense (U, I) softmax would be O(U*I) memory
+    # (4+ GB at ML-20M-class sizes); per-block cdf + vectorized inverse-cdf
+    # sampling keeps it bounded and fast at any scale.
+    block = max(1, min(num_users, (1 << 25) // max(num_items, 1)))
+    item_blocks = []
+    pf, qf = p.astype(np.float32), q.astype(np.float32)
+    for lo in range(0, num_users, block):
+        b = min(lo + block, num_users) - lo
+        logits = pf[lo:lo + b] @ qf.T  # (b, I) — f32: sampling noise
+        probs = np.exp(logits - logits.max(axis=1, keepdims=True))
+        cdf = np.cumsum(probs, axis=1)  # dwarfs f32 cdf rounding
+        cdf /= cdf[:, -1:]
+        draws = rng.random((b, interactions_per_user))
+        # Row-wise inverse cdf in ONE flat searchsorted: shift each row's
+        # cdf (and its draws) by the row index so rows occupy disjoint
+        # strictly-increasing value ranges, then map flat positions back.
+        # The shift must happen in f64 — at row offsets in the tens of
+        # thousands an f32 sum has ~2^-7 ulp, coarser than the cdf steps.
+        offs = np.arange(b, dtype=np.float64)[:, None]
+        flat = np.searchsorted((cdf.astype(np.float64) + offs).ravel(),
+                               (draws + offs).ravel())
+        rows = np.repeat(np.arange(b, dtype=np.int64), interactions_per_user)
+        item_blocks.append(np.clip(flat - rows * num_items, 0,
+                                   num_items - 1))
+    items = np.concatenate(item_blocks)
     rating = rng.poisson(2.0, len(users)).astype(np.float32) + 1.0
     return {
         "user": users.astype(np.int32),

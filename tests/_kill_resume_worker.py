@@ -1,0 +1,92 @@
+"""Subprocess body for the SIGKILL-and-resume end-to-end test.
+
+Usage: python _kill_resume_worker.py <mode> <ckdir> <out_npz>
+
+Modes (all on one process with 8 virtual CPU devices, (2, 4) mesh):
+
+* ``straight`` — train 4 indexed epochs uninterrupted, dump the model.
+* ``victim``   — train with a rolling Checkpointer (keep=2,
+  checkpoint_every=1) and SIGKILL OURSELVES from the ``on_epoch`` callback
+  after epoch 3's training but BEFORE its checkpoint lands: the process
+  dies mid-run with no atexit/flush, losing epoch 3's work — the crash the
+  reference's Flink-era checkpointing cannot survive on iterative streams.
+* ``resume``   — FRESH process: restore the latest snapshot (epoch 2),
+  continue with ``start_epoch=2`` for the remaining 2 epochs, dump the
+  model. The parent asserts straight == resumed bit-for-bit, which is only
+  possible if the per-epoch shuffle (``plan.epoch_args(e)``) and PRNG
+  stream (``fold_in(key, e)``) genuinely continue across the process
+  boundary (driver.py's resume contract).
+"""
+
+import os
+import signal
+import sys
+
+
+def main() -> int:
+    mode, ckdir, out = sys.argv[1], sys.argv[2], sys.argv[3]
+
+    import numpy as np
+
+    import jax
+
+    from fps_tpu.core.checkpoint import Checkpointer
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.parallel.mesh import make_ps_mesh
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    mesh = make_ps_mesh(num_shards=4, num_data=2)
+    W = num_workers_of(mesh)
+    data = synthetic_ratings(57, 31, 2000, seed=0)
+    cfg = MFConfig(num_users=57, num_items=31, rank=4, learning_rate=0.1)
+    trainer, store = online_mf(mesh, cfg)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    ds = DeviceDataset(mesh, data)
+    plan = DeviceEpochPlan(ds, num_workers=W, local_batch=32,
+                           route_key="user", seed=5)
+    key = jax.random.key(1)
+
+    from fps_tpu.models.recommendation import mf_user_vectors
+
+    def dump(path):
+        # Local state compared in LOGICAL user order: physical padding
+        # slots (users >= 57 on this worker layout) are dead state — never
+        # routed, never observable — and the exported-checkpoint roundtrip
+        # does not preserve them (import zero-fills), by design.
+        np.savez(path, item_factors=store.dump_model("item_factors")[1],
+                 user_factors=mf_user_vectors(np.asarray(ls), W,
+                                              np.arange(57)))
+
+    if mode == "straight":
+        tables, ls, _ = trainer.run_indexed(tables, ls, plan, key, epochs=4)
+        dump(out)
+        return 0
+
+    ckpt = Checkpointer(ckdir, keep=2)
+
+    if mode == "victim":
+        def die_mid_run(e, _metrics):
+            if e == 2:  # epoch 3 trained; its checkpoint has NOT landed yet
+                os.kill(os.getpid(), signal.SIGKILL)
+
+        trainer.run_indexed(tables, ls, plan, key, epochs=4,
+                            checkpointer=ckpt, checkpoint_every=1,
+                            on_epoch=die_mid_run)
+        raise AssertionError("victim must never get here")
+
+    if mode == "resume":
+        tables, ls, step = trainer.restore_checkpoint(ckpt, ls)
+        assert step == 2, f"latest surviving snapshot should be 2, got {step}"
+        tables, ls, _ = trainer.run_indexed(tables, ls, plan, key,
+                                            epochs=4 - step,
+                                            start_epoch=step)
+        dump(out)
+        return 0
+
+    raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

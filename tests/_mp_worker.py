@@ -12,9 +12,18 @@ global mesh. Scenarios:
 * ``host_sync`` — HOST ingest (`fit_stream` over numpy chunks placed via
   ``make_array_from_process_local_data``), synchronous.
 * ``host_ssp``  — host ingest, SSP bounded staleness (sync_every=2).
+* ``indexed_shard8`` — indexed ingest on a ``(data=1, shard=8)`` mesh, so
+  the SHARD axis spans the process boundary: every pull's all_gather /
+  psum_scatter, every push's shard-axis all_gather, ``dump_model``'s
+  replication, and the checkpoint save's host transfer all move shard ROWS
+  between the two OS processes (round-2 verdict: the one untested
+  collective topology — every other scenario keeps shards process-local).
 
 Every rank calls `dump_model` (a collective); rank 0 writes the table for
-the parent test to compare against a single-process run.
+the parent test to compare against a single-process run. The shard8
+scenario also checkpoints (every rank — the save's table dump is itself
+a collective) and re-reads the snapshot to prove the cross-process
+checkpoint path agrees with ``dump_model``.
 """
 
 import sys
@@ -45,7 +54,10 @@ def main() -> int:
     from fps_tpu.parallel.mesh import make_ps_mesh
     from fps_tpu.utils.datasets import synthetic_ratings
 
-    mesh = make_ps_mesh(num_shards=4, num_data=2)
+    if scenario == "indexed_shard8":
+        mesh = make_ps_mesh(num_shards=8, num_data=1)
+    else:
+        mesh = make_ps_mesh(num_shards=4, num_data=2)
     W = num_workers_of(mesh)
     data = synthetic_ratings(57, 31, 2000, seed=0)
     cfg = MFConfig(num_users=57, num_items=31, rank=4, learning_rate=0.1)
@@ -53,7 +65,7 @@ def main() -> int:
     trainer, store = online_mf(mesh, cfg, sync_every=sync_every)
     tables, ls = trainer.init_state(jax.random.key(0))
 
-    if scenario == "indexed":
+    if scenario in ("indexed", "indexed_shard8"):
         ds = DeviceDataset(mesh, data)
         plan = DeviceEpochPlan(
             ds, num_workers=W, local_batch=32, route_key="user", seed=5
@@ -82,6 +94,23 @@ def main() -> int:
     # shard axis spans processes, a rank-0-only call deadlocks waiting for
     # the other processes' shards). Rank 0 alone writes the file.
     ids, values = store.dump_model("item_factors")
+
+    if scenario == "indexed_shard8":
+        # Cross-process checkpoint: every rank runs the collective table
+        # dump inside save (atomic same-path writes race benignly), then
+        # the re-read snapshot must agree with dump_model's host view.
+        import os
+
+        from fps_tpu.core.checkpoint import Checkpointer
+
+        ck = Checkpointer(os.path.join(os.path.dirname(out), "ck_shard8"),
+                          keep=1)
+        ck.save(1, store, ls)
+        _, snap_tables, _, _ = ck.read_snapshot(1)
+        got = snap_tables["item_factors"]  # logical order, padding stripped
+        host = store.lookup_host("item_factors", np.arange(31))
+        assert np.array_equal(got, host), "checkpoint != dump view"
+
     if pid == 0:
         np.savez(out, item_factors=values)
     return 0

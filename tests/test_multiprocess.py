@@ -73,14 +73,17 @@ def _single_process_reference(devices8, scenario: str) -> np.ndarray:
     from fps_tpu.parallel.mesh import make_ps_mesh
     from fps_tpu.utils.datasets import synthetic_ratings
 
-    mesh = make_ps_mesh(num_shards=4, num_data=2, devices=devices8[:8])
+    if scenario == "indexed_shard8":
+        mesh = make_ps_mesh(num_shards=8, num_data=1, devices=devices8[:8])
+    else:
+        mesh = make_ps_mesh(num_shards=4, num_data=2, devices=devices8[:8])
     W = num_workers_of(mesh)
     data = synthetic_ratings(57, 31, 2000, seed=0)
     cfg = MFConfig(num_users=57, num_items=31, rank=4, learning_rate=0.1)
     sync_every = 2 if scenario == "host_ssp" else None
     trainer, store = online_mf(mesh, cfg, sync_every=sync_every)
     tables, ls = trainer.init_state(jax.random.key(0))
-    if scenario == "indexed":
+    if scenario in ("indexed", "indexed_shard8"):
         ds = DeviceDataset(mesh, data)
         plan = DeviceEpochPlan(
             ds, num_workers=W, local_batch=32, route_key="user", seed=5
@@ -98,9 +101,16 @@ def _single_process_reference(devices8, scenario: str) -> np.ndarray:
     return store.dump_model("item_factors")[1]
 
 
-@pytest.mark.parametrize("scenario", ["indexed", "host_sync", "host_ssp"])
+@pytest.mark.parametrize(
+    "scenario", ["indexed", "host_sync", "host_ssp", "indexed_shard8"]
+)
 def test_two_process_training_matches_single_process(devices8, tmp_path,
                                                      scenario):
+    """``indexed_shard8`` is the round-2-verdict topology: a (data=1,
+    shard=8) mesh over 2 processes puts the SHARD axis across the process
+    boundary, so pull/push collectives, ``dump_model`` replication, and the
+    checkpoint save all move shard rows between OS processes (the worker
+    also cross-checks checkpoint-vs-dump agreement in-process)."""
     mp_values = _run_two_processes(tmp_path, scenario)
     sp_values = _single_process_reference(devices8, scenario)
     np.testing.assert_array_equal(sp_values, mp_values)

@@ -171,3 +171,59 @@ def test_parse_ratings_crlf_and_blank_lines(lib, tmp_path):
     np.testing.assert_array_equal(u, [1, 4])
     np.testing.assert_array_equal(i, [2, 5])
     np.testing.assert_allclose(r, [3.5, 2.0])
+
+
+def test_baseline_mf_learns_and_modes_agree(lib):
+    """The measured-baseline MF loop must actually train (bench.py's
+    equal-target credit depends on it), be deterministic per seed, and the
+    message-structured mode must be semantically identical to the fused
+    loop (the ring only adds cost, never changes updates)."""
+    rng = np.random.default_rng(0)
+    nu, ni, rank, n = 300, 200, 4, 20000
+    P = rng.normal(0, 0.5, (nu, rank))
+    Q = rng.normal(0, 0.5, (ni, rank))
+    u = rng.integers(0, nu, n).astype(np.int32)
+    i = rng.integers(0, ni, n).astype(np.int32)
+    r = (np.sum(P[u] * Q[i], 1) + 0.05 * rng.normal(size=n)).astype(
+        np.float32)
+    secs_ps, mse_ps = lib.baseline_mf(u, i, r, nu, ni, rank=rank, lr=0.1,
+                                      epochs=10, ps_mode=True)
+    secs_id, mse_id = lib.baseline_mf(u, i, r, nu, ni, rank=rank, lr=0.1,
+                                      epochs=10, ps_mode=False)
+    assert mse_ps[-1] < 0.5 * mse_ps[0]          # it learns
+    np.testing.assert_allclose(mse_ps, mse_id, rtol=1e-6)  # same semantics
+    assert all(s > 0 for s in secs_ps + secs_id)
+    # deterministic per seed
+    _, mse2 = lib.baseline_mf(u, i, r, nu, ni, rank=rank, lr=0.1, epochs=10,
+                              ps_mode=True)
+    np.testing.assert_array_equal(mse_ps, mse2)
+
+
+def test_baseline_w2v_learns_and_modes_agree(lib):
+    rng = np.random.default_rng(1)
+    V, dim, n = 500, 16, 30000
+    # planted co-occurrence: context = center + small offset mod V
+    c = rng.integers(0, V, n).astype(np.int32)
+    x = ((c + rng.integers(1, 4, n)) % V).astype(np.int32)
+    uni = np.bincount(c, minlength=V).astype(np.float64) + 1
+    s_ps, loss_ps = lib.baseline_w2v(c, x, uni, dim=dim, negatives=3,
+                                     ps_mode=True)
+    s_id, loss_id = lib.baseline_w2v(c, x, uni, dim=dim, negatives=3,
+                                     ps_mode=False)
+    assert loss_ps < 0.6931  # below chance (sigmoid at 0)
+    assert abs(loss_ps - loss_id) < 1e-6
+    assert s_ps > 0 and s_id > 0
+
+
+def test_baseline_logreg_learns_and_modes_agree(lib):
+    rng = np.random.default_rng(2)
+    nf, nnz, n = 5000, 8, 40000
+    ids = rng.integers(0, nf, (n, nnz)).astype(np.int32)
+    vals = rng.normal(0, 1, (n, nnz)).astype(np.float32)
+    w_true = rng.normal(0, 1, nf)
+    y = ((vals * w_true[ids]).sum(1) > 0).astype(np.float32)
+    s_ps, ll_ps = lib.baseline_logreg(ids, vals, y, nf, ps_mode=True)
+    s_id, ll_id = lib.baseline_logreg(ids, vals, y, nf, ps_mode=False)
+    assert ll_ps < 0.6        # well below chance logloss 0.693
+    assert abs(ll_ps - ll_id) < 1e-6
+    assert s_ps > 0 and s_id > 0
