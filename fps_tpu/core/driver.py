@@ -228,13 +228,36 @@ class Trainer:
 
     # -- device-side bodies ----------------------------------------------
 
+    def _resolve_hot_rows(self, spec) -> int:
+        """LOCAL hot-row count for a table's push scatter.
+
+        ``hot_ids="auto"`` routes the WHOLE shard slice through the packed
+        MXU scatter when it is thinner than the measured crossover
+        (:func:`fps_tpu.ops.packed_crossover_rows`) — the many-shard
+        regime; on fat shards it resolves to 0 (plain XLA scatter, exact).
+        An int is the NuPS-style global head count: global hot ids [0, H)
+        sit in local rows [0, ceil(H/S)) under the owner-major cyclic
+        layout.
+        """
+        from fps_tpu.core.store import rows_per_shard
+
+        if spec.hot_ids == "auto":
+            rps = rows_per_shard(spec.num_ids, self.num_shards)
+            return rps if rps <= ops.packed_crossover_rows(spec.dim) else 0
+        if isinstance(spec.hot_ids, str):
+            # Fail at the right altitude — inside the jitted push this
+            # would surface as a cryptic TypeError on a unary minus.
+            raise ValueError(
+                f"table {spec.name!r}: hot_ids={spec.hot_ids!r} — "
+                "expected an int or the literal 'auto'"
+            )
+        return -(-spec.hot_ids // self.num_shards) if spec.hot_ids else 0
+
     def _apply_pushes(self, tables, pushes):
         new_tables = dict(tables)
         for name, (pids, pdeltas) in pushes.items():
             spec = self.store.specs[name]
-            # Global hot ids [0, H) sit in local rows [0, ceil(H/S)) on
-            # every shard under the owner-major cyclic layout.
-            hot_local = -(-spec.hot_ids // self.num_shards) if spec.hot_ids else 0
+            hot_local = self._resolve_hot_rows(spec)
             new_tables[name] = push(
                 tables[name],
                 pids,
@@ -449,12 +472,25 @@ class Trainer:
         donate = (0, 1) if self.config.donate else ()
         return jax.jit(run, donate_argnums=donate)
 
+    def _server_logic_key(self):
+        """Identity key over the per-table server logics: combine modes and
+        apply_fns are baked into the compiled program as constants, so
+        swapping ``trainer.server_logic['t']`` after a compile must miss
+        the cache (same reason the ops backend is in the key). Callables go
+        into the key AS OBJECTS (identity hash + a live reference) — a bare
+        ``id()`` could be reused by a later callable after the original is
+        garbage-collected, silently hitting a stale compiled program."""
+        return tuple(
+            (name, sl.combine, sl.apply_fn)
+            for name, sl in sorted(self.server_logic.items())
+        )
+
     def _get_compiled(self, mode: str):
-        # Keyed on the ops backend and push_delay too: set_backend() or a
-        # config change after a compile must take effect on the next chunk,
-        # not be shadowed by the jit cache.
+        # Keyed on the ops backend, push_delay, and server logic too:
+        # set_backend() or a config/logic change after a compile must take
+        # effect on the next chunk, not be shadowed by the jit cache.
         key = (mode, ops.get_backend(), self.config.push_delay,
-               self.config.step_tap)
+               self.config.step_tap, self._server_logic_key())
         if key not in self._compiled:
             self._compiled[key] = self._build_chunk_fn(mode)
         return self._compiled[key]
@@ -586,7 +622,8 @@ class Trainer:
         # Keyed on the plan object itself (its geometry is baked into the
         # compiled program as constants, so identity is the correct key).
         ck = ("indexed", mode, plan, ops.get_backend(),
-              self.config.push_delay, self.config.step_tap)
+              self.config.push_delay, self.config.step_tap,
+              self._server_logic_key())
         if ck not in self._compiled:
             self._compiled[ck] = self._build_indexed_fn(plan, mode)
         fn = self._compiled[ck]

@@ -279,13 +279,22 @@ class TableSpec:
     dim: int
     init_fn: Callable[[Array, Array], Array] = None  # (key, ids) -> values
     dtype: Any = jnp.float32
-    # Number of leading GLOBAL ids treated as write-hot (NuPS-style hot/cold
-    # split, :func:`fps_tpu.ops.scatter_add`). Meaningful when ids are
-    # frequency-ranked (hottest first) — the shipped loaders and synthetic
-    # generators lay ids out that way — but semantics are exact for any
-    # distribution; a wrong guess costs only MXU work, capped by the
-    # dispatcher's SCATTER_FLOP_BUDGET fallback.
-    hot_ids: int = 0
+    # Write-hot routing for push scatters (:func:`fps_tpu.ops.scatter_add`):
+    #   * int H > 0 — NuPS-style split: the leading H GLOBAL ids ride the
+    #     lane-packed MXU contraction, the tail keeps the XLA scatter.
+    #     Meaningful when ids are frequency-ranked (hottest first) — the
+    #     shipped loaders and synthetic generators lay ids out that way —
+    #     but drop/duplicate semantics hold for any distribution; a wrong
+    #     guess costs only MXU work, capped by SCATTER_FLOP_BUDGET.
+    #   * "auto" — whole-shard packed routing whenever the per-shard row
+    #     slice is below the MEASURED single-chip crossover
+    #     (:func:`fps_tpu.ops.packed_crossover_rows`, from
+    #     ``tools/bench_scatter.py sweep``) — i.e. enabled exactly in the
+    #     many-shard regime it wins in, off on fat single-chip shards.
+    # Default 0 (pure XLA): the packed path carries f32 deltas as bf16
+    # hi+lo (~16 mantissa bits) and would break bit-reproducibility across
+    # shard counts, so it is opt-in.
+    hot_ids: int | str = 0
 
     def zeros_init(self) -> "TableSpec":
         return dataclasses.replace(

@@ -46,6 +46,29 @@ _BACKEND = os.environ.get("FPS_TPU_OPS", "auto").lower()
 SCATTER_FLOP_BUDGET = 2e10
 
 
+def packed_crossover_rows(dim: int) -> int:
+    """Measured single-chip crossover: the lane-packed MXU scatter beats
+    XLA's scatter when the per-shard row count is at or below this (below
+    it the whole-shard one-hot contraction is cheaper than the per-row
+    -transaction scatter). From ``tools/bench_scatter.py sweep`` on a
+    v5 lite chip, B=32768, Zipf(0.8) ids:
+
+    ==== ======================= =======================
+    dim  packed wins through R=  packed loses from R=
+    ==== ======================= =======================
+    10   2048 (667 vs 702 us)    16384 (1222 vs 1092)
+    32   4096 (577 vs 663 us)     8192 ( 911 vs  684)
+    100  2048 (828 vs 829 us)     4096 (1394 vs 1091)
+    ==== ======================= =======================
+
+    Returned thresholds sit at the conservative (clear-win) edge. This is
+    the ``TableSpec.hot_ids="auto"`` policy: a large shard axis leaves
+    each shard a thin row slice, which is exactly the packed kernel's
+    regime — on one shard the shipped tables (26k-1M rows) stay on XLA.
+    """
+    return 4096 if 17 <= dim <= 48 else 2048
+
+
 def set_backend(name: str) -> None:
     """Select the hot-path backend for subsequently *traced* programs.
 
@@ -140,6 +163,19 @@ def scatter_add(
     # scatter, which adds in the table's native dtype.
     if jnp.dtype(table.dtype).itemsize > 4:
         return _xla_scatter_add(table, ids, deltas)
+
+    if use and hot_rows >= R > 0:
+        # Whole-shard packed routing (hot_ids="auto" below the measured
+        # crossover): every row is "hot", so there is no tail scatter at
+        # all — out-of-range/-1 ids match no one-hot row and drop.
+        pack = max(1, 128 // D)
+        head_flops = -(-R // pack) * (2 * ids.shape[0]) * 128
+        if head_flops > SCATTER_FLOP_BUDGET:
+            return _xla_scatter_add(table, ids, deltas)
+        from fps_tpu.ops.pallas_kernels import scatter_add_packed_pallas
+
+        return scatter_add_packed_pallas(table, ids, deltas,
+                                         interpret=interpret)
 
     if use and 0 < hot_rows < R:
         pack = max(1, 128 // D)

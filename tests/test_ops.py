@@ -217,3 +217,62 @@ def test_mf_chunk_runs_with_pallas_backend(devices8, pallas_backend):
     ops_mod.set_backend("xla")
     want = run_one()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_whole_shard_packed_scatter_matches_xla(devices8):
+    """hot_rows >= R routes the ENTIRE scatter through the packed MXU
+    kernel (no tail scatter); result must match the XLA scatter within
+    the bf16 hi+lo limb tolerance, including drops and duplicates."""
+    from fps_tpu import ops
+
+    rng = np.random.default_rng(3)
+    R, D, B = 96, 8, 512
+    tab = jnp.asarray(rng.normal(0, 0.1, (R, D)), jnp.float32)
+    ids = jnp.asarray(rng.integers(-1, R + 2, B), jnp.int32)  # drops both ends
+    deltas = jnp.asarray(rng.normal(0, 1e-2, (B, D)), jnp.float32)
+
+    want = np.asarray(ops.scatter_add(tab, ids, deltas))  # hot_rows=0: XLA
+    old = ops.get_backend()
+    ops.set_backend("pallas")
+    try:
+        got = np.asarray(ops.scatter_add(tab, ids, deltas, hot_rows=R))
+    finally:
+        ops.set_backend(old)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-5)
+
+
+def test_hot_ids_auto_resolution(devices8):
+    """hot_ids="auto" enables whole-shard packed routing exactly when the
+    per-shard slice is at or below the measured crossover."""
+    from fps_tpu.core.api import ServerLogic, StepOutput, WorkerLogic
+    from fps_tpu.core.driver import Trainer
+    from fps_tpu.core.store import ParamStore, TableSpec, rows_per_shard
+    from fps_tpu.ops import packed_crossover_rows
+    from fps_tpu.parallel.mesh import make_ps_mesh
+
+    class Noop(WorkerLogic):
+        def pull_ids(self, batch):
+            return {}
+
+        def step(self, batch, pulled, local_state, key):
+            return StepOutput(pushes={}, local_state=local_state, out={})
+
+    mesh = make_ps_mesh(num_shards=8, num_data=1)
+    thin = TableSpec("thin", 8 * 1024, 10, hot_ids="auto").zeros_init()
+    fat = TableSpec("fat", 8 * 65536, 10, hot_ids="auto").zeros_init()
+    head = TableSpec("head", 8 * 65536, 10, hot_ids=4096).zeros_init()
+    store = ParamStore(mesh, [thin, fat, head])
+    tr = Trainer(mesh, store, Noop(), server_logic=ServerLogic())
+
+    assert rows_per_shard(8 * 1024, 8) <= packed_crossover_rows(10)
+    assert tr._resolve_hot_rows(store.specs["thin"]) == 1024  # whole shard
+    assert tr._resolve_hot_rows(store.specs["fat"]) == 0      # above cutover
+    assert tr._resolve_hot_rows(store.specs["head"]) == 512   # ceil(4096/8)
+
+    # Any other string must fail loudly at the right altitude, not as a
+    # cryptic TypeError inside the jitted push.
+    bad = TableSpec("bad", 100, 4, hot_ids="Auto").zeros_init()
+    store2 = ParamStore(mesh, [bad])
+    tr2 = Trainer(mesh, store2, Noop(), server_logic=ServerLogic())
+    with pytest.raises(ValueError, match="hot_ids"):
+        tr2._resolve_hot_rows(store2.specs["bad"])

@@ -461,3 +461,62 @@ def test_push_combine_mean_float64_precision(devices8):
         got2 = np.asarray(g(tables["t"], jnp.asarray(ids2),
                             jnp.asarray(big)))
         assert got2[phys, 0] == pytest.approx(-1.0e39, rel=1e-12)
+
+
+def test_server_logic_swap_recompiles(devices8):
+    """Swapping trainer.server_logic after a compile must MISS the compile
+    cache (combine is baked into the program as a constant): the next
+    chunk must fold with the new strategy, not the shadowed old one."""
+    import jax.numpy as jnp
+
+    from fps_tpu.core.api import ServerLogic, StepOutput, WorkerLogic
+    from fps_tpu.core.driver import Trainer, TrainerConfig, num_workers_of
+    from fps_tpu.core.ingest import epoch_chunks
+
+    class Pusher(WorkerLogic):
+        def pull_ids(self, batch):
+            return {"t": batch["id"].astype(jnp.int32)}
+
+        def step(self, batch, pulled, local_state, key):
+            ids = jnp.where(batch["weight"] > 0,
+                            batch["id"].astype(jnp.int32), -1)
+            return StepOutput(pushes={"t": (ids, batch["val"][:, None])},
+                              local_state=local_state, out={})
+
+    mesh = make_ps_mesh(num_shards=4, num_data=2, devices=devices8[:8])
+    W = num_workers_of(mesh)
+    rng = np.random.default_rng(0)
+    n = 128
+    data = {"id": rng.integers(0, 7, n).astype(np.int32),
+            "val": rng.normal(0, 1, n).astype(np.float32)}
+    chunk = next(epoch_chunks(data, num_workers=W, local_batch=16,
+                              steps_per_chunk=1, seed=3))
+
+    def fold(combine):
+        store = ParamStore(mesh, [TableSpec("t", 7, 1).zeros_init()])
+        tr = Trainer(mesh, store, Pusher(),
+                     server_logic=ServerLogic(combine=combine),
+                     config=TrainerConfig(donate=False))
+        t, ls = tr.init_state(jax.random.key(0))
+        return tr, store, t, ls
+
+    tr, store, t, ls = fold("sum")
+    t, ls, _ = tr.run_chunk(t, ls, chunk, jax.random.key(1))
+    got_sum = store.dump_model("t")[1].copy()
+
+    # Swap the logic on the SAME trainer; rerun the same chunk on fresh
+    # state. Without server_logic in the cache key this silently reuses
+    # the sum program.
+    from fps_tpu.core.api import ServerLogic as SL
+    tr.server_logic = {"t": SL(combine="mean")}
+    t2, ls2 = tr.init_state(jax.random.key(0))
+    t2, ls2, _ = tr.run_chunk(t2, ls2, chunk, jax.random.key(1))
+    got_swapped = store.dump_model("t")[1]
+
+    # Oracle: a trainer built with mean from the start.
+    tr3, store3, t3, ls3 = fold("mean")
+    t3, ls3, _ = tr3.run_chunk(t3, ls3, chunk, jax.random.key(1))
+    got_mean = store3.dump_model("t")[1]
+
+    np.testing.assert_array_equal(got_swapped, got_mean)
+    assert not np.array_equal(got_sum, got_mean)  # the swap matters

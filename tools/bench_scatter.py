@@ -84,5 +84,39 @@ def main():
     run("w2v 1chip", 50000, 100, 49152, alpha=0.75)
 
 
+
+def small_r_sweep():
+    """The hot/cold split's claimed win regime (round-2 verdict #5): SMALL
+    per-shard row counts — a large shard axis leaves each shard a thin row
+    slice, where the packed one-hot MXU contraction can beat the per-row
+    -transaction-bound XLA scatter. Sweep R x D at fixed batch, print the
+    measured crossover. Configs whose packed-contraction FLOPs exceed ~4x
+    the runtime budget are skipped — scatter_add's flop cap auto-rejects
+    them in production anyway, so timing them is pure wall-clock burn."""
+    from fps_tpu.ops import SCATTER_FLOP_BUDGET
+
+    B = 32768
+    for D in (10, 32, 100):
+        for R in (256, 1024, 2048, 4096, 8192, 16384):
+            pack = max(1, 128 // D)
+            flops = -(-R // pack) * (2 * B) * 128
+            if flops > 4 * SCATTER_FLOP_BUDGET:
+                print(f"sweep D={D:3d} R={R:6d}: skipped "
+                      f"(packed flops {flops:.1e} > 4x budget)", flush=True)
+                continue
+            run(f"sweep D={D}", R, D, B)
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if len(sys.argv) == 1:
+        main()
+    elif sys.argv[1:] == ["sweep"]:
+        small_r_sweep()
+    else:
+        raise SystemExit(
+            f"unknown args {sys.argv[1:]!r} — usage: bench_scatter.py "
+            "[sweep]  (no args = full workload-shape bench; 'sweep' = "
+            "small-R crossover sweep)"
+        )

@@ -1,5 +1,8 @@
-"""Staleness sweep: convergence vs (sync_every s, push_delay d) on MF and
-SSP logreg, over an 8-worker mesh. Generates the table in docs/STALENESS.md.
+"""Staleness sweep: convergence vs (sync_every s, push_delay d) on MF,
+SSP logreg, and word2vec, over an 8-worker mesh. Generates the table in
+docs/STALENESS.md. The w2v column is a QUALITY metric (planted-synonym
+nearest-neighbor partner recovery@5, chance 5/(2*V2)), not loss — stale
+embeddings must still resolve the planted semantics.
 
 Run (CPU mesh, like the test suite):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -64,6 +67,28 @@ def logreg_run(mesh, train, test, nf, *, s, d, lr, epochs):
     return float(np.mean((p > 0.5) == (test["label"] > 0.5)))
 
 
+def w2v_run(mesh, tokens, uni, V2, *, s, d, lr, epochs):
+    from fps_tpu.models.word2vec import (
+        W2VConfig, nearest_neighbors, skipgram_chunks, word2vec,
+    )
+
+    W = num_workers_of(mesh)
+    cfg = W2VConfig(vocab_size=2 * V2, dim=16, window=3, negatives=4,
+                    learning_rate=lr, subsample_t=None)
+    trainer, store = word2vec(mesh, cfg, uni, sync_every=s, push_delay=d)
+    t, l = trainer.init_state(jax.random.key(0))
+    for e in range(epochs):
+        chunks = skipgram_chunks(tokens, uni, cfg, num_workers=W,
+                                 local_batch=64,
+                                 steps_per_chunk=max(8, s or 0),
+                                 sync_every=s, seed=11 + e)
+        t, l, _ = trainer.fit_stream(t, l, chunks, jax.random.key(e))
+    probes = np.argsort(-uni[:V2])[:40]
+    ids, _ = nearest_neighbors(store, probes, k=5)
+    partner = probes + V2
+    return float(np.mean([partner[i] in ids[i] for i in range(len(probes))]))
+
+
 def main():
     mesh = make_ps_mesh(num_shards=8, num_data=1)
 
@@ -76,6 +101,15 @@ def main():
                                               noise=0.05)
     lg_data["label"] = (lg_data["label"] > 0).astype(np.float32)
     lg_train, lg_test = train_test_split(lg_data)
+
+    from fps_tpu.utils.datasets import synthetic_corpus
+
+    V2 = 100
+    wrng = np.random.default_rng(17)
+    wbase = synthetic_corpus(V2, 40_000, num_topics=8, seed=0)
+    wtokens = np.where(wrng.random(len(wbase)) < 0.5, wbase,
+                       wbase + V2).astype(np.int32)
+    wuni = np.bincount(wtokens, minlength=2 * V2).astype(np.float64)
 
     # (s, d, lr multiplier, epoch multiplier): the async-SGD recipe — scale
     # the learning rate down and the steps up with the TOTAL staleness.
@@ -91,6 +125,7 @@ def main():
     ]
     mf_lr0, mf_ep0 = 0.08, 3
     lg_lr0, lg_ep0 = 0.5, 3
+    wv_lr0, wv_ep0 = 0.05, 4
 
     rows = []
     for s, d, lrm, epm in grid:
@@ -98,17 +133,21 @@ def main():
                    lr=mf_lr0 * lrm, epochs=mf_ep0 * epm)
         a = logreg_run(mesh, lg_train, lg_test, NF, s=s, d=d,
                        lr=lg_lr0 * lrm, epochs=lg_ep0 * epm)
+        w = w2v_run(mesh, wtokens, wuni, V2, s=s, d=d,
+                    lr=wv_lr0 * lrm, epochs=wv_ep0 * epm)
         tag = "sync" if s is None else f"s={s}"
-        rows.append((tag, d, lrm, epm, r, a))
+        rows.append((tag, d, lrm, epm, r, a, w))
         print(f"{tag:6s} d={d:3d} lr x{lrm:<5g} ep x{epm}: "
-              f"MF test RMSE {r:.4f}   logreg test acc {a:.4f}",
+              f"MF test RMSE {r:.4f}   logreg test acc {a:.4f}   "
+              f"w2v partner-rec@5 {w:.3f}",
               flush=True)
 
     print("\n| reads | push delay | lr scale | epochs scale | "
-          "MF test RMSE | logreg test acc |")
-    print("|---|---|---|---|---|---|")
-    for tag, d, lrm, epm, r, a in rows:
-        print(f"| {tag} | {d} | x{lrm:g} | x{epm} | {r:.4f} | {a:.4f} |")
+          "MF test RMSE | logreg test acc | w2v partner-rec@5 |")
+    print("|---|---|---|---|---|---|---|")
+    for tag, d, lrm, epm, r, a, w in rows:
+        print(f"| {tag} | {d} | x{lrm:g} | x{epm} | {r:.4f} | {a:.4f} "
+              f"| {w:.3f} |")
     return 0
 
 
