@@ -111,13 +111,56 @@ def run_mf(args):
 
     data, nu, ni = load_movielens(args.movielens_path, args.scale)
     nr = len(data["user"])
+    target = args.rmse_target
+    LR, REG = 0.1, 0.01
+
+    # MEASURED baseline FIRST, before any TPU work: the process is quiet
+    # here, so the sequential loop gets its least-contended (most
+    # favorable) timing window. The native loop runs the SAME ratings with
+    # the SAME hyperparameters to the SAME target on its own online-RMSE
+    # curve; per-epoch times are element-wise min'd over two runs
+    # (host-contention noise on this shared VM swings single-run epochs by
+    # ~1.5x). The baseline gets the same --max-epochs search budget as our
+    # side — a stricter --rmse-target must not silently drop the
+    # comparison by under-searching the baseline.
+    baseline = {"kind": "unavailable"}
+    base_tt = {}
+    for label, ps_mode in (("ps", True), ("ideal", False)):
+        runs = [native.baseline_mf(
+            data["user"], data["item"], data["rating"], nu, ni,
+            rank=args.rank, lr=LR, reg=REG, seed=0,
+            epochs=args.max_epochs, ps_mode=ps_mode,
+        ) for _ in range(2)]
+        if any(r is None for r in runs):
+            break
+        secs = [min(a, b) for a, b in zip(runs[0][0], runs[1][0])]
+        curve = [m ** 0.5 for m in runs[0][1]]
+        tt, _ = _time_to_target(secs, curve, target)
+        base_tt[label] = tt
+        if label == "ps":
+            baseline = {
+                "kind": "measured native sequential PS loop (message-hop "
+                        "mode); 'ideal' = fused-loop floor",
+                "ps_time_to_target_s": round(tt, 3) if tt else None,
+                "ps_epoch_s": round(float(np.median(secs)), 4),
+            }
+        else:
+            baseline["ideal_time_to_target_s"] = round(tt, 3) if tt else None
+            baseline["ideal_epoch_s"] = round(float(np.median(secs)), 4)
+        print(f"native baseline [{label}]: epoch_s="
+              f"{[round(s, 3) for s in secs]} rmse="
+              f"{[round(r, 4) for r in curve]}", file=sys.stderr)
 
     devs = jax.devices()
     nd, ns = default_mesh_shape(len(devs))
     mesh = make_ps_mesh(num_shards=ns, num_data=nd)
     W = num_workers_of(mesh)
 
-    LR, REG = 0.05, 0.01
+    # LR=0.1 is the shared operating point for BOTH systems (measured
+    # sweep, round 3): at this noise floor it converges in 3 epochs for
+    # ours AND the native sequential loop (vs 5 and 4 at the old 0.05),
+    # stable across shuffle seeds; both sides always run the SAME
+    # hyperparameters, so the comparison never rests on asymmetric tuning.
     cfg = MFConfig(num_users=nu, num_items=ni, rank=args.rank,
                    learning_rate=LR, reg=REG)
     # Per-id mean combine: at this batch size summed duplicate updates on
@@ -141,7 +184,6 @@ def run_mf(args):
     tables, local_state = trainer.init_state(jax.random.key(0))
     trainer.run_indexed(tables, local_state, plan, jax.random.key(9))
 
-    target = args.rmse_target
     tables, local_state = trainer.init_state(jax.random.key(0))
     epoch_times, rmse_curve = [], []
     for e in range(args.max_epochs):
@@ -161,37 +203,9 @@ def run_mf(args):
     median_epoch = statistics.median(epoch_times)
     reached = rmse_curve[-1] <= target
 
-    # MEASURED baseline: the native sequential per-record loop on the SAME
-    # ratings with the SAME hyperparameters, run to the SAME target on its
-    # own online-RMSE curve (each system pays its own epochs-to-target).
-    baseline = {"kind": "unavailable"}
     vs = None
-    for label, ps_mode in (("ps", True), ("ideal", False)):
-        res = native.baseline_mf(
-            data["user"], data["item"], data["rating"], nu, ni,
-            rank=args.rank, lr=LR, reg=REG, seed=0,
-            epochs=args.max_epochs, ps_mode=ps_mode,
-        )
-        if res is None:
-            break
-        secs, mses = res
-        curve = [m ** 0.5 for m in mses]
-        tt, _ = _time_to_target(secs, curve, target)
-        if label == "ps":
-            baseline = {
-                "kind": "measured native sequential PS loop (message-hop "
-                        "mode); 'ideal' = fused-loop floor",
-                "ps_time_to_target_s": round(tt, 3) if tt else None,
-                "ps_epoch_s": round(float(np.median(secs)), 4),
-            }
-            if tt is not None and reached:
-                vs = round(tt / total_s, 2)
-        else:
-            baseline["ideal_time_to_target_s"] = round(tt, 3) if tt else None
-            baseline["ideal_epoch_s"] = round(float(np.median(secs)), 4)
-        print(f"native baseline [{label}]: epoch_s="
-              f"{[round(s, 3) for s in secs]} rmse="
-              f"{[round(r, 4) for r in curve]}", file=sys.stderr)
+    if base_tt.get("ps") is not None and reached:
+        vs = round(base_tt["ps"] / total_s, 2)
 
     print(
         "quality: per-epoch train RMSE "
@@ -254,6 +268,30 @@ def run_w2v(args):
         block_len=args.block_len, seed=1, mode="block",
     )
 
+    # MEASURED baseline FIRST (quiet pre-TPU window — host contention from
+    # device dispatch must not inflate the baseline's per-pair cost):
+    # native per-pair SGNS over a representative pair sample from the same
+    # generator/distribution. Converted to words/s AFTER the epoch runs,
+    # via the epoch's actual pair count.
+    per_pair_ns = {}
+    loss_by_mode = {}
+    keep_p = _keep_probs(cfg, uni).astype(np.float32)
+    sample = native.skipgram_pairs(
+        np.ascontiguousarray(tokens[:2_000_000]), cfg.window, 3,
+        keep_p=keep_p,
+    )
+    if sample is not None:
+        c, x = sample
+        m_pairs = min(len(c), 1_500_000)
+        for label, (secs, loss) in _measure_native_modes(
+            lambda m: native.baseline_w2v(
+                c[:m_pairs], x[:m_pairs], uni, dim=cfg.dim,
+                negatives=cfg.negatives, lr=cfg.learning_rate, ps_mode=m,
+            )
+        ):
+            per_pair_ns[label] = secs / m_pairs
+            loss_by_mode[label] = loss
+
     # Warm-up epoch: compiles the fused program.
     tables, ls, m = trainer.run_indexed(tables, ls, plan, jax.random.key(9))
 
@@ -272,42 +310,25 @@ def run_w2v(args):
         file=sys.stderr,
     )
 
-    # MEASURED baseline: native per-pair SGNS over a representative pair
-    # sample from the same generator/distribution, converted to words/s via
-    # this epoch's actual pair count.
     # metrics "n" counts PAIRS (the quality line above compares loss/n to
     # the (1+K)*log2 per-pair init loss), so no (1+K) rescale here.
     pairs = float(metrics[0]["n"].sum())
     baseline = {"kind": "unavailable"}
     vs = None
-    keep_p = _keep_probs(cfg, uni).astype(np.float32)
-    sample = native.skipgram_pairs(
-        np.ascontiguousarray(tokens[:2_000_000]), cfg.window, 3,
-        keep_p=keep_p,
-    )
-    if sample is not None:
-        c, x = sample
-        m_pairs = min(len(c), 1_500_000)
-        for label, (secs, loss) in _measure_native_modes(
-            lambda m: native.baseline_w2v(
-                c[:m_pairs], x[:m_pairs], uni, dim=cfg.dim,
-                negatives=cfg.negatives, lr=cfg.learning_rate, ps_mode=m,
-            )
-        ):
-            per_pair = secs / m_pairs
-            base_words_s = len(tokens) / (pairs * per_pair)
-            if label == "ps":
-                baseline = {
-                    "kind": "measured native sequential per-pair SGNS "
-                            "(message-hop mode); 'ideal' = fused floor",
-                    "ps_words_per_s": round(base_words_s, 1),
-                }
-                vs = round(words_s / base_words_s, 2)
-            else:
-                baseline["ideal_words_per_s"] = round(base_words_s, 1)
-            print(f"native baseline [{label}]: {per_pair * 1e9:.0f} ns/pair"
-                  f" ({base_words_s / 1e3:.0f}k words/s), loss {loss:.4f}",
-                  file=sys.stderr)
+    for label, per_pair in per_pair_ns.items():
+        base_words_s = len(tokens) / (pairs * per_pair)
+        if label == "ps":
+            baseline = {
+                "kind": "measured native sequential per-pair SGNS "
+                        "(message-hop mode); 'ideal' = fused floor",
+                "ps_words_per_s": round(base_words_s, 1),
+            }
+            vs = round(words_s / base_words_s, 2)
+        else:
+            baseline["ideal_words_per_s"] = round(base_words_s, 1)
+        print(f"native baseline [{label}]: {per_pair * 1e9:.0f} ns/pair"
+              f" ({base_words_s / 1e3:.0f}k words/s), loss "
+              f"{loss_by_mode[label]:.4f}", file=sys.stderr)
 
     return {
         "metric": "text8_w2v_words_per_sec_per_chip",
@@ -339,20 +360,47 @@ def run_logreg(args):
     )
 
     NF, NNZ, NEX = 1_000_000, 39, 4_000_000  # Criteo-ish shape
+    DENSE = 13  # Criteo's numeric columns, fixed-slot (id j at slot j)
     if args.input:
-        data, NF = load_sparse(args.input, num_features=NF)
+        from fps_tpu.utils.datasets import sniff_sparse_format
+
+        fmt = sniff_sparse_format(args.input)  # sniff ONCE, pass through
+        data, NF = load_sparse(args.input, fmt=fmt, num_features=NF)
         NEX, NNZ = data["feat_ids"].shape
+        # Only the Criteo TSV loader guarantees the fixed-slot head.
+        if fmt != "criteo":
+            DENSE = 0
     else:
         data = synthetic_sparse_classification(NEX, NF, NNZ, seed=0,
-                                               noise=0.05)
+                                               noise=0.05,
+                                               dense_features=DENSE)
     data = dict(data, label=(data["label"] > 0).astype(np.float32))
+
+    LR = 0.1
+    # MEASURED baseline FIRST (quiet pre-TPU window): native per-example
+    # fan-out loop on a sample of the same dataset (the reference pulls
+    # and pushes each active feature individually — dense or not).
+    m_ex = min(NEX, 500_000)
+    base_ex_s = {}
+    loss_by_mode = {}
+    for label, (secs, loss) in _measure_native_modes(
+        lambda m: native.baseline_logreg(
+            data["feat_ids"][:m_ex], data["feat_vals"][:m_ex],
+            data["label"][:m_ex], NF, lr=LR, ps_mode=m,
+        )
+    ):
+        base_ex_s[label] = m_ex / secs
+        loss_by_mode[label] = loss
 
     devs = jax.devices()
     nd, ns = default_mesh_shape(len(devs))
     mesh = make_ps_mesh(num_shards=ns, num_data=nd)
     W = num_workers_of(mesh)
-    LR = 0.1
-    cfg = LogRegConfig(num_features=NF, learning_rate=LR)
+    # dense_features: the 13 numeric weights ride one static pull and one
+    # batch-combined push per step instead of 13 scatter rows per example
+    # (the fixed-slot layout contract; see LogRegConfig).
+    cfg = LogRegConfig(num_features=NF, learning_rate=LR,
+                       dense_features=DENSE)
     trainer, store = logistic_regression(
         mesh, cfg, sync_every=8, max_steps_per_call=256
     )
@@ -381,25 +429,18 @@ def run_logreg(args):
     # same dataset (the reference pulls/pushes each feature individually).
     baseline = {"kind": "unavailable"}
     vs = None
-    m_ex = min(NEX, 500_000)
-    for label, (secs, loss) in _measure_native_modes(
-        lambda m: native.baseline_logreg(
-            data["feat_ids"][:m_ex], data["feat_vals"][:m_ex],
-            data["label"][:m_ex], NF, lr=LR, ps_mode=m,
-        )
-    ):
-        base_ex_s = m_ex / secs
+    for label, rate in base_ex_s.items():
         if label == "ps":
             baseline = {
                 "kind": "measured native sequential per-feature-fan-out "
                         "logreg (message-hop mode); 'ideal' = fused floor",
-                "ps_examples_per_s": round(base_ex_s, 1),
+                "ps_examples_per_s": round(rate, 1),
             }
-            vs = round(ex_s / base_ex_s, 2)
+            vs = round(ex_s / rate, 2)
         else:
-            baseline["ideal_examples_per_s"] = round(base_ex_s, 1)
-        print(f"native baseline [{label}]: {secs / m_ex * 1e9:.0f} ns/ex "
-              f"({base_ex_s / 1e6:.2f}M ex/s), logloss {loss:.4f}",
+            baseline["ideal_examples_per_s"] = round(rate, 1)
+        print(f"native baseline [{label}]: {1e9 / rate:.0f} ns/ex "
+              f"({rate / 1e6:.2f}M ex/s), logloss {loss_by_mode[label]:.4f}",
               file=sys.stderr)
 
     return {

@@ -47,11 +47,24 @@ class LogRegConfig:
     # split, fps_tpu.ops.scatter_add); effective with frequency-ranked ids
     # and a small per-shard table slice. Default 0 — see MFConfig.hot_items.
     hot_features: int = 0
+    # FIXED-SLOT dense head: the first ``dense_features`` batch slots carry
+    # feature id j at slot j in EVERY example (value 0 = inactive), the
+    # Criteo loader's layout for the 13 numeric columns. The worker then
+    # pulls those weights once per step (d rows, not B*d gathered rows)
+    # and pushes ONE batch-combined delta per column — cutting the sparse
+    # scatter from B*nnz to B*(nnz-d) rows. Semantically identical to
+    # dense_features=0 under the additive server fold (the per-id sums are
+    # just pre-combined on the worker; equal up to f32 reassociation).
+    dense_features: int = 0
     dtype: object = jnp.float32
 
     def __post_init__(self):
         if self.optimizer not in ("sgd", "adagrad"):
             raise ValueError(f"unknown optimizer {self.optimizer!r}")
+        if not 0 <= self.dense_features <= self.num_features:
+            raise ValueError(
+                f"dense_features={self.dense_features} out of range"
+            )
 
     @property
     def table_width(self) -> int:
@@ -64,17 +77,36 @@ class LogisticRegressionWorker(WorkerLogic):
         self.cfg = cfg
 
     def pull_ids(self, batch) -> Mapping[str, Array]:
-        return {WEIGHT_TABLE: batch["feat_ids"].astype(jnp.int32).reshape(-1)}
+        d = self.cfg.dense_features
+        if not d:
+            return {
+                WEIGHT_TABLE: batch["feat_ids"].astype(jnp.int32).reshape(-1)
+            }
+        # Dense head: one static d-row pull (fixed-slot contract: slot j
+        # carries id j for j < d) + the sparse tail per example.
+        tail = batch["feat_ids"][:, d:].astype(jnp.int32).reshape(-1)
+        return {
+            WEIGHT_TABLE: jnp.concatenate(
+                [jnp.arange(d, dtype=jnp.int32), tail]
+            )
+        }
 
     def step(self, batch, pulled, local_state, key) -> StepOutput:
         cfg = self.cfg
+        d = cfg.dense_features
         B, nnz = batch["feat_ids"].shape
         x = batch["feat_vals"].astype(cfg.dtype)
         y = batch["label"].astype(cfg.dtype)  # {0,1}
         w = batch["weight"].astype(cfg.dtype)
 
         width = cfg.table_width
-        wrows = pulled[WEIGHT_TABLE].reshape(B, nnz, width)[:, :, 0]
+        if d:
+            flat = pulled[WEIGHT_TABLE]
+            head = jnp.broadcast_to(flat[:d, 0][None], (B, d))
+            tail = flat[d:].reshape(B, nnz - d, width)[:, :, 0]
+            wrows = jnp.concatenate([head, tail], axis=1)
+        else:
+            wrows = pulled[WEIGHT_TABLE].reshape(B, nnz, width)[:, :, 0]
         logit = jnp.sum(wrows * x, axis=-1)
         p = jax.nn.sigmoid(logit)
         g = (p - y) * w  # dL/dlogit, zeroed for padding
@@ -89,7 +121,28 @@ class LogisticRegressionWorker(WorkerLogic):
             deltas = (-cfg.learning_rate * grads)[:, :, None]
 
         active = (x != 0.0) & (w[:, None] > 0)
-        push_ids = jnp.where(active, batch["feat_ids"].astype(jnp.int32), -1)
+        if d:
+            # Head: batch-combine on the worker (the per-id sum the server
+            # fold would compute anyway) -> d pushed rows, not B*d.
+            head_deltas = jnp.sum(
+                jnp.where(active[:, :d, None], deltas[:, :d, :], 0.0),
+                axis=0,
+            )
+            tail_ids = jnp.where(
+                active[:, d:], batch["feat_ids"][:, d:].astype(jnp.int32), -1
+            )
+            push_ids = jnp.concatenate(
+                [jnp.arange(d, dtype=jnp.int32), tail_ids.reshape(-1)]
+            )
+            push_deltas = jnp.concatenate(
+                [head_deltas.astype(cfg.dtype),
+                 deltas[:, d:, :].reshape(-1, width)]
+            )
+        else:
+            push_ids = jnp.where(
+                active, batch["feat_ids"].astype(jnp.int32), -1
+            ).reshape(-1)
+            push_deltas = deltas.reshape(-1, width)
 
         # log loss, clipped for monitoring stability.
         eps = 1e-7
@@ -100,9 +153,7 @@ class LogisticRegressionWorker(WorkerLogic):
             "mistakes": mistakes.astype(jnp.float32),
             "n": jnp.sum(w).astype(jnp.float32),
         }
-        pushes = {
-            WEIGHT_TABLE: (push_ids.reshape(-1), deltas.reshape(-1, width))
-        }
+        pushes = {WEIGHT_TABLE: (push_ids, push_deltas)}
         return StepOutput(pushes=pushes, local_state=local_state, out=out)
 
 

@@ -391,9 +391,12 @@ long fps_parse_svmlight(const char* path, float* labels, int32_t* ids,
 // value log1p(x); negative or empty numerics are treated as missing.
 // Categorical column j with token s becomes feature id
 //   13 + hash(j, s) % (num_features - 13)      (FNV-1a + splitmix64)
-// with value 1.0. Output is CALLER-ZEROED row-major (cap_rows x 39); missing
-// fields leave inactive pad slots. Lines with a non-0/1 label or a wrong
-// field count are malformed. Returns rows, or -1 on IO error.
+// with value 1.0. Output is CALLER-ZEROED row-major (cap_rows x 39) with a
+// FIXED-SLOT layout: numeric column j always sits at slot j (id j, value 0
+// when missing — inactive by the models' x != 0 convention), categoricals
+// append from slot 13; absent fields leave inactive pads. Lines with a
+// non-0/1 label or a wrong field count are malformed. Returns rows, or -1
+// on IO error.
 long fps_parse_criteo(const char* path, float* labels, int32_t* ids,
                       float* vals, long cap_rows, long num_features,
                       long* malformed) {
@@ -423,7 +426,13 @@ long fps_parse_criteo(const char* path, float* labels, int32_t* ids,
     // label
     long label = parse_uint(p, le);
     if (label != 0 && label != 1) ok = false;
-    long nnz = 0;
+    // FIXED-SLOT layout: numeric column j always occupies batch slot j
+    // (id j; value 0 = inactive when missing/negative), so slot<->id is
+    // deterministic for the dense head — models exploit it by pulling and
+    // pushing the 13 numeric weights densely (LogRegConfig.dense_features)
+    // instead of paying 13 scatter rows per example. Categorical features
+    // append from slot 13 in field order; absent cats leave inactive pads.
+    long nnz = kNum;  // cat slots start after the fixed numeric head
     long field = 0;
     while (ok && field < kNnz) {
       if (p >= le || *p != '\t') {
@@ -434,6 +443,10 @@ long fps_parse_criteo(const char* path, float* labels, int32_t* ids,
       const char* fs = p;
       while (p < le && *p != '\t') ++p;
       long flen = p - fs;
+      if (field < kNum) {
+        ids[n * kNnz + field] = static_cast<int32_t>(field);
+        vals[n * kNnz + field] = 0.0f;  // inactive unless present below
+      }
       if (flen == 0) {
         ++field;
         continue;  // missing value
@@ -449,9 +462,7 @@ long fps_parse_criteo(const char* path, float* labels, int32_t* ids,
           // log1p, cheap enough inline
           double x = v, r = 0.0;
           r = __builtin_log1p(x);
-          ids[n * kNnz + nnz] = static_cast<int32_t>(field);
-          vals[n * kNnz + nnz] = static_cast<float>(r);
-          ++nnz;
+          vals[n * kNnz + field] = static_cast<float>(r);
         }
       } else {
         uint64_t h = hash_bytes(static_cast<uint64_t>(field), fs, flen);

@@ -335,7 +335,11 @@ def load_svmlight(path: str, *, num_features: int | None = None,
 
 def _parse_criteo_py(path: str, num_features: int):
     """Pure-python Criteo TSV parse (fallback) — conventions identical to
-    the native scanner, including the categorical hash."""
+    the native scanner, including the categorical hash and the FIXED-SLOT
+    layout: numeric column j always sits at batch slot j (id j, value 0 =
+    inactive when missing), categoricals append from slot 13. The fixed
+    head is what lets ``LogRegConfig.dense_features`` pull/push the
+    numeric weights densely instead of via per-example scatter rows."""
     cat_space = num_features - CRITEO_NUM_COLS
     labels, ids_rows, vals_rows = [], [], []
     malformed = 0
@@ -348,7 +352,8 @@ def _parse_criteo_py(path: str, num_features: int):
             ok = len(fields) == 1 + CRITEO_NNZ and fields[0] in (b"0", b"1")
             row_ids = np.zeros(CRITEO_NNZ, np.int32)
             row_vals = np.zeros(CRITEO_NNZ, np.float32)
-            nnz = 0
+            row_ids[:CRITEO_NUM_COLS] = np.arange(CRITEO_NUM_COLS)
+            nnz = CRITEO_NUM_COLS  # cat slots start after the fixed head
             if ok:
                 for j, tok in enumerate(fields[1 : 1 + CRITEO_NUM_COLS]):
                     if not tok:
@@ -360,9 +365,7 @@ def _parse_criteo_py(path: str, num_features: int):
                         break
                     v = float(tok)
                     if v >= 0:
-                        row_ids[nnz] = j
-                        row_vals[nnz] = np.log1p(v)
-                        nnz += 1
+                        row_vals[j] = np.log1p(v)
             if ok:
                 for j, tok in enumerate(fields[1 + CRITEO_NUM_COLS:],
                                         start=CRITEO_NUM_COLS):
@@ -464,18 +467,38 @@ def synthetic_sparse_classification(
     *,
     seed: int = 0,
     noise: float = 0.1,
+    dense_features: int = 0,
 ):
     """Linearly separable-ish sparse examples with Zipfian feature frequency.
+
+    ``dense_features=d`` mirrors the Criteo TSV loader's FIXED-SLOT layout:
+    batch slot ``j < d`` always carries feature id ``j`` (a dense numeric
+    column, present in ~every example; occasionally value 0 = missing),
+    and the remaining ``nnz - d`` slots draw Zipfian ids from ``[d, NF)``
+    — the shape `LogRegConfig.dense_features` exploits. Default 0 keeps
+    the fully-random layout.
 
     Returns dict with ``feat_ids (N, nnz)``, ``feat_vals (N, nnz)``,
     ``label (N,)`` in {-1, +1}.
     """
+    if not 0 <= dense_features <= min(nnz_per_example, num_features):
+        raise ValueError(f"dense_features={dense_features} out of range")
     rng = np.random.default_rng(seed)
     w_true = rng.normal(0, 1, num_features)
-    feat_pop = 1.0 / np.arange(1, num_features + 1) ** 0.9
+    d = dense_features
+    tail_nnz = nnz_per_example - d
+    tail_nf = num_features - d
+    feat_pop = 1.0 / np.arange(1, tail_nf + 1) ** 0.9
     feat_pop /= feat_pop.sum()
-    ids = rng.choice(num_features, (num_examples, nnz_per_example), p=feat_pop)
+    tail_ids = d + rng.choice(tail_nf, (num_examples, tail_nnz), p=feat_pop)
+    head_ids = np.broadcast_to(np.arange(d, dtype=np.int64),
+                               (num_examples, d))
+    ids = np.concatenate([head_ids, tail_ids], axis=1)
     vals = rng.normal(0, 1, (num_examples, nnz_per_example)).astype(np.float32)
+    if d:
+        # ~5% missing numerics (value 0 = inactive), like real Criteo rows.
+        vals[:, :d] = np.where(rng.random((num_examples, d)) < 0.05, 0.0,
+                               vals[:, :d])
     margin = np.sum(w_true[ids] * vals, axis=-1) / np.sqrt(nnz_per_example)
     flip = rng.random(num_examples) < noise
     label = np.where((margin > 0) ^ flip, 1.0, -1.0).astype(np.float32)

@@ -253,3 +253,26 @@ def test_criteo_degenerate_numeric_tokens(tmp_path, use_native):
         p.write_text(_criteo_line(1, nums, ["aa"] * 26) + "\n")
         with pytest.raises(ValueError, match="malformed"):
             load_criteo(str(p), num_features=1 << 16, use_native=use_native)
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_criteo_fixed_slot_layout(tmp_path, use_native):
+    """Numeric column j always sits at batch slot j (id j; value 0 when
+    missing) — the fixed-slot contract LogRegConfig.dense_features relies
+    on. Categoricals append from slot 13."""
+    if use_native and not native.available():
+        pytest.skip("native unavailable")
+    p = tmp_path / "c.tsv"
+    nums = [5, None, 7] + [None] * 10  # I2 and I4..I13 missing
+    p.write_text(_criteo_line(1, nums, ["aa", "bb"] + [""] * 24) + "\n")
+    data, _ = load_criteo(str(p), num_features=1 << 16,
+                          use_native=use_native)
+    ids, vals = data["feat_ids"][0], data["feat_vals"][0]
+    np.testing.assert_array_equal(ids[:13], np.arange(13))
+    np.testing.assert_allclose(vals[0], np.log1p(5.0), rtol=1e-6)
+    assert vals[1] == 0.0  # missing numeric: inactive, slot preserved
+    np.testing.assert_allclose(vals[2], np.log1p(7.0), rtol=1e-6)
+    assert (vals[3:13] == 0.0).all()
+    # two categoricals at slots 13, 14; the rest padding
+    assert (ids[13:15] >= 13).all() and (vals[13:15] == 1.0).all()
+    assert (vals[15:] == 0.0).all()

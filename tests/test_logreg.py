@@ -2,6 +2,7 @@
 and staleness actually changes the trajectory (proving reads are stale)."""
 
 import jax
+import pytest
 import numpy as np
 
 from fps_tpu.core.driver import num_workers_of
@@ -108,3 +109,45 @@ def test_logreg_adagrad_converges_and_keeps_state_in_table(devices8):
     assert rows.shape == (NF, 2)
     assert (rows[:, 1] >= 0).all()  # accumulator is a sum of squares
     assert (rows[:, 1] > 0).sum() > NF // 2  # most features were touched
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adagrad"])
+def test_dense_head_matches_scatter_path(devices8, optimizer):
+    """dense_features=d (fixed-slot numeric head pulled/pushed densely)
+    must train to the SAME weights as the all-scatter path on the same
+    structured data — the head deltas are just pre-combined on the worker,
+    so the additive fold sees identical per-id sums (up to f32
+    reassociation)."""
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.models.logistic_regression import (
+        LogRegConfig, logistic_regression,
+    )
+
+    NF, NNZ, D, NEX = 2000, 8, 3, 2048
+    data = synthetic_sparse_classification(NEX, NF, NNZ, seed=5, noise=0.05,
+                                           dense_features=D)
+    # fixed-slot contract holds in the generator
+    np.testing.assert_array_equal(
+        data["feat_ids"][:, :D], np.broadcast_to(np.arange(D), (NEX, D)))
+    data = dict(data, label=(data["label"] > 0).astype(np.float32))
+
+    mesh = make_ps_mesh(num_shards=4, num_data=2, devices=devices8[:8])
+    W = num_workers_of(mesh)
+
+    def run(dense):
+        cfg = LogRegConfig(num_features=NF, learning_rate=0.3,
+                           optimizer=optimizer, dense_features=dense)
+        trainer, store = logistic_regression(mesh, cfg, donate=False)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        ds = DeviceDataset(mesh, data)
+        plan = DeviceEpochPlan(ds, num_workers=W, local_batch=64, seed=2)
+        tables, ls, m = trainer.run_indexed(tables, ls, plan,
+                                            jax.random.key(1), epochs=2)
+        lls = [float(mm["logloss"].sum() / mm["n"].sum()) for mm in m]
+        return store.dump_model("weights")[1], lls
+
+    w_dense, ll_dense = run(D)
+    w_flat, ll_flat = run(0)
+    np.testing.assert_allclose(w_dense, w_flat, rtol=2e-4, atol=2e-6)
+    assert ll_dense[-1] < ll_dense[0]  # it learns
+    np.testing.assert_allclose(ll_dense, ll_flat, rtol=1e-4)
