@@ -79,6 +79,25 @@ def _time_to_target(per_epoch_s, curve, target):
     return None, None
 
 
+def _rate_baseline(base_by_mode, kind, unit, our_rate, quality_by_mode):
+    """Assemble the JSON ``baseline`` dict + ``vs_baseline`` for a
+    rate-metric workload (logreg, pa) from per-mode measured rates, and
+    print the per-mode stderr lines. Shared so the baseline JSON shape and
+    report format cannot drift between workloads."""
+    baseline = {"kind": "unavailable"}
+    vs = None
+    for label, rate in base_by_mode.items():
+        if label == "ps":
+            baseline = {"kind": kind, f"ps_{unit}_per_s": round(rate, 1)}
+            vs = round(our_rate / rate, 2)
+        else:
+            baseline[f"ideal_{unit}_per_s"] = round(rate, 1)
+        print(f"native baseline [{label}]: {1e9 / rate:.0f} ns/{unit[:-1]} "
+              f"({rate / 1e6:.2f}M {unit}/s), "
+              f"{quality_by_mode[label]}", file=sys.stderr)
+    return baseline, vs
+
+
 def _measure_native_modes(thunk):
     """Yield ``(label, result)`` for the ``ps`` then ``ideal`` native
     baseline modes, best-of-2 each: transient host contention from the
@@ -126,15 +145,25 @@ def run_mf(args):
     baseline = {"kind": "unavailable"}
     base_tt = {}
     for label, ps_mode in (("ps", True), ("ideal", False)):
-        runs = [native.baseline_mf(
-            data["user"], data["item"], data["rating"], nu, ni,
-            rank=args.rank, lr=LR, reg=REG, seed=0,
-            epochs=args.max_epochs, ps_mode=ps_mode,
-        ) for _ in range(2)]
-        if any(r is None for r in runs):
+        # Early-stop schedule: at the shared lr the sequential loop reaches
+        # the default target inside 3 epochs; only a stricter --rmse-target
+        # pays for the full --max-epochs search (wall-clock matters — the
+        # driver runs all five workloads in one bench invocation).
+        for budget in (min(3, args.max_epochs), args.max_epochs):
+            runs = [native.baseline_mf(
+                data["user"], data["item"], data["rating"], nu, ni,
+                rank=args.rank, lr=LR, reg=REG, seed=0,
+                epochs=budget, ps_mode=ps_mode,
+            ) for _ in range(2)]
+            if any(r is None for r in runs):
+                runs = None
+                break
+            curve = [m ** 0.5 for m in runs[0][1]]
+            if any(r <= target for r in curve) or budget >= args.max_epochs:
+                break
+        if runs is None:
             break
         secs = [min(a, b) for a, b in zip(runs[0][0], runs[1][0])]
-        curve = [m ** 0.5 for m in runs[0][1]]
         tt, _ = _time_to_target(secs, curve, target)
         base_tt[label] = tt
         if label == "ps":
@@ -427,24 +456,105 @@ def run_logreg(args):
 
     # MEASURED baseline: native per-example fan-out loop on a sample of the
     # same dataset (the reference pulls/pushes each feature individually).
-    baseline = {"kind": "unavailable"}
-    vs = None
-    for label, rate in base_ex_s.items():
-        if label == "ps":
-            baseline = {
-                "kind": "measured native sequential per-feature-fan-out "
-                        "logreg (message-hop mode); 'ideal' = fused floor",
-                "ps_examples_per_s": round(rate, 1),
-            }
-            vs = round(ex_s / rate, 2)
-        else:
-            baseline["ideal_examples_per_s"] = round(rate, 1)
-        print(f"native baseline [{label}]: {1e9 / rate:.0f} ns/ex "
-              f"({rate / 1e6:.2f}M ex/s), logloss {loss_by_mode[label]:.4f}",
-              file=sys.stderr)
+    baseline, vs = _rate_baseline(
+        base_ex_s,
+        "measured native sequential per-feature-fan-out logreg "
+        "(message-hop mode); 'ideal' = fused floor",
+        "examples", ex_s,
+        {k: f"logloss {v:.4f}" for k, v in loss_by_mode.items()},
+    )
 
     return {
         "metric": "criteo_ssp_logreg_examples_per_sec_per_chip",
+        "value": round(ex_s, 1),
+        "unit": "examples/s",
+        "vs_baseline": vs,
+        "epoch_s": round(epoch_s, 3),
+        "baseline": baseline,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Passive-aggressive (RCV1-scale binary, PA-I)
+# ---------------------------------------------------------------------------
+
+def run_pa(args):
+    """RCV1-scale binary passive-aggressive throughput (PA-I closed form)."""
+    import jax
+
+    from fps_tpu import native
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.models.passive_aggressive import (
+        PAConfig, passive_aggressive,
+    )
+    from fps_tpu.parallel.mesh import default_mesh_shape, make_ps_mesh
+    from fps_tpu.utils.datasets import (
+        load_sparse, synthetic_sparse_classification,
+    )
+
+    # RCV1 shape: 47236 features, ~76 nonzeros/doc, ~800k docs.
+    NF, NNZ, NEX = 47_236, 64, 800_000
+    if args.input:
+        data, NF = load_sparse(args.input, num_features=NF)
+        NEX, NNZ = data["feat_ids"].shape
+    else:
+        data = synthetic_sparse_classification(NEX, NF, NNZ, seed=3,
+                                               noise=0.05)
+
+    C = 1.0
+    # MEASURED baseline FIRST (quiet pre-TPU window).
+    m_ex = min(NEX, 400_000)
+    base_ex_s = {}
+    quality = {}
+    for label, res in _measure_native_modes(
+        lambda m: native.baseline_pa(
+            data["feat_ids"][:m_ex], data["feat_vals"][:m_ex],
+            data["label"][:m_ex], NF, C=C, variant="PA-I", ps_mode=m,
+        )
+    ):
+        secs, hinge, mist = res
+        base_ex_s[label] = m_ex / secs
+        quality[label] = (hinge, mist)
+
+    devs = jax.devices()
+    nd, ns = default_mesh_shape(len(devs))
+    mesh = make_ps_mesh(num_shards=ns, num_data=nd)
+    W = num_workers_of(mesh)
+    cfg = PAConfig(num_features=NF, variant="PA-I", C=C)
+    trainer, store = passive_aggressive(mesh, cfg, max_steps_per_call=256)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    ds = DeviceDataset(mesh, data)
+    plan = DeviceEpochPlan(ds, num_workers=W, local_batch=16384, seed=1)
+
+    tables, ls, _ = trainer.run_indexed(tables, ls, plan, jax.random.key(9))
+    t0 = time.perf_counter()
+    tables, ls, metrics = trainer.run_indexed(
+        tables, ls, plan, jax.random.key(1)
+    )
+    epoch_s = time.perf_counter() - t0
+    ex_s = NEX / epoch_s / len(devs)
+
+    per0, per1 = first_last_real_step(metrics[0], "mistakes")
+    print(
+        f"quality: online mistake rate step0 {per0:.4f} -> last-real-step "
+        f"{per1:.4f} (epoch 2; chance = 0.5)",
+        file=sys.stderr,
+    )
+
+    baseline, vs = _rate_baseline(
+        base_ex_s,
+        "measured native sequential per-feature-fan-out PA-I (message-hop "
+        "mode); 'ideal' = fused floor. NOTE: at RCV1 scale the whole "
+        "190 KB weight vector is L2-resident on the host core — the "
+        "degenerate best case for the sequential loop",
+        "examples", ex_s,
+        {k: f"hinge {h:.4f}, mistakes {m:.4f}"
+         for k, (h, m) in quality.items()},
+    )
+
+    return {
+        "metric": "rcv1_pa1_examples_per_sec_per_chip",
         "value": round(ex_s, 1),
         "unit": "examples/s",
         "vs_baseline": vs,
@@ -526,13 +636,13 @@ def run_ials(args):
 
 
 RUNNERS = {"mf": run_mf, "w2v": run_w2v, "logreg": run_logreg,
-           "ials": run_ials}
+           "pa": run_pa, "ials": run_ials}
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="all",
-                    choices=["all", "mf", "w2v", "logreg", "ials"])
+                    choices=["all", "mf", "w2v", "logreg", "pa", "ials"])
     ap.add_argument("--scale", default="20m", choices=["100k", "1m", "20m"])
     ap.add_argument("--rank", type=int, default=10)
     ap.add_argument("--local-batch", type=int, default=32768)
@@ -557,7 +667,7 @@ def main():
     if args.workload == "all":
         # Headline (mf) LAST: the driver's artifact parses the final JSON
         # line and its tail window shows the rest.
-        order = ["w2v", "logreg", "ials", "mf"]
+        order = ["w2v", "logreg", "pa", "ials", "mf"]
     else:
         order = [args.workload]
     for name in order:

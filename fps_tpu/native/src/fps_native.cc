@@ -858,4 +858,80 @@ double fps_baseline_logreg(const int32_t* ids, const float* vals,
   return dt;
 }
 
+
+// Sequential per-example passive-aggressive (binary, Crammer et al. 2006):
+// the reference's shape — pull each active feature individually, compute
+// the margin and the closed-form step (variant 0=PA, 1=PA-I, 2=PA-II),
+// push one delta per feature. Labels in {-1,+1}; pad slots (value 0)
+// skipped. One pass; writes the mean hinge loss and the online mistake
+// fraction. Returns seconds, or -1.
+double fps_baseline_pa(const int32_t* ids, const float* vals,
+                       const float* labels, long n, long nnz,
+                       long num_features, float C, int variant, int ps_mode,
+                       double* mean_hinge, double* mistake_frac) {
+  float* w = static_cast<float*>(calloc(num_features, sizeof(float)));
+  if (!w) return -1.0;
+  Ring ring;
+  double hinge = 0.0;
+  long mistakes = 0;
+  double t0 = now_s();
+  for (long k = 0; k < n; ++k) {
+    const int32_t* fid = ids + k * nnz;
+    const float* fval = vals + k * nnz;
+    float y = labels[k];
+    float m = 0.0f, x2 = 0.0f;
+    for (long j = 0; j < nnz; ++j) {
+      if (fval[j] == 0.0f) continue;
+      float wj;
+      if (ps_mode) {
+        char* s1 = ring_send(ring, &fid[j], sizeof(int32_t));
+        int32_t gi;
+        ring_recv(&gi, s1, sizeof(gi));
+        char* s2 = ring_send(ring, &w[gi], sizeof(float));
+        ring_recv(&wj, s2, sizeof(float));
+      } else {
+        wj = w[fid[j]];
+      }
+      m += wj * fval[j];
+      x2 += fval[j] * fval[j];
+    }
+    float l = 1.0f - y * m;
+    if (l < 0.0f) l = 0.0f;
+    hinge += l;
+    if (y * m <= 0.0f) ++mistakes;
+    if (l > 0.0f && x2 > 0.0f) {
+      float tau;
+      if (variant == 0) {
+        tau = l / x2;
+      } else if (variant == 1) {
+        tau = l / x2;
+        if (tau > C) tau = C;
+      } else {
+        tau = l / (x2 + 0.5f / C);
+      }
+      float step = tau * y;
+      for (long j = 0; j < nnz; ++j) {
+        if (fval[j] == 0.0f) continue;
+        if (ps_mode) {
+          float msg[2];
+          int32_t* mid = reinterpret_cast<int32_t*>(&msg[0]);
+          *mid = fid[j];
+          msg[1] = step * fval[j];
+          char* s3 = ring_send(ring, msg, sizeof(msg));
+          ring_recv(msg, s3, sizeof(msg));
+          w[*reinterpret_cast<int32_t*>(&msg[0])] += msg[1];
+        } else {
+          w[fid[j]] += step * fval[j];
+        }
+      }
+    }
+  }
+  double dt = now_s() - t0;
+  if (mean_hinge) *mean_hinge = hinge / (n > 0 ? n : 1);
+  if (mistake_frac)
+    *mistake_frac = static_cast<double>(mistakes) / (n > 0 ? n : 1);
+  free(w);
+  return dt;
+}
+
 }  // extern "C"

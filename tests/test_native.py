@@ -227,3 +227,18 @@ def test_baseline_logreg_learns_and_modes_agree(lib):
     assert ll_ps < 0.6        # well below chance logloss 0.693
     assert abs(ll_ps - ll_id) < 1e-6
     assert s_ps > 0 and s_id > 0
+
+
+def test_baseline_pa_learns_and_modes_agree(lib):
+    rng = np.random.default_rng(4)
+    nf, nnz, n = 3000, 8, 30000
+    ids = rng.integers(0, nf, (n, nnz)).astype(np.int32)
+    vals = rng.normal(0, 1, (n, nnz)).astype(np.float32)
+    w_true = rng.normal(0, 1, nf)
+    y = np.where((vals * w_true[ids]).sum(1) > 0, 1.0, -1.0).astype(
+        np.float32)
+    s_ps, h_ps, m_ps = lib.baseline_pa(ids, vals, y, nf, ps_mode=True)
+    s_id, h_id, m_id = lib.baseline_pa(ids, vals, y, nf, ps_mode=False)
+    assert m_ps < 0.35          # online mistakes well below chance 0.5
+    assert abs(h_ps - h_id) < 1e-6 and abs(m_ps - m_id) < 1e-9
+    assert s_ps > 0 and s_id > 0
