@@ -59,6 +59,7 @@ def main(argv=None) -> int:
         train_test_split,
     )
 
+    dense = 0
     if args.input:
         # Real dataset (Criteo TSV with hashed categoricals, or svmlight).
         fmt = args.input_format
@@ -69,6 +70,12 @@ def main(argv=None) -> int:
             num_features=args.num_features if fmt == "criteo" else None,
             nnz_cap=args.nnz_cap,
         )
+        if fmt == "criteo":
+            # The Criteo loader's fixed-slot layout (numeric column j at
+            # slot j) lets the worker handle those 13 weights densely —
+            # one static pull + one combined push per step instead of 13
+            # scatter rows per example (LogRegConfig.dense_features).
+            dense = 13
     else:
         data = synthetic_sparse_classification(
             args.num_examples, args.num_features, args.nnz, seed=args.seed
@@ -83,7 +90,7 @@ def main(argv=None) -> int:
 
     cfg = LogRegConfig(num_features=args.num_features,
                        learning_rate=args.learning_rate, l2=args.l2,
-                       optimizer=args.optimizer)
+                       optimizer=args.optimizer, dense_features=dense)
     trainer, store = logistic_regression(mesh, cfg, sync_every=args.sync_every)
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
