@@ -501,6 +501,12 @@ def run_pa(args):
     else:
         data = synthetic_sparse_classification(NEX, NF, NNZ, seed=3,
                                                noise=0.05)
+    # PA (model and native baseline alike) requires labels in {-1,+1};
+    # svmlight files commonly carry 0/1, which would pin the hinge at 1.0
+    # for negative rows. (run_logreg's analog maps to {0,1} instead —
+    # logloss wants probabilities, hinge wants signs.)
+    data = dict(data, label=np.where(data["label"] > 0, 1.0,
+                                     -1.0).astype(np.float32))
 
     C = 1.0
     # MEASURED baseline FIRST (quiet pre-TPU window).

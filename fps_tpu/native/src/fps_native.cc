@@ -577,7 +577,17 @@ struct Ring {
   long w;
   Ring() : data(static_cast<char*>(malloc(SLOT * NSLOT))), w(0) {}
   ~Ring() { free(data); }
+  bool ok() const { return data != nullptr; }
 };
+
+// Move an int32 id in and out of a float-typed message slot without
+// violating strict aliasing; memcpy compiles to the same single store/load.
+inline void put_id(float* slot, int32_t id) { memcpy(slot, &id, sizeof(id)); }
+inline int32_t get_id(const float* slot) {
+  int32_t id;
+  memcpy(&id, slot, sizeof(id));
+  return id;
+}
 
 __attribute__((noinline)) char* ring_send(Ring& r, const void* src,
                                           long nbytes) {
@@ -629,6 +639,11 @@ double fps_baseline_mf(const int32_t* users, const int32_t* items,
     Q[k] = static_cast<float>((rng.uniform() - 0.5) * 0.2);
 
   Ring ring;
+  if (ps_mode && !ring.ok()) {
+    free(P);
+    free(Q);
+    return -1.0;
+  }
   float qbuf[128];
   float dbuf[129];
   double total = 0.0;
@@ -658,9 +673,7 @@ double fps_baseline_mf(const int32_t* users, const int32_t* items,
       se += static_cast<double>(err) * err;
       if (ps_mode) {
         // local user update + push message (id + rank floats) -> server.
-        dbuf[0] = 0.0f;
-        int32_t* did = reinterpret_cast<int32_t*>(&dbuf[0]);
-        *did = static_cast<int32_t>(i);
+        put_id(&dbuf[0], static_cast<int32_t>(i));
         for (int d = 0; d < rank; ++d) {
           float pd = p[d];
           dbuf[1 + d] = lr * (err * pd - reg * q[d]);
@@ -668,7 +681,7 @@ double fps_baseline_mf(const int32_t* users, const int32_t* items,
         }
         char* s3 = ring_send(ring, dbuf, sizeof(float) * (rank + 1));
         ring_recv(dbuf, s3, sizeof(float) * (rank + 1));
-        float* qrow = Q + (*reinterpret_cast<int32_t*>(&dbuf[0])) * rank;
+        float* qrow = Q + get_id(&dbuf[0]) * rank;
         for (int d = 0; d < rank; ++d) qrow[d] += dbuf[1 + d];
       } else {
         float* qrow = Q + i * rank;
@@ -712,6 +725,11 @@ double fps_baseline_w2v(const int32_t* centers, const int32_t* contexts,
   memset(OUT, 0, sizeof(float) * vocab * dim);
 
   Ring ring;
+  if (ps_mode && !ring.ok()) {
+    free(IN);
+    free(OUT);
+    return -1.0;
+  }
   float vbuf[128], ubuf[128], dbuf[129];
   double loss = 0.0;
   double t0 = now_s();
@@ -768,24 +786,22 @@ double fps_baseline_w2v(const int32_t* centers, const int32_t* contexts,
                   : -__builtin_log(1.0f - sig > 1e-7f ? 1.0f - sig : 1e-7f);
       for (int d = 0; d < dim; ++d) dv[d] -= lr * g * u[d];
       if (ps_mode) {
-        int32_t* did = reinterpret_cast<int32_t*>(&dbuf[0]);
-        *did = static_cast<int32_t>(o);
+        put_id(&dbuf[0], static_cast<int32_t>(o));
         for (int d = 0; d < dim; ++d) dbuf[1 + d] = -lr * g * v[d];
         char* s3 = ring_send(ring, dbuf, sizeof(float) * (dim + 1));
         ring_recv(dbuf, s3, sizeof(float) * (dim + 1));
-        float* orow = OUT + (*reinterpret_cast<int32_t*>(&dbuf[0])) * dim;
+        float* orow = OUT + get_id(&dbuf[0]) * dim;
         for (int d = 0; d < dim; ++d) orow[d] += dbuf[1 + d];
       } else {
         for (int d = 0; d < dim; ++d) u[d] -= lr * g * v[d];
       }
     }
     if (ps_mode) {
-      int32_t* did = reinterpret_cast<int32_t*>(&dbuf[0]);
-      *did = static_cast<int32_t>(c);
+      put_id(&dbuf[0], static_cast<int32_t>(c));
       for (int d = 0; d < dim; ++d) dbuf[1 + d] = dv[d];
       char* s3 = ring_send(ring, dbuf, sizeof(float) * (dim + 1));
       ring_recv(dbuf, s3, sizeof(float) * (dim + 1));
-      float* crow = IN + (*reinterpret_cast<int32_t*>(&dbuf[0])) * dim;
+      float* crow = IN + get_id(&dbuf[0]) * dim;
       for (int d = 0; d < dim; ++d) crow[d] += dbuf[1 + d];
     } else {
       for (int d = 0; d < dim; ++d) v[d] += dv[d];
@@ -810,6 +826,10 @@ double fps_baseline_logreg(const int32_t* ids, const float* vals,
   float* w = static_cast<float*>(calloc(num_features, sizeof(float)));
   if (!w) return -1.0;
   Ring ring;
+  if (ps_mode && !ring.ok()) {
+    free(w);
+    return -1.0;
+  }
   double loss = 0.0;
   double t0 = now_s();
   for (long k = 0; k < n; ++k) {
@@ -841,12 +861,11 @@ double fps_baseline_logreg(const int32_t* ids, const float* vals,
       if (fval[j] == 0.0f) continue;
       if (ps_mode) {
         float msg[2];
-        int32_t* mid = reinterpret_cast<int32_t*>(&msg[0]);
-        *mid = fid[j];
+        put_id(&msg[0], fid[j]);
         msg[1] = -g * fval[j];
         char* s3 = ring_send(ring, msg, sizeof(msg));
         ring_recv(msg, s3, sizeof(msg));
-        w[*reinterpret_cast<int32_t*>(&msg[0])] += msg[1];
+        w[get_id(&msg[0])] += msg[1];
       } else {
         w[fid[j]] -= g * fval[j];
       }
@@ -872,6 +891,10 @@ double fps_baseline_pa(const int32_t* ids, const float* vals,
   float* w = static_cast<float*>(calloc(num_features, sizeof(float)));
   if (!w) return -1.0;
   Ring ring;
+  if (ps_mode && !ring.ok()) {
+    free(w);
+    return -1.0;
+  }
   double hinge = 0.0;
   long mistakes = 0;
   double t0 = now_s();
@@ -914,12 +937,11 @@ double fps_baseline_pa(const int32_t* ids, const float* vals,
         if (fval[j] == 0.0f) continue;
         if (ps_mode) {
           float msg[2];
-          int32_t* mid = reinterpret_cast<int32_t*>(&msg[0]);
-          *mid = fid[j];
+          put_id(&msg[0], fid[j]);
           msg[1] = step * fval[j];
           char* s3 = ring_send(ring, msg, sizeof(msg));
           ring_recv(msg, s3, sizeof(msg));
-          w[*reinterpret_cast<int32_t*>(&msg[0])] += msg[1];
+          w[get_id(&msg[0])] += msg[1];
         } else {
           w[fid[j]] += step * fval[j];
         }
