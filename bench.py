@@ -671,14 +671,32 @@ def main():
     args = ap.parse_args()
 
     if args.workload == "all":
-        # Headline (mf) LAST: the driver's artifact parses the final JSON
-        # line and its tail window shows the rest.
+        # Headline (mf) LAST among the per-workload lines.
         order = ["w2v", "logreg", "pa", "ials", "mf"]
     else:
         order = [args.workload]
+    results = {}
     for name in order:
         print(f"--- workload: {name} ---", file=sys.stderr)
-        print(json.dumps(RUNNERS[name](args)), flush=True)
+        results[name] = RUNNERS[name](args)
+        print(json.dumps(results[name]), flush=True)
+
+    if args.workload == "all":
+        # Self-certifying artifact: the driver parses the FINAL line and
+        # keeps only a bounded tail, so the last line must carry every
+        # workload's result by itself (round 3's tail truncated mid-stream
+        # and lost the w2v headline). Top-level keys stay the mf headline
+        # for the driver's metric/value/vs_baseline parse; the full
+        # per-workload dicts ride in "workloads".
+        mf = results["mf"]
+        combined = {
+            "metric": mf["metric"],
+            "value": mf["value"],
+            "unit": mf["unit"],
+            "vs_baseline": mf["vs_baseline"],
+            "workloads": results,
+        }
+        print(json.dumps(combined), flush=True)
 
 
 if __name__ == "__main__":
