@@ -36,13 +36,20 @@ def main(argv=None) -> int:
     ap.add_argument("--window", type=int, default=5)
     ap.add_argument("--negatives", type=int, default=5)
     ap.add_argument("--learning-rate", type=float, default=0.025)
+    ap.add_argument("--sketch-words", type=int, default=0,
+                    help="track the P most frequent words' co-occurrence "
+                         "similarity with a tug-of-war sketch riding the "
+                         "training loop (host-ingest path only; 0 = off)")
     args = ap.parse_args(argv)
 
     from fps_tpu.core.driver import num_workers_of
     from fps_tpu.models.word2vec import (
         W2VConfig,
         Word2VecDevicePlan,
+        accumulate_sketch_taps,
+        cooccurrence_sketch_tap,
         nearest_neighbors,
+        sketch_similarity,
         skipgram_chunks,
         word2vec,
         word2vec_block,
@@ -58,6 +65,27 @@ def main(argv=None) -> int:
 
     cfg = W2VConfig(vocab_size=vocab, dim=args.dim, window=args.window,
                     negatives=args.negatives, learning_rate=args.learning_rate)
+
+    sketch_probe = None
+    step_tap = None
+    if args.sketch_words > 0:
+        if args.ingest == "device":
+            # The block worker never materializes its pairs, so there is
+            # nothing batch-visible to sketch on the fused path.
+            emit({"event": "warning",
+                  "msg": "--sketch-words needs the host-ingest pair path; "
+                         "ignored with --ingest device"})
+        else:
+            from fps_tpu.sketch import TugOfWarSpec
+
+            sketch_probe = np.argsort(-uni)[: args.sketch_words].astype(
+                np.int32
+            )
+            step_tap = cooccurrence_sketch_tap(
+                TugOfWarSpec(depth=5, width=1024, seed=args.seed),
+                sketch_probe,
+            )
+
     block_len = max(64, args.local_batch // (2 * cfg.window))
     if args.ingest == "device":
         # Block-granularity worker: one pull/push row per block position
@@ -68,16 +96,20 @@ def main(argv=None) -> int:
         )
     else:
         trainer, store = word2vec(mesh, cfg, uni, sync_every=args.sync_every,
-                                  max_steps_per_call=256)
+                                  max_steps_per_call=256, step_tap=step_tap)
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
 
     total_pairs = 0.0
+    sketch_sum = None
 
     def report(i, m):
-        nonlocal total_pairs
+        nonlocal total_pairs, sketch_sum
         n = max(1.0, float(np.sum(m["n"])))
         total_pairs += n
+        if sketch_probe is not None and "tap" in m:
+            part = accumulate_sketch_taps([m])
+            sketch_sum = part if sketch_sum is None else sketch_sum + part
         emit({"event": "chunk", "i": i,
               "sgns_loss": float(np.sum(m["loss"]) / n)})
 
@@ -118,6 +150,12 @@ def main(argv=None) -> int:
     emit({"event": "done", "pairs_per_sec": total_pairs / max(dt, 1e-9),
           "words_per_sec": args.epochs * len(tokens) / max(dt, 1e-9),
           "seconds": dt})
+
+    if sketch_sum is not None:
+        sims = sketch_similarity(sketch_sum)
+        emit({"event": "cooccurrence_similarity",
+              "probe_words": sketch_probe,
+              "inner_products": np.round(sims, 1)})
 
     # Qualitative: neighbors of a few frequent words (ids 1..4; 0 may be UNK).
     probes = np.arange(1, 5)

@@ -358,9 +358,33 @@ def make_store(mesh, cfg: W2VConfig) -> ParamStore:
 
 
 def _make_trainer(mesh, cfg: W2VConfig, worker, *, sync_every, donate,
-                  max_steps_per_call, push_delay=0):
+                  max_steps_per_call, push_delay=0, step_tap=None):
     from fps_tpu.core.api import MEAN_COMBINE
     from fps_tpu.core.driver import Trainer, TrainerConfig
+
+    if push_delay >= 16:
+        import warnings
+
+        # Measured guardrail (docs/STALENESS.md finding #5): the staleness
+        # sweep holds SGNS partner recovery at 0.675-0.700 through s=64
+        # STALE READS at full lr, but the delayed-WRITE diagonal with the
+        # lr-downscale recipe collapses it — 0.125 at s=d=16, 0.050 at
+        # s=d=64 (chance 0.017). The mechanism is under-training, not
+        # divergence: the downscale that stabilizes MF's bilinear objective
+        # leaves the non-convex SGNS objective barely moving.
+        downscaled = cfg.learning_rate < W2VConfig.learning_rate
+        warnings.warn(
+            f"word2vec with push_delay={push_delay}"
+            + (f" and downscaled learning_rate={cfg.learning_rate} "
+               f"(< default {W2VConfig.learning_rate})" if downscaled
+               else "")
+            + ": the measured staleness sweep (docs/STALENESS.md finding "
+            "#5) collapsed SGNS quality in this regime (partner recovery "
+            "0.70 -> 0.125 at delay 16 with the lr-downscale recipe). "
+            "Prefer bounding READS (sync_every) at full lr and keeping "
+            "push_delay small or zero.",
+            UserWarning, stacklevel=3,
+        )
 
     store = make_store(mesh, cfg)
     # Per-id mean combine: with Zipfian word frequencies a hot id appears
@@ -370,14 +394,15 @@ def _make_trainer(mesh, cfg: W2VConfig, worker, *, sync_every, donate,
         mesh, store, worker, server_logic=MEAN_COMBINE,
         config=TrainerConfig(sync_every=sync_every, donate=donate,
                              max_steps_per_call=max_steps_per_call,
-                             push_delay=push_delay),
+                             push_delay=push_delay, step_tap=step_tap),
     )
     return trainer, store
 
 
 def word2vec(mesh, cfg: W2VConfig, unigram_counts: np.ndarray, *,
              sync_every: int | None = None, donate: bool = True,
-             max_steps_per_call: int | None = None, push_delay: int = 0):
+             max_steps_per_call: int | None = None, push_delay: int = 0,
+             step_tap=None):
     """(trainer, store) — the analog of the reference's word2vec transform.
     ``sync_every``/``push_delay`` select SSP staleness brackets exactly as
     in :func:`fps_tpu.models.matrix_factorization.online_mf`."""
@@ -385,6 +410,7 @@ def word2vec(mesh, cfg: W2VConfig, unigram_counts: np.ndarray, *,
         mesh, cfg, Word2VecWorker(cfg, unigram_counts),
         sync_every=sync_every, donate=donate,
         max_steps_per_call=max_steps_per_call, push_delay=push_delay,
+        step_tap=step_tap,
     )
 
 
@@ -543,6 +569,76 @@ def nearest_neighbors(store: ParamStore, word_ids: np.ndarray, k: int = 5,
     sims = q @ emb.T
     order = np.argsort(-sims, axis=1)
     return order[:, 1 : k + 1], np.take_along_axis(sims, order, 1)[:, 1 : k + 1]
+
+
+# ---------------------------------------------------------------------------
+# Streaming co-occurrence similarity via tug-of-war sketches (step_tap).
+#
+# The reference family's sketch module estimated word co-occurrence
+# similarity from the pair stream without storing the |V|x|V| matrix
+# (SURVEY.md §2 #10, [conf: L]). Here the estimator RIDES THE TRAINING
+# LOOP: a ``step_tap`` sketches each probe word's context distribution
+# from the very batches the SGNS worker trains on — no second pass over
+# the corpus, no extra host<->device traffic beyond the (P, depth, width)
+# delta that joins the metrics stream.
+# ---------------------------------------------------------------------------
+
+def cooccurrence_sketch_tap(spec, probe_ids):
+    """``step_tap`` emitting per-step tug-of-war sketch DELTAS of each probe
+    word's context-frequency vector.
+
+    For every training batch the tap sketches ``{context: weight}`` of the
+    pairs whose center is ``probe_ids[p]`` into row ``p`` of a
+    ``(P, depth, width)`` stack. Sketches are additive, so the stream
+    sketch is just the sum of the emitted deltas over steps AND workers —
+    exactly :func:`accumulate_sketch_taps`. Pad pairs carry weight 0 and
+    vanish from the estimate.
+
+    Works with the PAIR worker's batch schema (``center``/``context``/
+    ``weight`` columns — :func:`skipgram_chunks` and the pair-mode
+    :class:`Word2VecDevicePlan`); the block worker never materializes its
+    pairs, so it has nothing batch-visible to sketch.
+    """
+    from fps_tpu.sketch import tow_update_rows
+
+    probe = jnp.asarray(probe_ids, jnp.int32)  # (P,)
+    P = int(probe.shape[0])
+
+    def tap(tables, batch, local_state, t):
+        del tables, local_state, t
+        ctx = batch["context"].astype(jnp.int32)  # (B,)
+        center = batch["center"].astype(jnp.int32)
+        w = batch["weight"].astype(jnp.float32)
+        # One O(B*P) compare to route each pair to its probe row (or drop),
+        # then ONE scatter into the flattened stack — not a full-width
+        # scatter per probe.
+        eq = center[:, None] == probe[None, :]  # (B, P)
+        row = jnp.where(eq.any(axis=1), jnp.argmax(eq, axis=1), -1)
+        stack = jnp.zeros((P, spec.depth, spec.width), jnp.float32)
+        return tow_update_rows(spec, stack, row, ctx, w)
+
+    return tap
+
+
+def accumulate_sketch_taps(metrics) -> np.ndarray:
+    """Sum the ``tap`` channel of ``fit_stream``/``run_indexed`` metrics
+    into the stream's (P, depth, width) co-occurrence sketch stack."""
+    total = None
+    for m in metrics:
+        # (steps, W, P, depth, width) -> (P, depth, width)
+        part = np.asarray(m["tap"]).sum(axis=(0, 1))
+        total = part if total is None else total + part
+    if total is None:
+        raise ValueError("no metrics chunks — nothing was trained")
+    return total
+
+
+def sketch_similarity(sketches: np.ndarray) -> np.ndarray:
+    """(P, P) unbiased co-occurrence inner-product estimates among the
+    probe words (median-of-rows tug-of-war estimator, all on host — one
+    einsum, not P^2 device dispatches)."""
+    s = np.asarray(sketches)
+    return np.median(np.einsum("pdw,qdw->pqd", s, s), axis=-1)
 
 
 # ---------------------------------------------------------------------------

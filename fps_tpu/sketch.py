@@ -155,6 +155,27 @@ def tow_update(spec: TugOfWarSpec, sketch: Array, ids: Array,
     return updated.reshape(spec.depth, spec.width)
 
 
+def tow_update_rows(spec: TugOfWarSpec, stack: Array, rows: Array,
+                    ids: Array, values: Array | None = None) -> Array:
+    """Batched multi-sketch update: add each id's tug-of-war contribution
+    into ``stack[rows[b]]`` of a ``(P, depth, width)`` sketch stack in ONE
+    scatter (vs one full-width scatter per stack row). Items with
+    ``rows < 0`` or ``ids < 0`` are dropped."""
+    P = stack.shape[0]
+    a1, b1, a2, b2 = spec.constants()
+    cols = _bucket(ids, a1, b1, spec.width)  # (depth, B)
+    signs = _sign(ids, a2, b2)  # (depth, B)
+    v = jnp.ones(ids.shape, jnp.float32) if values is None else values
+    v = jnp.where((ids >= 0) & (rows >= 0), v.astype(jnp.float32), 0.0)
+    r = jnp.clip(rows, 0, P - 1).astype(jnp.int32)  # dropped rows add 0.0
+    d = jnp.arange(spec.depth, dtype=jnp.int32)[:, None]
+    flat = (r[None, :] * spec.depth + d) * spec.width + cols  # (depth, B)
+    updated = stack.reshape(-1).at[flat.reshape(-1)].add(
+        (signs * v[None, :]).reshape(-1)
+    )
+    return updated.reshape(stack.shape)
+
+
 def tow_inner(s1: Array, s2: Array) -> Array:
     """Unbiased estimate of the inner product of the two sketched frequency
     vectors — the co-occurrence-similarity estimator (median over rows)."""

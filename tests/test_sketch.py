@@ -136,3 +136,24 @@ def test_sketch_inside_compiled_step_and_psum_merge(devices8):
     ))
     single = sk.cm_update(spec, sk.cm_init(spec), jnp.asarray(ids))
     np.testing.assert_allclose(np.asarray(merged), np.asarray(single))
+
+
+def test_tow_update_rows_matches_per_row_updates():
+    """The batched multi-sketch scatter must equal P independent
+    tow_update calls with per-row masks (drop semantics included)."""
+    spec = sk.TugOfWarSpec(depth=3, width=64, seed=11)
+    rng = np.random.default_rng(0)
+    B, P = 200, 4
+    ids = rng.integers(-1, 500, B).astype(np.int32)
+    rows = rng.integers(-1, P, B).astype(np.int32)
+    vals = rng.random(B).astype(np.float32)
+
+    stack = sk.tow_update_rows(
+        spec, jnp.zeros((P, spec.depth, spec.width), jnp.float32),
+        jnp.asarray(rows), jnp.asarray(ids), jnp.asarray(vals),
+    )
+    for p in range(P):
+        ref = sk.tow_update(spec, sk.tow_init(spec), jnp.asarray(ids),
+                            jnp.asarray(np.where(rows == p, vals, 0.0)))
+        np.testing.assert_allclose(np.asarray(stack[p]), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
