@@ -105,15 +105,47 @@ def _use_pallas() -> tuple[bool, bool]:
     return _BACKEND == "pallas", True
 
 
+# Scalar-table (D == 1) lane-packed routing. XLA's TPU gather AND scatter
+# are per-row-transaction bound (~8 ns/row at B = 2^20, dedup-safe T=256
+# measurement), so a dim-1 table pays ~8 ns per SCALAR moved. The dim-1
+# kernels pack 128 rows per lane row and build the one-hot + lane
+# placement in-kernel: at the PA workload shape (47k rows, 2^20 ids,
+# 95% duplication) measured 2.8 ms vs XLA's 7.7 (scatter) / 8.2 (gather)
+# ms per call. Kernel cost scales with ceil(R/128), so the win inverts
+# around R ~ 120-150k rows; the cap below keeps a safety margin. Reads
+# and duplicate sums carry the hi+lo bf16 contract (~16 mantissa bits) —
+# see scatter_add_packed_pallas — hence bit-exactness across backends is
+# not promised for routed shapes (CPU "auto" stays on XLA).
+DIM1_MAX_ROWS = 100_000
+DIM1_MIN_BATCH = 8_192
+
+
+def _route_dim1(R: int, D: int, B: int, dtype=jnp.float32) -> bool:
+    if D != 1 or _BACKEND == "xla":
+        return False
+    # The kernels carry values as bf16 hi+lo: f64 would silently lose 8
+    # mantissa bits and integer tables their exact-add semantics.
+    dt = jnp.dtype(dtype)
+    if dt.itemsize > 4 or not jnp.issubdtype(dt, jnp.floating):
+        return False
+    if not (_on_tpu() or _BACKEND == "pallas"):
+        return False
+    return R <= DIM1_MAX_ROWS and B >= DIM1_MIN_BATCH
+
+
 def gather_rows(table: Array, ids: Array) -> Array:
     """``table[ids]``; ids outside ``[0, rows)`` yield **zero rows** on every
     backend (the pull path's ``-1`` padding slots read as zeros; real pulls
     are always in range)."""
     R, D = table.shape
+    if _route_dim1(R, D, ids.shape[0], table.dtype):
+        from fps_tpu.ops.pallas_kernels import gather_rows_dim1_pallas
+
+        return gather_rows_dim1_pallas(table, ids, interpret=not _on_tpu())
     # Forced-pallas only: XLA's gather is not collision-serialized, and
     # dedup-safe on-chip measurement shows it matching or beating the
     # one-hot kernel at the shipped workloads' shapes, so "auto" never
-    # routes gathers to Pallas.
+    # routes WIDE gathers to Pallas (the dim-1 route above is measured).
     if _BACKEND == "pallas" and D >= 64 and (
         R * ids.shape[0] * D <= SCATTER_FLOP_BUDGET
     ):
@@ -163,6 +195,13 @@ def scatter_add(
     # scatter, which adds in the table's native dtype.
     if jnp.dtype(table.dtype).itemsize > 4:
         return _xla_scatter_add(table, ids, deltas)
+
+    if _route_dim1(R, D, ids.shape[0], table.dtype):
+        from fps_tpu.ops.pallas_kernels import scatter_add_dim1_pallas
+
+        return scatter_add_dim1_pallas(table, ids, deltas,
+                                       row_tile=512, batch_tile=8192,
+                                       interpret=not _on_tpu())
 
     if use and hot_rows >= R > 0:
         # Whole-shard packed routing (hot_ids="auto" below the measured

@@ -226,18 +226,7 @@ def scatter_add_packed_pallas(
         ).reshape(B, pack * D)
     else:
         dt = deltas.astype(jnp.float32)
-    # Explicit mantissa-truncation split: hi = dt's top 16 bits (exactly a
-    # bf16 value), lo = the remainder (exact in f32, fits bf16 to ~2^-16
-    # relative). A plain ``dt.astype(bf16)`` round-trip is NOT safe here:
-    # under ``--xla_allow_excess_precision`` XLA may keep the f32 value
-    # through the downcast-upcast pair, making lo == 0 and silently
-    # degrading the contraction to single-pass bf16.
-    hi_f32 = jax.lax.bitcast_convert_type(
-        jax.lax.bitcast_convert_type(dt, jnp.int32) & jnp.int32(-65536),
-        jnp.float32,
-    )
-    hi = hi_f32.astype(jnp.bfloat16)
-    lo = (dt - hi_f32).astype(jnp.bfloat16)
+    hi, lo = _split_hi_lo(dt)
     # One kernel pass over 2B rows: [hi; lo] with duplicated ids.
     ids_cat = jnp.concatenate([prow, prow])
     d_cat = jnp.concatenate([hi, lo])
@@ -262,6 +251,209 @@ def scatter_add_packed_pallas(
     )(ids2, d2)
     upd = acc.reshape(rp * pack, D)[:R]
     return table + upd.astype(table.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Dim-1 lane-packed kernels: scalar tables (PA / logreg weight vectors).
+#
+# For D == 1 the generic packed path's XLA-side lane placement materializes
+# a (B, 128) delta matrix in HBM — at the PA workload shape (B = 2^20 ids
+# into a 47k-row scalar table) that is ~0.5 GB per step and measured to
+# cost as much as the XLA scatter it replaces (~12 vs ~13.5 ms/step).
+# These kernels move BOTH the packed-row one-hot and the lane placement
+# inside the kernel: HBM traffic is just ids + deltas (8 MB), and the MXU
+# pays (R/128) x B x 128 MACs per precision pass. Measured on-chip at the
+# PA shape (dedup-safe scan timing): scatter 13.5 -> ~1.3 ms, gather
+# 14.5 -> ~1.3 ms (see tools/bench_scatter.py pa_shape).
+#
+# Precision contract matches scatter_add_packed_pallas: f32 values ride as
+# hi+lo bf16 halves (~16 of 24 mantissa bits) with exact f32 MXU
+# accumulation; gathered rows and duplicate sums can differ from XLA in
+# the low mantissa bits.
+# ---------------------------------------------------------------------------
+
+def _split_hi_lo(x: Array) -> tuple[Array, Array]:
+    """f32 -> (hi, lo) bf16 with x == hi + lo to ~2^-16 relative.
+
+    Explicit mantissa-truncation split: hi = x's top 16 bits (exactly a
+    bf16 value), lo = the remainder (exact in f32, fits bf16 to ~2^-16
+    relative). A plain ``x.astype(bf16)`` round-trip is NOT safe here:
+    under ``--xla_allow_excess_precision`` XLA may keep the f32 value
+    through the downcast-upcast pair, making lo == 0 and silently
+    degrading the contraction to single-pass bf16."""
+    hi_f32 = jax.lax.bitcast_convert_type(
+        jax.lax.bitcast_convert_type(x, jnp.int32) & jnp.int32(-65536),
+        jnp.float32,
+    )
+    return hi_f32.astype(jnp.bfloat16), (x - hi_f32).astype(jnp.bfloat16)
+
+
+def _scatter_dim1_kernel(ids_ref, deltas_ref, out_ref, *, row_tile):
+    """out[(id // 128), (id % 128)] += delta, packed rows x 128 lanes."""
+    i = pl.program_id(0)  # packed-row tile (slow)
+    j = pl.program_id(1)  # batch tile (fast: out block stays resident)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bt = ids_ref.shape[1]
+    ids = ids_ref[:]  # (1, bt) int32; negative = drop
+    # Arithmetic shift keeps negatives negative (never match a row tile).
+    prow = jax.lax.shift_right_arithmetic(ids, 7)
+    lane = jnp.bitwise_and(ids, 127)
+    rows = i * row_tile + jax.lax.broadcasted_iota(
+        jnp.int32, (row_tile, bt), dimension=0
+    )
+    onehot = (prow == rows).astype(jnp.bfloat16)  # (row_tile, bt)
+    # Lane placement IN-KERNEL: (bt, 128) bf16, built per batch tile. The
+    # deltas arrive as f32 (Mosaic cannot minor-dim-reshape 16-bit vectors)
+    # holding exactly-bf16 values from the caller's hi/lo split, so the
+    # downcast after the reshape is exact.
+    lane_col = lane.reshape(bt, 1)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (bt, 128), dimension=1)
+    dl = jnp.where(
+        lane_col == lanes, deltas_ref[:].reshape(bt, 1), 0.0
+    ).astype(jnp.bfloat16)
+    out_ref[:] += jnp.dot(onehot, dl, preferred_element_type=jnp.float32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("row_tile", "batch_tile", "interpret")
+)
+def scatter_add_dim1_pallas(
+    table: Array,
+    ids: Array,
+    deltas: Array,
+    *,
+    row_tile: int = 256,
+    batch_tile: int = 8192,
+    interpret: bool = False,
+):
+    """``table.at[ids].add(deltas)`` for a scalar table ``(R, 1)``.
+
+    ``ids (B,)`` int32 (negative/out-of-range dropped), ``deltas (B, 1)``
+    f32. hi+lo bf16 precision contract as in
+    :func:`scatter_add_packed_pallas`.
+    """
+    R, D = table.shape
+    assert D == 1, "scatter_add_dim1_pallas requires a (R, 1) table"
+    B = ids.shape[0]
+    rp = -(-R // 128)  # packed rows
+
+    hi, lo = _split_hi_lo(deltas.astype(jnp.float32).reshape(B))
+    ids_cat = jnp.concatenate([ids.astype(jnp.int32)] * 2)
+    d_cat = jnp.concatenate([hi, lo]).astype(jnp.float32)
+
+    B2 = 2 * B
+    row_tile, batch_tile = _tiles(rp, B2, row_tile, batch_tile)
+    pad_b = _round_up(B2, batch_tile) - B2
+    ids2 = jnp.pad(ids_cat, (0, pad_b), constant_values=-1).reshape(1, -1)
+    d2 = jnp.pad(d_cat, ((0, pad_b),)).reshape(1, -1)
+
+    grid = (pl.cdiv(rp, row_tile), ids2.shape[1] // batch_tile)
+    acc = pl.pallas_call(
+        functools.partial(_scatter_dim1_kernel, row_tile=row_tile),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, batch_tile), lambda i, j: (0, j)),
+            pl.BlockSpec((1, batch_tile), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((row_tile, 128), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rp, 128), jnp.float32),
+        interpret=interpret,
+    )(ids2, d2)
+    upd = acc.reshape(rp * 128, 1)[:R]
+    return table + upd.astype(table.dtype)
+
+
+def _gather_dim1_kernel(ids_ref, hi_ref, lo_ref, out_ref, *, row_tile,
+                        num_rows):
+    """out[b] = table[(id // 128), (id % 128)]; accumulate over row tiles
+    (each id matches exactly one packed row), lane-select per tile."""
+    i = pl.program_id(0)  # batch tile (slow)
+    j = pl.program_id(1)  # packed-row tile (fast: out block stays resident)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bt = ids_ref.shape[1]
+    ids = ids_ref[:]
+    prow = jax.lax.shift_right_arithmetic(ids, 7)
+    lane = jnp.bitwise_and(ids, 127)
+    rows = j * row_tile + jax.lax.broadcasted_iota(
+        jnp.int32, (bt, row_tile), dimension=1
+    )
+    onehot = (prow.reshape(bt, 1) == rows).astype(jnp.bfloat16)
+    # Boundary row tiles read past the packed table; the padding rows carry
+    # garbage (NaN in interpret mode) and 0 x NaN would poison the
+    # contraction, so zero them explicitly (cf. _gather_kernel).
+    row_ids = j * row_tile + jax.lax.broadcasted_iota(
+        jnp.int32, (row_tile, 1), dimension=0
+    )
+    live = row_ids < num_rows
+    hi_t = jnp.where(live, hi_ref[:].astype(jnp.float32), 0.0)
+    lo_t = jnp.where(live, lo_ref[:].astype(jnp.float32), 0.0)
+    t = jnp.dot(onehot, hi_t.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32)
+    t += jnp.dot(onehot, lo_t.astype(jnp.bfloat16),
+                 preferred_element_type=jnp.float32)
+    # Lane select: each id contributes from exactly one lane column.
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (bt, 128), dimension=1)
+    sel = jnp.where(lane.reshape(bt, 1) == lanes, t, 0.0)
+    out_ref[:] += jnp.sum(sel, axis=1, keepdims=True)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("row_tile", "batch_tile", "interpret")
+)
+def gather_rows_dim1_pallas(
+    table: Array,
+    ids: Array,
+    *,
+    row_tile: int = 512,
+    batch_tile: int = 4096,
+    interpret: bool = False,
+):
+    """``table[ids]`` for a scalar table ``(R, 1)``; ids outside ``[0, R)``
+    produce zero rows. Values carry the hi+lo bf16 precision contract
+    (~16 mantissa bits) — callers needing bit-exact reads use the XLA
+    gather."""
+    R, D = table.shape
+    assert D == 1, "gather_rows_dim1_pallas requires a (R, 1) table"
+    B = ids.shape[0]
+    rp = -(-R // 128)
+
+    packed = jnp.pad(
+        table.astype(jnp.float32).reshape(-1), (0, rp * 128 - R)
+    ).reshape(rp, 128)
+    hi, lo = _split_hi_lo(packed)
+
+    # Mask ALL out-of-range ids to the -1 drop sentinel: ids in [R, rp*128)
+    # would lane-select table padding, and larger ids can land a packed row
+    # inside the final row tile's BLOCK padding, whose contents are
+    # undefined — the zero-row contract must not depend on either.
+    ids = jnp.where((ids >= 0) & (ids < R), ids.astype(jnp.int32), -1)
+    row_tile, batch_tile = _tiles(rp, B, row_tile, batch_tile)
+    pad_b = _round_up(B, batch_tile) - B
+    ids2 = jnp.pad(ids, (0, pad_b), constant_values=-1).reshape(1, -1)
+
+    grid = (ids2.shape[1] // batch_tile, pl.cdiv(rp, row_tile))
+    out = pl.pallas_call(
+        functools.partial(_gather_dim1_kernel, row_tile=row_tile,
+                          num_rows=rp),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, batch_tile), lambda i, j: (0, i)),
+            pl.BlockSpec((row_tile, 128), lambda i, j: (j, 0)),
+            pl.BlockSpec((row_tile, 128), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((batch_tile, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((ids2.shape[1], 1), jnp.float32),
+        interpret=interpret,
+    )(ids2, hi, lo)
+    return out[:B].astype(table.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -330,3 +330,81 @@ def test_hot_ids_auto_trains_equivalently(devices8):
     want = run(0)
     np.testing.assert_allclose(got_auto, want, rtol=3e-3, atol=3e-5)
     assert np.abs(want).sum() > 0  # the workload actually moved the table
+
+
+# ---------------------------------------------------------------------------
+# Dim-1 (scalar table) lane-packed kernels — the PA/logreg weight-vector
+# shape, where XLA pays ~8 ns per scalar moved (measured dedup-safe on
+# chip: dim1 kernels 2.8 ms vs XLA 7.7/8.2 ms at R=47k, B=2^20).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("R,B", [(1000, 5000), (128, 300), (47_236, 4096),
+                                 (130, 513)])
+def test_dim1_scatter_parity(R, B):
+    from fps_tpu.ops.pallas_kernels import scatter_add_dim1_pallas
+
+    rng = np.random.default_rng(1)
+    table = rng.normal(0, 1, (R, 1)).astype(np.float32)
+    # include drop sentinels and out-of-range ids
+    ids = rng.integers(-3, R + 200, B).astype(np.int32)
+    deltas = rng.normal(0, 1, (B, 1)).astype(np.float32)
+    ref = table.copy()
+    keep = (ids >= 0) & (ids < R)
+    np.add.at(ref[:, 0], ids[keep], deltas[keep, 0])
+    got = np.asarray(scatter_add_dim1_pallas(
+        jnp.asarray(table), jnp.asarray(ids), jnp.asarray(deltas),
+        interpret=True,
+    ))
+    # hi+lo bf16 contract: ~16 mantissa bits per delta.
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("R,B", [(1000, 5000), (128, 300), (47_236, 4096)])
+def test_dim1_gather_parity(R, B):
+    from fps_tpu.ops.pallas_kernels import gather_rows_dim1_pallas
+
+    rng = np.random.default_rng(2)
+    table = rng.normal(0, 1, (R, 1)).astype(np.float32)
+    ids = rng.integers(-3, R + 200, B).astype(np.int32)
+    ref = np.where(((ids >= 0) & (ids < R))[:, None],
+                   table[np.clip(ids, 0, R - 1)], 0.0)
+    got = np.asarray(gather_rows_dim1_pallas(
+        jnp.asarray(table), jnp.asarray(ids), interpret=True))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_dim1_routing_conditions(pallas_backend):
+    """_route_dim1: only scalar tables below the measured row cap at large
+    batch route to the dim-1 kernels; everything else keeps its path."""
+    assert ops._route_dim1(47_236, 1, 1 << 20)
+    assert not ops._route_dim1(47_236, 2, 1 << 20)      # not scalar
+    assert not ops._route_dim1(1_000_000, 1, 1 << 20)   # row cap
+    assert not ops._route_dim1(47_236, 1, 1024)         # batch floor
+    prev = ops.get_backend()
+    ops.set_backend("xla")
+    try:
+        assert not ops._route_dim1(47_236, 1, 1 << 20)  # forced xla
+    finally:
+        ops.set_backend(prev)
+
+
+def test_dim1_routed_scatter_and_gather_through_dispatcher(pallas_backend):
+    """The dispatcher-level ops with a routed dim-1 shape must match the
+    XLA backend to the hi+lo precision contract."""
+    rng = np.random.default_rng(3)
+    R, B = 9_000, 16_384
+    table = rng.normal(0, 1, (R, 1)).astype(np.float32)
+    ids = rng.integers(-1, R, B).astype(np.int32)
+    deltas = rng.normal(0, 1e-2, (B, 1)).astype(np.float32)
+    assert ops._route_dim1(R, 1, B)
+
+    got_s = np.asarray(ops.scatter_add(jnp.asarray(table), jnp.asarray(ids),
+                                       jnp.asarray(deltas)))
+    ref_s = table.copy()
+    keep = ids >= 0
+    np.add.at(ref_s[:, 0], ids[keep], deltas[keep, 0])
+    np.testing.assert_allclose(got_s, ref_s, rtol=2e-4, atol=2e-4)
+
+    got_g = np.asarray(ops.gather_rows(jnp.asarray(table), jnp.asarray(ids)))
+    ref_g = np.where((ids >= 0)[:, None], table[np.clip(ids, 0, None)], 0.0)
+    np.testing.assert_allclose(got_g, ref_g, rtol=2e-4, atol=2e-4)
