@@ -253,6 +253,28 @@ class Trainer:
             )
         return -(-spec.hot_ids // self.num_shards) if spec.hot_ids else 0
 
+    def _resolve_dense(self, spec) -> bool:
+        """Dense-collective route for this table on this mesh (see
+        ``TableSpec.dense_collectives``). Static per trainer — part of the
+        traced program, keyed into the compile cache via the mesh+spec."""
+        from fps_tpu.core.store import rows_per_shard
+
+        if self.num_shards * self.mesh.shape[DATA_AXIS] == 1:
+            return False  # no collectives to save; gathered route is free
+        if spec.dense_collectives == "auto":
+            rps = rows_per_shard(spec.num_ids, self.num_shards)
+            table_bytes = (
+                rps * self.num_shards * spec.dim
+                * jnp.dtype(spec.dtype).itemsize
+            )
+            return table_bytes <= ops.DENSE_TABLE_BYTES
+        if isinstance(spec.dense_collectives, str):
+            raise ValueError(
+                f"table {spec.name!r}: dense_collectives="
+                f"{spec.dense_collectives!r} — expected a bool or 'auto'"
+            )
+        return bool(spec.dense_collectives)
+
     def _apply_pushes(self, tables, pushes):
         new_tables = dict(tables)
         for name, (pids, pdeltas) in pushes.items():
@@ -268,6 +290,7 @@ class Trainer:
                 apply_fn=self.server_logic[name].apply_fn,
                 combine=self.server_logic[name].combine,
                 hot_rows=hot_local,
+                dense=self._resolve_dense(spec),
             )
         return new_tables
 
@@ -279,7 +302,10 @@ class Trainer:
         ids = self.logic.pull_ids(batch)
         if snapshot is None:
             pulled = {
-                name: pull(tables[name], tids, num_shards=self.num_shards)
+                name: pull(
+                    tables[name], tids, num_shards=self.num_shards,
+                    dense=self._resolve_dense(self.store.specs[name]),
+                )
                 for name, tids in ids.items()
             }
         else:
@@ -287,7 +313,9 @@ class Trainer:
             for name, tids in ids.items():
                 rps = tables[name].shape[0]
                 phys = id_to_phys(tids, self.num_shards, rps)
-                pulled[name] = jnp.take(snapshot[name], phys, axis=0)
+                # ops.gather_rows (not a bare take): dim-1 snapshot reads
+                # ride the same lane-packed kernel as live pulls on TPU.
+                pulled[name] = ops.gather_rows(snapshot[name], phys)
         out = self.logic.step(batch, pulled, local_state, key)
         return out.pushes, out.local_state, out.out
 

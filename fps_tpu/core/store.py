@@ -85,6 +85,7 @@ def pull(
     *,
     num_shards: int,
     shard_axis: str = SHARD_AXIS,
+    dense: bool = False,
 ) -> Array:
     """Gather parameter rows for ``ids`` from the sharded table.
 
@@ -92,6 +93,12 @@ def pull(
       local_shard: this device's ``(rps, dim)`` block of the table.
       ids: ``(B,)`` int32 parameter ids requested by this worker.
       num_shards: size of the shard axis (static).
+      dense: replicate-on-read route for SMALL tables: all_gather the
+        whole table (one table-sized collective riding ICI) and gather
+        locally — ``O(B)`` row transactions per worker instead of the
+        gathered route's ``O(W * B)`` per shard (every shard processes
+        every worker's ids). Policy: ``TableSpec.dense_collectives``,
+        resolved against :data:`fps_tpu.ops.DENSE_TABLE_BYTES`.
 
     Returns:
       ``(B, dim)`` values, one row per requested id.
@@ -100,6 +107,15 @@ def pull(
     ``ParameterServerLogic.onPullRecv`` → ``answerPull`` round trip
     (expected upstream ``.../ps/FlinkParameterServer.scala``).
     """
+    if dense:
+        # (S*rps, dim) in PHYSICAL (owner-major) layout: tiled all_gather
+        # concatenates shard s's block at rows [s*rps, (s+1)*rps).
+        full = lax.all_gather(local_shard, shard_axis, tiled=True)
+        rps = local_shard.shape[0]
+        # Negative ids read as zero rows on every route (id_to_phys would
+        # wrap them into range via the Python-semantics modulo).
+        phys = jnp.where(ids >= 0, id_to_phys(ids, num_shards, rps), -1)
+        return ops.gather_rows(full, phys)
     me = lax.axis_index(shard_axis)
     # Every shard sees every worker's request ids: (S*B,).
     all_ids = lax.all_gather(ids, shard_axis, tiled=True)
@@ -139,6 +155,7 @@ def push(
     apply_fn: Callable[[Array, Array], Array] | None = None,
     combine: str | Callable[[Array, Array], Array] = "sum",
     hot_rows: int = 0,
+    dense: bool = False,
 ) -> Array:
     """Scatter-add ``deltas`` for ``ids`` into the sharded table.
 
@@ -174,10 +191,52 @@ def push(
         write-hot (see :func:`fps_tpu.ops.scatter_add`); under the
         owner-major cyclic layout, global hot ids ``[0, H)`` land exactly
         in local rows ``[0, ceil(H / num_shards))`` on every shard.
+      dense: dense-reduce route for SMALL tables with the ADDITIVE fold:
+        each worker scatters its OWN ``B`` deltas into a table-shaped
+        zeros buffer (physical layout); an ``all_to_all`` of per-shard
+        windows plus fixed-order in-program sums (see the NOTE in the
+        body — deliberately NOT psum/psum_scatter) deliver every shard
+        its summed slice — ``O(B)`` row transactions per worker instead
+        of ``O(W * B)`` per shard, at the price of table-sized
+        collectives. Non-additive folds (``apply_fn``/non-"sum"
+        ``combine`` need per-id combine-then-apply semantics over the
+        gathered union) silently keep the gathered route.
 
     Returns:
       Updated ``(rps, dim)`` local block.
     """
+    if dense and apply_fn is None and combine == "sum":
+        # NOTE deliberate collective choice: all_to_all/all_gather move
+        # position-indexed data (order-insensitive), and the cross-worker
+        # sums below run as FIXED-ORDER in-program reductions — a psum /
+        # psum_scatter here would delegate the f32 reduction order to the
+        # backend topology and break the tested bit-identity of 1-process
+        # vs multi-process runs on the same mesh
+        # (tests/test_multiprocess.py). Payloads are table-sized either
+        # way; only small tables take this route.
+        rps = local_shard.shape[0]
+        phys = jnp.where(ids >= 0, id_to_phys(ids, num_shards, rps), -1)
+        buf = ops.scatter_add(
+            jnp.zeros((rps * num_shards, deltas.shape[1]),
+                      local_shard.dtype),
+            phys,
+            deltas,
+        )
+        if num_shards > 1:
+            # Route each shard's window of my contributions to its owner:
+            # every shard receives (S, rps, dim) — all workers' deltas for
+            # ITS rows — and folds them in shard-index order.
+            parts = lax.all_to_all(
+                buf.reshape(num_shards, rps, -1), shard_axis,
+                split_axis=0, concat_axis=0, tiled=False,
+            )
+            mine = jnp.sum(parts, axis=0)
+        else:
+            mine = buf
+        if data_axis is not None:
+            mine = jnp.sum(lax.all_gather(mine, data_axis), axis=0)
+        return local_shard + mine
+
     gathered_ids = ids
     gathered_deltas = deltas
     if data_axis is not None:
@@ -293,8 +352,24 @@ class TableSpec:
     #     many-shard regime it wins in, off on fat single-chip shards.
     # Default 0 (pure XLA): the packed path carries f32 deltas as bf16
     # hi+lo (~16 mantissa bits) and would break bit-reproducibility across
-    # shard counts, so it is opt-in.
+    # shard counts, so it is opt-in. (One default-path exception exists:
+    # f32 SCALAR tables auto-route to the dim-1 kernels on TPU — see
+    # ``fps_tpu.ops._route_dim1`` for the rationale and the xla-backend
+    # escape hatch.)
     hot_ids: int | str = 0
+    # Dense collective route (replicate-on-read / dense-reduce-on-write,
+    # :func:`pull`/:func:`push` ``dense=``): per-worker row transactions
+    # drop from the gathered route's O(W * B) per shard to O(B), at the
+    # price of table-sized collectives per step — the right trade exactly
+    # when the table is small (PA/logreg weight vectors, MF item factors).
+    #   * "auto" — on multi-device meshes, dense whenever the padded table
+    #     is at most :data:`fps_tpu.ops.DENSE_TABLE_BYTES`; single-device
+    #     meshes always take the (collective-free) gathered route.
+    #   * True / False — force. Forcing True on an embedding-scale table
+    #     turns every step into a full-table broadcast; measure first.
+    # Only the additive fold takes the dense write path; non-additive
+    # folds keep gathered writes (reads may still go dense).
+    dense_collectives: bool | str = "auto"
 
     def zeros_init(self) -> "TableSpec":
         return dataclasses.replace(

@@ -114,10 +114,31 @@ def _use_pallas() -> tuple[bool, bool]:
 # ms per call. Kernel cost scales with ceil(R/128), so the win inverts
 # around R ~ 120-150k rows; the cap below keeps a safety margin. Reads
 # and duplicate sums carry the hi+lo bf16 contract (~16 mantissa bits) —
-# see scatter_add_packed_pallas — hence bit-exactness across backends is
-# not promised for routed shapes (CPU "auto" stays on XLA).
+# see scatter_add_packed_pallas — hence bit-exactness is not promised for
+# routed shapes, neither across backends (CPU "auto" stays on XLA) nor
+# across SHARD COUNTS on TPU: the route predicate sees per-shard R and
+# the gathered W*B batch, both of which change with the mesh, so the
+# same scalar table can route at one shard count and not another. This
+# is the one deliberate default-path exception to the framework's
+# bit-reproducibility-across-shard-counts invariant (a 2.7x measured win
+# on BOTH sides of every scalar-table transaction bought it); force
+# ``set_backend("xla")`` / FPS_TPU_OPS=xla for bit-exact audits.
 DIM1_MAX_ROWS = 100_000
 DIM1_MIN_BATCH = 8_192
+
+# Small-table threshold for the store's DENSE collective route (replicate
+# on read, dense-reduce on write — fps_tpu.core.store.pull/push). The
+# gathered route's per-shard work grows with the number of workers (every
+# shard processes every worker's ids: O(W * B_local) row transactions per
+# step per shard), while the dense route pays O(B_local) transactions plus
+# table-sized collectives (all_gather on pull; all_to_all + fixed-order
+# sums on push — order-deterministic by design) that ride ICI at line
+# rate. At 8 ns/row, a worker pushing 2^20 ids on an
+# 8-way mesh saves ~7 * 8.4 ms of serialized scatter per step; a 4 MB
+# table costs ~tens of us per collective hop — the trade is lopsided for
+# every shipped small table (PA 190 KB, MF items 1.2 MB, logreg 4 MB) and
+# wrong for embedding-scale ones (w2v 20 MB+), hence the cap.
+DENSE_TABLE_BYTES = 4 << 20
 
 
 def _route_dim1(R: int, D: int, B: int, dtype=jnp.float32) -> bool:

@@ -84,6 +84,57 @@ def main():
     run("w2v 1chip", 50000, 100, 49152, alpha=0.75)
 
 
+def dim1_shapes():
+    """Scalar-table (D=1) kernels at the PA workload shape: XLA gather and
+    scatter vs the in-kernel-lane-packed dim-1 kernels (the round-4 PA
+    win; numbers quoted in fps_tpu/ops/pallas_kernels.py's dim-1 header
+    and BASELINE.md). B = 2^20 ids, Zipf(0.9), ~95% duplication."""
+    from fps_tpu.ops.pallas_kernels import (
+        gather_rows_dim1_pallas, scatter_add_dim1_pallas,
+    )
+
+    R, B = 47_236, 16_384 * 64
+    rng = np.random.default_rng(0)
+    tab = jnp.asarray(rng.normal(0, 0.1, (R, 1)), jnp.float32)
+    pop = 1.0 / np.arange(1, R + 1) ** 0.9
+    pop /= pop.sum()
+    cdf = np.cumsum(pop)
+    ids = jnp.asarray(np.searchsorted(cdf, rng.random((T, B))), jnp.int32)
+    dup = 1 - len(np.unique(np.asarray(ids[0]))) / B
+    deltas = jnp.asarray(rng.normal(0, 1e-4, (T, B, 1)), jnp.float32)
+    print(f"PA shape R={R} D=1 B={B}: dup frac {dup:.2f}", flush=True)
+
+    def scan_of(op):
+        @jax.jit
+        def f(tab, ids, deltas):
+            def body(t, x):
+                i, d = x
+                return op(t, i, d), None
+            return lax.scan(body, tab, (ids, deltas))[0]
+        return f
+
+    def gathers(take_fn):
+        def op(t, i, d):
+            v = take_fn(t, i)
+            return t + 1e-12 * jnp.sum(v)  # chain so nothing is elided
+        return op
+
+    for name, fn in (
+        ("xla scatter", scan_of(xla_scatter)),
+        ("dim1 scatter", scan_of(
+            lambda t, i, d: scatter_add_dim1_pallas(
+                t, i, d, row_tile=512, batch_tile=8192))),
+        ("xla gather", scan_of(gathers(lambda t, i: jnp.take(t, i, axis=0)))),
+        ("dim1 gather", scan_of(gathers(gather_rows_dim1_pallas))),
+    ):
+        us = timeit(fn, tab, ids, deltas)
+        print(f"{name:16s} {us / 1e3:8.2f} ms/call", flush=True)
+
+    a = np.asarray(xla_scatter(tab, ids[0], deltas[0]))
+    b = np.asarray(scatter_add_dim1_pallas(tab, ids[0], deltas[0]))
+    print(f"dim1 scatter vs xla max abs err {np.max(np.abs(a - b)):.2e}")
+
+
 
 def small_r_sweep():
     """The hot/cold split's claimed win regime (round-2 verdict #5): SMALL
@@ -114,9 +165,11 @@ if __name__ == "__main__":
         main()
     elif sys.argv[1:] == ["sweep"]:
         small_r_sweep()
+    elif sys.argv[1:] == ["dim1"]:
+        dim1_shapes()
     else:
         raise SystemExit(
             f"unknown args {sys.argv[1:]!r} — usage: bench_scatter.py "
-            "[sweep]  (no args = full workload-shape bench; 'sweep' = "
-            "small-R crossover sweep)"
+            "[sweep|dim1]  (no args = full workload-shape bench; 'sweep' "
+            "= small-R crossover sweep; 'dim1' = scalar-table PA shape)"
         )
