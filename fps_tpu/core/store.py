@@ -352,10 +352,10 @@ class TableSpec:
     #     many-shard regime it wins in, off on fat single-chip shards.
     # Default 0 (pure XLA): the packed path carries f32 deltas as bf16
     # hi+lo (~16 mantissa bits) and would break bit-reproducibility across
-    # shard counts, so it is opt-in. (One default-path exception exists:
-    # f32 SCALAR tables auto-route to the dim-1 kernels on TPU — see
-    # ``fps_tpu.ops._route_dim1`` for the rationale and the xla-backend
-    # escape hatch.)
+    # shard counts, so it is opt-in. (f32 SCALAR tables are the exception:
+    # they auto-route to the dim-1 kernels on TPU — see
+    # ``fps_tpu.ops._route_dim1`` for the precise invariant scope and the
+    # xla-backend escape hatch.)
     hot_ids: int | str = 0
     # Dense collective route (replicate-on-read / dense-reduce-on-write,
     # :func:`pull`/:func:`push` ``dense=``): per-worker row transactions

@@ -336,3 +336,25 @@ def test_packed_blowup_guard_falls_back(mesh):
     t, l = tr.init_state(jax.random.key(0))
     t, l, m = tr.run_indexed(t, l, plan, jax.random.key(1))
     assert sum(float(x["n"].sum()) for x in m) == n
+
+
+def test_negative_seed_and_sort_key_shape(devices8):
+    """epoch_args' host-side rng must accept negative seeds (SeedSequence
+    rejects negative entropy) and fabricate sort key data sized for the
+    active prng impl."""
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.parallel.mesh import make_ps_mesh
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    mesh = make_ps_mesh(num_shards=4, num_data=2)
+    ds = DeviceDataset(mesh, synthetic_ratings(32, 24, 512, seed=0))
+    for shuffle in ("interleave", "sort"):
+        plan = DeviceEpochPlan(ds, num_workers=8, local_batch=8,
+                               shuffle=shuffle, seed=-3)
+        args = plan.epoch_args(0)
+        assert args is not None
+        # deterministic per (seed, epoch)
+        a0 = jax.tree.map(lambda x: np.asarray(x), plan.epoch_args(1))
+        a1 = jax.tree.map(lambda x: np.asarray(x), plan.epoch_args(1))
+        for x, y in zip(jax.tree.leaves(a0), jax.tree.leaves(a1)):
+            np.testing.assert_array_equal(x, y)

@@ -100,6 +100,10 @@ def main():
     from fps_tpu.utils.hostenv import cpu_mesh_env, reexec_count
 
     routes = sys.argv[1:] or ["dense", "gathered"]
+    bad = [r for r in routes if r not in ("auto", "dense", "gathered")]
+    if bad:
+        raise SystemExit(f"unknown route(s) {bad!r} — choose from "
+                         "auto / dense / gathered")
     if len(jax.devices()) >= 8:
         for route in routes:
             run_curve(route)
