@@ -632,7 +632,8 @@ class Trainer:
 
     def run_indexed(self, tables, local_state, plan, key, *, epochs: int = 1,
                     on_epoch=None, checkpointer=None,
-                    checkpoint_every: int = 0, start_epoch: int = 0):
+                    checkpoint_every: int = 0, start_epoch: int = 0,
+                    as_numpy: bool = True):
         """Run ``epochs`` full passes with ingest fused into the jit.
 
         ``plan.sync_every`` must match the trainer's config. Pass a
@@ -643,6 +644,14 @@ class Trainer:
         per-epoch shuffles (``plan.epoch_args(e)``) and the PRNG stream
         (``fold_in(key, e)``) continue where the interrupted run left off.
         Returns (tables, local_state, per-epoch host metrics list).
+
+        ``as_numpy=False`` returns the metrics as DEVICE arrays without
+        blocking on them (no effect when ``on_epoch`` is given — callbacks
+        need host values). The call then returns as soon as the last
+        epoch is dispatched, letting the caller overlap host work — e.g.
+        evaluating epoch ``e``'s metrics while the device races ahead on
+        ``e+1`` (speculative epoch pipelining; the per-dispatch +
+        metric-sync round trip otherwise serializes between epochs).
         """
         mode = "sync" if self.config.sync_every is None else "ssp"
         if (self.config.sync_every or None) != (plan.sync_every or None):
@@ -700,7 +709,7 @@ class Trainer:
             checkpoint_every <= 0 or end_epoch % checkpoint_every != 0
         ):
             self._save_checkpoint(checkpointer, end_epoch, local_state)
-        if on_epoch is None:
+        if on_epoch is None and as_numpy:
             all_metrics = [jax.tree.map(np.asarray, m) for m in all_metrics]
         return tables, local_state, all_metrics
 
