@@ -464,17 +464,21 @@ def run_logreg(args):
     )
 
     tables, ls, _ = trainer.run_indexed(tables, ls, plan, jax.random.key(9))
+    # Steady-state throughput over E back-to-back epochs (see run_pa).
+    E = 2
     t0 = time.perf_counter()
     tables, ls, metrics = trainer.run_indexed(
-        tables, ls, plan, jax.random.key(1)
+        tables, ls, plan, jax.random.key(1), epochs=E, as_numpy=False,
     )
-    epoch_s = time.perf_counter() - t0
+    np.asarray(metrics[-1]["n"])
+    epoch_s = (time.perf_counter() - t0) / E
     ex_s = NEX / epoch_s / len(devs)
 
-    per0, per1 = first_last_real_step(metrics[0], "logloss")
+    per0, _ = first_last_real_step(metrics[0], "logloss")
+    _, per1 = first_last_real_step(metrics[-1], "logloss")
     print(
-        f"quality: logloss step0 {per0:.4f} -> last-real-step {per1:.4f} "
-        f"(epoch 2; chance = 0.693)",
+        f"quality: logloss step0 {per0:.4f} (epoch 2) -> last-real-step "
+        f"{per1:.4f} (epoch {E + 1}; chance = 0.693)",
         file=sys.stderr,
     )
 
@@ -494,6 +498,7 @@ def run_logreg(args):
         "unit": "examples/s",
         "vs_baseline": vs,
         "epoch_s": round(epoch_s, 3),
+        "steady_state_epochs": E,
         "baseline": baseline,
     }
 
@@ -558,17 +563,26 @@ def run_pa(args):
     plan = DeviceEpochPlan(ds, num_workers=W, local_batch=16384, seed=1)
 
     tables, ls, _ = trainer.run_indexed(tables, ls, plan, jax.random.key(9))
+    # Steady-state throughput: E back-to-back epochs in one call, blocking
+    # only on the final epoch's metrics — epochs queue on-device with no
+    # host round trip between them, the same zero-per-pass-overhead
+    # semantics the native baseline's tight loop gets. (Single-epoch
+    # timing charged ~0.2 s of dispatch + metric-sync against a ~0.25 s
+    # device epoch — measured ~90% of the device floor at E=4.)
+    E = 4
     t0 = time.perf_counter()
     tables, ls, metrics = trainer.run_indexed(
-        tables, ls, plan, jax.random.key(1)
+        tables, ls, plan, jax.random.key(1), epochs=E, as_numpy=False,
     )
-    epoch_s = time.perf_counter() - t0
+    np.asarray(metrics[-1]["n"])  # fence on the last epoch
+    epoch_s = (time.perf_counter() - t0) / E
     ex_s = NEX / epoch_s / len(devs)
 
-    per0, per1 = first_last_real_step(metrics[0], "mistakes")
+    per0, _ = first_last_real_step(metrics[0], "mistakes")
+    _, per1 = first_last_real_step(metrics[-1], "mistakes")
     print(
-        f"quality: online mistake rate step0 {per0:.4f} -> last-real-step "
-        f"{per1:.4f} (epoch 2; chance = 0.5)",
+        f"quality: online mistake rate step0 {per0:.4f} (epoch 2) -> "
+        f"last-real-step {per1:.4f} (epoch {E + 1}; chance = 0.5)",
         file=sys.stderr,
     )
 
@@ -597,14 +611,19 @@ def run_pa(args):
     mds = DeviceDataset(mesh, mdata)
     mplan = DeviceEpochPlan(mds, num_workers=W, local_batch=16384, seed=1)
     mt, mls, _ = mtr.run_indexed(mt, mls, mplan, jax.random.key(9))
+    E_MC = 2  # steady-state over 2 back-to-back epochs (as above)
     t0 = time.perf_counter()
-    mt, mls, mm = mtr.run_indexed(mt, mls, mplan, jax.random.key(1))
-    mc_epoch_s = time.perf_counter() - t0
+    mt, mls, mm = mtr.run_indexed(mt, mls, mplan, jax.random.key(1),
+                                  epochs=E_MC, as_numpy=False)
+    np.asarray(mm[-1]["n"])
+    mc_epoch_s = (time.perf_counter() - t0) / E_MC
     mc_ex_s = NEX_MC / mc_epoch_s / len(devs)
-    m0, m1 = first_last_real_step(mm[0], "mistakes")
+    m0, _ = first_last_real_step(mm[0], "mistakes")
+    _, m1 = first_last_real_step(mm[-1], "mistakes")
     print(
         f"multiclass ({NCLS} classes): online mistake rate step0 {m0:.4f} "
-        f"-> last-real-step {m1:.4f} (epoch 2; chance = {1 - 1 / NCLS:.2f})",
+        f"-> last-real-step {m1:.4f} (epoch {E_MC + 1}; "
+        f"chance = {1 - 1 / NCLS:.2f})",
         file=sys.stderr,
     )
 
@@ -614,11 +633,13 @@ def run_pa(args):
         "unit": "examples/s",
         "vs_baseline": vs,
         "epoch_s": round(epoch_s, 3),
+        "steady_state_epochs": E,
         "baseline": baseline,
         "multiclass": {
             "num_classes": NCLS,
             "examples_per_sec_per_chip": round(mc_ex_s, 1),
             "epoch_s": round(mc_epoch_s, 3),
+            "steady_state_epochs": E_MC,
             "mistake_rate_step0": round(float(m0), 4),
             "mistake_rate_last": round(float(m1), 4),
             "chance": round(1 - 1 / NCLS, 2),
