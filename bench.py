@@ -556,7 +556,19 @@ def run_pa(args):
     nd, ns = default_mesh_shape(len(devs))
     mesh = make_ps_mesh(num_shards=ns, num_data=nd)
     W = num_workers_of(mesh)
-    cfg = PAConfig(num_features=NF, variant="PA-I", C=C)
+    # Head-prefix routing (single-device meshes): frequency-sort each
+    # example's slots so the first q columns carry ids < H, and the
+    # guaranteed prefix rides head-only kernels (ceil(H/128) packed rows
+    # instead of ceil(NF/128)). Pure routing — equality-tested in
+    # tests/test_passive_aggressive.py.
+    HEAD = 2048
+    q = 0
+    if len(devs) == 1:
+        from fps_tpu.utils.datasets import head_sort_slots
+
+        data, q = head_sort_slots(data, HEAD)
+    cfg = PAConfig(num_features=NF, variant="PA-I", C=C,
+                   hot_features=HEAD if q else 0, head_prefix_cols=q)
     trainer, store = passive_aggressive(mesh, cfg, max_steps_per_call=256)
     tables, ls = trainer.init_state(jax.random.key(0))
     ds = DeviceDataset(mesh, data)

@@ -79,6 +79,18 @@ class WorkerLogic:
         """Map table name -> (B,) int32 ids to pull for this batch."""
         raise NotImplementedError
 
+    def head_prefix(self, batch: Pytree) -> Mapping[str, int]:
+        """Optional STATIC guarantee: table name -> count of LEADING ids
+        (in both :meth:`pull_ids` order and the step's push order) that
+        lie in ``[0, spec.hot_ids) ∪ {-1}`` — the frequency-ranked head a
+        sorted-slot batch layout (``head_sort_slots``) puts first. The
+        driver turns it into head-only kernel routing on single-device
+        meshes (collective routes reorder the id streams, voiding the
+        guarantee) — see ``fps_tpu.ops.gather_rows``. Counts must be
+        plain ints derived from batch SHAPES (trace-time static).
+        Default: no guarantee."""
+        return {}
+
     def step(
         self,
         batch: Pytree,

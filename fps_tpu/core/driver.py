@@ -275,7 +275,27 @@ class Trainer:
             )
         return bool(spec.dense_collectives)
 
-    def _apply_pushes(self, tables, pushes):
+    def _head_prefix(self, batch) -> dict:
+        """Resolve the worker's head-prefix guarantee for this batch.
+
+        Honored only on single-device meshes: the collective pull/push
+        routes reorder the id streams (all_gather across workers, physical
+        re-indexing in the dense route), voiding the leading-ids
+        guarantee. Requires the table to declare its frequency head via
+        ``spec.hot_ids`` (an int H — the prefix ids must lie in
+        ``[0, H) ∪ {-1}``)."""
+        if self.num_shards * self.mesh.shape[DATA_AXIS] != 1:
+            return {}
+        out = {}
+        for name, n in (self.logic.head_prefix(batch) or {}).items():
+            spec = self.store.specs.get(name)
+            if (spec is not None and isinstance(spec.hot_ids, int)
+                    and spec.hot_ids > 0 and n):
+                out[name] = int(n)
+        return out
+
+    def _apply_pushes(self, tables, pushes, head_prefix=None):
+        head_prefix = head_prefix or {}
         new_tables = dict(tables)
         for name, (pids, pdeltas) in pushes.items():
             spec = self.store.specs[name]
@@ -291,20 +311,25 @@ class Trainer:
                 combine=self.server_logic[name].combine,
                 hot_rows=hot_local,
                 dense=self._resolve_dense(spec),
+                head_prefix=head_prefix.get(name, 0),
             )
         return new_tables
 
     def _compute_step(self, tables, snapshot, local_state, batch, key):
         """Pull (from live tables, or the SSP ``snapshot`` when given), run
-        the worker step, and return its pushes WITHOUT applying them."""
+        the worker step, and return its pushes WITHOUT applying them,
+        plus the (static) head-prefix guarantee for those pushes."""
         key, prep_key = jax.random.split(key)
         batch = self.logic.prepare(batch, prep_key)
         ids = self.logic.pull_ids(batch)
+        hp = self._head_prefix(batch)
         if snapshot is None:
             pulled = {
                 name: pull(
                     tables[name], tids, num_shards=self.num_shards,
                     dense=self._resolve_dense(self.store.specs[name]),
+                    hot_rows=self._resolve_hot_rows(self.store.specs[name]),
+                    head_prefix=hp.get(name, 0),
                 )
                 for name, tids in ids.items()
             }
@@ -315,9 +340,15 @@ class Trainer:
                 phys = id_to_phys(tids, self.num_shards, rps)
                 # ops.gather_rows (not a bare take): dim-1 snapshot reads
                 # ride the same lane-packed kernel as live pulls on TPU.
-                pulled[name] = ops.gather_rows(snapshot[name], phys)
+                # phys == ids on the single-device meshes where hp is
+                # nonempty, so the head guarantee survives the mapping.
+                pulled[name] = ops.gather_rows(
+                    snapshot[name], phys,
+                    hot_rows=self._resolve_hot_rows(self.store.specs[name]),
+                    head_prefix=hp.get(name, 0),
+                )
         out = self.logic.step(batch, pulled, local_state, key)
-        return out.pushes, out.local_state, out.out
+        return out.pushes, out.local_state, out.out, hp
 
     # -- delayed pushes (async in-flight emulation) ------------------------
 
@@ -376,12 +407,14 @@ class Trainer:
         tapped = tap(tables, batch, local_state, t)
         return dict(out, tap=jax.tree.map(self._gather_workers, tapped))
 
-    def _apply_or_buffer(self, tables, bufs, t, pushes):
+    def _apply_or_buffer(self, tables, bufs, t, pushes, head_prefix=None):
         """Apply ``pushes`` now (push_delay 0) or deliver the pushes from
-        ``push_delay`` steps ago and enqueue the new ones in their slot."""
+        ``push_delay`` steps ago and enqueue the new ones in their slot.
+        Ring slots preserve the push layout, so the head-prefix guarantee
+        carries over to delayed deliveries unchanged."""
         d = self.config.push_delay
         if not d:
-            return self._apply_pushes(tables, pushes), bufs
+            return self._apply_pushes(tables, pushes, head_prefix), bufs
         slot = t % d
         new_bufs = {}
         delayed = {}
@@ -395,10 +428,13 @@ class Trainer:
                 lax.dynamic_update_index_in_dim(bids, ids, slot, 0),
                 lax.dynamic_update_index_in_dim(bdel, deltas, slot, 0),
             )
-        return self._apply_pushes(tables, delayed), new_bufs
+        return self._apply_pushes(tables, delayed, head_prefix), new_bufs
 
-    def _flush_push_bufs(self, tables, bufs, t):
-        """Deliver everything still in flight, oldest first (end of call)."""
+    def _flush_push_bufs(self, tables, bufs, t, head_prefix=None):
+        """Deliver everything still in flight, oldest first (end of call).
+
+        Cold ring slots hold all ``-1`` ids with zero deltas — inside the
+        head-prefix contract, so the guarantee applies to them too."""
         d = self.config.push_delay
         if not d:
             return tables
@@ -412,7 +448,7 @@ class Trainer:
                 )
                 for name, (bids, bdel) in bufs.items()
             }
-            return self._apply_pushes(tables, pending)
+            return self._apply_pushes(tables, pending, head_prefix)
 
         return lax.fori_loop(0, d, body, tables)
 
@@ -431,13 +467,17 @@ class Trainer:
                 )
                 bufs = self._init_push_bufs(tables, local_state, batch0, key)
 
+            hp_seen = {}
+
             def step_fn(carry, batch_t, snapshot=None):
                 tables, bufs, local_state, key, t = carry
                 key, sub = jax.random.split(key)
-                pushes, local_state, out = self._compute_step(
+                pushes, local_state, out, hp = self._compute_step(
                     tables, snapshot, local_state, batch_t, sub
                 )
-                tables, bufs = self._apply_or_buffer(tables, bufs, t, pushes)
+                hp_seen.update(hp)  # static, identical every traced step
+                tables, bufs = self._apply_or_buffer(tables, bufs, t, pushes,
+                                                     hp)
                 out = jax.tree.map(
                     lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
                 )
@@ -467,7 +507,7 @@ class Trainer:
                 outs = jax.tree.map(
                     lambda x: x.reshape((-1,) + x.shape[2:]), outs
                 )
-            tables = self._flush_push_bufs(tables, bufs, t)
+            tables = self._flush_push_bufs(tables, bufs, t, hp_seen)
             return tables, local_state, outs
 
         table_specs = {name: P(SHARD_AXIS, None) for name in self.store.specs}
@@ -564,14 +604,18 @@ class Trainer:
                 batch0 = plan.local_batch_at(iargs, widx, start)
                 bufs = self._init_push_bufs(tables, local_state, batch0, key)
 
+            hp_seen = {}
+
             def step_t(carry, t, snapshot=None):
                 tables, bufs, local_state, key = carry
                 key, sub = jax.random.split(key)
                 batch = plan.local_batch_at(iargs, widx, t)
-                pushes, local_state, out = self._compute_step(
+                pushes, local_state, out, hp = self._compute_step(
                     tables, snapshot, local_state, batch, sub
                 )
-                tables, bufs = self._apply_or_buffer(tables, bufs, t, pushes)
+                hp_seen.update(hp)  # static, identical every traced step
+                tables, bufs = self._apply_or_buffer(tables, bufs, t, pushes,
+                                                     hp)
                 out = jax.tree.map(
                     lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
                 )
@@ -583,7 +627,8 @@ class Trainer:
                 (tables, bufs, local_state, _), outs = lax.scan(
                     step_t, carry0, start + jnp.arange(T, dtype=jnp.int32),
                 )
-                tables = self._flush_push_bufs(tables, bufs, start + T)
+                tables = self._flush_push_bufs(tables, bufs, start + T,
+                                               hp_seen)
                 return tables, local_state, outs
 
             def round_body(carry, r):
@@ -600,7 +645,7 @@ class Trainer:
             (tables, bufs, local_state, _), outs = lax.scan(
                 round_body, carry0, jnp.arange(T // s, dtype=jnp.int32),
             )
-            tables = self._flush_push_bufs(tables, bufs, start + T)
+            tables = self._flush_push_bufs(tables, bufs, start + T, hp_seen)
             outs = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), outs)
             return tables, local_state, outs
 

@@ -86,6 +86,8 @@ def pull(
     num_shards: int,
     shard_axis: str = SHARD_AXIS,
     dense: bool = False,
+    hot_rows: int = 0,
+    head_prefix: int = 0,
 ) -> Array:
     """Gather parameter rows for ``ids`` from the sharded table.
 
@@ -121,7 +123,12 @@ def pull(
     all_ids = lax.all_gather(ids, shard_axis, tiled=True)
     owned = (all_ids % num_shards) == me
     local_idx = jnp.where(owned, all_ids // num_shards, 0)
-    vals = ops.gather_rows(local_shard, local_idx)
+    # The head-prefix guarantee only survives when the gathered stream IS
+    # the caller's stream (single shard; local_idx == ids there).
+    vals = ops.gather_rows(
+        local_shard, local_idx, hot_rows=hot_rows,
+        head_prefix=head_prefix if num_shards == 1 else 0,
+    )
     vals = jnp.where(owned[:, None], vals, jnp.zeros_like(vals))
     # Each worker ends up with its own (B, dim) slice, summed over shards
     # (exactly one shard contributed each row).
@@ -156,6 +163,7 @@ def push(
     combine: str | Callable[[Array, Array], Array] = "sum",
     hot_rows: int = 0,
     dense: bool = False,
+    head_prefix: int = 0,
 ) -> Array:
     """Scatter-add ``deltas`` for ``ids`` into the sharded table.
 
@@ -256,8 +264,13 @@ def push(
         raise ValueError(f"unknown combine mode {combine!r}")
 
     if apply_fn is None and combine == "sum":
+        # Head-prefix guarantee survives only when the gathered stream is
+        # the caller's own (single shard, no data axis — the driver also
+        # gates it to single-device meshes).
+        keep_prefix = (num_shards == 1 and data_axis is None)
         return ops.scatter_add(local_shard, local_idx, masked,
-                               hot_rows=hot_rows)
+                               hot_rows=hot_rows,
+                               head_prefix=head_prefix if keep_prefix else 0)
 
     dim = masked.shape[1]
     # Accumulate in at least f32, but never BELOW the table's own precision:

@@ -64,6 +64,17 @@ class PAConfig:
     # split, fps_tpu.ops.scatter_add); effective with frequency-ranked ids
     # and a small per-shard table slice. Default 0 — see MFConfig.hot_items.
     hot_features: int = 0
+    # Head-prefix routing (single-device meshes): set together with
+    # ``hot_features = H`` after laying the dataset out with
+    # ``fps_tpu.utils.datasets.head_sort_slots(data, H)`` — the returned
+    # ``q`` is the number of leading slot COLUMNS guaranteed to carry ids
+    # in [0, H). The worker then flattens ids nnz-major so those q*B
+    # leading entries ride head-only kernels whose MXU cost scales with
+    # ceil(H/128) instead of ceil(num_features/128)
+    # (``fps_tpu.ops.gather_rows`` ``head_prefix``). Purely a routing
+    # hint: results are identical (to the dim-1 kernels' documented hi+lo
+    # precision) with it on or off.
+    head_prefix_cols: int = 0
     dtype: object = jnp.float32
 
     @property
@@ -95,8 +106,24 @@ class PassiveAggressiveWorker(WorkerLogic):
             raise ValueError("use MulticlassPassiveAggressiveWorker")
         self.cfg = cfg
 
+    def _flatten(self, a: Array) -> Array:
+        """(B, nnz[, ...]) -> (B*nnz[, ...]): nnz-major when head-prefix
+        routing is on (so the head-sorted leading COLUMNS become the
+        leading flat entries), row-major otherwise."""
+        if self.cfg.head_prefix_cols:
+            a = jnp.swapaxes(a, 0, 1)
+        return a.reshape((-1,) + a.shape[2:])
+
     def pull_ids(self, batch) -> Mapping[str, Array]:
-        return {WEIGHT_TABLE: batch["feat_ids"].astype(jnp.int32).reshape(-1)}
+        return {WEIGHT_TABLE: self._flatten(
+            batch["feat_ids"].astype(jnp.int32))}
+
+    def head_prefix(self, batch) -> Mapping[str, int]:
+        q = self.cfg.head_prefix_cols
+        if not q:
+            return {}
+        B, nnz = batch["feat_ids"].shape
+        return {WEIGHT_TABLE: min(q, nnz) * B}
 
     def step(self, batch, pulled, local_state, key) -> StepOutput:
         cfg = self.cfg
@@ -105,7 +132,10 @@ class PassiveAggressiveWorker(WorkerLogic):
         y = batch["label"].astype(cfg.dtype)  # (B,)
         w = batch["weight"].astype(cfg.dtype)  # (B,)
 
-        wrows = pulled[WEIGHT_TABLE].reshape(B, nnz)  # (B, nnz)
+        if cfg.head_prefix_cols:  # nnz-major pull order (see _flatten)
+            wrows = pulled[WEIGHT_TABLE].reshape(nnz, B).T
+        else:
+            wrows = pulled[WEIGHT_TABLE].reshape(B, nnz)
         margin = jnp.sum(wrows * x, axis=-1)
         loss = jnp.maximum(0.0, 1.0 - y * margin)
         x2 = jnp.sum(x * x, axis=-1)
@@ -125,7 +155,8 @@ class PassiveAggressiveWorker(WorkerLogic):
             "n": jnp.sum(w).astype(jnp.float32),
         }
         pushes = {
-            WEIGHT_TABLE: (push_ids.reshape(-1), deltas.reshape(-1, 1))
+            WEIGHT_TABLE: (self._flatten(push_ids),
+                           self._flatten(deltas)[:, None])
         }
         return StepOutput(pushes=pushes, local_state=local_state, out=out)
 
@@ -143,6 +174,14 @@ class MulticlassPassiveAggressiveWorker(WorkerLogic):
     def __init__(self, cfg: PAConfig):
         if cfg.num_classes < 3:
             raise ValueError("use PassiveAggressiveWorker for binary")
+        if cfg.head_prefix_cols:
+            # Head-prefix routing targets scalar tables (dim-1 kernels);
+            # the multiclass table is (NF, num_classes). Fail loudly
+            # rather than silently ignoring the knob.
+            raise ValueError(
+                "head_prefix_cols is binary-only (the multiclass table "
+                "is not dim-1; no head-only kernel route exists for it)"
+            )
         self.cfg = cfg
 
     def pull_ids(self, batch) -> Mapping[str, Array]:

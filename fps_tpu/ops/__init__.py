@@ -161,11 +161,52 @@ def _route_dim1(R: int, D: int, B: int, dtype=jnp.float32) -> bool:
     return R <= DIM1_MAX_ROWS and B >= DIM1_MIN_BATCH
 
 
-def gather_rows(table: Array, ids: Array) -> Array:
+def _route_head_prefix(R: int, D: int, head_prefix: int, hot_rows: int,
+                       dtype) -> bool:
+    """Route the guaranteed-head prefix through a head-only dim-1 kernel?
+
+    The dim-1 kernels' cost is ``ceil(R/128) x B x 128`` MACs REGARDLESS
+    of drop masks, so splitting only pays when the head slice is
+    genuinely small and the prefix long enough to amortize the extra
+    kernel launch. The caller guarantees ``ids[:head_prefix]`` are in
+    ``[0, hot_rows) ∪ {-1}`` (ingest-side frequency sort — see
+    ``fps_tpu.utils.datasets.head_sort_slots``)."""
+    if head_prefix < 2048 or hot_rows <= 0 or D != 1:
+        return False
+    if _BACKEND == "xla" or not (_on_tpu() or _BACKEND == "pallas"):
+        return False
+    dt = jnp.dtype(dtype)
+    if dt.itemsize > 4 or not jnp.issubdtype(dt, jnp.floating):
+        return False
+    # Head kernel must be meaningfully cheaper than running the prefix
+    # through the full-table route it would otherwise take.
+    return hot_rows * 4 <= R
+
+
+def gather_rows(table: Array, ids: Array, *, hot_rows: int = 0,
+                head_prefix: int = 0) -> Array:
     """``table[ids]``; ids outside ``[0, rows)`` yield **zero rows** on every
     backend (the pull path's ``-1`` padding slots read as zeros; real pulls
-    are always in range)."""
+    are always in range).
+
+    ``head_prefix > 0`` (with ``hot_rows = H``) asserts the STATIC
+    guarantee that ``ids[:head_prefix]`` lie in ``[0, H) ∪ {-1}`` — the
+    frequency-ranked head a sorted-slot batch layout puts first. The
+    prefix then reads through a head-only kernel whose MXU cost scales
+    with ``ceil(H/128)`` instead of ``ceil(R/128)``. Violating the
+    guarantee silently reads zeros for the out-of-head ids (the drop
+    contract), so callers must only pass prefixes the ingest layer
+    actually certified.
+    """
     R, D = table.shape
+    if _route_head_prefix(R, D, head_prefix, hot_rows, table.dtype):
+        from fps_tpu.ops.pallas_kernels import gather_rows_dim1_pallas
+
+        head = gather_rows_dim1_pallas(
+            table[:hot_rows], ids[:head_prefix], interpret=not _on_tpu()
+        )
+        tail = gather_rows(table, ids[head_prefix:])
+        return jnp.concatenate([head, tail], axis=0)
     if _route_dim1(R, D, ids.shape[0], table.dtype):
         from fps_tpu.ops.pallas_kernels import gather_rows_dim1_pallas
 
@@ -195,7 +236,8 @@ def _xla_scatter_add(table: Array, ids: Array, deltas: Array) -> Array:
 
 
 def scatter_add(
-    table: Array, ids: Array, deltas: Array, *, hot_rows: int = 0
+    table: Array, ids: Array, deltas: Array, *, hot_rows: int = 0,
+    head_prefix: int = 0
 ) -> Array:
     """``table.at[ids].add(deltas)``; ids outside ``[0, rows)`` are dropped,
     duplicate ids accumulate (the server's additive ``paramUpdate`` fold).
@@ -223,6 +265,21 @@ def scatter_add(
     # scatter, which adds in the table's native dtype.
     if jnp.dtype(table.dtype).itemsize > 4:
         return _xla_scatter_add(table, ids, deltas)
+
+    if _route_head_prefix(R, D, head_prefix, hot_rows, table.dtype):
+        # Guaranteed-head prefix (see gather_rows): accumulate the prefix
+        # into the head slice via the head-only kernel, then run the tail
+        # through the normal routing (WITHOUT the legacy hot_rows masked
+        # split — the prefix split supersedes it for this call).
+        from fps_tpu.ops.pallas_kernels import scatter_add_dim1_pallas
+
+        head_new = scatter_add_dim1_pallas(
+            table[:hot_rows], ids[:head_prefix], deltas[:head_prefix],
+            interpret=not _on_tpu(),
+        )
+        table = jax.lax.dynamic_update_slice_in_dim(table, head_new, 0,
+                                                    axis=0)
+        return scatter_add(table, ids[head_prefix:], deltas[head_prefix:])
 
     if _route_dim1(R, D, ids.shape[0], table.dtype):
         from fps_tpu.ops.pallas_kernels import scatter_add_dim1_pallas

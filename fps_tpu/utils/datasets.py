@@ -509,6 +509,34 @@ def synthetic_sparse_classification(
     }
 
 
+def head_sort_slots(data: dict, head_features: int):
+    """Reorder each example's nnz slots so frequency-head ids come first.
+
+    For frequency-ranked feature spaces (the shipped loaders and synthetic
+    generators put the hottest ids lowest), stable-partitioning every
+    example's slots into (ids < head_features) then (ids >= head_features)
+    makes the first ``q = min_examples(head_count)`` slot COLUMNS carry
+    head ids in EVERY example — a static guarantee the sparse workers turn
+    into ``ops.gather_rows``/``scatter_add`` ``head_prefix`` routing
+    (head-only kernels whose MXU cost scales with the head size, not the
+    table size). Slot order within an example is semantically irrelevant
+    (the models sum over slots), so this is a pure relayout.
+
+    Returns ``(data2, q)`` — data with ``feat_ids``/``feat_vals`` columns
+    reordered per example (other columns untouched), and the guaranteed
+    head-prefix column count (0 if any example has no head feature).
+    """
+    ids = np.asarray(data["feat_ids"])
+    vals = np.asarray(data["feat_vals"])
+    is_tail = ids >= head_features
+    order = np.argsort(is_tail, axis=1, kind="stable")
+    out = dict(data)
+    out["feat_ids"] = np.take_along_axis(ids, order, axis=1)
+    out["feat_vals"] = np.take_along_axis(vals, order, axis=1)
+    q = int((~is_tail).sum(axis=1).min())
+    return out, q
+
+
 def synthetic_sparse_multiclass(
     num_examples: int,
     num_features: int,

@@ -408,3 +408,41 @@ def test_dim1_routed_scatter_and_gather_through_dispatcher(pallas_backend):
     got_g = np.asarray(ops.gather_rows(jnp.asarray(table), jnp.asarray(ids)))
     ref_g = np.where((ids >= 0)[:, None], table[np.clip(ids, 0, None)], 0.0)
     np.testing.assert_allclose(got_g, ref_g, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("R,H,B,q", [(47_236, 2048, 12_288, 8192),
+                                     (9_000, 1024, 6_000, 2048)])
+def test_head_prefix_scatter_and_gather_parity(pallas_backend, R, H, B, q):
+    """head_prefix routing: ids[:q] in [0, H) ∪ {-1} ride the head-only
+    kernel; results match plain numpy to the hi+lo contract."""
+    rng = np.random.default_rng(7)
+    table = rng.normal(0, 1, (R, 1)).astype(np.float32)
+    head_ids = rng.integers(0, H, q).astype(np.int32)
+    head_ids[::11] = -1  # dropped slots inside the guaranteed prefix
+    tail_ids = rng.integers(-1, R, B - q).astype(np.int32)
+    ids = np.concatenate([head_ids, tail_ids])
+    deltas = rng.normal(0, 1, (B, 1)).astype(np.float32)
+    assert ops._route_head_prefix(R, 1, q, H, np.float32)
+
+    got = np.asarray(ops.scatter_add(
+        jnp.asarray(table), jnp.asarray(ids), jnp.asarray(deltas),
+        hot_rows=H, head_prefix=q,
+    ))
+    ref = table.copy()
+    keep = ids >= 0
+    np.add.at(ref[:, 0], ids[keep], deltas[keep, 0])
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    got_g = np.asarray(ops.gather_rows(
+        jnp.asarray(table), jnp.asarray(ids), hot_rows=H, head_prefix=q))
+    ref_g = np.where(keep[:, None], table[np.clip(ids, 0, None)], 0.0)
+    np.testing.assert_allclose(got_g, ref_g, rtol=2e-4, atol=2e-4)
+
+
+def test_head_prefix_routing_conditions(pallas_backend):
+    f32 = np.float32
+    assert ops._route_head_prefix(47_236, 1, 8192, 2048, f32)
+    assert not ops._route_head_prefix(47_236, 1, 1024, 2048, f32)  # short
+    assert not ops._route_head_prefix(47_236, 2, 8192, 2048, f32)  # D!=1
+    assert not ops._route_head_prefix(47_236, 1, 8192, 0, f32)     # no head
+    assert not ops._route_head_prefix(4_096, 1, 8192, 2048, f32)   # H~R

@@ -85,3 +85,70 @@ def test_pa_weights_stay_zero_without_data(devices8):
         "weights", np.arange(NF // 2, NF)
     )
     np.testing.assert_array_equal(untouched, 0.0)
+
+
+def test_head_sort_slots_contract():
+    """head_sort_slots: per-example multiset preserved, head ids first,
+    q = min head count."""
+    from fps_tpu.utils.datasets import head_sort_slots
+
+    data = synthetic_sparse_classification(500, NF, NNZ, seed=7)
+    H = 50
+    data2, q = head_sort_slots(data, H)
+    ids, ids2 = data["feat_ids"], data2["feat_ids"]
+    # multiset of (id, val) pairs preserved per example
+    for b in (0, 123, 499):
+        a = sorted(zip(data["feat_ids"][b], data["feat_vals"][b]))
+        c = sorted(zip(data2["feat_ids"][b], data2["feat_vals"][b]))
+        assert a == c
+    head_counts = (ids < H).sum(axis=1)
+    assert q == int(head_counts.min())
+    # first q columns are head ids in EVERY example
+    assert (ids2[:, :q] < H).all()
+    # within each example, no head id after a tail id
+    is_tail = ids2 >= H
+    assert (np.diff(is_tail.astype(int), axis=1) >= 0).all()
+
+
+@pytest.mark.parametrize("backend", ["xla", "pallas"])
+def test_head_prefix_training_matches_plain(devices8, backend):
+    """PA with head-prefix routing (sorted slots + nnz-major flatten +
+    head-only kernels) must train to the same weights as the plain
+    row-major path on the same sorted data — the hint is routing only."""
+    import dataclasses as _dc
+
+    import fps_tpu.ops as ops_mod
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.utils.datasets import head_sort_slots
+
+    H = 64
+    data = synthetic_sparse_classification(4096, NF, NNZ, seed=9,
+                                           noise=0.05)
+    data, q = head_sort_slots(data, H)
+    assert q >= 1
+    mesh = make_ps_mesh(num_shards=1, num_data=1, devices=jax.devices()[:1])
+
+    prev = ops_mod.get_backend()
+    ops_mod.set_backend(backend)
+    try:
+        def run(head):
+            cfg = PAConfig(num_features=NF, variant="PA-I", C=1.0,
+                           hot_features=H if head else 0,
+                           head_prefix_cols=q if head else 0)
+            trainer, store = passive_aggressive(mesh, cfg, donate=False)
+            tables, ls = trainer.init_state(jax.random.key(0))
+            ds = DeviceDataset(mesh, data)
+            plan = DeviceEpochPlan(ds, num_workers=1, local_batch=2048,
+                                   seed=3)
+            tables, ls, m = trainer.run_indexed(tables, ls, plan,
+                                                jax.random.key(1), epochs=2)
+            return (np.asarray(store.dump_model("weights")[1]),
+                    float(np.sum(m[-1]["mistakes"])))
+
+        w_head, mk_head = run(True)
+        w_plain, mk_plain = run(False)
+    finally:
+        ops_mod.set_backend(prev)
+
+    assert np.abs(w_plain).max() > 0
+    np.testing.assert_allclose(w_head, w_plain, rtol=3e-4, atol=3e-4)
