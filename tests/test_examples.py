@@ -188,3 +188,38 @@ def test_logreg_real_input_criteo(devices8, capsys, tmp_path):
         capsys,
     )
     assert ev["done"][0]["test_accuracy"] > 0.8
+
+
+def test_bench_combined_summary_line_contract(capsys):
+    """The driver parses bench.py's FINAL stdout line and keeps a bounded
+    tail: in all-workload mode that line must be one JSON object carrying
+    the top-level metric keys AND every workload's full result."""
+    import importlib.util
+    import json
+    import os
+    import sys as _sys
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    for name in bench.RUNNERS:
+        bench.RUNNERS[name] = (lambda n: lambda args: {
+            "metric": f"{n}_metric", "value": 1.0, "unit": "u",
+            "vs_baseline": None if n == "ials" else 2.0,
+        })(name)
+    argv, _sys.argv = _sys.argv, ["bench.py"]
+    try:
+        bench.main()
+    finally:
+        _sys.argv = argv
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    final = json.loads(lines[-1])
+    assert {"metric", "value", "unit", "vs_baseline"} <= final.keys()
+    assert set(final["workloads"]) == {"mf", "w2v", "logreg", "pa", "ials"}
+    for name, res in final["workloads"].items():
+        assert res["metric"] == f"{name}_metric"
+    # per-workload lines still precede it (one JSON line each + summary)
+    assert len(lines) == 6
