@@ -358,3 +358,29 @@ def test_negative_seed_and_sort_key_shape(devices8):
         a1 = jax.tree.map(lambda x: np.asarray(x), plan.epoch_args(1))
         for x, y in zip(jax.tree.leaves(a0), jax.tree.leaves(a1)):
             np.testing.assert_array_equal(x, y)
+
+
+def test_run_indexed_as_numpy_false_matches(mesh, dataset):
+    """as_numpy=False returns DEVICE metrics (no blocking conversion) that
+    are value-identical to the default host metrics of the same run."""
+    W = num_workers_of(mesh)
+    cfg = MFConfig(num_users=57, num_items=31, rank=4, learning_rate=0.1)
+
+    def run(as_numpy):
+        tr, _ = online_mf(mesh, cfg, donate=False)
+        t, l = tr.init_state(jax.random.key(0))
+        plan = DeviceEpochPlan(
+            dataset, num_workers=W, local_batch=32, route_key="user", seed=5,
+        )
+        return tr.run_indexed(t, l, plan, jax.random.key(1), epochs=2,
+                              as_numpy=as_numpy)[2]
+
+    host = run(True)
+    dev = run(False)
+    assert all(isinstance(x, np.ndarray)
+               for m in host for x in jax.tree.leaves(m))
+    assert all(isinstance(x, jax.Array)
+               for m in dev for x in jax.tree.leaves(m))
+    for mh, md in zip(host, dev):
+        for kh, kd in zip(jax.tree.leaves(mh), jax.tree.leaves(md)):
+            np.testing.assert_array_equal(kh, np.asarray(kd))
