@@ -109,10 +109,13 @@ def _use_pallas() -> tuple[bool, bool]:
 # are per-row-transaction bound (~8 ns/row at B = 2^20, dedup-safe T=256
 # measurement), so a dim-1 table pays ~8 ns per SCALAR moved. The dim-1
 # kernels pack 128 rows per lane row and build the one-hot + lane
-# placement in-kernel: at the PA workload shape (47k rows, 2^20 ids,
-# 95% duplication) measured 2.8 ms vs XLA's 7.7 (scatter) / 8.2 (gather)
-# ms per call. Kernel cost scales with ceil(R/128), so the win inverts
-# around R ~ 120-150k rows; the cap below keeps a safety margin. Reads
+# placement in-kernel (v2: transpose-free, see the kernel docstrings):
+# at the PA workload shape (47k rows, 2^20 ids, 95% duplication)
+# measured 1.5 (scatter) / 1.6 (gather) ms vs XLA's 7.6 / 8.1 ms per
+# call. Kernel cost scales with ceil(R/128), so the win inverts well
+# above the cap below (set with the v1 kernels' safety margin; the v2
+# crossover is higher still — revisit if a 100k-400k-row scalar table
+# ever ships). Reads
 # and duplicate sums carry the hi+lo bf16 contract (~16 mantissa bits) —
 # see scatter_add_packed_pallas — hence bit-exactness is not promised for
 # routed shapes, neither across backends (CPU "auto" stays on XLA) nor
@@ -127,7 +130,7 @@ def _use_pallas() -> tuple[bool, bool]:
 # invariant on any route (fold order follows the gathered batch layout;
 # the dense-collective route reassociates differently again). What this
 # route adds is same-shape backend sensitivity on TPU, in exchange for
-# a 2.7x measured win on both sides of every scalar-table transaction;
+# a ~5x measured win on both sides of every scalar-table transaction;
 # force ``set_backend("xla")`` / FPS_TPU_OPS=xla for bit-exact audits
 # within one mesh shape.
 DIM1_MAX_ROWS = 100_000

@@ -262,9 +262,12 @@ def scatter_add_packed_pallas(
 # cost as much as the XLA scatter it replaces (~12 vs ~13.5 ms/step).
 # These kernels move BOTH the packed-row one-hot and the lane placement
 # inside the kernel: HBM traffic is just ids + deltas (8 MB), and the MXU
-# pays (R/128) x B x 128 MACs per precision pass. Measured on-chip at the
-# PA shape, dedup-safe T=256 scan timing (tools/bench_scatter.py dim1):
-# scatter 7.7 -> 2.8 ms, gather 8.2 -> 2.8 ms per 2^20-id call.
+# pays (R/128) x B x 128 MACs per precision pass. The round-4 v2
+# formulation is TRANSPOSE-FREE (see the kernel docstrings): measured
+# on-chip at the PA shape, dedup-safe T=256 scan timing
+# (tools/bench_scatter.py dim1): scatter 7.6 -> 1.5 ms, gather
+# 8.1 -> 1.6 ms per 2^20-id call (the v1 kernels with in-kernel lane
+# placement via minor-dim reshapes measured 2.8 ms each).
 #
 # Precision contract matches scatter_add_packed_pallas: f32 values ride as
 # hi+lo bf16 halves (~16 of 24 mantissa bits) with exact f32 MXU
@@ -289,7 +292,22 @@ def _split_hi_lo(x: Array) -> tuple[Array, Array]:
 
 
 def _scatter_dim1_kernel(ids_ref, deltas_ref, out_ref, *, row_tile):
-    """out[(id // 128), (id % 128)] += delta, packed rows x 128 lanes."""
+    """out[(id // 128), (id % 128)] += delta, packed rows x 128 lanes.
+
+    TRANSPOSE-FREE formulation (round-4 v2): the delta multiplies into
+    the packed-row one-hot (a native (1, bt)-over-(row_tile, bt)
+    broadcast), and the lane one-hot is built TRANSPOSED (128, bt) and
+    contracted via dot_general over the shared bt dim — no (bt, 1)
+    minor-dim reshapes anywhere. The v1 kernel's in-kernel lane
+    placement paid ~4 us/cell in relayouts plus a per-cell floor;
+    measured at the PA shape (tools/bench_scatter.py dim1, Zipf(0.9)
+    ids) this form is 2.8 -> 1.5 ms/call — uniform ids measure ~1.9 —
+    and 0.83 -> ~0.4 ms at the 2048-row head shape.
+
+    Exactness: deltas arrive as f32 containers of exactly-bf16 values
+    (the caller's hi/lo split), and one-hot entries are exactly 0/1, so
+    ``A = where(match, d, 0)`` downcasts to bf16 losslessly.
+    """
     i = pl.program_id(0)  # packed-row tile (slow)
     j = pl.program_id(1)  # batch tile (fast: out block stays resident)
 
@@ -305,17 +323,13 @@ def _scatter_dim1_kernel(ids_ref, deltas_ref, out_ref, *, row_tile):
     rows = i * row_tile + jax.lax.broadcasted_iota(
         jnp.int32, (row_tile, bt), dimension=0
     )
-    onehot = (prow == rows).astype(jnp.bfloat16)  # (row_tile, bt)
-    # Lane placement IN-KERNEL: (bt, 128) bf16, built per batch tile. The
-    # deltas arrive as f32 (Mosaic cannot minor-dim-reshape 16-bit vectors)
-    # holding exactly-bf16 values from the caller's hi/lo split, so the
-    # downcast after the reshape is exact.
-    lane_col = lane.reshape(bt, 1)
-    lanes = jax.lax.broadcasted_iota(jnp.int32, (bt, 128), dimension=1)
-    dl = jnp.where(
-        lane_col == lanes, deltas_ref[:].reshape(bt, 1), 0.0
-    ).astype(jnp.bfloat16)
-    out_ref[:] += jnp.dot(onehot, dl, preferred_element_type=jnp.float32)
+    A = jnp.where(prow == rows, deltas_ref[:], 0.0).astype(jnp.bfloat16)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (128, bt), dimension=0)
+    Lt = (lane == lanes).astype(jnp.bfloat16)  # (128, bt)
+    out_ref[:] += jax.lax.dot_general(
+        A, Lt, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
 
 
 @functools.partial(
@@ -367,10 +381,20 @@ def scatter_add_dim1_pallas(
     return table + upd.astype(table.dtype)
 
 
-def _gather_dim1_kernel(ids_ref, hi_ref, lo_ref, out_ref, *, row_tile,
-                        num_rows):
+def _gather_dim1_kernel(ids_ref, hi_ref, lo_ref, out_ref, *, row_tile):
     """out[b] = table[(id // 128), (id % 128)]; accumulate over row tiles
-    (each id matches exactly one packed row), lane-select per tile."""
+    (each id matches exactly one packed row).
+
+    TRANSPOSE-FREE formulation (round-4 v2, cf. _scatter_dim1_kernel):
+    ``P = W_tile @ laneOneHot^T`` gives ``P[p, b] = W[p, lane_b]``; the
+    packed-row match then selects and a column-sum lands the values in
+    the native ``(1, bt)`` output layout — no minor-dim reshapes.
+    Measured 2.8 -> 1.6 ms per 2^20-id call at the PA shape
+    (tools/bench_scatter.py dim1). Garbage in
+    the final row tile's block padding stays in its own P rows (the dot
+    never mixes rows) and the row mask drops it, so no explicit
+    padding-zeroing is needed.
+    """
     i = pl.program_id(0)  # batch tile (slow)
     j = pl.program_id(1)  # packed-row tile (fast: out block stays resident)
 
@@ -382,27 +406,15 @@ def _gather_dim1_kernel(ids_ref, hi_ref, lo_ref, out_ref, *, row_tile,
     ids = ids_ref[:]
     prow = jax.lax.shift_right_arithmetic(ids, 7)
     lane = jnp.bitwise_and(ids, 127)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (128, bt), dimension=0)
+    Lt = (lane == lanes).astype(jnp.bfloat16)  # (128, bt)
+    P = jnp.dot(hi_ref[:], Lt, preferred_element_type=jnp.float32)
+    P += jnp.dot(lo_ref[:], Lt, preferred_element_type=jnp.float32)
     rows = j * row_tile + jax.lax.broadcasted_iota(
-        jnp.int32, (bt, row_tile), dimension=1
+        jnp.int32, (row_tile, bt), dimension=0
     )
-    onehot = (prow.reshape(bt, 1) == rows).astype(jnp.bfloat16)
-    # Boundary row tiles read past the packed table; the padding rows carry
-    # garbage (NaN in interpret mode) and 0 x NaN would poison the
-    # contraction, so zero them explicitly (cf. _gather_kernel).
-    row_ids = j * row_tile + jax.lax.broadcasted_iota(
-        jnp.int32, (row_tile, 1), dimension=0
-    )
-    live = row_ids < num_rows
-    hi_t = jnp.where(live, hi_ref[:].astype(jnp.float32), 0.0)
-    lo_t = jnp.where(live, lo_ref[:].astype(jnp.float32), 0.0)
-    t = jnp.dot(onehot, hi_t.astype(jnp.bfloat16),
-                preferred_element_type=jnp.float32)
-    t += jnp.dot(onehot, lo_t.astype(jnp.bfloat16),
-                 preferred_element_type=jnp.float32)
-    # Lane select: each id contributes from exactly one lane column.
-    lanes = jax.lax.broadcasted_iota(jnp.int32, (bt, 128), dimension=1)
-    sel = jnp.where(lane.reshape(bt, 1) == lanes, t, 0.0)
-    out_ref[:] += jnp.sum(sel, axis=1, keepdims=True)
+    sel = jnp.where(prow == rows, P, 0.0)  # (row_tile, bt)
+    out_ref[:] += jnp.sum(sel, axis=0, keepdims=True)
 
 
 @functools.partial(
@@ -412,8 +424,8 @@ def gather_rows_dim1_pallas(
     table: Array,
     ids: Array,
     *,
-    row_tile: int = 512,
-    batch_tile: int = 4096,
+    row_tile: int = 128,
+    batch_tile: int = 8192,
     interpret: bool = False,
 ):
     """``table[ids]`` for a scalar table ``(R, 1)``; ids outside ``[0, R)``
@@ -441,19 +453,18 @@ def gather_rows_dim1_pallas(
 
     grid = (ids2.shape[1] // batch_tile, pl.cdiv(rp, row_tile))
     out = pl.pallas_call(
-        functools.partial(_gather_dim1_kernel, row_tile=row_tile,
-                          num_rows=rp),
+        functools.partial(_gather_dim1_kernel, row_tile=row_tile),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, batch_tile), lambda i, j: (0, i)),
             pl.BlockSpec((row_tile, 128), lambda i, j: (j, 0)),
             pl.BlockSpec((row_tile, 128), lambda i, j: (j, 0)),
         ],
-        out_specs=pl.BlockSpec((batch_tile, 1), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((ids2.shape[1], 1), jnp.float32),
+        out_specs=pl.BlockSpec((1, batch_tile), lambda i, j: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, ids2.shape[1]), jnp.float32),
         interpret=interpret,
     )(ids2, hi, lo)
-    return out[:B].astype(table.dtype)
+    return out.reshape(-1)[:B, None].astype(table.dtype)
 
 
 # ---------------------------------------------------------------------------
