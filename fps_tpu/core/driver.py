@@ -337,7 +337,12 @@ class Trainer:
             pulled = {}
             for name, tids in ids.items():
                 rps = tables[name].shape[0]
-                phys = id_to_phys(tids, self.num_shards, rps)
+                # -1 padding ids must stay -1 (the zero-row pull contract):
+                # id_to_phys's floor-mod would wrap them onto the live row
+                # (S-1)*rps-1 when num_shards > 1 — the same hazard the
+                # dense pull in store.py guards.
+                phys = jnp.where(
+                    tids >= 0, id_to_phys(tids, self.num_shards, rps), -1)
                 # ops.gather_rows (not a bare take): dim-1 snapshot reads
                 # ride the same lane-packed kernel as live pulls on TPU.
                 # phys == ids on the single-device meshes where hp is

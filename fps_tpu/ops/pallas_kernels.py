@@ -356,7 +356,13 @@ def scatter_add_dim1_pallas(
     rp = -(-R // 128)  # packed rows
 
     hi, lo = _split_hi_lo(deltas.astype(jnp.float32).reshape(B))
-    ids_cat = jnp.concatenate([ids.astype(jnp.int32)] * 2)
+    # Mask ALL out-of-range ids to the -1 drop sentinel (mirrors the gather
+    # kernel): ids in [R, rp*128) would otherwise be dropped only by the
+    # [:R] truncation and ids >= rp*128 only by Mosaic discarding
+    # out-of-bounds block stores — the drop contract must not depend on
+    # OOB-store semantics that interpret mode can't exercise.
+    ids = jnp.where((ids >= 0) & (ids < R), ids.astype(jnp.int32), -1)
+    ids_cat = jnp.concatenate([ids] * 2)
     d_cat = jnp.concatenate([hi, lo]).astype(jnp.float32)
 
     B2 = 2 * B

@@ -121,3 +121,58 @@ def test_staleness_sweep_degrades_gracefully(devices8):
     # Read-stale + write-delayed at the scaled lr reaches (near-)sync
     # quality — degradation is graceful, not a cliff.
     assert results[("s=4", 4)] < results[("sync", 0)] * 1.35 + 0.05, results
+
+
+class _PaddingProbe(WorkerLogic):
+    """Pulls a fixed id vector whose tail is -1 padding and reports the
+    max |value| read through those padding slots — must be 0 on every
+    pull route (the zero-row contract for drop-sentinel ids)."""
+
+    def __init__(self, num_rows):
+        self.num_rows = num_rows
+
+    def pull_ids(self, batch):
+        ids = batch["id"].astype(jnp.int32)
+        # Second half of every batch is -1 padding.
+        half = ids.shape[0] // 2
+        ids = ids.at[half:].set(-1)
+        return {"t": ids}
+
+    def step(self, batch, pulled, local_state, key):
+        half = batch["id"].shape[0] // 2
+        pad_max = jnp.max(jnp.abs(pulled["t"][half:]))
+        out = {"pad_max": pad_max}
+        ids = jnp.full_like(batch["id"], -1, dtype=jnp.int32)
+        deltas = jnp.zeros((ids.shape[0], 1), jnp.float32)
+        return StepOutput(pushes={"t": (ids, deltas)},
+                          local_state=local_state, out=out)
+
+
+def test_ssp_snapshot_pull_zeroes_padding_ids(devices8):
+    """The SSP snapshot pull must honor the -1 zero-row contract when
+    num_shards > 1: id_to_phys's floor-mod would wrap -1 onto the live
+    physical row (S-1)*rps-1, silently reading a real parameter. The
+    table is all-ones, so any wrap shows up as pad_max == 1."""
+    mesh = make_ps_mesh(num_shards=4, num_data=2, devices=devices8[:8])
+    W = num_workers_of(mesh)
+    R = 40
+    store = ParamStore(
+        mesh,
+        [TableSpec("t", R, 1,
+                   init_fn=lambda key, ids: jnp.ones(
+                       (ids.shape[0], 1), jnp.float32))],
+    )
+    trainer = Trainer(
+        mesh, store, _PaddingProbe(R),
+        config=TrainerConfig(sync_every=2, donate=False),
+    )
+    tables, ls = trainer.init_state(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    data = {"id": rng.integers(0, R, 256).astype(np.int32)}
+    chunks = multi_epoch_chunks(
+        data, 1, num_workers=W, local_batch=16, steps_per_chunk=4,
+        sync_every=2, seed=3,
+    )
+    _, _, metrics = trainer.fit_stream(tables, ls, chunks, jax.random.key(1))
+    for m in metrics:
+        assert float(np.max(m["pad_max"])) == 0.0
