@@ -88,6 +88,7 @@ def pull(
     dense: bool = False,
     hot_rows: int = 0,
     head_prefix: int = 0,
+    exact: bool = False,
 ) -> Array:
     """Gather parameter rows for ``ids`` from the sharded table.
 
@@ -101,6 +102,9 @@ def pull(
         gathered route's ``O(W * B)`` per shard (every shard processes
         every worker's ids). Policy: ``TableSpec.dense_collectives``,
         resolved against :data:`fps_tpu.ops.DENSE_TABLE_BYTES`.
+      exact: bit-exact reads — forward to :func:`fps_tpu.ops.gather_rows`
+        so read-only pulls (eval, export) skip the lossy dim-1 route
+        instead of inheriting training's precision contract.
 
     Returns:
       ``(B, dim)`` values, one row per requested id.
@@ -117,7 +121,7 @@ def pull(
         # Negative ids read as zero rows on every route (id_to_phys would
         # wrap them into range via the Python-semantics modulo).
         phys = jnp.where(ids >= 0, id_to_phys(ids, num_shards, rps), -1)
-        return ops.gather_rows(full, phys)
+        return ops.gather_rows(full, phys, exact=exact)
     me = lax.axis_index(shard_axis)
     # Every shard sees every worker's request ids: (S*B,).
     all_ids = lax.all_gather(ids, shard_axis, tiled=True)
@@ -128,6 +132,7 @@ def pull(
     vals = ops.gather_rows(
         local_shard, local_idx, hot_rows=hot_rows,
         head_prefix=head_prefix if num_shards == 1 else 0,
+        exact=exact,
     )
     vals = jnp.where(owned[:, None], vals, jnp.zeros_like(vals))
     # Each worker ends up with its own (B, dim) slice, summed over shards

@@ -112,10 +112,20 @@ def _use_pallas() -> tuple[bool, bool]:
 # placement in-kernel (v2: transpose-free, see the kernel docstrings):
 # at the PA workload shape (47k rows, 2^20 ids, 95% duplication)
 # measured 1.5 (scatter) / 1.6 (gather) ms vs XLA's 7.6 / 8.1 ms per
-# call. Kernel cost scales with ceil(R/128), so the win inverts well
-# above the cap below (set with the v1 kernels' safety margin; the v2
-# crossover is higher still — revisit if a 100k-400k-row scalar table
-# ever ships). Reads
+# call. Kernel cost scales with ceil(R/128) once MAC-bound, so the win
+# inverts above the cap below — MEASURED with the v2 kernels at the
+# logreg stream shape (B = 426k Zipf(0.9) ids, round 5,
+# tools/bench_logreg_routes.py stage b on a v5 lite chip):
+#
+#   R        dim1 scatter/gather   XLA scatter/gather
+#   131k     1.78 / 1.78 ms        3.46 / 3.77 ms   (dim1 ~2x win)
+#   262k     2.93 / 3.11 ms        3.67 / 3.89 ms   (dim1 still wins)
+#   524k     5.53 / 5.78 ms        3.89 / 4.35 ms   (XLA wins)
+#   1M      12.09 / 11.44 ms       6.15 / 5.29 ms   (XLA wins 2x+)
+#
+# The cap sits at the last measured clear win (262144). The shipped 1M-row
+# logreg table stays correctly excluded — its full-table contraction is
+# MAC-bound at ~2x XLA's transaction cost. Reads
 # and duplicate sums carry the hi+lo bf16 contract (~16 mantissa bits) —
 # see scatter_add_packed_pallas — hence bit-exactness is not promised for
 # routed shapes, neither across backends (CPU "auto" stays on XLA) nor
@@ -133,7 +143,7 @@ def _use_pallas() -> tuple[bool, bool]:
 # a ~5x measured win on both sides of every scalar-table transaction;
 # force ``set_backend("xla")`` / FPS_TPU_OPS=xla for bit-exact audits
 # within one mesh shape.
-DIM1_MAX_ROWS = 100_000
+DIM1_MAX_ROWS = 262_144
 DIM1_MIN_BATCH = 8_192
 
 # Small-table threshold for the store's DENSE collective route (replicate
@@ -168,11 +178,13 @@ def _route_head_prefix(R: int, D: int, head_prefix: int, hot_rows: int,
                        dtype) -> bool:
     """Route the guaranteed-head prefix through a head-only dim-1 kernel?
 
-    The dim-1 kernels' cost is ``ceil(R/128) x B x 128`` MACs REGARDLESS
-    of drop masks, so splitting only pays when the head slice is
-    genuinely small and the prefix long enough to amortize the extra
-    kernel launch. The caller guarantees ``ids[:head_prefix]`` are in
-    ``[0, hot_rows) ∪ {-1}`` (ingest-side frequency sort — see
+    The dim-1 kernels are STREAM-bound at small row counts (cost ~
+    ``rp x B`` — measured round 5, tools/bench_logreg_routes.py), so the
+    head-only form saves the row-tile factor on the prefix slice: at the
+    PA shape the composite is worth ~15% of the END-TO-END headline
+    (measured with the machinery off: 4.53M vs 5.36M examples/s). The
+    caller guarantees ``ids[:head_prefix]`` are in ``[0, hot_rows) ∪
+    {-1}`` (ingest-side frequency sort — see
     ``fps_tpu.utils.datasets.head_sort_slots``)."""
     if head_prefix < 2048 or hot_rows <= 0 or D != 1:
         return False
@@ -187,22 +199,32 @@ def _route_head_prefix(R: int, D: int, head_prefix: int, hot_rows: int,
 
 
 def gather_rows(table: Array, ids: Array, *, hot_rows: int = 0,
-                head_prefix: int = 0) -> Array:
+                head_prefix: int = 0, exact: bool = False) -> Array:
     """``table[ids]``; ids outside ``[0, rows)`` yield **zero rows** on every
     backend (the pull path's ``-1`` padding slots read as zeros; real pulls
     are always in range).
 
+    ``exact=True`` forces the bit-exact XLA gather regardless of backend
+    and shape: the dim-1 route reads scalar tables through a hi+lo bf16
+    pair (~16 mantissa bits) whenever ``B >= DIM1_MIN_BATCH``, which is a
+    deliberate TRAINING concession. This is the per-call escape hatch for
+    read-only consumers (an eval pass or audit pulling through the device
+    path); the store's :func:`pull` forwards it. The shipped host-side
+    read paths (``lookup_host``/``dump_model``) read the table arrays
+    directly and are always exact.
+
     ``head_prefix > 0`` (with ``hot_rows = H``) asserts the STATIC
     guarantee that ``ids[:head_prefix]`` lie in ``[0, H) ∪ {-1}`` — the
     frequency-ranked head a sorted-slot batch layout puts first. The
-    prefix then reads through a head-only kernel whose MXU cost scales
-    with ``ceil(H/128)`` instead of ``ceil(R/128)``. Violating the
+    prefix then reads through a head-only kernel whose cost scales with
+    ``ceil(H/128)`` row tiles instead of ``ceil(R/128)``. Violating the
     guarantee silently reads zeros for the out-of-head ids (the drop
     contract), so callers must only pass prefixes the ingest layer
     actually certified.
     """
     R, D = table.shape
-    if _route_head_prefix(R, D, head_prefix, hot_rows, table.dtype):
+    if not exact and _route_head_prefix(R, D, head_prefix, hot_rows,
+                                        table.dtype):
         from fps_tpu.ops.pallas_kernels import gather_rows_dim1_pallas
 
         head = gather_rows_dim1_pallas(
@@ -210,7 +232,7 @@ def gather_rows(table: Array, ids: Array, *, hot_rows: int = 0,
         )
         tail = gather_rows(table, ids[head_prefix:])
         return jnp.concatenate([head, tail], axis=0)
-    if _route_dim1(R, D, ids.shape[0], table.dtype):
+    if not exact and _route_dim1(R, D, ids.shape[0], table.dtype):
         from fps_tpu.ops.pallas_kernels import gather_rows_dim1_pallas
 
         return gather_rows_dim1_pallas(table, ids, interpret=not _on_tpu())
@@ -218,7 +240,7 @@ def gather_rows(table: Array, ids: Array, *, hot_rows: int = 0,
     # dedup-safe on-chip measurement shows it matching or beating the
     # one-hot kernel at the shipped workloads' shapes, so "auto" never
     # routes WIDE gathers to Pallas (the dim-1 route above is measured).
-    if _BACKEND == "pallas" and D >= 64 and (
+    if not exact and _BACKEND == "pallas" and D >= 64 and (
         R * ids.shape[0] * D <= SCATTER_FLOP_BUDGET
     ):
         from fps_tpu.ops.pallas_kernels import gather_rows_pallas

@@ -278,10 +278,12 @@ def test_hot_ids_auto_resolution(devices8):
         tr2._resolve_hot_rows(store2.specs["bad"])
 
 
-def test_hot_ids_auto_trains_equivalently(devices8):
+def test_hot_ids_auto_trains_equivalently(devices8, monkeypatch):
     """End-to-end: a Trainer with hot_ids="auto" on a thin 8-shard table
     (auto -> whole-shard packed routing) trains to the same result as the
-    exact XLA path within the packed kernel's bf16 hi+lo tolerance."""
+    exact XLA path within the packed kernel's bf16 hi+lo tolerance — AND
+    the packed kernel is asserted to actually be on the traced path (a
+    route that never fires would vacuously pass the equality check)."""
     from fps_tpu.core.api import ServerLogic, StepOutput, WorkerLogic
     from fps_tpu.core.driver import Trainer, TrainerConfig
     from fps_tpu.core.ingest import epoch_chunks
@@ -310,10 +312,15 @@ def test_hot_ids_auto_trains_equivalently(devices8):
     chunks = list(epoch_chunks(data, num_workers=8, local_batch=32,
                                steps_per_chunk=2, seed=1))
 
+    # Mean combine = word2vec's SHIPPED server logic; non-"sum" combines
+    # always take the gathered route (the dense-collective route would
+    # otherwise claim every small additive table and bypass hot_rows —
+    # which is exactly where hot_ids="auto" spent two rounds dark).
     def run(hot):
         store = ParamStore(
             mesh, [TableSpec("t", R, D, hot_ids=hot).zeros_init()])
-        tr = Trainer(mesh, store, Pusher(), server_logic=ServerLogic(),
+        tr = Trainer(mesh, store, Pusher(),
+                     server_logic=ServerLogic(combine="mean"),
                      config=TrainerConfig(donate=False))
         t, ls = tr.init_state(jax.random.key(0))
         for c in chunks:
@@ -321,13 +328,29 @@ def test_hot_ids_auto_trains_equivalently(devices8):
         return store.dump_model("t")[1]
 
     from fps_tpu import ops
+    from fps_tpu.ops import pallas_kernels
+
+    # Count packed-kernel invocations at TRACE time (scatter_add imports it
+    # per call, so patching the module attribute intercepts the route).
+    calls = {"packed": 0}
+    real_packed = pallas_kernels.scatter_add_packed_pallas
+
+    def counting_packed(*args, **kwargs):
+        calls["packed"] += 1
+        return real_packed(*args, **kwargs)
+
+    monkeypatch.setattr(pallas_kernels, "scatter_add_packed_pallas",
+                        counting_packed)
     old = ops.get_backend()
     ops.set_backend("pallas")  # interpret-mode kernels on the CPU mesh
     try:
         got_auto = run("auto")
     finally:
         ops.set_backend(old)
+    assert calls["packed"] > 0, "auto never routed through the packed kernel"
+    calls["packed"] = 0
     want = run(0)
+    assert calls["packed"] == 0  # hot_ids=0 must NOT take the packed route
     np.testing.assert_allclose(got_auto, want, rtol=3e-3, atol=3e-5)
     assert np.abs(want).sum() > 0  # the workload actually moved the table
 
@@ -408,6 +431,72 @@ def test_dim1_routed_scatter_and_gather_through_dispatcher(pallas_backend):
     got_g = np.asarray(ops.gather_rows(jnp.asarray(table), jnp.asarray(ids)))
     ref_g = np.where((ids >= 0)[:, None], table[np.clip(ids, 0, None)], 0.0)
     np.testing.assert_allclose(got_g, ref_g, rtol=2e-4, atol=2e-4)
+
+
+def test_gather_exact_overrides_lossy_routes(pallas_backend):
+    """``exact=True`` must take the bit-exact XLA gather even on shapes the
+    dim-1 hi+lo-bf16 route would claim — the read-only escape hatch that
+    keeps eval/export pulls out of training's precision concession."""
+    rng = np.random.default_rng(7)
+    R, B = 9_000, 16_384
+    # Values with >16 significant mantissa bits so the hi+lo bf16 pair
+    # visibly diverges from the exact read.
+    table = (rng.normal(0, 1, (R, 1)) * (1 + 1e-7)).astype(np.float32)
+    ids = rng.integers(-1, R, B).astype(np.int32)
+    assert ops._route_dim1(R, 1, B)
+
+    ref = np.where((ids >= 0)[:, None], table[np.clip(ids, 0, None)], 0.0)
+    got_exact = np.asarray(
+        ops.gather_rows(jnp.asarray(table), jnp.asarray(ids), exact=True))
+    # Bit-exact, not just close.
+    np.testing.assert_array_equal(got_exact, ref)
+
+    # Sanity: the routed (non-exact) read on this shape is NOT bit-exact
+    # under the forced-pallas backend, which is the whole reason the
+    # override exists.
+    got_routed = np.asarray(
+        ops.gather_rows(jnp.asarray(table), jnp.asarray(ids)))
+    assert not np.array_equal(got_routed, ref)
+    np.testing.assert_allclose(got_routed, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_pull_exact_plumbs_through_both_routes(devices8):
+    """store.pull(exact=True) must produce bit-exact reads on both the
+    gathered and dense collective routes."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from fps_tpu.core.store import SHARD_AXIS, pull
+    from fps_tpu.parallel.mesh import make_ps_mesh
+
+    prev = ops.get_backend()
+    ops.set_backend("pallas")
+    try:
+        mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+        S, R = 4, 36_000
+        rps = R // S
+        rng = np.random.default_rng(11)
+        full = (rng.normal(0, 1, (R, 1)) * (1 + 1e-7)).astype(np.float32)
+        # owner-major physical layout: shard s holds ids with id % S == s
+        shards = np.stack([full[s::S, 0] for s in range(S)])  # (S, rps)
+        ids = rng.integers(0, R, 16_384).astype(np.int32)
+
+        for dense in (False, True):
+            def f(local, i):
+                return pull(local.reshape(-1)[:, None], i, num_shards=S,
+                            dense=dense, exact=True)
+
+            got = jax.jit(shard_map(
+                f, mesh=mesh,
+                in_specs=(P(SHARD_AXIS), P()), out_specs=P(SHARD_AXIS),
+            ))(jnp.asarray(shards), jnp.asarray(ids))
+            # One (B, 1) answer block per shard-position worker; every
+            # worker asked for the same ids, so each must be bit-exact.
+            for blk in np.split(np.asarray(got), S):
+                np.testing.assert_array_equal(
+                    blk, full[ids], err_msg=f"dense={dense}")
+    finally:
+        ops.set_backend(prev)
 
 
 @pytest.mark.parametrize("R,H,B,q", [(47_236, 2048, 12_288, 8192),

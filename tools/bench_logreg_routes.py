@@ -1,0 +1,243 @@
+"""On-chip route shoot-out at the LOGREG shape (round-5 kill-or-win).
+
+The logreg workload is the last one far from its fused floor: a 1M-row
+scalar table, B = 16384 examples x 26 sparse slots = 425,984 gathered /
+scattered rows per step, Zipf(0.9) ids. This tool measures every candidate
+route for that traffic with the dedup-safe chained-scan harness
+(cf. bench_scatter.py):
+
+  a. XLA gather + scatter on the full stream (the shipped route).
+  b. dim-1 v2 full-table kernels at R in {131k, 262k, 524k, 1M} -- the
+     measured v2 crossover that DIM1_MAX_ROWS=100k (a v1-margin guess)
+     must be replaced with.
+  c. head-only dim-1 kernel over table[:H] on the FULL stream (ids >= H
+     masked to -1), H in {16k, 64k, 128k} -- the head half of a head/tail
+     split; cost scales with ceil(H/128), not ceil(R/128).
+  d. XLA gather/scatter on REDUCED column counts (the tail half: after an
+     ingest-side head partition, only the non-head columns still pay the
+     per-row-transaction XLA path).
+
+Run on the TPU:  PYTHONPATH="/root/repo:$PYTHONPATH" python tools/bench_logreg_routes.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from fps_tpu.ops.pallas_kernels import (
+    gather_rows_dim1_pallas,
+    scatter_add_dim1_pallas,
+)
+
+T = 256
+R_FULL = 1_000_000
+B_EX, NNZ = 16_384, 26
+B = B_EX * NNZ
+ALPHA = 0.9
+
+
+def timeit(fn, *args):
+    r = fn(*args)
+    np.asarray(jax.tree.leaves(r)[0]).ravel()[0]
+    best = 1e9
+    for _ in range(2):
+        t0 = time.perf_counter()
+        r = fn(*args)
+        np.asarray(jax.tree.leaves(r)[0]).ravel()[0]
+        best = min(best, time.perf_counter() - t0)
+    return best / T * 1e6
+
+
+def xla_scatter(tab, ids, deltas):
+    safe = jnp.where((ids >= 0) & (ids < tab.shape[0]), ids, tab.shape[0])
+    return tab.at[safe].add(deltas, mode="drop")
+
+
+def xla_gather(tab, ids):
+    keep = (ids >= 0) & (ids < tab.shape[0])
+    v = jnp.take(tab, jnp.where(keep, ids, 0), axis=0)
+    return jnp.where(keep[:, None], v, 0.0)
+
+
+def scan_scatter(op):
+    @jax.jit
+    def f(tab, ids, deltas):
+        def body(t, x):
+            i, d = x
+            return op(t, i, d), None
+
+        return lax.scan(body, tab, (ids, deltas))[0]
+
+    return f
+
+
+def scan_gather(op):
+    @jax.jit
+    def f(tab, ids, _deltas):
+        def body(t, x):
+            i, _d = x
+            return t + 1e-12 * jnp.sum(op(t, i)), None
+
+        return lax.scan(body, tab, (ids, _deltas))[0]
+
+    return f
+
+
+def make_ids(R, B, T_, alpha=ALPHA, seed=0):
+    rng = np.random.default_rng(seed)
+    pop = 1.0 / np.arange(1, R + 1) ** alpha
+    pop /= pop.sum()
+    cdf = np.cumsum(pop)
+    return np.searchsorted(cdf, rng.random((T_, B))).astype(np.int32)
+
+
+def stage_a():
+    rng = np.random.default_rng(1)
+    ids_np = make_ids(R_FULL, B, T)
+    ids = jnp.asarray(ids_np)
+    deltas = jnp.asarray(rng.normal(0, 1e-4, (T, B, 1)), jnp.float32)
+    tab = jnp.asarray(rng.normal(0, 0.1, (R_FULL, 1)), jnp.float32)
+    uniq = len(np.unique(ids_np[0]))
+    print(f"logreg shape: R={R_FULL} B={B} ({B_EX}x{NNZ}) zipf({ALPHA}) "
+          f"dup frac {1 - uniq / B:.3f}", flush=True)
+    for H in (16_384, 65_536, 131_072):
+        frac = float(np.mean(ids_np[0] < H))
+        print(f"  head coverage H={H}: {frac:.3f}", flush=True)
+
+    us = timeit(scan_scatter(xla_scatter), tab, ids, deltas)
+    print(f"a. xla scatter  R=1M B={B}: {us / 1e3:8.3f} ms", flush=True)
+    us = timeit(scan_gather(xla_gather), tab, ids, deltas)
+    print(f"a. xla gather   R=1M B={B}: {us / 1e3:8.3f} ms", flush=True)
+
+
+def stage_b(rs):
+    rng = np.random.default_rng(1)
+    deltas = jnp.asarray(rng.normal(0, 1e-4, (T, B, 1)), jnp.float32)
+    for R in rs:
+        t2 = jnp.asarray(rng.normal(0, 0.1, (R, 1)), jnp.float32)
+        i2 = jnp.asarray(make_ids(R, B, T, seed=2))
+        us_xs = timeit(scan_scatter(xla_scatter), t2, i2, deltas)
+        us_ds = timeit(
+            scan_scatter(lambda t, i, d: scatter_add_dim1_pallas(
+                t, i, d, row_tile=512, batch_tile=8192)),
+            t2, i2, deltas)
+        us_xg = timeit(scan_gather(xla_gather), t2, i2, deltas)
+        us_dg = timeit(scan_gather(gather_rows_dim1_pallas), t2, i2, deltas)
+        print(f"b. R={R:8d}: scatter xla {us_xs / 1e3:7.3f} "
+              f"dim1 {us_ds / 1e3:7.3f} | gather xla {us_xg / 1e3:7.3f} "
+              f"dim1 {us_dg / 1e3:7.3f} ms", flush=True)
+
+
+def stage_c():
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(make_ids(R_FULL, B, T))
+    deltas = jnp.asarray(rng.normal(0, 1e-4, (T, B, 1)), jnp.float32)
+    tab = jnp.asarray(rng.normal(0, 0.1, (R_FULL, 1)), jnp.float32)
+    for H in (16_384, 65_536, 131_072):
+        def head_scatter(t, i, d, H=H):
+            im = jnp.where(i < H, i, -1)
+            head = scatter_add_dim1_pallas(
+                t[:H], im, d, row_tile=512, batch_tile=8192)
+            return lax.dynamic_update_slice_in_dim(t, head, 0, axis=0)
+
+        def head_gather(t, i, H=H):
+            im = jnp.where(i < H, i, -1)
+            return gather_rows_dim1_pallas(t[:H], im)
+
+        us_s = timeit(scan_scatter(head_scatter), tab, ids, deltas)
+        us_g = timeit(scan_gather(head_gather), tab, ids, deltas)
+        print(f"c. head H={H:7d} full-B masked: scatter {us_s / 1e3:7.3f} "
+              f"gather {us_g / 1e3:7.3f} ms", flush=True)
+
+
+def stage_d():
+    rng = np.random.default_rng(1)
+    ids_np = make_ids(R_FULL, B, T)
+    deltas = jnp.asarray(rng.normal(0, 1e-4, (T, B, 1)), jnp.float32)
+    tab = jnp.asarray(rng.normal(0, 0.1, (R_FULL, 1)), jnp.float32)
+    for cols in (4, 8, 12, 16):
+        Bt = B_EX * cols
+        it = jnp.asarray(ids_np[:, :Bt])
+        dt = deltas[:, :Bt]
+        us_s = timeit(scan_scatter(xla_scatter), tab, it, dt)
+        us_g = timeit(scan_gather(xla_gather), tab, it, dt)
+        print(f"d. xla tail cols={cols:2d} (B={Bt:6d}): "
+              f"scatter {us_s / 1e3:7.3f} gather {us_g / 1e3:7.3f} ms",
+              flush=True)
+
+
+def stage_pa_head():
+    """Head-prefix deepening ceiling at the PA shape (round-5 kill-or-win
+    on the head-prefix machinery): if the head-only kernel's cost on the
+    full stream is already close to the full-table dim-1 kernel's, the
+    maximum win ANY guaranteed-prefix scheme (per-dataset q, per-batch q,
+    plan-level budgets) can deliver is their difference — the kernels are
+    STREAM-bound at small rp, not head-size-bound."""
+    R, B_pa = 47_236, 16_384 * 64
+    H = 2_048
+    rng = np.random.default_rng(3)
+    tab = jnp.asarray(rng.normal(0, 0.1, (R, 1)), jnp.float32)
+    ids = jnp.asarray(make_ids(R, B_pa, T, seed=4))
+    deltas = jnp.asarray(rng.normal(0, 1e-4, (T, B_pa, 1)), jnp.float32)
+
+    us = timeit(scan_scatter(lambda t, i, d: scatter_add_dim1_pallas(
+        t, i, d, row_tile=512, batch_tile=8192)), tab, ids, deltas)
+    print(f"pa. full dim1 scatter R={R}: {us / 1e3:7.3f} ms", flush=True)
+    us = timeit(scan_gather(gather_rows_dim1_pallas), tab, ids, deltas)
+    print(f"pa. full dim1 gather  R={R}: {us / 1e3:7.3f} ms", flush=True)
+
+    def head_scatter(t, i, d):
+        im = jnp.where(i < H, i, -1)
+        head = scatter_add_dim1_pallas(t[:H], im, d, row_tile=512,
+                                       batch_tile=8192)
+        return lax.dynamic_update_slice_in_dim(t, head, 0, axis=0)
+
+    def head_gather(t, i):
+        im = jnp.where(i < H, i, -1)
+        return gather_rows_dim1_pallas(t[:H], im)
+
+    us = timeit(scan_scatter(head_scatter), tab, ids, deltas)
+    print(f"pa. head-only scatter H={H}: {us / 1e3:7.3f} ms", flush=True)
+    us = timeit(scan_gather(head_gather), tab, ids, deltas)
+    print(f"pa. head-only gather  H={H}: {us / 1e3:7.3f} ms", flush=True)
+
+
+def stage_tune():
+    """Batch-tile tuning shot for the head kernel at the logreg shape —
+    is the stream-bound floor a tile-overhead artifact?"""
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(make_ids(R_FULL, B, T))
+    deltas = jnp.asarray(rng.normal(0, 1e-4, (T, B, 1)), jnp.float32)
+    tab = jnp.asarray(rng.normal(0, 0.1, (R_FULL, 1)), jnp.float32)
+    H = 65_536
+    for bt in (8_192, 16_384, 32_768):
+        def head_scatter(t, i, d, bt=bt):
+            im = jnp.where(i < H, i, -1)
+            head = scatter_add_dim1_pallas(t[:H], im, d, row_tile=512,
+                                           batch_tile=bt)
+            return lax.dynamic_update_slice_in_dim(t, head, 0, axis=0)
+
+        us = timeit(scan_scatter(head_scatter), tab, ids, deltas)
+        print(f"t. head H={H} batch_tile={bt:6d}: scatter {us / 1e3:7.3f} ms",
+              flush=True)
+
+
+STAGES = {
+    "a": stage_a,
+    "b1": lambda: stage_b([131_072, 262_144]),
+    "b2": lambda: stage_b([524_288, 1_000_000]),
+    "c": stage_c,
+    "d": stage_d,
+    "pa_head": stage_pa_head,
+    "tune": stage_tune,
+}
+
+
+if __name__ == "__main__":
+    import sys
+
+    for name in (sys.argv[1:] or list(STAGES)):
+        STAGES[name]()
