@@ -255,6 +255,13 @@ def run_mf(args):
     epochs = len(epoch_times)
     median_epoch = statistics.median(epoch_times)
     reached = rmse_curve[-1] <= target
+    # Speculative pipelining: when the target is hit with an epoch still in
+    # flight, that epoch's updates are already in `tables` — the post-loop
+    # state reflects up to epochs+1 training passes, while timing/quality
+    # cover exactly `epochs`. Only timing + rmse_curve are reported here;
+    # anyone consuming the final state (export, extra eval) must account
+    # for the extra pass — hence the explicit flag in the summary.
+    state_extra_epochs = len(pending)
 
     vs = None
     if base_tt.get("ps") is not None and reached:
@@ -280,6 +287,7 @@ def run_mf(args):
         "median_epoch_s": round(median_epoch, 4),
         "final_train_rmse": round(rmse_curve[-1], 4),
         "reached": reached,
+        "state_extra_epochs": state_extra_epochs,
         "baseline": baseline,
     }
 
@@ -552,14 +560,34 @@ def run_pa(args):
         base_ex_s[label] = m_ex / secs
         quality[label] = (hinge, mist)
 
+    # Multiclass baseline in the same quiet pre-TPU window (the 20-class
+    # sequential closed-form loop, fps_baseline_pa_mc) on the SAME data the
+    # TPU multiclass run will train on.
+    from fps_tpu.utils.datasets import synthetic_sparse_multiclass
+
+    NCLS, NEX_MC = 20, 200_000
+    mdata = synthetic_sparse_multiclass(NEX_MC, NF, NCLS, NNZ, seed=5)
+    mc_base_ex_s = {}
+    mc_quality = {}
+    for label, res in _measure_native_modes(
+        lambda m: native.baseline_pa_mc(
+            mdata["feat_ids"], mdata["feat_vals"], mdata["label"], NF, NCLS,
+            C=C, variant="PA-I", ps_mode=m,
+        )
+    ):
+        secs, hinge, mist = res
+        mc_base_ex_s[label] = NEX_MC / secs
+        mc_quality[label] = (hinge, mist)
+
     devs = jax.devices()
     nd, ns = default_mesh_shape(len(devs))
     mesh = make_ps_mesh(num_shards=ns, num_data=nd)
     W = num_workers_of(mesh)
     # Head-prefix routing (single-device meshes): frequency-sort each
     # example's slots so the first q columns carry ids < H, and the
-    # guaranteed prefix rides head-only kernels (ceil(H/128) packed rows
-    # instead of ceil(NF/128)). Pure routing — equality-tested in
+    # guaranteed prefix rides head-only kernels — measured at ~15% of
+    # the end-to-end headline (BASELINE.md round-5: 4.53M ex/s with the
+    # machinery off vs 5.36M with it on). Equality-tested in
     # tests/test_passive_aggressive.py.
     HEAD = 2048
     q = 0
@@ -610,13 +638,8 @@ def run_pa(args):
     )
 
     # Multiclass PA (transformMulticlass parity, SURVEY §2 #9): a 20-class
-    # RCV1-shaped run measured under the same roof — no native baseline
-    # exists (fps_baseline_pa is the binary fan-out loop), so the line is
-    # quality-annotated throughput, like iALS.
-    from fps_tpu.utils.datasets import synthetic_sparse_multiclass
-
-    NCLS, NEX_MC = 20, 200_000
-    mdata = synthetic_sparse_multiclass(NEX_MC, NF, NCLS, NNZ, seed=5)
+    # RCV1-shaped run measured under the same roof, against its own
+    # measured native sequential loop (fps_baseline_pa_mc, above).
     mcfg = PAConfig(num_features=NF, num_classes=NCLS, variant="PA-I", C=C)
     mtr, _ = passive_aggressive(mesh, mcfg, max_steps_per_call=256)
     mt, mls = mtr.init_state(jax.random.key(0))
@@ -638,6 +661,15 @@ def run_pa(args):
         f"chance = {1 - 1 / NCLS:.2f})",
         file=sys.stderr,
     )
+    mc_baseline, mc_vs = _rate_baseline(
+        mc_base_ex_s,
+        f"measured native sequential per-feature-fan-out {NCLS}-class PA-I "
+        "(message-hop mode, num_classes-float row messages); 'ideal' = "
+        "fused floor",
+        "examples", mc_ex_s,
+        {k: f"hinge {h:.4f}, mistakes {m:.4f}"
+         for k, (h, m) in mc_quality.items()},
+    )
 
     return {
         "metric": "rcv1_pa1_examples_per_sec_per_chip",
@@ -655,8 +687,8 @@ def run_pa(args):
             "mistake_rate_step0": round(float(m0), 4),
             "mistake_rate_last": round(float(m1), 4),
             "chance": round(1 - 1 / NCLS, 2),
-            "baseline": {"kind": "none — no native multiclass loop; "
-                                 "quality-annotated throughput"},
+            "baseline": mc_baseline,
+            "vs_baseline": mc_vs,
         },
     }
 
@@ -737,6 +769,29 @@ RUNNERS = {"mf": run_mf, "w2v": run_w2v, "logreg": run_logreg,
            "pa": run_pa, "ials": run_ials}
 
 
+def compact_summary(results):
+    """Digest for the driver-parsed FINAL stdout line.
+
+    Per workload only {metric, value, unit, vs_baseline}, floats rounded
+    to 4 significant-ish decimals — no nested baseline dicts, no prose —
+    so the whole line stays within the driver's bounded tail window
+    (asserted <=1000 bytes in the contract test). The mf headline is
+    mirrored at top level for the driver's single-metric parse.
+    """
+    def rnd(v):
+        return round(v, 4) if isinstance(v, float) else v
+
+    digest = {
+        name: {k: rnd(res.get(k)) for k in
+               ("metric", "value", "unit", "vs_baseline")}
+        for name, res in results.items()
+    }
+    mf = digest.get("mf", {})
+    return {"metric": mf.get("metric"), "value": mf.get("value"),
+            "unit": mf.get("unit"), "vs_baseline": mf.get("vs_baseline"),
+            "workloads": digest}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="all",
@@ -775,11 +830,17 @@ def main():
 
     if args.workload == "all":
         # Self-certifying artifact: the driver parses the FINAL line and
-        # keeps only a bounded tail, so the last line must carry every
-        # workload's result by itself (round 3's tail truncated mid-stream
-        # and lost the w2v headline). Top-level keys stay the mf headline
-        # for the driver's metric/value/vs_baseline parse; the full
-        # per-workload dicts ride in "workloads".
+        # keeps only a bounded TAIL, so the last line must carry every
+        # workload's result by itself AND fit the tail window. Round 3's
+        # tail truncated mid-stream; round 4's single rich combined line
+        # (nested baseline dicts, prose "kind" strings) was itself longer
+        # than the window and BENCH_r04.json.parsed came back null. So:
+        # the rich combined line goes out first, and the FINAL line is a
+        # compact digest — per workload only {metric, value, unit,
+        # vs_baseline}, floats rounded — size-asserted at <=1000 bytes by
+        # tests/test_examples.py::test_bench_combined_summary_line_contract.
+        # Top-level keys stay the mf headline for the driver's
+        # metric/value/vs_baseline parse.
         mf = results["mf"]
         combined = {
             "metric": mf["metric"],
@@ -789,6 +850,7 @@ def main():
             "workloads": results,
         }
         print(json.dumps(combined), flush=True)
+        print(json.dumps(compact_summary(results)), flush=True)
 
 
 if __name__ == "__main__":

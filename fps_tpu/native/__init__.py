@@ -160,6 +160,16 @@ def _load():
             ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_double),
         ]
+        lib.fps_baseline_pa_mc.restype = ctypes.c_double
+        lib.fps_baseline_pa_mc.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_long, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+            ctypes.c_float, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+        ]
         lib.fps_baseline_logreg.restype = ctypes.c_double
         lib.fps_baseline_logreg.argtypes = [
             ctypes.POINTER(ctypes.c_int32),
@@ -459,6 +469,33 @@ def baseline_pa(feat_ids, feat_vals, labels, num_features, *, C=1.0,
         _ptr(feat_ids, ctypes.c_int32), _ptr(feat_vals, ctypes.c_float),
         _ptr(labels, ctypes.c_float), n, nnz, int(num_features), float(C),
         var, 1 if ps_mode else 0, ctypes.byref(hinge), ctypes.byref(mist),
+    )
+    if secs < 0:
+        return None
+    return float(secs), float(hinge.value), float(mist.value)
+
+
+def baseline_pa_mc(feat_ids, feat_vals, labels, num_features, num_classes,
+                   *, C=1.0, variant="PA-I", ps_mode=True):
+    """MEASURED sequential per-example MULTICLASS passive-aggressive
+    baseline (per-feature pull/push fan-out of ``num_classes``-float class
+    rows; labels are class indices). One pass; returns
+    ``(seconds, mean_hinge, mistake_frac)`` or ``None`` if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    var = {"PA": 0, "PA-I": 1, "PA-II": 2}[variant]
+    feat_ids = np.ascontiguousarray(feat_ids, np.int32)
+    feat_vals = np.ascontiguousarray(feat_vals, np.float32)
+    labels = np.ascontiguousarray(labels, np.int32)
+    n, nnz = feat_ids.shape
+    hinge = ctypes.c_double(0.0)
+    mist = ctypes.c_double(0.0)
+    secs = lib.fps_baseline_pa_mc(
+        _ptr(feat_ids, ctypes.c_int32), _ptr(feat_vals, ctypes.c_float),
+        _ptr(labels, ctypes.c_int32), n, nnz, int(num_features),
+        int(num_classes), float(C), var, 1 if ps_mode else 0,
+        ctypes.byref(hinge), ctypes.byref(mist),
     )
     if secs < 0:
         return None

@@ -571,7 +571,10 @@ inline double now_s() {
 // path back into the ideal loop — each message pays two real calls and two
 // real memcpys, the irreducible cost of an operator hop.
 struct Ring {
-  static const long SLOT = 512;  // >= largest message (id + 100 floats)
+  // >= largest message: id + kMaxClasses floats (multiclass PA row) and
+  // id + 100 floats (rank-100 w2v/MF rows) both fit; static_asserts at
+  // the consumers tie the caps to this size.
+  static const long SLOT = 512;
   static const long NSLOT = 256;
   char* data;
   long w;
@@ -944,6 +947,128 @@ double fps_baseline_pa(const int32_t* ids, const float* vals,
           w[get_id(&msg[0])] += msg[1];
         } else {
           w[fid[j]] += step * fval[j];
+        }
+      }
+    }
+  }
+  double dt = now_s() - t0;
+  if (mean_hinge) *mean_hinge = hinge / (n > 0 ? n : 1);
+  if (mistake_frac)
+    *mistake_frac = static_cast<double>(mistakes) / (n > 0 ? n : 1);
+  free(w);
+  return dt;
+}
+
+// Sequential per-example MULTICLASS passive-aggressive (Crammer et al.
+// 2006 max-margin-violation update — the closed form the TPU path's
+// MulticlassPassiveAggressiveWorker computes in batch): per example, pull
+// each active feature's num_classes-float class row, score all classes,
+// take the true class r vs the highest-scoring wrong class s,
+// l = max(0, 1 - (score_r - score_s)), tau per variant with ||x||^2
+// DOUBLED (the update touches two class columns), then push one
+// num_classes-float delta row per active feature (+tau*x_j in column r,
+// -tau*x_j in column s). Labels are class indices in [0, num_classes).
+// ps_mode forces every pull request/response and push delta through the
+// message ring exactly like the binary loop, with row-sized messages.
+// One pass; writes mean hinge loss and the online mistake fraction.
+// Returns seconds, or -1.
+double fps_baseline_pa_mc(const int32_t* ids, const float* vals,
+                          const int32_t* labels, long n, long nnz,
+                          long num_features, long num_classes, float C,
+                          int variant, int ps_mode, double* mean_hinge,
+                          double* mistake_frac) {
+  // The class cap is tied to the fixed buffers below and the ring slot:
+  // msg carries id + num_classes floats, rowbuf/scores hold num_classes.
+  const long kMaxClasses = 120;
+  static_assert(sizeof(float) * (kMaxClasses + 1) <= Ring::SLOT,
+                "multiclass PA message must fit one ring slot");
+  static_assert(kMaxClasses + 1 <= 128,
+                "multiclass PA buffers are 128 floats");
+  if (num_classes < 3 || num_classes > kMaxClasses) return -1.0;
+  // Labels index the scores/msg stack arrays and the weight rows: an
+  // out-of-range class (1-based labels, -1 missing sentinel) must surface
+  // as the -1 error return, not as silent memory corruption.
+  for (long k = 0; k < n; ++k) {
+    if (labels[k] < 0 || labels[k] >= num_classes) return -1.0;
+  }
+  float* w =
+      static_cast<float*>(calloc(num_features * num_classes, sizeof(float)));
+  if (!w) return -1.0;
+  Ring ring;
+  if (ps_mode && !ring.ok()) {
+    free(w);
+    return -1.0;
+  }
+  float rowbuf[128];
+  float msg[128];  // id + num_classes floats
+  float scores[128];
+  double hinge = 0.0;
+  long mistakes = 0;
+  double t0 = now_s();
+  for (long k = 0; k < n; ++k) {
+    const int32_t* fid = ids + k * nnz;
+    const float* fval = vals + k * nnz;
+    long r = labels[k];
+    for (long c = 0; c < num_classes; ++c) scores[c] = 0.0f;
+    float x2 = 0.0f;
+    for (long j = 0; j < nnz; ++j) {
+      if (fval[j] == 0.0f) continue;
+      const float* row;
+      if (ps_mode) {
+        char* s1 = ring_send(ring, &fid[j], sizeof(int32_t));
+        int32_t gi;
+        ring_recv(&gi, s1, sizeof(gi));
+        char* s2 = ring_send(ring, w + static_cast<long>(gi) * num_classes,
+                             sizeof(float) * num_classes);
+        ring_recv(rowbuf, s2, sizeof(float) * num_classes);
+        row = rowbuf;
+      } else {
+        row = w + static_cast<long>(fid[j]) * num_classes;
+      }
+      float xv = fval[j];
+      for (long c = 0; c < num_classes; ++c) scores[c] += row[c] * xv;
+      x2 += xv * xv;
+    }
+    // Highest-scoring WRONG class s; prediction = overall argmax (first
+    // max wins, matching jnp.argmax).
+    long s = (r == 0) ? 1 : 0;
+    long pred = 0;
+    for (long c = 1; c < num_classes; ++c) {
+      if (scores[c] > scores[pred]) pred = c;
+      if (c != r && scores[c] > scores[s]) s = c;
+    }
+    if (pred != r) ++mistakes;
+    float l = 1.0f - (scores[r] - scores[s]);
+    if (l < 0.0f) l = 0.0f;
+    hinge += l;
+    if (l > 0.0f && x2 > 0.0f) {
+      float x2m = 2.0f * x2;
+      float tau;
+      if (variant == 0) {
+        tau = l / x2m;
+      } else if (variant == 1) {
+        tau = l / x2m;
+        if (tau > C) tau = C;
+      } else {
+        tau = l / (x2m + 0.5f / C);
+      }
+      for (long j = 0; j < nnz; ++j) {
+        if (fval[j] == 0.0f) continue;
+        float step = tau * fval[j];
+        if (ps_mode) {
+          put_id(&msg[0], fid[j]);
+          for (long c = 0; c < num_classes; ++c) msg[1 + c] = 0.0f;
+          msg[1 + r] = step;
+          msg[1 + s] = -step;
+          char* s3 = ring_send(ring, msg, sizeof(float) * (num_classes + 1));
+          ring_recv(msg, s3, sizeof(float) * (num_classes + 1));
+          float* wrow =
+              w + static_cast<long>(get_id(&msg[0])) * num_classes;
+          for (long c = 0; c < num_classes; ++c) wrow[c] += msg[1 + c];
+        } else {
+          float* wrow = w + static_cast<long>(fid[j]) * num_classes;
+          wrow[r] += step;
+          wrow[s] -= step;
         }
       }
     }

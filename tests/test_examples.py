@@ -192,8 +192,12 @@ def test_logreg_real_input_criteo(devices8, capsys, tmp_path):
 
 def test_bench_combined_summary_line_contract(capsys):
     """The driver parses bench.py's FINAL stdout line and keeps a bounded
-    tail: in all-workload mode that line must be one JSON object carrying
-    the top-level metric keys AND every workload's full result."""
+    tail. Round 4 proved the binding constraint is SIZE, not shape: the
+    rich combined line (nested baseline dicts, prose) overran the tail
+    window and BENCH_r04.json.parsed was null. The final line must be a
+    compact digest — per workload only {metric, value, unit, vs_baseline}
+    — and must stay under a hard byte budget; the rich combined line
+    rides immediately above it."""
     import importlib.util
     import json
     import os
@@ -206,9 +210,19 @@ def test_bench_combined_summary_line_contract(capsys):
     spec.loader.exec_module(bench)
 
     for name in bench.RUNNERS:
+        # Realistically verbose stub results: long metric names, full
+        # nested baseline dicts with prose "kind" strings, unrounded
+        # floats — the exact payload class that overran the round-4 tail.
         bench.RUNNERS[name] = (lambda n: lambda args: {
-            "metric": f"{n}_metric", "value": 1.0, "unit": "u",
-            "vs_baseline": None if n == "ials" else 2.0,
+            "metric": f"synthetic_{n}_examples_per_sec_per_chip_headline",
+            "value": 5355285.333333333, "unit": "examples/s",
+            "vs_baseline": None if n == "ials" else 5.302187123,
+            "epoch_s": 0.1492837465,
+            "baseline": {"kind": "measured native sequential loop "
+                                 "(message-hop mode); 'ideal' = fused "
+                                 "floor — long prose annotation " * 3,
+                         "ps_examples_per_s": 1010333.7123,
+                         "ideal_examples_per_s": 8836468.0123},
         })(name)
     argv, _sys.argv = _sys.argv, ["bench.py"]
     try:
@@ -216,10 +230,25 @@ def test_bench_combined_summary_line_contract(capsys):
     finally:
         _sys.argv = argv
     lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
-    final = json.loads(lines[-1])
-    assert {"metric", "value", "unit", "vs_baseline"} <= final.keys()
-    assert set(final["workloads"]) == {"mf", "w2v", "logreg", "pa", "ials"}
-    for name, res in final["workloads"].items():
-        assert res["metric"] == f"{name}_metric"
-    # per-workload lines still precede it (one JSON line each + summary)
-    assert len(lines) == 6
+    # 5 per-workload lines + rich combined + compact digest
+    assert len(lines) == 7
+
+    final = lines[-1]
+    # The driver keeps a bounded tail; the final line must fit it with
+    # margin even with every workload present. 1000 bytes is the budget.
+    assert len(final.encode("utf-8")) <= 1000, len(final)
+    digest = json.loads(final)
+    assert {"metric", "value", "unit", "vs_baseline"} <= digest.keys()
+    assert set(digest["workloads"]) == {"mf", "w2v", "logreg", "pa", "ials"}
+    for name, res in digest["workloads"].items():
+        assert set(res) == {"metric", "value", "unit", "vs_baseline"}
+        assert res["metric"] == f"synthetic_{name}_examples_per_sec_per_chip_headline"
+        # floats rounded: json round-trip stays short
+        assert res["value"] == 5355285.3333
+    assert digest["metric"] == digest["workloads"]["mf"]["metric"]
+    assert digest["vs_baseline"] == digest["workloads"]["mf"]["vs_baseline"]
+
+    # The rich combined line still precedes it with the full results.
+    rich = json.loads(lines[-2])
+    assert set(rich["workloads"]) == {"mf", "w2v", "logreg", "pa", "ials"}
+    assert "baseline" in rich["workloads"]["mf"]

@@ -242,3 +242,20 @@ def test_baseline_pa_learns_and_modes_agree(lib):
     assert m_ps < 0.35          # online mistakes well below chance 0.5
     assert abs(h_ps - h_id) < 1e-6 and abs(m_ps - m_id) < 1e-9
     assert s_ps > 0 and s_id > 0
+
+
+def test_baseline_pa_mc_learns_and_modes_agree(lib):
+    rng = np.random.default_rng(5)
+    nf, nnz, n, nc = 3000, 8, 30000, 6
+    ids = rng.integers(0, nf, (n, nnz)).astype(np.int32)
+    vals = rng.normal(0, 1, (n, nnz)).astype(np.float32)
+    # Planted per-class weights: label = argmax of true class scores.
+    w_true = rng.normal(0, 1, (nf, nc))
+    scores = np.einsum("bn,bnc->bc", vals, w_true[ids])
+    y = np.argmax(scores, axis=-1).astype(np.int32)
+    s_ps, h_ps, m_ps = lib.baseline_pa_mc(ids, vals, y, nf, nc, ps_mode=True)
+    s_id, h_id, m_id = lib.baseline_pa_mc(ids, vals, y, nf, nc, ps_mode=False)
+    chance = 1.0 - 1.0 / nc
+    assert m_ps < chance - 0.2    # online mistakes well below chance
+    assert abs(h_ps - h_id) < 1e-6 and abs(m_ps - m_id) < 1e-9
+    assert s_ps > 0 and s_id > 0
