@@ -39,7 +39,8 @@ def main(argv=None) -> int:
     ap.add_argument("--sketch-words", type=int, default=0,
                     help="track the P most frequent words' co-occurrence "
                          "similarity with a tug-of-war sketch riding the "
-                         "training loop (host-ingest path only; 0 = off)")
+                         "training loop (pair AND fused block paths; "
+                         "0 = off)")
     args = ap.parse_args(argv)
 
     from fps_tpu.core.driver import num_workers_of
@@ -69,22 +70,16 @@ def main(argv=None) -> int:
     sketch_probe = None
     step_tap = None
     if args.sketch_words > 0:
-        if args.ingest == "device":
-            # The block worker never materializes its pairs, so there is
-            # nothing batch-visible to sketch on the fused path.
-            emit({"event": "warning",
-                  "msg": "--sketch-words needs the host-ingest pair path; "
-                         "ignored with --ingest device"})
-        else:
-            from fps_tpu.sketch import TugOfWarSpec
+        # Rides BOTH paths: the pair batches directly, and the fused block
+        # path via id-only pair-stream reconstruction from the raw block
+        # batch (models.word2vec.block_pair_stream).
+        from fps_tpu.sketch import TugOfWarSpec
 
-            sketch_probe = np.argsort(-uni)[: args.sketch_words].astype(
-                np.int32
-            )
-            step_tap = cooccurrence_sketch_tap(
-                TugOfWarSpec(depth=5, width=1024, seed=args.seed),
-                sketch_probe,
-            )
+        sketch_probe = np.argsort(-uni)[: args.sketch_words].astype(np.int32)
+        step_tap = cooccurrence_sketch_tap(
+            TugOfWarSpec(depth=5, width=1024, seed=args.seed),
+            sketch_probe,
+        )
 
     block_len = max(64, args.local_batch // (2 * cfg.window))
     if args.ingest == "device":
@@ -92,7 +87,7 @@ def main(argv=None) -> int:
         # (~10x fewer sparse row transactions than per-pair pull/push).
         trainer, store = word2vec_block(
             mesh, cfg, uni, block_len, sync_every=args.sync_every,
-            max_steps_per_call=256,
+            max_steps_per_call=256, step_tap=step_tap,
         )
     else:
         trainer, store = word2vec(mesh, cfg, uni, sync_every=args.sync_every,

@@ -91,8 +91,14 @@ class W2VConfig:
     # Word ids [0, hot_words) are write-hot (NuPS-style hot/cold push split,
     # fps_tpu.ops.scatter_add); vocabulary ids are frequency-ranked by every
     # loader (most_common order), so the Zipf head sits exactly there.
+    # "auto" routes the WHOLE shard slice through the packed MXU scatter
+    # when the mesh leaves it thinner than the measured crossover
+    # (fps_tpu.ops.packed_crossover_rows) — the many-shard regime; w2v's
+    # mean-combine push always takes the gathered route, so this is the
+    # shipped family where "auto" actually fires (vocab 50k on 32+ shards,
+    # or proportionally smaller vocabs — see dryrun_multichip).
     # Default 0 — see MFConfig.hot_items for when enabling it pays.
-    hot_words: int = 0
+    hot_words: int | str = 0
     # Block-mode only (Word2VecBlockWorker): positions share one set of K
     # negatives per group of this many tokens. Default 1 = per-POSITION
     # negatives (shared only across a position's ~2*window instances) —
@@ -340,7 +346,16 @@ class Word2VecBlockWorker(WorkerLogic, _AliasNegativeSampler):
 
 def make_store(mesh, cfg: W2VConfig) -> ParamStore:
     half = 0.5 / cfg.dim
-    hot = min(cfg.hot_words, cfg.vocab_size)
+    hot = cfg.hot_words
+    if isinstance(hot, str):
+        if hot != "auto":
+            # Same altitude contract as driver._resolve_hot_rows: a typo'd
+            # literal must not surface as a TypeError inside min().
+            raise ValueError(
+                f"hot_words={hot!r} — expected an int or the literal 'auto'"
+            )
+    else:
+        hot = min(hot, cfg.vocab_size)
     in_spec = TableSpec(
         name=IN_TABLE,
         num_ids=cfg.vocab_size,
@@ -418,15 +433,19 @@ def word2vec_block(mesh, cfg: W2VConfig, unigram_counts: np.ndarray,
                    block_len: int, *, sync_every: int | None = None,
                    donate: bool = True,
                    max_steps_per_call: int | None = None,
-                   push_delay: int = 0):
+                   push_delay: int = 0, step_tap=None):
     """(trainer, store) with the block-granularity worker — pair with a
     ``Word2VecDevicePlan(..., block_len=block_len, mode="block")``. Same
     tables, same SGNS objective; ~10x fewer sparse row transactions per
-    step at the default geometry (see :class:`Word2VecBlockWorker`)."""
+    step at the default geometry (see :class:`Word2VecBlockWorker`).
+    ``step_tap`` taps (e.g. :func:`cooccurrence_sketch_tap`) see the raw
+    block batch and can reconstruct its exact pair stream id-only via
+    :func:`block_pair_stream`."""
     return _make_trainer(
         mesh, cfg, Word2VecBlockWorker(cfg, unigram_counts, block_len),
         sync_every=sync_every, donate=donate,
         max_steps_per_call=max_steps_per_call, push_delay=push_delay,
+        step_tap=step_tap,
     )
 
 
@@ -583,6 +602,51 @@ def nearest_neighbors(store: ParamStore, word_ids: np.ndarray, k: int = 5,
 # delta that joins the metrics stream.
 # ---------------------------------------------------------------------------
 
+def _sketch_pair_stream(spec, probe, center, ctx, w):
+    """Route each (center, context, weight) pair to its probe row and add
+    its tug-of-war contribution — one O(B*P) compare plus ONE scatter into
+    the flattened ``(P, depth, width)`` stack (not a full-width scatter
+    per probe)."""
+    from fps_tpu.sketch import tow_update_rows
+
+    P = int(probe.shape[0])
+    eq = center[:, None] == probe[None, :]  # (B, P)
+    row = jnp.where(eq.any(axis=1), jnp.argmax(eq, axis=1), -1)
+    stack = jnp.zeros((P, spec.depth, spec.width), jnp.float32)
+    return tow_update_rows(spec, stack, row, ctx, w)
+
+
+def block_pair_stream(batch):
+    """Reconstruct the exact (center, context, weight) pair stream of one
+    BLOCK-worker batch from its raw columns — the same pairs
+    :class:`Word2VecBlockWorker.step` trains on without materializing.
+
+    The block batch carries ``block (L+W,)``, ``half (L,)`` and
+    ``valid_len ()``; the worker's pair semantics are, for every offset
+    ``d in [1, W]`` and position ``i < L``: weight
+    ``(half[i] >= d) & (i + d < valid_len)`` on BOTH orientations of the
+    adjacency ``(i, i+d)``. ``W`` is inferred from the static shapes
+    (``len(block) - len(half)``). Returns ``(center, ctx, w)`` arrays of
+    length ``2*W*L`` — ids only, so a probe tap costs O(W·L·P) compares
+    per step, no embedding traffic.
+    """
+    block = batch["block"].astype(jnp.int32)  # (L+W,)
+    half = batch["half"].astype(jnp.int32)  # (L,)
+    vlen = batch["valid_len"].astype(jnp.int32)  # ()
+    L = half.shape[0]
+    W = block.shape[0] - L
+    pos = jnp.arange(L, dtype=jnp.int32)
+    centers, ctxs, ws = [], [], []
+    for d in range(1, W + 1):
+        wk = ((half >= d) & (pos + d < vlen)).astype(jnp.float32)
+        lo, hi = block[:L], block[d : L + d]
+        centers += [lo, hi]
+        ctxs += [hi, lo]
+        ws += [wk, wk]
+    return (jnp.concatenate(centers), jnp.concatenate(ctxs),
+            jnp.concatenate(ws))
+
+
 def cooccurrence_sketch_tap(spec, probe_ids):
     """``step_tap`` emitting per-step tug-of-war sketch DELTAS of each probe
     word's context-frequency vector.
@@ -594,28 +658,25 @@ def cooccurrence_sketch_tap(spec, probe_ids):
     exactly :func:`accumulate_sketch_taps`. Pad pairs carry weight 0 and
     vanish from the estimate.
 
-    Works with the PAIR worker's batch schema (``center``/``context``/
+    Works with BOTH worker schemas: the PAIR batch (``center``/``context``/
     ``weight`` columns — :func:`skipgram_chunks` and the pair-mode
-    :class:`Word2VecDevicePlan`); the block worker never materializes its
-    pairs, so it has nothing batch-visible to sketch.
+    :class:`Word2VecDevicePlan`) is sketched directly, and a BLOCK batch
+    (``block``/``half``/``valid_len``) has its exact pair stream
+    reconstructed id-only on the fly (:func:`block_pair_stream`) — so the
+    estimator also rides the fused fast path that delivers the w2v
+    headline, at ~2·window·L extra int32 compares per step.
     """
-    from fps_tpu.sketch import tow_update_rows
-
     probe = jnp.asarray(probe_ids, jnp.int32)  # (P,)
-    P = int(probe.shape[0])
 
     def tap(tables, batch, local_state, t):
         del tables, local_state, t
-        ctx = batch["context"].astype(jnp.int32)  # (B,)
-        center = batch["center"].astype(jnp.int32)
-        w = batch["weight"].astype(jnp.float32)
-        # One O(B*P) compare to route each pair to its probe row (or drop),
-        # then ONE scatter into the flattened stack — not a full-width
-        # scatter per probe.
-        eq = center[:, None] == probe[None, :]  # (B, P)
-        row = jnp.where(eq.any(axis=1), jnp.argmax(eq, axis=1), -1)
-        stack = jnp.zeros((P, spec.depth, spec.width), jnp.float32)
-        return tow_update_rows(spec, stack, row, ctx, w)
+        if "block" in batch:
+            center, ctx, w = block_pair_stream(batch)
+        else:
+            center = batch["center"].astype(jnp.int32)
+            ctx = batch["context"].astype(jnp.int32)
+            w = batch["weight"].astype(jnp.float32)
+        return _sketch_pair_stream(spec, probe, center, ctx, w)
 
     return tap
 

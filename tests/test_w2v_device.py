@@ -212,3 +212,78 @@ def test_block_worker_embedding_quality_parity(mesh):
     # ...and block must be within noise of pairs (no quality-for-speed
     # trade hiding in the coupling).
     assert rec_block >= rec_pairs - 0.1, (rec_block, rec_pairs)
+
+
+def test_block_path_sketch_tap_tracks_exact(mesh):
+    """The co-occurrence sketch must also ride the BLOCK (fused) path: the
+    tap reconstructs the block batch's exact pair stream id-only
+    (block_pair_stream) and sketches it. Verified two ways against a
+    combined tap computing ground truth from the SAME reconstructed
+    stream inside the compiled loop: (a) the stream's total pair weight
+    equals the worker's own npairs metric (exactness of the
+    reconstruction), and (b) tug-of-war similarities track the exact
+    co-occurrence inner products (estimator accuracy)."""
+    from fps_tpu.models.word2vec import (
+        block_pair_stream,
+        sketch_similarity,
+        _sketch_pair_stream,
+    )
+    from fps_tpu.sketch import TugOfWarSpec
+
+    W = num_workers_of(mesh)
+    V2 = 80
+    tokens = synthetic_corpus(V2, 30_000, num_topics=4, seed=5)
+    uni = np.bincount(tokens, minlength=V2).astype(np.float64)
+    cfg = W2VConfig(vocab_size=V2, dim=8, window=2, negatives=2,
+                    subsample_t=None)
+    probe = np.argsort(-uni)[:6].astype(np.int32)
+    P = len(probe)
+    spec = TugOfWarSpec(depth=5, width=512, seed=7)
+    probe_j = jnp.asarray(probe)
+
+    def tap(tables, batch, local_state, t):
+        del tables, local_state, t
+        center, ctx, w = block_pair_stream(batch)
+        sk = _sketch_pair_stream(spec, probe_j, center, ctx, w)
+        # Exact (P, V2) context counts from the same stream + total weight.
+        eq = center[:, None] == probe_j[None, :]
+        row = jnp.where(eq.any(axis=1), jnp.argmax(eq, axis=1), -1)
+        flat = jnp.where(row >= 0, row * V2 + ctx, -1)
+        exact = jnp.zeros(P * V2, jnp.float32).at[
+            jnp.where(flat >= 0, flat, P * V2)
+        ].add(jnp.where(row >= 0, w, 0.0), mode="drop").reshape(P, V2)
+        return {"sketch": sk, "exact": exact, "wsum": jnp.sum(w)}
+
+    trainer, store = word2vec_block(mesh, cfg, uni, 64, step_tap=tap)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    plan = Word2VecDevicePlan(tokens, uni, cfg, mesh, num_workers=W,
+                              block_len=64, seed=0, mode="block")
+    tables, ls, metrics = trainer.run_indexed(
+        tables, ls, plan, jax.random.key(1), epochs=1
+    )
+
+    sk_sum = None
+    ex_sum = None
+    wsum = 0.0
+    npairs = 0.0
+    for m in metrics:
+        sk_sum = (0 if sk_sum is None else sk_sum) + np.asarray(
+            m["tap"]["sketch"]).sum(axis=(0, 1))
+        ex_sum = (0 if ex_sum is None else ex_sum) + np.asarray(
+            m["tap"]["exact"]).sum(axis=(0, 1))
+        wsum += float(np.asarray(m["tap"]["wsum"]).sum())
+        npairs += float(np.asarray(m["n"]).sum())
+
+    # (a) the reconstructed stream IS the worker's pair stream.
+    assert abs(wsum - npairs) < 1e-3 * max(npairs, 1.0), (wsum, npairs)
+    assert npairs > 1000
+
+    est = sketch_similarity(sk_sum)
+    exact = ex_sum.astype(np.float64) @ ex_sum.astype(np.float64).T
+    rel = np.abs(np.diag(est) - np.diag(exact)) / np.maximum(
+        np.diag(exact), 1.0
+    )
+    assert np.median(rel) < 0.15, (np.diag(est), np.diag(exact))
+    iu = np.triu_indices(P, k=1)
+    r = np.corrcoef(est[iu], exact[iu])[0, 1]
+    assert r > 0.9, (r, est[iu], exact[iu])
