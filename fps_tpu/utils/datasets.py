@@ -518,9 +518,11 @@ def head_sort_slots(data: dict, head_features: int):
     makes the first ``q = min_examples(head_count)`` slot COLUMNS carry
     head ids in EVERY example — a static guarantee the sparse workers turn
     into ``ops.gather_rows``/``scatter_add`` ``head_prefix`` routing
-    (head-only kernels whose MXU cost scales with the head size, not the
-    table size). Slot order within an example is semantically irrelevant
-    (the models sum over slots), so this is a pure relayout.
+    (head-only kernels whose cost scales with the head's row tiles, not
+    the table's). Measured at ~15% of the end-to-end PA headline
+    (BASELINE.md round-5 section). Slot order within an example is
+    semantically irrelevant (the models sum over slots), so this is a
+    pure relayout.
 
     Returns ``(data2, q)`` — data with ``feat_ids``/``feat_vals`` columns
     reordered per example (other columns untouched), and the guaranteed

@@ -115,8 +115,6 @@ def test_head_prefix_training_matches_plain(devices8, backend):
     """PA with head-prefix routing (sorted slots + nnz-major flatten +
     head-only kernels) must train to the same weights as the plain
     row-major path on the same sorted data — the hint is routing only."""
-    import dataclasses as _dc
-
     import fps_tpu.ops as ops_mod
     from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
     from fps_tpu.utils.datasets import head_sort_slots
