@@ -230,8 +230,10 @@ def test_bench_combined_summary_line_contract(capsys):
     finally:
         _sys.argv = argv
     lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
-    # 5 per-workload lines + rich combined + compact digest
-    assert len(lines) == 7
+    # 5 x (per-workload line + cumulative digest) + rich combined + final
+    # digest: a killed run's final stdout line is ALWAYS a digest of what
+    # completed.
+    assert len(lines) == 12
 
     final = lines[-1]
     # The driver keeps a bounded tail; the final line must fit it with
@@ -248,7 +250,20 @@ def test_bench_combined_summary_line_contract(capsys):
     assert digest["metric"] == digest["workloads"]["mf"]["metric"]
     assert digest["vs_baseline"] == digest["workloads"]["mf"]["vs_baseline"]
 
-    # The rich combined line still precedes it with the full results.
+    # Every cumulative digest (odd positions) is parseable, in budget, and
+    # mirrors a headline even before mf completes (kill-resilience): the
+    # fallback must track the LAST completed workload, not a stale one.
+    order = ["w2v", "logreg", "pa", "ials", "mf"]
+    for seen, i in enumerate((1, 3, 5, 7, 9), start=1):
+        d = json.loads(lines[i])
+        assert len(lines[i].encode("utf-8")) <= 1000
+        assert len(d["workloads"]) == seen
+        expect = "mf" if seen == 5 else order[seen - 1]
+        assert d["metric"] == (
+            f"synthetic_{expect}_examples_per_sec_per_chip_headline")
+
+    # The rich combined line still precedes the final digest with the
+    # full results.
     rich = json.loads(lines[-2])
     assert set(rich["workloads"]) == {"mf", "w2v", "logreg", "pa", "ials"}
     assert "baseline" in rich["workloads"]["mf"]
