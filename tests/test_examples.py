@@ -230,10 +230,11 @@ def test_bench_combined_summary_line_contract(capsys):
     finally:
         _sys.argv = argv
     lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
-    # 5 x (per-workload line + cumulative digest) + rich combined + final
-    # digest: a killed run's final stdout line is ALWAYS a digest of what
+    # 4 x (per-workload line + cumulative digest) + final workload + rich
+    # combined + final digest (the last workload's digest IS the final
+    # line): a killed run's final stdout line is ALWAYS a digest of what
     # completed.
-    assert len(lines) == 12
+    assert len(lines) == 11
 
     final = lines[-1]
     # The driver keeps a bounded tail; the final line must fit it with
@@ -254,13 +255,12 @@ def test_bench_combined_summary_line_contract(capsys):
     # mirrors a headline even before mf completes (kill-resilience): the
     # fallback must track the LAST completed workload, not a stale one.
     order = ["w2v", "logreg", "pa", "ials", "mf"]
-    for seen, i in enumerate((1, 3, 5, 7, 9), start=1):
+    for seen, i in enumerate((1, 3, 5, 7), start=1):
         d = json.loads(lines[i])
         assert len(lines[i].encode("utf-8")) <= 1000
         assert len(d["workloads"]) == seen
-        expect = "mf" if seen == 5 else order[seen - 1]
         assert d["metric"] == (
-            f"synthetic_{expect}_examples_per_sec_per_chip_headline")
+            f"synthetic_{order[seen - 1]}_examples_per_sec_per_chip_headline")
 
     # The rich combined line still precedes the final digest with the
     # full results.
