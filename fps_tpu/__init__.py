@@ -18,9 +18,19 @@ The user contract mirrors the reference's two-trait API (``WorkerLogic`` /
 ``ParameterServerLogic``) in functional form — see :mod:`fps_tpu.core.api`.
 """
 
+from fps_tpu.utils import compat as _compat
+
+_compat.install()
+
 from fps_tpu.core.api import ServerLogic, WorkerLogic, StepOutput
 from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
 from fps_tpu.core.driver import Trainer, TrainerConfig, num_workers_of
+from fps_tpu.core.resilience import (
+    GuardConfig,
+    PoisonedStreamError,
+    RollbackPolicy,
+    SnapshotCorruptionError,
+)
 from fps_tpu.core.store import TableSpec, ParamStore
 from fps_tpu.parallel.mesh import init_distributed, make_ps_mesh
 
@@ -39,5 +49,9 @@ __all__ = [
     "DeviceEpochPlan",
     "make_ps_mesh",
     "init_distributed",
+    "GuardConfig",
+    "RollbackPolicy",
+    "SnapshotCorruptionError",
+    "PoisonedStreamError",
     "__version__",
 ]

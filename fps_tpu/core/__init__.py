@@ -6,6 +6,12 @@ from fps_tpu.core.checkpoint import (
     load_rows,
     load_saved_model,
 )
+from fps_tpu.core.resilience import (
+    GuardConfig,
+    PoisonedStreamError,
+    RollbackPolicy,
+    SnapshotCorruptionError,
+)
 from fps_tpu.core.store import TableSpec, ParamStore, pull, push
 
 __all__ = [
@@ -21,4 +27,8 @@ __all__ = [
     "load_model",
     "load_rows",
     "load_saved_model",
+    "GuardConfig",
+    "PoisonedStreamError",
+    "RollbackPolicy",
+    "SnapshotCorruptionError",
 ]

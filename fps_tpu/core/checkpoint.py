@@ -25,26 +25,68 @@ mesh restores onto any other shard count.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
+import struct
 import tempfile
+import zipfile
+import zlib
 from typing import Any, Mapping
 
 import jax
 import numpy as np
 
+from fps_tpu.core.resilience import SnapshotCorruptionError, array_crc32
 from fps_tpu.core.store import ParamStore, id_to_phys, rows_per_shard
 
 Pytree = Any
 
+_log = logging.getLogger("fps_tpu.checkpoint")
+
 _SEP = "::"  # npz key separator: kind::name
+
+# Snapshot filename contract — the single source of truth, shared with
+# the chaos injectors (fps_tpu.testing.chaos.snapshot_paths).
+SNAPSHOT_RE = re.compile(r"ckpt_(\d{12})\.npz")
+SNAPSHOT_FMT = "ckpt_{step:012d}.npz"
+
+# Per-array integrity tags: ``meta::crc::<key>`` holds the CRC-32 of
+# <key>'s raw bytes, written at save time and checked by read_snapshot —
+# the defense against silent bit rot that the zip container's own member
+# CRCs don't fully provide (numpy reads members lazily/partially).
+_CRC_PREFIX = f"meta{_SEP}crc{_SEP}"
+
+# Everything a torn/corrupted .npz throws on open or member read (zip
+# magic, central directory, member CRC, npy header parsing, ...).
+# Deliberately NOT OSError: transient environment failures (EMFILE,
+# EACCES, a flaky NFS mount) must surface as what they are, not be
+# classified as corruption — the auto-resolve restore path DESTRUCTIVELY
+# quarantines "corrupt" snapshots, and a transient would otherwise rename
+# every intact snapshot to *.corrupt before failing.
+_IO_ERRORS = (
+    EOFError,
+    KeyError,
+    IndexError,
+    ValueError,
+    struct.error,
+    zipfile.BadZipFile,
+    zipfile.LargeZipFile,
+    zlib.error,
+)
+
+
+def _keys(z):
+    """Key collection of an open npz OR a plain {key: array} dict (the
+    verified-read path materializes entries before using these helpers)."""
+    return z.files if hasattr(z, "files") else z
 
 
 def _ls_leaves(z) -> list:
-    """Local-state leaves from an open npz (touches only ls:: keys)."""
+    """Local-state leaves from an npz/dict (touches only ls:: keys)."""
     leaves = []
     i = 0
-    while f"ls{_SEP}{i}" in z.files:
+    while f"ls{_SEP}{i}" in _keys(z):
         leaves.append(z[f"ls{_SEP}{i}"])
         i += 1
     return leaves
@@ -52,7 +94,7 @@ def _ls_leaves(z) -> list:
 
 def _ls_format(z) -> str:
     key = f"meta{_SEP}ls_format"
-    return str(z[key]) if key in z.files else "raw"
+    return str(z[key]) if key in _keys(z) else "raw"
 
 
 # ---------------------------------------------------------------------------
@@ -180,6 +222,19 @@ class Checkpointer:
     in logical user order) — ``Trainer.restore_checkpoint`` re-lays it out
     for any worker count when the logic implements ``import_local_state``;
     the raw :meth:`restore` keeps the same-worker-count contract.
+
+    Integrity: every array is saved with a ``meta::crc::<key>`` CRC-32
+    tag, verified by :meth:`read_snapshot` (so by both restore paths).
+    When the latest snapshot turns out truncated/bit-flipped, an
+    auto-resolved restore (``step=None``) logs, renames the bad file to
+    ``*.corrupt``, and falls back to the previous surviving snapshot —
+    ``keep >= 2`` is therefore a real redundancy contract, not just a
+    disk-usage knob. Pinning an explicit ``step=`` raises
+    :class:`~fps_tpu.core.resilience.SnapshotCorruptionError` instead.
+    Construction sweeps stale ``*.tmp.npz`` files (leftovers of a save
+    that died mid-write before its atomic rename) — but only ones older
+    than :attr:`TMP_SWEEP_AGE_S`, so a concurrent writer's in-flight tmp
+    file is never deleted from under it.
     """
 
     def __init__(self, directory: str, *, keep: int = 3):
@@ -188,9 +243,39 @@ class Checkpointer:
         self.dir = directory
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
+        self._sweep_tmp()
+
+    # A tmp file younger than this is treated as a LIVE write in progress
+    # (another process mid-_atomic_savez) and left alone; older ones are
+    # crash leftovers. Far above any realistic serialize+fsync time.
+    TMP_SWEEP_AGE_S = 3600.0
+
+    def _sweep_tmp(self) -> None:
+        """Remove partial ``.tmp.npz`` files left by a crash mid-save.
+
+        ``_atomic_savez`` names tmp files uniquely (mkstemp) and publishes
+        only via ``os.replace``, so anything still wearing the tmp suffix
+        was never a live snapshot — but it may be a CONCURRENT writer's
+        in-flight file (a monitoring process constructing a Checkpointer
+        on a live training dir), so only files older than
+        :attr:`TMP_SWEEP_AGE_S` are swept."""
+        import time
+
+        now = time.time()
+        for f in os.listdir(self.dir):
+            if not f.endswith(".tmp.npz"):
+                continue
+            path = os.path.join(self.dir, f)
+            try:
+                if now - os.path.getmtime(path) < self.TMP_SWEEP_AGE_S:
+                    continue
+                _log.warning("sweeping stale checkpoint tmp file %s", f)
+                os.remove(path)
+            except OSError:
+                pass
 
     def _path(self, step: int) -> str:
-        return os.path.join(self.dir, f"ckpt_{step:012d}.npz")
+        return os.path.join(self.dir, SNAPSHOT_FMT.format(step=step))
 
     def save(self, step: int, store: ParamStore, local_state: Pytree = None,
              *, local_state_format: str = "raw") -> str:
@@ -216,6 +301,8 @@ class Checkpointer:
             arrays[f"ls{_SEP}{i}"] = np.asarray(leaf)
         arrays[f"meta{_SEP}ls_format"] = np.array(local_state_format)
         del treedef  # structure is supplied by local_state_like at restore
+        for k in list(arrays):
+            arrays[_CRC_PREFIX + k] = np.uint32(array_crc32(arrays[k]))
         path = self._path(step)
         _atomic_savez(path, arrays)
         self._gc()
@@ -224,7 +311,7 @@ class Checkpointer:
     def steps(self) -> list[int]:
         out = []
         for f in os.listdir(self.dir):
-            m = re.fullmatch(r"ckpt_(\d{12})\.npz", f)
+            m = SNAPSHOT_RE.fullmatch(f)
             if m:
                 out.append(int(m.group(1)))
         return sorted(out)
@@ -240,23 +327,105 @@ class Checkpointer:
                 raise FileNotFoundError(f"no checkpoints under {self.dir}")
         return step
 
+    def _read_verified(self, step: int, verify: bool) -> tuple[dict, list, str]:
+        """Load EVERY entry of one snapshot, checking each against its
+        ``meta::crc`` tag; any read error or checksum mismatch raises
+        :class:`SnapshotCorruptionError`. Pre-integrity snapshots (no crc
+        tags) still get the structural checks — an unreadable zip fails
+        either way."""
+        try:
+            with np.load(self._path(step)) as z:
+                entries = {k: z[k] for k in z.files
+                           if not k.startswith(_CRC_PREFIX)}
+                if verify:
+                    for k, v in entries.items():
+                        ck = _CRC_PREFIX + k
+                        if ck in z.files and int(z[ck]) != array_crc32(v):
+                            raise SnapshotCorruptionError(
+                                f"snapshot step {step}: checksum mismatch "
+                                f"on entry {k!r}"
+                            )
+        except (SnapshotCorruptionError, FileNotFoundError):
+            # A missing file is "no such checkpoint", not disk corruption —
+            # a pinned-but-gc'd step must keep raising FileNotFoundError.
+            raise
+        except _IO_ERRORS as e:
+            raise SnapshotCorruptionError(
+                f"snapshot step {step} unreadable: {e!r}"
+            ) from e
+        tables = {
+            k.split(_SEP, 1)[1]: v
+            for k, v in entries.items()
+            if k.startswith(f"table{_SEP}")
+        }
+        return tables, _ls_leaves(entries), _ls_format(entries)
+
+    def _quarantine(self, step: int, err: Exception) -> None:
+        """Take a corrupt snapshot out of the rotation (rename to
+        ``*.corrupt`` — preserved for forensics, invisible to
+        :meth:`steps`)."""
+        path = self._path(step)
+        _log.warning(
+            "discarding corrupt snapshot step %d (%s); falling back to the "
+            "previous surviving snapshot", step, err,
+        )
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            pass
+
     def read_snapshot(
-        self, step: int | None = None
+        self, step: int | None = None, *, verify: bool = True
     ) -> tuple[int, dict, list, str]:
         """ONE-open read of a snapshot: ``(step, {table: values},
         local_state_leaves, local_state_format)``. The other accessors and
         both restore paths are built on this so a restore parses the .npz
-        exactly once."""
+        exactly once.
+
+        Integrity contract: every entry is CRC-verified (``verify=False``
+        opts out). With ``step=None`` a corrupt snapshot is quarantined
+        and the read falls back to the previous surviving one; with an
+        explicit ``step`` corruption raises
+        :class:`SnapshotCorruptionError` (the caller pinned that exact
+        snapshot, silently answering with another would lie)."""
+        explicit = step is not None
         step = self._resolve_step(step)
-        with np.load(self._path(step)) as z:
-            tables = {
-                k.split(_SEP, 1)[1]: z[k]
-                for k in z.files
-                if k.startswith(f"table{_SEP}")
-            }
-            leaves = _ls_leaves(z)
-            fmt = _ls_format(z)
-        return step, tables, leaves, fmt
+        tried: set[int] = set()
+        while True:
+            try:
+                tables, leaves, fmt = self._read_verified(step, verify)
+                return step, tables, leaves, fmt
+            except SnapshotCorruptionError as err:
+                if explicit:
+                    raise
+                tried.add(step)  # terminates even if quarantine can't
+                self._quarantine(step, err)  # rename the file (RO dir)
+                candidates = [s for s in self.steps() if s not in tried]
+                if not candidates:
+                    raise FileNotFoundError(
+                        f"no intact checkpoints under {self.dir} (latest "
+                        f"was corrupt: {err})"
+                    ) from err
+                step = candidates[-1]
+
+    def verify_snapshot(self, step: int | None = None) -> bool:
+        """Full integrity pass over one snapshot (default: latest) without
+        loading it into a store: ``True`` iff every entry reads back and
+        matches its recorded checksum."""
+        try:
+            self._read_verified(self._resolve_step(step), True)
+            return True
+        except (SnapshotCorruptionError, FileNotFoundError):
+            return False
+
+    def latest_valid_step(self) -> int | None:
+        """Newest step whose snapshot passes :meth:`verify_snapshot`
+        (scanning newest→oldest); ``None`` when none does. Read-only —
+        corrupt files are left in place (restore quarantines them)."""
+        for s in reversed(self.steps()):
+            if self.verify_snapshot(s):
+                return s
+        return None
 
     def load_tables(self, store: ParamStore, step: int, values_by_name: dict
                     ) -> dict:
@@ -289,18 +458,17 @@ class Checkpointer:
     def raw_local_state(self, step: int | None = None) -> list[np.ndarray]:
         """The snapshot's local-state leaves as saved (flattened order).
 
-        Touches only the ``ls::`` keys (np.load decompresses lazily per
-        access — no full-table decompress just for metadata)."""
-        step = self._resolve_step(step)
-        with np.load(self._path(step)) as z:
-            return _ls_leaves(z)
+        Rides :meth:`read_snapshot`, so it shares the integrity contract —
+        CRC verification and, for ``step=None``, fallback past a corrupt
+        newest snapshot (at the price of reading the whole file)."""
+        return self.read_snapshot(step)[2]
 
     def local_state_format(self, step: int | None = None) -> str:
-        """``"raw"`` or ``"exported"`` (pre-tag snapshots read as raw);
-        touches only the metadata key."""
-        step = self._resolve_step(step)
-        with np.load(self._path(step)) as z:
-            return _ls_format(z)
+        """``"raw"`` or ``"exported"`` (pre-tag snapshots read as raw).
+
+        Rides :meth:`read_snapshot` — same integrity/fallback contract as
+        :meth:`raw_local_state`."""
+        return self.read_snapshot(step)[3]
 
     def restore(
         self,

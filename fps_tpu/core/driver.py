@@ -25,6 +25,7 @@ by a host-side ingest loop. Two execution modes:
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Callable, Iterable, Mapping
 
 import jax
@@ -34,7 +35,9 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fps_tpu import ops
+from fps_tpu.core import resilience
 from fps_tpu.core.api import ServerLogic, WorkerLogic
+from fps_tpu.core.resilience import GuardConfig, RollbackPolicy
 from fps_tpu.core.store import ParamStore, id_to_phys, pull, pull_local, push
 from fps_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -45,6 +48,8 @@ from fps_tpu.parallel.mesh import (
 
 Array = jax.Array
 Pytree = Any
+
+_log = logging.getLogger("fps_tpu.driver")
 
 WORKER_AXES = (DATA_AXIS, SHARD_AXIS)
 
@@ -93,6 +98,17 @@ class TrainerConfig:
     # that is the push from ``push_delay`` steps ago, not this step's
     # (in-flight pushes are invisible, exactly like the async reference).
     step_tap: Callable[..., Any] | None = None
+    # On-device push-delta health guard (fps_tpu.core.resilience): None
+    # (default) traces the exact guard-free program of old — zero cost
+    # when off; "observe"/"mask" (or a full GuardConfig) screens every
+    # table's push deltas per step inside the compiled scan, counts
+    # non-finite / norm-exploded rows into a "health" entry on the
+    # metrics stream, and in mask mode drops the offending rows
+    # (id → -1, delta → 0) before they reach the server fold — a poison
+    # batch degrades to a lost update instead of table death. Requires
+    # the worker's out channel to be a dict (same constraint as
+    # step_tap). Part of the compile-cache key.
+    guard: GuardConfig | str | None = None
     donate: bool = True
     # Upper bound on scan steps per compiled call in run_indexed. A single
     # device program must not run for minutes (the TPU runtime enforces a
@@ -128,6 +144,15 @@ class Trainer:
             server_logic = {name: server_logic for name in param_store.specs}
         self.server_logic = dict(server_logic)
         self.config = config or TrainerConfig()
+        guard = resilience.as_guard(self.config.guard)  # fail fast on typos
+        if guard is not None and guard.tables is not None:
+            unknown = set(guard.tables) - set(param_store.specs)
+            if unknown:
+                raise ValueError(
+                    f"guard.tables names unknown tables {sorted(unknown)} — "
+                    f"store has {sorted(param_store.specs)}; a typo here "
+                    "would silently disable the guard"
+                )
         self.num_shards = mesh.shape[SHARD_AXIS]
         self.num_workers = num_workers_of(mesh)
 
@@ -353,7 +378,26 @@ class Trainer:
                     head_prefix=hp.get(name, 0),
                 )
         out = self.logic.step(batch, pulled, local_state, key)
-        return out.pushes, out.local_state, out.out, hp
+        pushes, outch = out.pushes, out.out
+        guard = resilience.as_guard(self.config.guard)
+        if guard is not None:
+            # Trace-time static: guard=None compiles byte-identically to a
+            # guard-free build (tested via lowered-HLO comparison).
+            pushes, health = resilience.guard_pushes(pushes, guard)
+            if health:
+                if not isinstance(outch, dict):
+                    raise TypeError(
+                        "TrainerConfig.guard requires the worker's out "
+                        "channel to be a dict so the health counters can "
+                        f"ride it (got {type(outch).__name__})"
+                    )
+                if resilience.HEALTH_KEY in outch:
+                    raise ValueError(
+                        "the worker's out channel already has a 'health' "
+                        "key — it would collide with the guard's counters"
+                    )
+                outch = dict(outch, **{resilience.HEALTH_KEY: health})
+        return pushes, out.local_state, outch, hp
 
     # -- delayed pushes (async in-flight emulation) ------------------------
 
@@ -563,7 +607,8 @@ class Trainer:
         # set_backend() or a config/logic change after a compile must take
         # effect on the next chunk, not be shadowed by the jit cache.
         key = (mode, ops.get_backend(), self.config.push_delay,
-               self.config.step_tap, self._server_logic_key())
+               self.config.step_tap, resilience.as_guard(self.config.guard),
+               self._server_logic_key())
         if key not in self._compiled:
             self._compiled[key] = self._build_chunk_fn(mode)
         return self._compiled[key]
@@ -680,10 +725,49 @@ class Trainer:
         donate = (0, 1) if self.config.donate else ()
         return jax.jit(run, donate_argnums=donate)
 
+    def _check_rollback(self, rollback) -> None:
+        if rollback is None:
+            return
+        if not isinstance(rollback, RollbackPolicy):
+            raise TypeError(
+                f"rollback must be a RollbackPolicy, got "
+                f"{type(rollback).__name__}"
+            )
+        if resilience.as_guard(self.config.guard) is None:
+            raise ValueError(
+                "a rollback policy needs the health channel: set "
+                "TrainerConfig.guard ('observe' for pure quarantine "
+                "semantics, 'mask' to also drop poison rows in-step)"
+            )
+
+    def _maybe_quarantine(self, rollback, last_good, metrics, index, what):
+        """Shared rollback step for fit_stream (chunks) and run_indexed
+        (epochs): host-sync the metrics and, when the health channel
+        reports poison, restore the pre-call state and record the
+        quarantine. Returns ``(host_metrics, restored_state_or_None)``.
+
+        Ordering matters: the state (and the store's host-side view) is
+        restored BEFORE ``record()``, whose budget check may raise — a
+        caller catching PoisonedStreamError must find last-good state, not
+        donated or poisoned buffers."""
+        metrics = jax.tree.map(np.asarray, metrics)
+        poison = resilience.health_total(metrics)
+        if not poison:
+            return metrics, None
+        tables, local_state = last_good
+        self.store.tables = dict(tables)
+        _log.warning(
+            "%s %d poisoned (%d bad push rows): rolled back and "
+            "quarantined", what, index, poison,
+        )
+        rollback.record(index)
+        return metrics, (tables, local_state)
+
     def run_indexed(self, tables, local_state, plan, key, *, epochs: int = 1,
                     on_epoch=None, checkpointer=None,
                     checkpoint_every: int = 0, start_epoch: int = 0,
-                    as_numpy: bool = True):
+                    as_numpy: bool = True,
+                    rollback: RollbackPolicy | None = None):
         """Run ``epochs`` full passes with ingest fused into the jit.
 
         ``plan.sync_every`` must match the trainer's config. Pass a
@@ -702,7 +786,18 @@ class Trainer:
         evaluating epoch ``e``'s metrics while the device races ahead on
         ``e+1`` (speculative epoch pipelining; the per-dispatch +
         metric-sync round trip otherwise serializes between epochs).
+
+        ``rollback`` (a :class:`~fps_tpu.core.resilience.RollbackPolicy`,
+        requires ``TrainerConfig.guard``): when an epoch's health channel
+        reports poison, restore the pre-epoch state, quarantine the epoch
+        (recorded in ``rollback.quarantined``, no metrics entry, no
+        checkpoint), and continue — later epochs' shuffles and PRNG keys
+        derive from the epoch index, so the streams are unaffected by the
+        skip. Forces a per-epoch host metrics sync and an on-device state
+        copy per epoch (degradation mode, not a fast path).
         """
+        self._check_rollback(rollback)
+        saved_at = None  # step of the last periodic save (quarantine-aware)
         mode = "sync" if self.config.sync_every is None else "ssp"
         if (self.config.sync_every or None) != (plan.sync_every or None):
             raise ValueError("plan.sync_every must match TrainerConfig")
@@ -710,6 +805,7 @@ class Trainer:
         # compiled program as constants, so identity is the correct key).
         ck = ("indexed", mode, plan, ops.get_backend(),
               self.config.push_delay, self.config.step_tap,
+              resilience.as_guard(self.config.guard),
               self._server_logic_key())
         if ck not in self._compiled:
             self._compiled[ck] = self._build_indexed_fn(plan, mode)
@@ -720,6 +816,9 @@ class Trainer:
         all_metrics = []
         end_epoch = start_epoch + epochs
         for e in range(start_epoch, end_epoch):
+            if rollback is not None:
+                last_good = (resilience.tree_copy(tables),
+                             resilience.tree_copy(local_state))
             iargs = plan.epoch_args(e)
             parts = []
             for ci in range(n_calls):
@@ -739,6 +838,13 @@ class Trainer:
             # metrics always have exactly steps_per_epoch rows.
             if n_calls * T_call > T:
                 metrics = jax.tree.map(lambda x: x[:T], metrics)
+            if rollback is not None:
+                metrics, restored = self._maybe_quarantine(
+                    rollback, last_good, metrics, e, "epoch"
+                )
+                if restored is not None:
+                    tables, local_state = restored
+                    continue
             all_metrics.append(metrics)
             # The donated pre-call buffers are dead; repoint the store's
             # host-side view (lookup_host / predict_*_host) at the live
@@ -754,10 +860,13 @@ class Trainer:
                 (e + 1) % checkpoint_every == 0
             ):
                 self._save_checkpoint(checkpointer, e + 1, local_state)
+                saved_at = e + 1
         self.store.tables = dict(tables)  # epochs == 0: loop never ran
-        if checkpointer is not None and epochs > 0 and (
-            checkpoint_every <= 0 or end_epoch % checkpoint_every != 0
-        ):
+        # End-of-run save whenever the last epoch's state isn't already on
+        # disk — including when a quarantined final epoch skipped its
+        # periodic save (the snapshot then holds the rolled-back state
+        # under the final step number, so a resume skips the poison).
+        if checkpointer is not None and epochs > 0 and saved_at != end_epoch:
             self._save_checkpoint(checkpointer, end_epoch, local_state)
         if on_epoch is None and as_numpy:
             all_metrics = [jax.tree.map(np.asarray, m) for m in all_metrics]
@@ -818,6 +927,7 @@ class Trainer:
         checkpoint_every: int = 0,
         start_step: int = 0,
         on_chunk=None,
+        rollback: RollbackPolicy | None = None,
     ):
         """Drive the compiled loop over a host-side stream of chunks.
 
@@ -840,14 +950,35 @@ class Trainer:
         device until the stream ends so the host never blocks mid-stream
         and chunk dispatch pipelines (device-resident ingest then runs the
         whole epoch without a single host↔device round trip).
+
+        ``rollback`` (a :class:`~fps_tpu.core.resilience.RollbackPolicy`,
+        requires ``TrainerConfig.guard``): when a chunk's health channel
+        reports poison, restore the state captured just before that chunk,
+        quarantine it (recorded in ``rollback.quarantined``, no metrics
+        entry, no checkpoint), and continue — the per-chunk PRNG stream
+        keys off the chunk index, so later chunks are unaffected by the
+        skip. Forces a per-chunk host metrics sync and an on-device state
+        copy per chunk (degradation mode, not a fast path).
         """
+        self._check_rollback(rollback)
+        saved_at = None  # step of the last periodic save (quarantine-aware)
         all_metrics = []
         i = start_step - 1
         for i, chunk in enumerate(chunks, start=start_step):
+            if rollback is not None:
+                last_good = (resilience.tree_copy(tables),
+                             resilience.tree_copy(local_state))
             ckey = jax.random.fold_in(key, i)
             tables, local_state, metrics = self.run_chunk(
                 tables, local_state, chunk, ckey
             )
+            if rollback is not None:
+                metrics, restored = self._maybe_quarantine(
+                    rollback, last_good, metrics, i, "chunk"
+                )
+                if restored is not None:
+                    tables, local_state = restored
+                    continue
             if on_chunk is not None:
                 host_metrics = jax.tree.map(np.asarray, metrics)
                 all_metrics.append(host_metrics)
@@ -866,9 +997,12 @@ class Trainer:
                 (i + 1) % checkpoint_every == 0
             ):
                 self._save_checkpoint(checkpointer, i + 1, local_state)
-        if checkpointer is not None and i >= start_step and (
-            checkpoint_every <= 0 or (i + 1) % checkpoint_every != 0
-        ):
+                saved_at = i + 1
+        # End-of-stream save whenever the last chunk's state isn't already
+        # on disk — including when a quarantined final chunk skipped its
+        # periodic save (the snapshot then holds the rolled-back state
+        # under the final step number, so a resume skips the poison).
+        if checkpointer is not None and i >= start_step and saved_at != i + 1:
             self._save_checkpoint(checkpointer, i + 1, local_state)
         if on_chunk is None:
             all_metrics = [jax.tree.map(np.asarray, m) for m in all_metrics]

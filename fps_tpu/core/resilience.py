@@ -1,0 +1,256 @@
+"""Resilience layer: step-health guards, snapshot integrity, rollback.
+
+A production PS serving live traffic must absorb two failure classes the
+reference (and our own seed) could not:
+
+* **poison updates** — one bad batch (corrupt ingest row, overflowed
+  feature, adversarial input) pushes NaN/Inf or norm-exploded deltas;
+  under the additive server fold a single such push irreversibly destroys
+  every row it touches, and the damage then spreads through every pull.
+* **torn snapshots** — a crash or disk fault mid-write (or bit rot at
+  rest) leaves the newest ``.npz`` unreadable; a restore that can only
+  try the latest file turns one bad snapshot into an unrecoverable job.
+
+This module holds the policy objects and pure helpers; the wiring lives in
+:mod:`fps_tpu.core.driver` (on-device guard + host-loop rollback) and
+:mod:`fps_tpu.core.checkpoint` (per-array checksums + fallback restore).
+Everything here is dependency-light (jax/numpy only) so both layers can
+import it without cycles. Failure injection for tests lives in
+:mod:`fps_tpu.testing.chaos`; the failure model is documented in
+``docs/resilience.md``.
+
+Design constraint: ``TrainerConfig.guard is None`` (the default) must
+compile to the *identical* program as a guard-free build — every branch
+below is resolved at trace time, so the health machinery costs nothing
+when it is off (tested via compiled-HLO comparison in
+``tests/test_resilience.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+Pytree = Any
+
+HEALTH_KEY = "health"
+
+GUARD_MODES = ("observe", "mask")
+
+
+class SnapshotCorruptionError(RuntimeError):
+    """A snapshot failed its integrity check (truncated, bit-flipped, or
+    otherwise unreadable). Raised by the checkpoint layer when the caller
+    pinned an explicit step; auto-resolved restores fall back to the
+    previous surviving snapshot instead."""
+
+
+class PoisonedStreamError(RuntimeError):
+    """The host-loop rollback policy exhausted its quarantine budget —
+    the input stream keeps producing poisoned chunks."""
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardConfig:
+    """On-device push-delta health guard (``TrainerConfig.guard``).
+
+    Inside the compiled scan, every table's push deltas are screened per
+    row *before* they reach the server fold:
+
+    * rows with any non-finite element count as ``nonfinite``;
+    * rows whose L2 norm exceeds ``norm_limit`` (when set) count as
+      ``norm`` — the early-warning tier for divergence that is still
+      finite;
+    * in ``mode="mask"``, offending rows are dropped (id → ``-1``, delta
+      → 0) so a poison batch degrades to a lost update instead of table
+      death; ``mode="observe"`` only counts, leaving the stream
+      byte-identical (pair it with a host-loop
+      :class:`RollbackPolicy` to quarantine instead).
+
+    The per-step, per-table counts ride the worker ``out`` channel as a
+    ``"health"`` entry (psum'd across workers like every other metric), so
+    surfacing them costs one int32 reduction per table per step — noise
+    next to the pull/push collectives.
+
+    Frozen (hashable): the guard is part of the trainer's compile-cache
+    key, like ``push_delay`` and the ops backend.
+    """
+
+    mode: str = "mask"
+    # Per-row L2 norm ceiling for push deltas; None disables the norm
+    # tier (non-finite screening is always on while a guard is set).
+    norm_limit: float | None = None
+    # Restrict guarding to these tables (None = all). Tables outside the
+    # set pass through untouched and report no health entry.
+    tables: tuple[str, ...] | None = None
+
+    def __post_init__(self):
+        if self.mode not in GUARD_MODES:
+            raise ValueError(
+                f"guard mode {self.mode!r} — expected one of {GUARD_MODES}"
+            )
+        if self.norm_limit is not None and not self.norm_limit > 0:
+            raise ValueError(f"norm_limit must be > 0, got {self.norm_limit}")
+        if self.tables is not None:
+            # Coerce here so a list fails at construction time, not as an
+            # unhashable-type error deep in the trainer's compile cache.
+            object.__setattr__(self, "tables", tuple(self.tables))
+
+
+def as_guard(guard) -> GuardConfig | None:
+    """Coerce ``TrainerConfig.guard`` (None | str | GuardConfig)."""
+    if guard is None or isinstance(guard, GuardConfig):
+        return guard
+    if isinstance(guard, str):
+        return GuardConfig(mode=guard)
+    raise TypeError(
+        f"guard must be None, 'observe'/'mask', or a GuardConfig; "
+        f"got {type(guard).__name__}"
+    )
+
+
+def guard_pushes(
+    pushes: Mapping[str, tuple[Array, Array]], guard: GuardConfig
+) -> tuple[dict[str, tuple[Array, Array]], dict[str, dict[str, Array]]]:
+    """Screen per-table ``(ids, deltas)`` pushes; trace-time static policy.
+
+    Returns ``(guarded_pushes, health)`` where ``health[table]`` holds
+    scalar int32 counts ``{"nonfinite", "norm", "masked"}`` for THIS
+    worker's batch (the driver psums them into global per-step counts).
+    Padding rows (id ``-1``) never count — they were already dropped.
+
+    In mask mode both the id (→ ``-1``) and the delta (→ 0) of a bad row
+    are cleared — and non-finite deltas are zeroed even on rows that were
+    ALREADY padding (a poisoned batch value can propagate NaN into a
+    weight-0 row's delta): the gathered/XLA routes drop dead rows by
+    select, but the lane-packed MXU routes multiply every delta by its
+    0/1 indicator, and ``0 * NaN`` would poison whole row tiles. Only
+    live rows count toward health (the padding row's poison always has a
+    live sibling in the same batch).
+    """
+    out_pushes: dict[str, tuple[Array, Array]] = {}
+    health: dict[str, dict[str, Array]] = {}
+    for name, (ids, deltas) in pushes.items():
+        if guard.tables is not None and name not in guard.tables:
+            out_pushes[name] = (ids, deltas)
+            continue
+        live = ids >= 0
+        finite = jnp.all(jnp.isfinite(deltas), axis=-1)
+        nonfinite = live & ~finite
+        if guard.norm_limit is not None:
+            # Compute the norm over zero-substituted rows so a NaN row
+            # never double-counts (NaN comparisons are False anyway, but
+            # keeping the operands finite is cheaper to reason about).
+            sq = jnp.sum(
+                jnp.where(finite[:, None], deltas, 0.0).astype(jnp.float32)
+                ** 2,
+                axis=-1,
+            )
+            exploded = live & finite & (sq > guard.norm_limit**2)
+        else:
+            exploded = jnp.zeros_like(nonfinite)
+        bad = nonfinite | exploded
+        counts = {
+            "nonfinite": jnp.sum(nonfinite, dtype=jnp.int32),
+            "norm": jnp.sum(exploded, dtype=jnp.int32),
+        }
+        if guard.mode == "mask":
+            ids = jnp.where(bad, jnp.asarray(-1, ids.dtype), ids)
+            scrub = bad | ~finite  # non-finite padding rows too (see above)
+            deltas = jnp.where(
+                scrub[:, None], 0.0, deltas
+            ).astype(deltas.dtype)
+            counts["masked"] = jnp.sum(bad, dtype=jnp.int32)
+        else:
+            counts["masked"] = jnp.zeros((), jnp.int32)
+        out_pushes[name] = (ids, deltas)
+        health[name] = counts
+    return out_pushes, health
+
+
+def health_total(metrics: Pytree) -> int:
+    """Total poison events in a chunk/epoch's HOST metrics pytree.
+
+    Sums the ``nonfinite`` and ``norm`` counters of every table over every
+    step (``masked`` is derived from those two, so it is excluded — it
+    would double-count). Returns 0 when no health channel is present
+    (guard off).
+    """
+    h = metrics.get(HEALTH_KEY) if isinstance(metrics, Mapping) else None
+    if not h:
+        return 0
+    total = 0
+    for counters in h.values():
+        for kind in ("nonfinite", "norm"):
+            if kind in counters:
+                total += int(np.sum(np.asarray(counters[kind])))
+    return total
+
+
+@dataclasses.dataclass
+class RollbackPolicy:
+    """Host-loop degradation policy for ``fit_stream`` / ``run_indexed``.
+
+    When a chunk/epoch's health channel reports poison (any nonzero
+    ``nonfinite``/``norm`` count), the driver restores the state captured
+    just before that chunk ran, records the chunk index in
+    :attr:`quarantined`, and continues with the next chunk — the PRNG and
+    shuffle streams are untouched because both key off the chunk/epoch
+    index, not off how many chunks actually applied.
+
+    Requires ``TrainerConfig.guard`` (either mode: ``"observe"`` gives
+    pure quarantine semantics; ``"mask"`` would normally make rollback
+    unnecessary, but combining them quarantines any chunk that needed
+    masking at all). Each guarded chunk pays one on-device state copy
+    (the pre-chunk snapshot must survive buffer donation) and one
+    metrics host-sync — this is a degradation mode, not a fast path.
+    """
+
+    # Quarantine budget: exceeding it raises PoisonedStreamError (a stream
+    # that is ALL poison is an ingest bug, not a transient).
+    max_rollbacks: int = 8
+    # Chunk/epoch indices rolled back so far (mutated by the driver).
+    quarantined: list = dataclasses.field(default_factory=list)
+
+    def record(self, index: int) -> None:
+        """Record a quarantined index; raises once the budget is exceeded.
+        The index is appended BEFORE the raise so the quarantine log is
+        complete for a caller that catches PoisonedStreamError. Callers
+        (the driver) restore last-good state before calling this, so the
+        raise never strands donated buffers."""
+        self.quarantined.append(index)
+        if len(self.quarantined) > self.max_rollbacks:
+            raise PoisonedStreamError(
+                f"rollback budget exhausted ({self.max_rollbacks}); "
+                f"quarantined chunks: {self.quarantined}"
+            )
+
+
+def tree_copy(tree: Pytree) -> Pytree:
+    """Fresh on-device buffers for every array leaf — a pre-chunk snapshot
+    that survives the training call's donation of the originals."""
+    return jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, tree
+    )
+
+
+# ---------------------------------------------------------------------------
+# Snapshot integrity primitives (shared by checkpoint.py and the tests).
+# ---------------------------------------------------------------------------
+
+def array_crc32(arr) -> int:
+    """CRC-32 of an array's raw bytes (dtype+shape-independent payload
+    checksum; the shape/dtype themselves are validated by the restore
+    paths' existing spec checks). Zero-copy: crc32 consumes the array's
+    buffer directly — a multi-hundred-MB table is not duplicated inside
+    the (already blocking) save path."""
+    a = np.asarray(arr)
+    if not a.flags.c_contiguous:
+        a = np.ascontiguousarray(a)
+    return zlib.crc32(a)

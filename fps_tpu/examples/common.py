@@ -43,7 +43,27 @@ def base_parser(description: str) -> argparse.ArgumentParser:
                          "and builds chunks with on-device gathers (fast "
                          "path on TPU VMs); 'host' regenerates and uploads "
                          "every chunk (the unbounded-stream shape)")
+    ap.add_argument("--guard", default=None, choices=["observe", "mask"],
+                    help="on-device push-delta health guard "
+                         "(fps_tpu.core.resilience): 'mask' drops "
+                         "non-finite / norm-exploded update rows in-step, "
+                         "'observe' only counts them onto the metrics "
+                         "stream; default off (zero-cost)")
+    ap.add_argument("--guard-norm-limit", type=float, default=None,
+                    help="per-row L2 norm ceiling for push deltas "
+                         "(requires --guard)")
     return ap
+
+
+def make_guard(args):
+    """Resolve the --guard flags into a TrainerConfig.guard value."""
+    if args.guard is None:
+        if args.guard_norm_limit is not None:
+            raise SystemExit("--guard-norm-limit requires --guard")
+        return None
+    from fps_tpu.core.resilience import GuardConfig
+
+    return GuardConfig(mode=args.guard, norm_limit=args.guard_norm_limit)
 
 
 def make_epoch_source(args, mesh, data, *, route_key=None, num_workers=None):

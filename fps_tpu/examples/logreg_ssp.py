@@ -13,6 +13,7 @@ import numpy as np
 
 from fps_tpu.examples.common import (
     base_parser,
+    make_guard,
     make_chunks,
     maybe_profile,
     emit,
@@ -91,7 +92,8 @@ def main(argv=None) -> int:
     cfg = LogRegConfig(num_features=args.num_features,
                        learning_rate=args.learning_rate, l2=args.l2,
                        optimizer=args.optimizer, dense_features=dense)
-    trainer, store = logistic_regression(mesh, cfg, sync_every=args.sync_every)
+    trainer, store = logistic_regression(
+        mesh, cfg, sync_every=args.sync_every, guard=make_guard(args))
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
 

@@ -14,6 +14,7 @@ import numpy as np
 
 from fps_tpu.examples.common import (
     base_parser,
+    make_guard,
     emit,
     finish,
     make_chunks,
@@ -66,7 +67,8 @@ def main(argv=None) -> int:
                    learning_rate=args.learning_rate, reg=args.reg,
                    negative_samples=args.negative_samples,
                    negative_weight=args.negative_weight)
-    trainer, store = online_mf(mesh, cfg, sync_every=args.sync_every)
+    trainer, store = online_mf(mesh, cfg, sync_every=args.sync_every,
+                               guard=make_guard(args))
     if args.topk_every:
         import dataclasses
 

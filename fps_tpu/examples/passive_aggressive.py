@@ -13,6 +13,7 @@ import numpy as np
 
 from fps_tpu.examples.common import (
     base_parser,
+    make_guard,
     make_chunks,
     maybe_profile,
     emit,
@@ -87,7 +88,8 @@ def main(argv=None) -> int:
 
     cfg = PAConfig(num_features=args.num_features, num_classes=args.num_classes,
                    variant=args.variant, C=args.C)
-    trainer, store = passive_aggressive(mesh, cfg, sync_every=args.sync_every)
+    trainer, store = passive_aggressive(
+        mesh, cfg, sync_every=args.sync_every, guard=make_guard(args))
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
 

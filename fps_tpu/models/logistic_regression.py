@@ -183,8 +183,12 @@ def adagrad_fold(lr: float, eps: float):
 def logistic_regression(mesh, cfg: LogRegConfig, *,
                         sync_every: int | None = None, push_delay: int = 0,
                         donate: bool = True,
-                        max_steps_per_call: int | None = None):
-    """(trainer, store); pass ``sync_every=s`` for SSP bounded staleness."""
+                        max_steps_per_call: int | None = None,
+                        guard=None):
+    """(trainer, store); pass ``sync_every=s`` for SSP bounded staleness.
+
+    ``guard``: push-delta health guard (``TrainerConfig.guard``) —
+    ``"mask"`` drops poison updates in-step, ``"observe"`` only counts."""
     from fps_tpu.core.driver import Trainer, TrainerConfig
 
     store = make_store(mesh, cfg)
@@ -198,7 +202,8 @@ def logistic_regression(mesh, cfg: LogRegConfig, *,
         server_logic=server_logic,
         config=TrainerConfig(sync_every=sync_every, push_delay=push_delay,
                              donate=donate,
-                             max_steps_per_call=max_steps_per_call),
+                             max_steps_per_call=max_steps_per_call,
+                             guard=guard),
     )
     return trainer, store
 

@@ -213,7 +213,7 @@ def make_store(mesh, cfg: MFConfig) -> ParamStore:
 def online_mf(mesh, cfg: MFConfig, *, sync_every: int | None = None,
               push_delay: int = 0, donate: bool = True,
               max_steps_per_call: int | None = None,
-              combine="sum"):
+              combine="sum", guard=None):
     """Construct (trainer, store) for online MF — the analog of
     ``PSOnlineMatrixFactorization.psOnlineMF(...)``.
 
@@ -222,7 +222,10 @@ def online_mf(mesh, cfg: MFConfig, *, sync_every: int | None = None,
     Zipfian-hot items receive hundreds of summed steps per batch and SGD
     diverges) or ``"mean"`` (one averaged step per touched item per batch,
     the analog of the reference's combining senders — stable at any batch
-    size)."""
+    size).
+
+    ``guard``: push-delta health guard (``TrainerConfig.guard``) —
+    ``"mask"`` drops poison updates in-step, ``"observe"`` only counts."""
     from fps_tpu.core.api import ServerLogic
     from fps_tpu.core.driver import Trainer, TrainerConfig, num_workers_of
 
@@ -233,7 +236,8 @@ def online_mf(mesh, cfg: MFConfig, *, sync_every: int | None = None,
         server_logic=ServerLogic(combine=combine),
         config=TrainerConfig(sync_every=sync_every, push_delay=push_delay,
                              donate=donate,
-                             max_steps_per_call=max_steps_per_call),
+                             max_steps_per_call=max_steps_per_call,
+                             guard=guard),
     )
     return trainer, store
 

@@ -242,9 +242,13 @@ def make_store(mesh, cfg: PAConfig) -> ParamStore:
 
 def passive_aggressive(mesh, cfg: PAConfig, *, sync_every: int | None = None,
                        donate: bool = True,
-                       max_steps_per_call: int | None = None):
+                       max_steps_per_call: int | None = None,
+                       guard=None):
     """(trainer, store) — the analog of
-    ``PassiveAggressiveParameterServer.transformBinary/transformMulticlass``."""
+    ``PassiveAggressiveParameterServer.transformBinary/transformMulticlass``.
+
+    ``guard``: push-delta health guard (``TrainerConfig.guard``) —
+    ``"mask"`` drops poison updates in-step, ``"observe"`` only counts."""
     from fps_tpu.core.driver import Trainer, TrainerConfig
 
     store = make_store(mesh, cfg)
@@ -256,7 +260,8 @@ def passive_aggressive(mesh, cfg: PAConfig, *, sync_every: int | None = None,
     trainer = Trainer(
         mesh, store, worker,
         config=TrainerConfig(sync_every=sync_every, donate=donate,
-                             max_steps_per_call=max_steps_per_call),
+                             max_steps_per_call=max_steps_per_call,
+                             guard=guard),
     )
     return trainer, store
 

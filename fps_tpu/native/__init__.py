@@ -475,19 +475,40 @@ def baseline_pa(feat_ids, feat_vals, labels, num_features, *, C=1.0,
     return float(secs), float(hinge.value), float(mist.value)
 
 
+# The native multiclass PA kernel's class-row message rides a fixed slot
+# (id + kMaxClasses floats) — mirror of fps_native.cc's kMaxClasses.
+PA_MC_MAX_CLASSES = 120
+
+
 def baseline_pa_mc(feat_ids, feat_vals, labels, num_features, num_classes,
                    *, C=1.0, variant="PA-I", ps_mode=True):
     """MEASURED sequential per-example MULTICLASS passive-aggressive
     baseline (per-feature pull/push fan-out of ``num_classes``-float class
     rows; labels are class indices). One pass; returns
-    ``(seconds, mean_hinge, mistake_frac)`` or ``None`` if unavailable."""
-    lib = _load()
-    if lib is None:
-        return None
+    ``(seconds, mean_hinge, mistake_frac)`` or ``None`` **only** for
+    environment failures (library unavailable / allocation failure).
+
+    Data bugs raise ``ValueError`` here on the Python side —
+    ``num_classes`` outside ``[3, PA_MC_MAX_CLASSES]`` or labels outside
+    ``[0, num_classes)`` must surface to the bench caller, not silently
+    drop the baseline the way an environment failure does."""
     var = {"PA": 0, "PA-I": 1, "PA-II": 2}[variant]
     feat_ids = np.ascontiguousarray(feat_ids, np.int32)
     feat_vals = np.ascontiguousarray(feat_vals, np.float32)
     labels = np.ascontiguousarray(labels, np.int32)
+    if not 3 <= int(num_classes) <= PA_MC_MAX_CLASSES:
+        raise ValueError(
+            f"num_classes={num_classes} outside the multiclass kernel's "
+            f"[3, {PA_MC_MAX_CLASSES}] range (binary PA is baseline_pa)"
+        )
+    if labels.size and (labels.min() < 0 or labels.max() >= num_classes):
+        raise ValueError(
+            f"labels outside [0, {num_classes}): min={labels.min()}, "
+            f"max={labels.max()} — a data bug, not a baseline failure"
+        )
+    lib = _load()
+    if lib is None:
+        return None
     n, nnz = feat_ids.shape
     hinge = ctypes.c_double(0.0)
     mist = ctypes.c_double(0.0)

@@ -123,7 +123,13 @@ def _use_pallas() -> tuple[bool, bool]:
 #   524k     5.53 / 5.78 ms        3.89 / 4.35 ms   (XLA wins)
 #   1M      12.09 / 11.44 ms       6.15 / 5.29 ms   (XLA wins 2x+)
 #
-# The cap sits at the last measured clear win (262144). The shipped 1M-row
+# The cap sits at the last measured win (262144) — a THIN (~20%) margin
+# verified only at the single-chip logreg stream shape above (B = 426k
+# Zipf(0.9) ids, one v5 lite chip); the (131k, 262k] band is unmeasured
+# at gathered multi-worker batch sizes, where per-shard R and the W*B
+# batch both shift with the mesh. Treat the 131k row band as the
+# robust-win region and re-run tools/bench_logreg_routes.py stage b
+# before leaning on the upper band at a new shape. The shipped 1M-row
 # logreg table stays correctly excluded — its full-table contraction is
 # MAC-bound at ~2x XLA's transaction cost. Reads
 # and duplicate sums carry the hi+lo bf16 contract (~16 mantissa bits) —

@@ -1,0 +1,12 @@
+"""Test-support utilities shipped with the framework (fault injection)."""
+
+from fps_tpu.testing.chaos import (  # noqa: F401
+    bitflip_file,
+    corrupt_latest_snapshot,
+    kill_at_epoch,
+    partial_write_then_kill,
+    poison_chunks,
+    poison_rows,
+    sigkill_self,
+    truncate_file,
+)

@@ -1,0 +1,78 @@
+"""Tiny deterministic workloads shared by the resilience tests
+(``tests/test_resilience.py``) and the chaos sweep
+(``tools/chaos_sweep.py``) — one copy of the harness, so a change to the
+guard API or the health-channel layout cannot silently drift between the
+two consumers.
+
+Everything here is seed-pinned: same mesh + same calls ⇒ bit-identical
+runs (the property several resilience tests assert on).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from fps_tpu.core.driver import num_workers_of
+from fps_tpu.core.ingest import multi_epoch_chunks
+from fps_tpu.models.logistic_regression import (
+    LogRegConfig,
+    logistic_regression,
+    predict_proba_host,
+)
+from fps_tpu.utils.datasets import (
+    synthetic_sparse_classification,
+    train_test_split,
+)
+
+# Small enough that every route stays fast on the CPU test mesh, big
+# enough that the planted structure is clearly learnable (acc >~ 0.75).
+NF, NNZ = 400, 8
+
+
+def logreg_data(num_examples: int = 4000):
+    """(train, test) split of the planted sparse-classification set."""
+    data = synthetic_sparse_classification(num_examples, NF, NNZ, seed=7,
+                                           noise=0.05)
+    data = dict(data, label=(data["label"] > 0).astype(np.float32))
+    return train_test_split(data)
+
+
+def logreg_chunks(train, num_workers: int, epochs: int = 3):
+    return list(
+        multi_epoch_chunks(
+            train, epochs, num_workers=num_workers, local_batch=32,
+            steps_per_chunk=8, seed=3,
+        )
+    )
+
+
+def run_logreg(mesh, chunks, *, guard=None, rollback=None):
+    """Train the standard tiny logreg over ``chunks``; returns
+    ``(trainer, store, per-chunk metrics list)``."""
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainer, store = logistic_regression(mesh, cfg, guard=guard)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    tables, ls, m = trainer.fit_stream(
+        tables, ls, iter(chunks), jax.random.key(1), rollback=rollback
+    )
+    return trainer, store, m
+
+
+def accuracy(store, test) -> float:
+    p = predict_proba_host(store, test["feat_ids"], test["feat_vals"])
+    return float(np.mean((p > 0.5) == (test["label"] > 0.5)))
+
+
+def weights(store) -> np.ndarray:
+    return store.lookup_host("weights", np.arange(NF))
+
+
+def health_sum(metrics, table: str, kind: str) -> int:
+    """Total of one health counter over a run's per-chunk metrics list."""
+    return sum(
+        int(np.sum(np.asarray(m["health"][table][kind])))
+        for m in metrics
+        if "health" in m
+    )

@@ -1,0 +1,35 @@
+"""Version shims for the pinned container toolchain.
+
+The framework targets the current jax API surface; the container pins an
+older wheel where ``shard_map`` still lives in ``jax.experimental`` and
+spells its replication check ``check_rep`` instead of ``check_vma``.
+:func:`install` bridges exactly that gap — a no-op on wheels that already
+expose ``jax.shard_map``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _shard_map_compat(f, /, **kwargs):
+    from jax.experimental.shard_map import shard_map as _sm
+
+    if "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _sm(f, **kwargs)
+
+
+def _axis_size_compat(axis_name):
+    # psum of 1 over the named axis: a traced constant XLA folds away —
+    # equivalent to the modern static lax.axis_size for in-trace arithmetic.
+    return jax.lax.psum(1, axis_name)
+
+
+def install() -> None:
+    """Expose ``jax.shard_map`` / ``jax.lax.axis_size`` on wheels that
+    predate them (idempotent)."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_compat
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size_compat

@@ -16,6 +16,19 @@ Modes (all on one process with 8 virtual CPU devices, (2, 4) mesh):
   possible if the per-epoch shuffle (``plan.epoch_args(e)``) and PRNG
   stream (``fold_in(key, e)``) genuinely continue across the process
   boundary (driver.py's resume contract).
+
+Chaos extensions (fps_tpu.testing.chaos; tests/test_checkpoint.py and
+tests/test_resilience.py):
+
+* ``victim-midwrite`` — like ``victim``, but dies DURING epoch 3's
+  checkpoint write, leaving a partial ``.tmp.npz`` in the directory (the
+  torn-write window of ``_atomic_savez``): snapshots 1 and 2 stay intact,
+  step 3 never lands.
+* ``resume-any`` — FRESH process: restore whatever the newest *intact*
+  snapshot is (fallback path — the parent may have corrupted the newest
+  file first), continue to 4 total epochs, dump the model. The parent
+  still asserts bit-identity with ``straight``, extending the kill-resume
+  contract to corrupted/torn snapshots.
 """
 
 import os
@@ -76,9 +89,28 @@ def main() -> int:
                             on_epoch=die_mid_run)
         raise AssertionError("victim must never get here")
 
-    if mode == "resume":
+    if mode == "victim-midwrite":
+        from fps_tpu.testing import chaos
+
+        real_save = ckpt.save
+
+        def dying_save(step, store_, local_state_=None, **kw):
+            if step == 3:
+                # Partial tmp file hits the disk, then SIGKILL — the torn
+                # window between mkstemp and os.replace in _atomic_savez.
+                chaos.partial_write_then_kill(ckdir)
+            return real_save(step, store_, local_state_, **kw)
+
+        ckpt.save = dying_save
+        trainer.run_indexed(tables, ls, plan, key, epochs=4,
+                            checkpointer=ckpt, checkpoint_every=1)
+        raise AssertionError("victim-midwrite must never get here")
+
+    if mode in ("resume", "resume-any"):
+        if mode == "resume":
+            # The plain kill window: snapshot 2 must be the survivor.
+            assert ckpt.latest_valid_step() == 2
         tables, ls, step = trainer.restore_checkpoint(ckpt, ls)
-        assert step == 2, f"latest surviving snapshot should be 2, got {step}"
         tables, ls, _ = trainer.run_indexed(tables, ls, plan, key,
                                             epochs=4 - step,
                                             start_epoch=step)

@@ -37,6 +37,12 @@ def _hostenv():
 
 
 def pytest_configure(config):
+    # Registered before the re-exec so both processes know the marker:
+    # tier-1 runs with ``-m 'not slow'``; chaos subprocess scenarios that
+    # exceed its budget carry @pytest.mark.slow.
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 budgeted run"
+    )
     hostenv = _hostenv()
     if hostenv.in_reexec():
         return

@@ -272,15 +272,136 @@ def test_raw_restore_of_exported_snapshot_fails_loudly(tmp_path, jaxmods,
         ckpt.restore(store, ls)
 
 
-def test_sigkill_and_fresh_process_resume(tmp_path):
-    """END-TO-END crash recovery: a training process is SIGKILLed mid-run
-    (epoch 3 trained, not yet checkpointed), and a FRESH OS process
-    restores the rolling snapshot and continues — final tables AND
-    worker-local state must be bit-identical to an uninterrupted run.
-    Same-process restore tests can't prove the PRNG/shuffle continuity
-    claims survive a real process boundary; this does."""
+# ---------------------------------------------------------------------------
+# Snapshot integrity + fallback restore (the keep>=2 redundancy contract).
+# ---------------------------------------------------------------------------
+
+def _two_snapshots(tmp_path, jaxmods, *, keep=2):
+    """Train 2 chunks, snapshotting after each: returns (ckpt, store,
+    trainer, per-step host dumps) so tests can corrupt the newest and
+    check the fallback lands exactly on the older state."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    W = 4
+    data = jaxmods["synthetic_ratings"](32, 24, 4 * W * 8 * 2, seed=3)
+    chunks = _chunks(jaxmods, data, W)[:2]
+    _, _, trainer, store = _mf(jaxmods, num_shards=4)
+    tab, ls = trainer.init_state(jax.random.key(1))
+    ckpt = ck.Checkpointer(str(tmp_path / "c"), keep=keep)
+    key = jax.random.key(5)
+    dumps = {}
+    for i, c in enumerate(chunks):
+        tab, ls, _ = trainer.run_chunk(tab, ls, c, jax.random.fold_in(key, i))
+        ckpt.save(i + 1, store, ls)
+        dumps[i + 1] = store.dump_model("item_factors")[1].copy()
+    return ckpt, store, trainer, ls, dumps
+
+
+@pytest.mark.parametrize("corruption", ["truncate", "bitflip"])
+def test_corrupt_newest_snapshot_falls_back(tmp_path, jaxmods, devices8,
+                                            corruption):
+    """keep=2 is a REAL redundancy contract: truncating or bit-flipping the
+    newest snapshot makes the raw restore path recover the previous one,
+    bit-for-bit, quarantining the bad file out of the rotation."""
+    from fps_tpu.testing import chaos
+
+    ckpt, store, _, ls, dumps = _two_snapshots(tmp_path, jaxmods)
+    assert ckpt.steps() == [1, 2]
+    assert ckpt.verify_snapshot(2) and ckpt.latest_valid_step() == 2
+
+    kw = {"seed": 7} if corruption == "bitflip" else {}
+    bad = chaos.corrupt_latest_snapshot(ckpt.dir, corruption, **kw)
+    assert ckpt.latest_valid_step() == 1
+    assert not ckpt.verify_snapshot(2)
+
+    tables, ls2, step = ckpt.restore(store, ls)
+    assert step == 1
+    np.testing.assert_array_equal(store.dump_model("item_factors")[1],
+                                  dumps[1])
+    # The corrupt file left the rotation but survives for forensics.
+    assert ckpt.steps() == [1]
+    assert not np.any([p.endswith("ckpt_%012d.npz" % 2)
+                       for p in chaos.snapshot_paths(ckpt.dir)])
     import os
-    import signal
+    assert os.path.exists(bad + ".corrupt")
+
+
+def test_corrupt_newest_trainer_restore_falls_back(tmp_path, jaxmods,
+                                                   devices8):
+    """Trainer.restore_checkpoint (the exported-local-state path) rides the
+    same verified read: corruption of the newest snapshot falls back too."""
+    from fps_tpu.testing import chaos
+
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    W = 4
+    data = jaxmods["synthetic_ratings"](32, 24, 4 * W * 8 * 2, seed=3)
+    chunks = _chunks(jaxmods, data, W)[:4]
+    _, _, trainer, store = _mf(jaxmods, num_shards=4)
+    tab, ls = trainer.init_state(jax.random.key(1))
+    ckpt = ck.Checkpointer(str(tmp_path / "c"), keep=2)
+    trainer.fit_stream(tab, ls, chunks, jax.random.key(5),
+                       checkpointer=ckpt, checkpoint_every=2)
+    assert ckpt.steps() == [2, 4]
+
+    chaos.corrupt_latest_snapshot(ckpt.dir, "bitflip", seed=3)
+
+    _, _, trainerC, storeC = _mf(jaxmods, num_shards=4)
+    tabC, lsC = trainerC.init_state(jax.random.key(77))
+    storeC.tables = tabC
+    tabC, lsC, step = trainerC.restore_checkpoint(ckpt, lsC)
+    assert step == 2
+
+
+def test_explicit_step_corruption_raises(tmp_path, jaxmods, devices8):
+    """Pinning step= must surface SnapshotCorruptionError, not silently
+    answer with a different snapshot."""
+    from fps_tpu.core.resilience import SnapshotCorruptionError
+    from fps_tpu.testing import chaos
+
+    ckpt, store, _, ls, _ = _two_snapshots(tmp_path, jaxmods)
+    chaos.corrupt_latest_snapshot(ckpt.dir, "truncate")
+    with pytest.raises(SnapshotCorruptionError):
+        ckpt.read_snapshot(2)
+    # Explicit-step failure must NOT quarantine (the caller may want the
+    # bytes for forensics).
+    assert 2 in ckpt.steps()
+
+
+def test_metadata_accessors_share_fallback(tmp_path, jaxmods, devices8):
+    """raw_local_state/local_state_format ride the verified read: with the
+    newest snapshot corrupted they fall back like restore does, instead of
+    leaking a raw zipfile error."""
+    from fps_tpu.testing import chaos
+
+    ckpt, _, _, ls, _ = _two_snapshots(tmp_path, jaxmods)
+    chaos.corrupt_latest_snapshot(ckpt.dir, "truncate")
+    assert ckpt.local_state_format() == "raw"  # fell back to step 1
+    assert len(ckpt.raw_local_state()) == len(
+        __import__("jax").tree.flatten(ls)[0]
+    )
+    assert ckpt.steps() == [1]
+
+
+def test_stale_tmp_files_swept_on_init(tmp_path, jaxmods, devices8):
+    """Crash leftovers (old tmp files) are swept; a FRESH tmp file — a
+    concurrent writer's in-flight save — is left alone."""
+    import os
+    import time
+
+    ck = jaxmods["ck"]
+    d = tmp_path / "c"
+    d.mkdir()
+    stale, live = d / "abc123.tmp.npz", d / "def456.tmp.npz"
+    for f in (stale, live):
+        f.write_bytes(b"PK\x03\x04partial")
+    past = time.time() - 2 * ck.Checkpointer.TMP_SWEEP_AGE_S
+    os.utime(stale, (past, past))
+    ck.Checkpointer(str(d), keep=2)
+    assert not stale.exists()
+    assert live.exists()
+
+
+def _run_kill_worker(mode, ckdir, out):
+    import os
     import subprocess
     import sys
 
@@ -290,20 +411,104 @@ def test_sigkill_and_fresh_process_resume(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     env["PYTHONPATH"] = root
+    return subprocess.run(
+        [sys.executable, worker, mode, ckdir, out],
+        env=env, cwd=root, capture_output=True, text=True, timeout=300,
+    )
+
+
+@pytest.mark.slow
+def test_corrupt_snapshot_fresh_process_resume_matches_straight(tmp_path):
+    """END-TO-END extension of the kill-resume contract: after the SIGKILL,
+    the newest surviving snapshot is bit-flipped on disk — a fresh process
+    must fall back to the older one and STILL reproduce the straight run
+    bit-for-bit (epochs 1..4 replayed from step 1)."""
+    import glob
+    import signal
+
+    from fps_tpu.testing import chaos
+
     ckdir = str(tmp_path / "roll")
     straight = str(tmp_path / "straight.npz")
     resumed = str(tmp_path / "resumed.npz")
 
-    def run(mode, out):
-        return subprocess.run(
-            [sys.executable, worker, mode, ckdir, out],
-            env=env, cwd=root, capture_output=True, text=True, timeout=300,
-        )
+    r = _run_kill_worker("straight", ckdir, straight)
+    assert r.returncode == 0, r.stdout + r.stderr
+    v = _run_kill_worker("victim", ckdir, "-")
+    assert v.returncode == -signal.SIGKILL, v.stdout + v.stderr
 
-    r = run("straight", straight)
+    chaos.corrupt_latest_snapshot(ckdir, "bitflip", seed=11)
+
+    r2 = _run_kill_worker("resume-any", ckdir, resumed)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert glob.glob(ckdir + "/*.corrupt"), "bad snapshot not quarantined"
+
+    a, b = np.load(straight), np.load(resumed)
+    np.testing.assert_array_equal(a["item_factors"], b["item_factors"])
+    np.testing.assert_array_equal(a["user_factors"], b["user_factors"])
+
+
+@pytest.mark.slow
+def test_midwrite_crash_tmp_cleanup_and_resume(tmp_path):
+    """Dying MID-checkpoint-write (partial .tmp.npz on disk, step 3 never
+    lands) must not confuse recovery: the tmp leftover is swept, snapshots
+    1/2 restore, and the resumed run matches the straight run."""
+    import glob
+    import signal
+
+    ckdir = str(tmp_path / "roll")
+    straight = str(tmp_path / "straight.npz")
+    resumed = str(tmp_path / "resumed.npz")
+
+    r = _run_kill_worker("straight", ckdir, straight)
+    assert r.returncode == 0, r.stdout + r.stderr
+    v = _run_kill_worker("victim-midwrite", ckdir, "-")
+    assert v.returncode == -signal.SIGKILL, v.stdout + v.stderr
+
+    # The torn write left its partial tmp file; snapshots 1 and 2 intact.
+    torn = glob.glob(ckdir + "/*.tmp.npz")
+    assert torn, "expected a torn tmp file"
+    steps = sorted(int(p[-16:-4]) for p in glob.glob(ckdir + "/ckpt_*.npz"))
+    assert steps == [1, 2]
+
+    # Age the leftover past the live-writer grace window (a real resume
+    # happens well after the crash; the sweep must not touch FRESH tmp
+    # files, which could be a concurrent writer's in-flight save).
+    import os
+    import time
+
+    from fps_tpu.core.checkpoint import Checkpointer
+
+    past = time.time() - 2 * Checkpointer.TMP_SWEEP_AGE_S
+    for p in torn:
+        os.utime(p, (past, past))
+
+    r2 = _run_kill_worker("resume-any", ckdir, resumed)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert glob.glob(ckdir + "/*.tmp.npz") == [], "tmp file not swept"
+
+    a, b = np.load(straight), np.load(resumed)
+    np.testing.assert_array_equal(a["item_factors"], b["item_factors"])
+    np.testing.assert_array_equal(a["user_factors"], b["user_factors"])
+
+
+def test_sigkill_and_fresh_process_resume(tmp_path):
+    """END-TO-END crash recovery: a training process is SIGKILLed mid-run
+    (epoch 3 trained, not yet checkpointed), and a FRESH OS process
+    restores the rolling snapshot and continues — final tables AND
+    worker-local state must be bit-identical to an uninterrupted run.
+    Same-process restore tests can't prove the PRNG/shuffle continuity
+    claims survive a real process boundary; this does."""
+    import signal
+
+    ckdir = str(tmp_path / "roll")
+    straight = str(tmp_path / "straight.npz")
+    resumed = str(tmp_path / "resumed.npz")
+
+    r = _run_kill_worker("straight", ckdir, straight)
     assert r.returncode == 0, r.stdout + r.stderr
 
-    v = run("victim", "-")
+    v = _run_kill_worker("victim", ckdir, "-")
     assert v.returncode == -signal.SIGKILL, (
         f"victim should die by SIGKILL, got rc={v.returncode}:\n"
         f"{v.stdout}{v.stderr}")
@@ -313,7 +518,7 @@ def test_sigkill_and_fresh_process_resume(tmp_path):
                     fromlist=["Checkpointer"]).Checkpointer(ckdir, keep=2)
     assert ck.steps() == [1, 2]
 
-    r2 = run("resume", resumed)
+    r2 = _run_kill_worker("resume", ckdir, resumed)
     assert r2.returncode == 0, r2.stdout + r2.stderr
 
     a, b = np.load(straight), np.load(resumed)

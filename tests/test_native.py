@@ -259,3 +259,40 @@ def test_baseline_pa_mc_learns_and_modes_agree(lib):
     assert m_ps < chance - 0.2    # online mistakes well below chance
     assert abs(h_ps - h_id) < 1e-6 and abs(m_ps - m_id) < 1e-9
     assert s_ps > 0 and s_id > 0
+
+
+def test_baseline_pa_mc_data_bugs_raise(lib):
+    """Data bugs must raise ValueError on the Python side — only
+    environment failures (library unavailable / allocation) may map to the
+    silent-None baseline drop (ADVICE round 5 low #3)."""
+    ids = np.zeros((4, 2), np.int32)
+    vals = np.ones((4, 2), np.float32)
+    y = np.array([0, 1, 2, 3], np.int32)
+
+    with pytest.raises(ValueError, match="num_classes"):
+        lib.baseline_pa_mc(ids, vals, y, 10, 2)  # binary belongs to baseline_pa
+    with pytest.raises(ValueError, match="num_classes"):
+        lib.baseline_pa_mc(ids, vals, y, 10, lib.PA_MC_MAX_CLASSES + 1)
+    with pytest.raises(ValueError, match="labels"):
+        lib.baseline_pa_mc(ids, vals, np.array([0, 1, 2, 4], np.int32), 10, 4)
+    with pytest.raises(ValueError, match="labels"):
+        lib.baseline_pa_mc(ids, vals, np.array([-1, 1, 2, 3], np.int32), 10, 4)
+
+    # Valid data with the library present: a real measurement, not None.
+    r = lib.baseline_pa_mc(ids, vals, y, 10, 4)
+    assert r is not None and len(r) == 3
+
+
+def test_baseline_pa_mc_none_reserved_for_env_failure(monkeypatch):
+    """With the library unavailable, VALID data returns None (the bench
+    drops the baseline) while bad data still raises — the two failure
+    classes stay distinguishable."""
+    from fps_tpu import native as mod
+
+    monkeypatch.setattr(mod, "_load", lambda: None)
+    ids = np.zeros((4, 2), np.int32)
+    vals = np.ones((4, 2), np.float32)
+    y = np.array([0, 1, 2, 3], np.int32)
+    assert mod.baseline_pa_mc(ids, vals, y, 10, 4) is None
+    with pytest.raises(ValueError, match="labels"):
+        mod.baseline_pa_mc(ids, vals, np.array([9, 9, 9, 9], np.int32), 10, 4)

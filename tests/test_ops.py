@@ -345,12 +345,16 @@ def test_hot_ids_auto_trains_equivalently(devices8, monkeypatch):
     ops.set_backend("pallas")  # interpret-mode kernels on the CPU mesh
     try:
         got_auto = run("auto")
+        assert calls["packed"] > 0, (
+            "auto never routed through the packed kernel")
+        # The negative claim must run INSIDE the pallas window too: with
+        # the backend restored to CPU "auto", every packed route is off
+        # regardless of hot_ids and the assert would be vacuous.
+        calls["packed"] = 0
+        want = run(0)
+        assert calls["packed"] == 0  # hot_ids=0 must NOT take packed route
     finally:
         ops.set_backend(old)
-    assert calls["packed"] > 0, "auto never routed through the packed kernel"
-    calls["packed"] = 0
-    want = run(0)
-    assert calls["packed"] == 0  # hot_ids=0 must NOT take the packed route
     np.testing.assert_allclose(got_auto, want, rtol=3e-3, atol=3e-5)
     assert np.abs(want).sum() > 0  # the workload actually moved the table
 

@@ -1,0 +1,396 @@
+"""Resilience layer: on-device step-health guards, host-loop rollback, and
+the chaos injectors themselves (snapshot-corruption fallback lives in
+tests/test_checkpoint.py, next to the machinery it extends).
+
+Acceptance contract (ISSUE 1):
+
+* a synthetic NaN-poisoned batch under ``guard="mask"`` leaves every table
+  finite, increments the ``health`` metrics channel, and final model
+  quality matches the clean run within tolerance — while the same run
+  with the guard off is demonstrably destroyed (negative control);
+* ``guard=None`` (the default) compiles to the identical program as a
+  guard-free build — no health-channel cost when the feature is off.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from fps_tpu.core.driver import Trainer, TrainerConfig, num_workers_of
+from fps_tpu.core.resilience import (
+    GuardConfig,
+    PoisonedStreamError,
+    RollbackPolicy,
+    as_guard,
+    guard_pushes,
+    health_total,
+)
+from fps_tpu.core.api import StepOutput, WorkerLogic
+from fps_tpu.core.store import ParamStore, TableSpec
+from fps_tpu.models.logistic_regression import (
+    LogRegConfig,
+    logistic_regression,
+)
+from fps_tpu.parallel.mesh import make_ps_mesh
+from fps_tpu.testing import chaos
+from fps_tpu.testing.workloads import (
+    NF,
+    accuracy as _accuracy,
+    health_sum as _health_sum,
+    logreg_chunks as _logreg_chunks,
+    logreg_data as _logreg_data,
+    run_logreg as _run_logreg,
+    weights as _weights,
+)
+
+
+def test_poison_mask_survives_and_matches_clean(devices8):
+    """ISSUE acceptance: poison batch + guard='mask' -> finite tables,
+    health channel incremented, quality within tolerance of the clean run;
+    guard=None on the same stream is destroyed."""
+    mesh = make_ps_mesh(num_shards=8, num_data=1)
+    W = num_workers_of(mesh)
+    train, test = _logreg_data()
+    clean = _logreg_chunks(train, W)
+    poisoned = list(
+        chaos.poison_chunks(
+            iter(clean), chunk_index=2, column="feat_vals", kind="nan",
+            frac=0.5, seed=1,
+        )
+    )
+
+    _, store_clean, _ = _run_logreg(mesh, clean)
+    acc_clean = _accuracy(store_clean, test)
+
+    # Negative control: no guard -> NaN deltas reach the additive fold and
+    # destroy the weight table.
+    _, store_dead, _ = _run_logreg(mesh, poisoned, guard=None)
+    assert not np.all(np.isfinite(_weights(store_dead)))
+
+    # Guarded: the poisoned rows degrade to dropped updates.
+    _, store_ok, metrics = _run_logreg(mesh, poisoned, guard="mask")
+    w = _weights(store_ok)
+    assert np.all(np.isfinite(w))
+    assert _health_sum(metrics, "weights", "nonfinite") > 0
+    assert _health_sum(metrics, "weights", "masked") > 0
+    acc_ok = _accuracy(store_ok, test)
+    assert acc_ok > 0.75, acc_ok
+    assert abs(acc_clean - acc_ok) < 0.05, (acc_clean, acc_ok)
+
+
+def test_guard_observe_counts_without_masking(devices8):
+    """'observe' surfaces the poison on the health channel but leaves the
+    update stream untouched (the table IS destroyed) — the mode rollback
+    policies build on."""
+    mesh = make_ps_mesh(num_shards=8, num_data=1)
+    W = num_workers_of(mesh)
+    train, _ = _logreg_data()
+    poisoned = list(
+        chaos.poison_chunks(
+            iter(_logreg_chunks(train, W, epochs=1)), chunk_index=0,
+            column="feat_vals", kind="nan", frac=0.5, seed=1,
+        )
+    )
+    _, store, metrics = _run_logreg(mesh, poisoned, guard="observe")
+    assert _health_sum(metrics, "weights", "nonfinite") > 0
+    assert _health_sum(metrics, "weights", "masked") == 0
+    assert not np.all(np.isfinite(_weights(store)))
+
+
+# ---------------------------------------------------------------------------
+# Precise semantics on a controlled pusher worker (1-device mesh).
+# ---------------------------------------------------------------------------
+
+class _Pusher(WorkerLogic):
+    """Pushes batch['val'] rows verbatim to batch['id'] rows of table 't'."""
+
+    def pull_ids(self, batch):
+        return {"t": batch["id"].astype(np.int32)}
+
+    def step(self, batch, pulled, local_state, key):
+        return StepOutput(
+            pushes={"t": (batch["id"].astype(np.int32), batch["val"])},
+            local_state=local_state,
+            out={},
+        )
+
+
+def _pusher_trainer(devices8, guard, dim=2):
+    mesh = make_ps_mesh(num_shards=1, num_data=1, devices=devices8[:1])
+    store = ParamStore(mesh, [TableSpec("t", 16, dim).zeros_init()])
+    trainer = Trainer(
+        mesh, store, _Pusher(),
+        config=TrainerConfig(donate=False, guard=guard),
+    )
+    return mesh, store, trainer
+
+
+def test_guard_mask_and_norm_limit_exact(devices8):
+    """Row-exact mask semantics: NaN rows and norm-exploded rows drop,
+    everything else lands; per-kind health counts are exact."""
+    _, store, trainer = _pusher_trainer(
+        devices8, GuardConfig(mode="mask", norm_limit=10.0)
+    )
+    ids = np.array([[0, 1, 2, -1]], np.int32)           # (T=1, B=4)
+    val = np.array([[[1.0, 1.0],
+                     [np.nan, 0.0],
+                     [100.0, 0.0],                      # norm 100 > 10
+                     [np.nan, np.nan]]], np.float32)    # padding row
+    tables, ls = trainer.init_state(jax.random.key(0))
+    tables, ls, m = trainer.run_chunk(
+        tables, ls, {"id": ids, "val": val}, jax.random.key(1)
+    )
+    got = store.dump_model("t")[1]
+    want = np.zeros_like(got)
+    want[0] = [1.0, 1.0]  # the only surviving push row
+    np.testing.assert_array_equal(got, want)
+    h = m["health"]["t"]
+    assert int(np.sum(np.asarray(h["nonfinite"]))) == 1  # live NaN row only
+    assert int(np.sum(np.asarray(h["norm"]))) == 1
+    assert int(np.sum(np.asarray(h["masked"]))) == 2
+    assert health_total(jax.tree.map(np.asarray, m)) == 2
+
+
+def test_guard_off_compiles_identical_program(devices8):
+    """guard=None must trace the exact guard-free program: no finite-checks
+    in the lowered HLO, no health channel in the metrics, and the text is
+    identical across fresh trainers (while guard='mask' does change it)."""
+    from fps_tpu.parallel.mesh import key_to_replicated
+
+    def lowered_text(guard):
+        mesh, store, trainer = _pusher_trainer(devices8, guard)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        chunk = {
+            "id": np.zeros((1, 4), np.int32),
+            "val": np.zeros((1, 4, 2), np.float32),
+        }
+        sharding = trainer._batch_sharding_for("sync")
+        from fps_tpu.parallel.mesh import host_to_sharded
+
+        batches = jax.tree.map(
+            lambda x: host_to_sharded(x, sharding), chunk
+        )
+        key = key_to_replicated(jax.random.key(1), mesh)
+        fn = trainer._get_compiled("sync")
+        return fn.lower(tables, ls, batches, key).as_text()
+
+    text_off = lowered_text(None)
+    assert "is_finite" not in text_off
+    assert lowered_text(None) == text_off  # deterministic trace
+    text_on = lowered_text("mask")
+    assert "is_finite" in text_on
+    assert text_on != text_off
+
+    # And the metrics tree carries no health entry when the guard is off.
+    _, _, trainer = _pusher_trainer(devices8, None)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    _, _, m = trainer.run_chunk(
+        tables, ls,
+        {"id": np.zeros((1, 4), np.int32),
+         "val": np.zeros((1, 4, 2), np.float32)},
+        jax.random.key(1),
+    )
+    assert "health" not in m
+    assert health_total(jax.tree.map(np.asarray, m)) == 0
+
+
+# ---------------------------------------------------------------------------
+# Host-loop degradation: rollback + quarantine.
+# ---------------------------------------------------------------------------
+
+def test_rollback_quarantines_poisoned_chunk(devices8):
+    """fit_stream + RollbackPolicy: the poisoned chunk is rolled back and
+    skipped; the result is bit-identical to running only the clean chunks
+    with their original per-chunk keys (PRNG stream intact)."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    train, _ = _logreg_data()
+    clean = _logreg_chunks(train, W, epochs=1)[:4]
+    poisoned = list(
+        chaos.poison_chunks(
+            iter(clean), chunk_index=1, column="feat_vals", kind="nan",
+            frac=0.5, seed=1,
+        )
+    )
+
+    policy = RollbackPolicy()
+    trainerA, storeA, _ = _run_logreg(
+        mesh, poisoned, guard="observe", rollback=policy
+    )
+    assert policy.quarantined == [1]
+    wA = _weights(storeA)
+    assert np.all(np.isfinite(wA))
+
+    # Reference: same guard (same compiled program), clean chunks only,
+    # with each chunk keyed by its ORIGINAL stream index.
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainerB, storeB = logistic_regression(mesh, cfg, guard="observe")
+    tables, ls = trainerB.init_state(jax.random.key(0))
+    for i in (0, 2, 3):
+        tables, ls, _ = trainerB.run_chunk(
+            tables, ls, clean[i], jax.random.fold_in(jax.random.key(1), i)
+        )
+    np.testing.assert_array_equal(wA, _weights(storeB))
+
+
+def test_rollback_final_chunk_still_checkpoints(tmp_path, devices8):
+    """A quarantined LAST chunk landing on a checkpoint boundary must not
+    suppress the end-of-stream save: the last clean state still reaches
+    disk (under the final step number, so a resume skips the poison)."""
+    from fps_tpu.core.checkpoint import Checkpointer
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    train, _ = _logreg_data()
+    clean = _logreg_chunks(train, W, epochs=1)[:4]
+    poisoned = list(
+        chaos.poison_chunks(
+            iter(clean), chunk_index=3, column="feat_vals", kind="nan",
+            frac=0.5, seed=1,
+        )
+    )
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainer, store = logistic_regression(mesh, cfg, guard="observe")
+    tables, ls = trainer.init_state(jax.random.key(0))
+    ckpt = Checkpointer(str(tmp_path / "c"))
+    policy = RollbackPolicy()
+    trainer.fit_stream(
+        tables, ls, iter(poisoned), jax.random.key(1),
+        checkpointer=ckpt, checkpoint_every=2, rollback=policy,
+    )
+    assert policy.quarantined == [3]
+    # Periodic save at step 2 happened; the i=3 boundary save was skipped
+    # by the quarantine, so the end-of-stream save must cover it.
+    assert ckpt.steps() == [2, 4]
+    _, vals, _, _ = ckpt.read_snapshot(4)
+    assert np.all(np.isfinite(vals["weights"]))
+
+
+def test_rollback_budget_and_guard_requirement(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    train, _ = _logreg_data()
+    clean = _logreg_chunks(train, W, epochs=1)[:3]
+    all_poisoned = [
+        next(iter(chaos.poison_chunks(
+            iter([c]), chunk_index=0, column="feat_vals", kind="nan",
+            frac=0.5, seed=i,
+        )))
+        for i, c in enumerate(clean)
+    ]
+
+    # rollback without a guard: no health channel to act on.
+    with pytest.raises(ValueError, match="guard"):
+        _run_logreg(mesh, clean, guard=None, rollback=RollbackPolicy())
+
+    # every chunk poisoned + budget 1 -> the stream is declared poisoned.
+    with pytest.raises(PoisonedStreamError):
+        _run_logreg(
+            mesh, all_poisoned, guard="observe",
+            rollback=RollbackPolicy(max_rollbacks=1),
+        )
+
+
+def test_rollback_run_indexed_epochs(devices8):
+    """run_indexed + RollbackPolicy: a dataset whose ratings are poisoned
+    rolls back every epoch — final tables bit-equal the initial ones, and
+    the quarantine record names each epoch."""
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    data = synthetic_ratings(57, 31, 800, seed=0)
+    data = dict(data, rating=chaos.poison_rows(
+        np.asarray(data["rating"], np.float32), np.arange(0, 800, 5), "nan"
+    ))
+    cfg = MFConfig(num_users=57, num_items=31, rank=4, learning_rate=0.1)
+    trainer, store = online_mf(mesh, cfg, guard="observe", donate=False)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    before = store.dump_model("item_factors")[1].copy()
+    plan = DeviceEpochPlan(DeviceDataset(mesh, data), num_workers=W,
+                           local_batch=32, route_key="user", seed=5)
+    policy = RollbackPolicy(max_rollbacks=4)
+    tables, ls, metrics = trainer.run_indexed(
+        tables, ls, plan, jax.random.key(1), epochs=2, rollback=policy
+    )
+    assert policy.quarantined == [0, 1]
+    assert metrics == []  # both epochs quarantined -> no metrics entries
+    np.testing.assert_array_equal(store.dump_model("item_factors")[1], before)
+    assert np.all(np.isfinite(np.asarray(ls)))
+
+
+# ---------------------------------------------------------------------------
+# Guard primitives + chaos injector determinism.
+# ---------------------------------------------------------------------------
+
+def test_as_guard_coercion_and_validation():
+    assert as_guard(None) is None
+    assert as_guard("observe") == GuardConfig(mode="observe")
+    g = GuardConfig(mode="mask", norm_limit=1.0)
+    assert as_guard(g) is g
+    with pytest.raises(ValueError):
+        GuardConfig(mode="zap")
+    with pytest.raises(ValueError):
+        GuardConfig(norm_limit=0.0)
+    with pytest.raises(TypeError):
+        as_guard(3)
+
+
+def test_guard_unknown_table_fails_fast(devices8):
+    """A typo'd guard.tables would silently disable the guard — the
+    trainer must reject it at construction."""
+    mesh = make_ps_mesh(num_shards=1, num_data=1, devices=devices8[:1])
+    store = ParamStore(mesh, [TableSpec("t", 16, 2).zeros_init()])
+    with pytest.raises(ValueError, match="unknown tables"):
+        Trainer(mesh, store, _Pusher(),
+                config=TrainerConfig(guard=GuardConfig(tables=("typo",))))
+
+
+def test_guard_pushes_table_scoping():
+    import jax.numpy as jnp
+
+    ids = jnp.array([0, 1], jnp.int32)
+    bad = jnp.array([[jnp.nan], [1.0]], jnp.float32)
+    pushes = {"a": (ids, bad), "b": (ids, bad)}
+    out, health = guard_pushes(pushes, GuardConfig(mode="mask", tables=("a",)))
+    assert set(health) == {"a"}
+    assert int(out["a"][0][0]) == -1      # masked in the guarded table
+    assert int(out["b"][0][0]) == 0       # untouched outside the scope
+    assert np.isnan(np.asarray(out["b"][1])[0, 0])
+
+
+def test_poison_chunks_deterministic_and_scoped():
+    chunks = [
+        {"x": np.zeros((2, 4), np.float32), "y": np.ones(3)},
+        {"x": np.zeros((2, 4), np.float32), "y": np.ones(3)},
+    ]
+    out1 = list(chaos.poison_chunks(iter(chunks), chunk_index=1, column="x",
+                                    frac=0.25, seed=9))
+    out2 = list(chaos.poison_chunks(iter(chunks), chunk_index=1, column="x",
+                                    frac=0.25, seed=9))
+    np.testing.assert_array_equal(out1[1]["x"], out2[1]["x"])
+    np.testing.assert_array_equal(out1[0]["x"], chunks[0]["x"])  # untouched
+    assert np.isnan(out1[1]["x"]).sum() == 2  # 25% of 8 entries
+    np.testing.assert_array_equal(out1[1]["y"], chunks[1]["y"])
+    # 'huge' stays finite (norm-tier poison, not NaN-tier).
+    h = list(chaos.poison_chunks(iter(chunks), chunk_index=0, column="x",
+                                 kind="huge", frac=0.25, seed=9))
+    assert np.all(np.isfinite(h[0]["x"]))
+    assert np.abs(h[0]["x"]).max() > 1e30
+
+
+def test_bitflip_and_truncate_deterministic(tmp_path):
+    p1, p2 = str(tmp_path / "a"), str(tmp_path / "b")
+    payload = bytes(range(256)) * 64
+    for p in (p1, p2):
+        with open(p, "wb") as f:
+            f.write(payload)
+    chaos.bitflip_file(p1, nflips=8, seed=4)
+    chaos.bitflip_file(p2, nflips=8, seed=4)
+    b1, b2 = open(p1, "rb").read(), open(p2, "rb").read()
+    assert b1 == b2 and b1 != payload and len(b1) == len(payload)
+
+    chaos.truncate_file(p1, keep_frac=0.5)
+    assert len(open(p1, "rb").read()) == len(payload) // 2

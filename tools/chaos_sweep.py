@@ -1,0 +1,141 @@
+"""Chaos sweep: run the fault-injector matrix end-to-end and print a
+one-line survival digest (bench.py-style compact JSON).
+
+Scenarios (all deterministic — fps_tpu.testing.chaos; the training
+harness is shared with tests/test_resilience.py via
+fps_tpu.testing.workloads):
+
+* ``nan_mask`` / ``inf_mask``  — NaN/Inf-poisoned chunk under guard="mask":
+  survives iff every table stays finite, the health channel fired, and
+  test accuracy stays within tolerance of the clean run.
+* ``huge_norm_mask``           — finite norm-exploded deltas under a
+  norm_limit guard: survives iff the norm tier fired and quality holds.
+* ``observe_rollback``         — guard="observe" + RollbackPolicy:
+  survives iff exactly the poisoned chunk is quarantined and the tables
+  stay finite.
+* ``ckpt_truncate`` / ``ckpt_bitflip`` — corrupt the newest of two
+  snapshots: survives iff restore falls back to the older one.
+* ``tmp_sweep``                — stale mid-write tmp file: survives iff a
+  fresh Checkpointer sweeps it and restores normally.
+
+Run (CPU mesh, like the test suite):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  PYTHONPATH=/root/repo python tools/chaos_sweep.py
+"""
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+from fps_tpu.core.checkpoint import Checkpointer
+from fps_tpu.core.driver import num_workers_of
+from fps_tpu.core.resilience import GuardConfig, RollbackPolicy
+from fps_tpu.models.logistic_regression import (
+    LogRegConfig,
+    logistic_regression,
+)
+from fps_tpu.parallel.mesh import make_ps_mesh
+from fps_tpu.testing import chaos
+from fps_tpu.testing.workloads import (
+    NF,
+    accuracy,
+    health_sum,
+    logreg_chunks,
+    logreg_data,
+    run_logreg,
+    weights,
+)
+
+
+def _finite(store):
+    return bool(np.all(np.isfinite(weights(store))))
+
+
+def poison_scenario(mesh, chunks, test, acc_clean, kind):
+    poisoned = list(chaos.poison_chunks(iter(chunks), chunk_index=1,
+                                        column="feat_vals", kind=kind,
+                                        frac=0.5, seed=1))
+    guard = (GuardConfig(mode="mask", norm_limit=100.0)
+             if kind == "huge" else GuardConfig(mode="mask"))
+    _, store, m = run_logreg(mesh, poisoned, guard=guard)
+    tier = "norm" if kind == "huge" else "nonfinite"
+    return (_finite(store) and health_sum(m, "weights", tier) > 0
+            and abs(accuracy(store, test) - acc_clean) < 0.05)
+
+
+def rollback_scenario(mesh, chunks):
+    poisoned = list(chaos.poison_chunks(iter(chunks), chunk_index=1,
+                                        column="feat_vals", kind="nan",
+                                        frac=0.5, seed=1))
+    policy = RollbackPolicy()
+    _, store, _ = run_logreg(mesh, poisoned, guard="observe",
+                             rollback=policy)
+    return _finite(store) and policy.quarantined == [1]
+
+
+def ckpt_scenario(tmpdir, mesh, chunks, mode):
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainer, store = logistic_regression(mesh, cfg)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    ckpt = Checkpointer(tmpdir, keep=2)
+    for i, c in enumerate(chunks[:2]):
+        tables, ls, _ = trainer.run_chunk(tables, ls, c, jax.random.key(i))
+        ckpt.save(i + 1, store, None)
+    want = weights(store).copy()
+    if mode == "tmp_sweep":
+        import time
+
+        torn = os.path.join(tmpdir, "torn.tmp.npz")
+        open(torn, "wb").write(b"PK\x03\x04x")
+        past = time.time() - 2 * Checkpointer.TMP_SWEEP_AGE_S
+        os.utime(torn, (past, past))  # crash leftover, not a live writer
+        ckpt2 = Checkpointer(tmpdir, keep=2)
+        _, step = ckpt2.restore_tables(store)
+        return (step == 2 and not glob.glob(tmpdir + "/*.tmp.npz")
+                and np.array_equal(weights(store), want))
+    chaos.corrupt_latest_snapshot(tmpdir, mode)
+    ok = Checkpointer(tmpdir, keep=2).latest_valid_step() == 1
+    _, step = ckpt.restore_tables(store)
+    return ok and step == 1 and _finite(store)
+
+
+def main():
+    import tempfile
+
+    mesh = make_ps_mesh()
+    train, test = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+    _, store_clean, _ = run_logreg(mesh, chunks)
+    acc_clean = accuracy(store_clean, test)
+
+    results = {}
+    results["nan_mask"] = poison_scenario(mesh, chunks, test, acc_clean,
+                                          "nan")
+    results["inf_mask"] = poison_scenario(mesh, chunks, test, acc_clean,
+                                          "inf")
+    results["huge_norm_mask"] = poison_scenario(mesh, chunks, test,
+                                                acc_clean, "huge")
+    results["observe_rollback"] = rollback_scenario(mesh, chunks)
+    for mode in ("truncate", "bitflip", "tmp_sweep"):
+        with tempfile.TemporaryDirectory() as d:
+            results[f"ckpt_{mode}" if mode != "tmp_sweep" else mode] = (
+                ckpt_scenario(d, mesh, chunks, mode))
+
+    digest = {
+        "chaos_sweep": results,
+        "survived": sum(results.values()),
+        "total": len(results),
+        "mesh": dict(mesh.shape),
+        "clean_test_acc": round(acc_clean, 4),
+    }
+    print(json.dumps(digest), flush=True)
+    return 0 if digest["survived"] == digest["total"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
