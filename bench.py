@@ -52,6 +52,25 @@ import time
 import numpy as np
 
 
+def attach_phase_recorder(trainer):
+    """Sink-less obs recorder on the trainer for the TIMED region: the
+    per-workload JSON gains a ``phases`` breakdown (dispatch / host_sync /
+    checkpoint seconds+counts), so a BENCH regression is attributable to
+    a phase instead of one opaque wall-clock number. Aggregates-only (no
+    sinks, no extra host syncs) — the recorder never changes the driver's
+    sync behavior, so the measured numbers are unaffected."""
+    from fps_tpu import obs
+
+    rec = obs.Recorder(sinks=[])
+    trainer.recorder = rec
+    return rec
+
+
+def phase_summary(rec):
+    return {ph: {"s": round(v["s"], 4), "n": v["n"]}
+            for ph, v in sorted(rec.phase_totals().items())}
+
+
 def first_last_real_step(metrics, key):
     """Per-example metric value at the first and last non-padding step of
     one epoch's metrics dict (trailing steps are weight-0 padding)."""
@@ -214,6 +233,7 @@ def run_mf(args):
     trainer.run_indexed(tables, local_state, plan, jax.random.key(9))
 
     tables, local_state = trainer.init_state(jax.random.key(0))
+    rec = attach_phase_recorder(trainer)  # timed region only (post-warmup)
     epoch_times, rmse_curve = [], []
     # Speculative epoch pipelining: dispatch epoch e+1 BEFORE blocking on
     # epoch e's metrics, so the ~0.1-0.3 s per-epoch dispatch + sync round
@@ -288,6 +308,7 @@ def run_mf(args):
         "final_train_rmse": round(rmse_curve[-1], 4),
         "reached": reached,
         "state_extra_epochs": state_extra_epochs,
+        "phases": phase_summary(rec),
         "baseline": baseline,
     }
 
@@ -356,6 +377,7 @@ def run_w2v(args):
     # Warm-up epoch: compiles the fused program.
     tables, ls, m = trainer.run_indexed(tables, ls, plan, jax.random.key(9))
 
+    rec = attach_phase_recorder(trainer)  # timed region only (post-warmup)
     t0 = time.perf_counter()
     tables, ls, metrics = trainer.run_indexed(
         tables, ls, plan, jax.random.key(1)
@@ -397,6 +419,7 @@ def run_w2v(args):
         "unit": "words/s",
         "vs_baseline": vs,
         "epoch_s": round(epoch_s, 3),
+        "phases": phase_summary(rec),
         "baseline": baseline,
     }
 
@@ -472,6 +495,7 @@ def run_logreg(args):
     )
 
     tables, ls, _ = trainer.run_indexed(tables, ls, plan, jax.random.key(9))
+    rec = attach_phase_recorder(trainer)  # timed region only (post-warmup)
     # Steady-state throughput over E back-to-back epochs (see run_pa).
     E = 2
     t0 = time.perf_counter()
@@ -507,6 +531,7 @@ def run_logreg(args):
         "vs_baseline": vs,
         "epoch_s": round(epoch_s, 3),
         "steady_state_epochs": E,
+        "phases": phase_summary(rec),
         "baseline": baseline,
     }
 
@@ -603,6 +628,7 @@ def run_pa(args):
     plan = DeviceEpochPlan(ds, num_workers=W, local_batch=16384, seed=1)
 
     tables, ls, _ = trainer.run_indexed(tables, ls, plan, jax.random.key(9))
+    rec = attach_phase_recorder(trainer)  # timed region only (post-warmup)
     # Steady-state throughput: E back-to-back epochs in one call, blocking
     # only on the final epoch's metrics — epochs queue on-device with no
     # host round trip between them, the same zero-per-pass-overhead
@@ -678,6 +704,7 @@ def run_pa(args):
         "vs_baseline": vs,
         "epoch_s": round(epoch_s, 3),
         "steady_state_epochs": E,
+        "phases": phase_summary(rec),
         "baseline": baseline,
         "multiclass": {
             "num_classes": NCLS,

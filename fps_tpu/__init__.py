@@ -33,6 +33,7 @@ from fps_tpu.core.resilience import (
 )
 from fps_tpu.core.store import TableSpec, ParamStore
 from fps_tpu.parallel.mesh import init_distributed, make_ps_mesh
+from fps_tpu import obs
 
 __version__ = "0.1.0"
 
@@ -53,5 +54,6 @@ __all__ = [
     "RollbackPolicy",
     "SnapshotCorruptionError",
     "PoisonedStreamError",
+    "obs",
     "__version__",
 ]

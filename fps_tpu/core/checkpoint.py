@@ -44,6 +44,23 @@ Pytree = Any
 
 _log = logging.getLogger("fps_tpu.checkpoint")
 
+
+def _obs_event(etype: str, **fields) -> None:
+    """Persistence events onto the process-default telemetry recorder
+    (fps_tpu.obs.events) — the run journal's checkpoint trail. Lazy
+    import + no-op when no recorder is installed, so this module adds no
+    hard obs dependency and no cost when telemetry is off."""
+    from fps_tpu.obs import events
+
+    events.emit(etype, **fields)
+
+
+def _obs_metric(kind: str, name: str, value: float, **labels) -> None:
+    from fps_tpu.obs import events
+
+    events.record_metric(kind, name, value, **labels)
+
+
 _SEP = "::"  # npz key separator: kind::name
 
 # Snapshot filename contract — the single source of truth, shared with
@@ -304,7 +321,21 @@ class Checkpointer:
         for k in list(arrays):
             arrays[_CRC_PREFIX + k] = np.uint32(array_crc32(arrays[k]))
         path = self._path(step)
+        import time
+
+        t0 = time.perf_counter()
         _atomic_savez(path, arrays)
+        secs = time.perf_counter() - t0
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            nbytes = -1
+        _obs_event("checkpoint_saved", step=int(step), path=path,
+                   seconds=round(secs, 4), bytes=nbytes)
+        _obs_metric("inc", "checkpoint.saves", 1)
+        _obs_metric("observe", "checkpoint.save_seconds", secs)
+        if nbytes >= 0:
+            _obs_metric("set", "checkpoint.bytes", nbytes)
         self._gc()
         return path
 
@@ -369,6 +400,9 @@ class Checkpointer:
             "discarding corrupt snapshot step %d (%s); falling back to the "
             "previous surviving snapshot", step, err,
         )
+        _obs_event("checkpoint_fallback", step=int(step), path=path,
+                   error=repr(err))
+        _obs_metric("inc", "checkpoint.fallbacks", 1)
         try:
             os.replace(path, path + ".corrupt")
         except OSError:

@@ -24,6 +24,7 @@ by a host-side ingest loop. Two execution modes:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 from typing import Any, Callable, Iterable, Mapping
@@ -39,6 +40,13 @@ from fps_tpu.core import resilience
 from fps_tpu.core.api import ServerLogic, WorkerLogic
 from fps_tpu.core.resilience import GuardConfig, RollbackPolicy
 from fps_tpu.core.store import ParamStore, id_to_phys, pull, pull_local, push
+from fps_tpu.obs.health import (
+    HEALTH_ABORT,
+    HEALTH_ESCALATE,
+    HealthMonitor,
+    StepWatchdog,
+)
+from fps_tpu.obs.timing import PhaseTimer
 from fps_tpu.parallel.mesh import (
     DATA_AXIS,
     SHARD_AXIS,
@@ -52,6 +60,19 @@ Pytree = Any
 _log = logging.getLogger("fps_tpu.driver")
 
 WORKER_AXES = (DATA_AXIS, SHARD_AXIS)
+
+# End-of-iterator sentinel for the timed ingest pull in fit_stream.
+_STREAM_END = object()
+
+
+def _phase(timer: PhaseTimer | None, name: str):
+    """Timer phase scope, or a free no-op when telemetry is off."""
+    return timer.phase(name) if timer is not None else contextlib.nullcontext()
+
+
+def _watch(watchdog: StepWatchdog | None, what: str, index: int):
+    return (watchdog.watch(what, index) if watchdog is not None
+            else contextlib.nullcontext())
 
 
 def worker_index() -> Array:
@@ -136,7 +157,14 @@ class Trainer:
         worker_logic: WorkerLogic,
         server_logic: Mapping[str, ServerLogic] | ServerLogic = ServerLogic(),
         config: TrainerConfig | None = None,
+        recorder=None,
     ):
+        # Telemetry (fps_tpu.obs.Recorder) — host-side only, never part of
+        # the traced program or the compile cache key; None (default) means
+        # the drivers skip every obs call. Assignable after construction
+        # (``trainer.recorder = rec``) and overridable per fit_stream /
+        # run_indexed call.
+        self.recorder = recorder
         self.mesh = mesh
         self.store = param_store
         self.logic = worker_logic
@@ -322,6 +350,16 @@ class Trainer:
     def _apply_pushes(self, tables, pushes, head_prefix=None):
         head_prefix = head_prefix or {}
         new_tables = dict(tables)
+        # named_scope: pure HLO metadata — the device-profile analog of the
+        # host PhaseTimer (pull/compute/push are fused into one dispatch,
+        # so their split is only visible on the traced timeline).
+        with jax.named_scope("fps.push"):
+            new_tables.update(self._apply_pushes_inner(tables, pushes,
+                                                       head_prefix))
+        return new_tables
+
+    def _apply_pushes_inner(self, tables, pushes, head_prefix):
+        new_tables = {}
         for name, (pids, pdeltas) in pushes.items():
             spec = self.store.specs[name]
             hot_local = self._resolve_hot_rows(spec)
@@ -348,36 +386,44 @@ class Trainer:
         batch = self.logic.prepare(batch, prep_key)
         ids = self.logic.pull_ids(batch)
         hp = self._head_prefix(batch)
-        if snapshot is None:
-            pulled = {
-                name: pull(
-                    tables[name], tids, num_shards=self.num_shards,
-                    dense=self._resolve_dense(self.store.specs[name]),
-                    hot_rows=self._resolve_hot_rows(self.store.specs[name]),
-                    head_prefix=hp.get(name, 0),
-                )
-                for name, tids in ids.items()
-            }
-        else:
-            pulled = {}
-            for name, tids in ids.items():
-                rps = tables[name].shape[0]
-                # -1 padding ids must stay -1 (the zero-row pull contract):
-                # id_to_phys's floor-mod would wrap them onto the live row
-                # (S-1)*rps-1 when num_shards > 1 — the same hazard the
-                # dense pull in store.py guards.
-                phys = jnp.where(
-                    tids >= 0, id_to_phys(tids, self.num_shards, rps), -1)
-                # ops.gather_rows (not a bare take): dim-1 snapshot reads
-                # ride the same lane-packed kernel as live pulls on TPU.
-                # phys == ids on the single-device meshes where hp is
-                # nonempty, so the head guarantee survives the mapping.
-                pulled[name] = ops.gather_rows(
-                    snapshot[name], phys,
-                    hot_rows=self._resolve_hot_rows(self.store.specs[name]),
-                    head_prefix=hp.get(name, 0),
-                )
-        out = self.logic.step(batch, pulled, local_state, key)
+        # fps.pull / fps.compute named scopes: device-timeline attribution
+        # for the phases the host PhaseTimer cannot split (pull, worker
+        # compute, and push fuse into one dispatch) — pure op metadata,
+        # visible under obs.trace() / --profile, free otherwise.
+        with jax.named_scope("fps.pull"):
+            if snapshot is None:
+                pulled = {
+                    name: pull(
+                        tables[name], tids, num_shards=self.num_shards,
+                        dense=self._resolve_dense(self.store.specs[name]),
+                        hot_rows=self._resolve_hot_rows(
+                            self.store.specs[name]),
+                        head_prefix=hp.get(name, 0),
+                    )
+                    for name, tids in ids.items()
+                }
+            else:
+                pulled = {}
+                for name, tids in ids.items():
+                    rps = tables[name].shape[0]
+                    # -1 padding ids must stay -1 (the zero-row pull
+                    # contract): id_to_phys's floor-mod would wrap them onto
+                    # the live row (S-1)*rps-1 when num_shards > 1 — the
+                    # same hazard the dense pull in store.py guards.
+                    phys = jnp.where(
+                        tids >= 0, id_to_phys(tids, self.num_shards, rps), -1)
+                    # ops.gather_rows (not a bare take): dim-1 snapshot reads
+                    # ride the same lane-packed kernel as live pulls on TPU.
+                    # phys == ids on the single-device meshes where hp is
+                    # nonempty, so the head guarantee survives the mapping.
+                    pulled[name] = ops.gather_rows(
+                        snapshot[name], phys,
+                        hot_rows=self._resolve_hot_rows(
+                            self.store.specs[name]),
+                        head_prefix=hp.get(name, 0),
+                    )
+        with jax.named_scope("fps.compute"):
+            out = self.logic.step(batch, pulled, local_state, key)
         pushes, outch = out.pushes, out.out
         guard = resilience.as_guard(self.config.guard)
         if guard is not None:
@@ -740,6 +786,100 @@ class Trainer:
                 "semantics, 'mask' to also drop poison rows in-step)"
             )
 
+    def _check_health(self, health) -> None:
+        if health is None:
+            return
+        if not isinstance(health, HealthMonitor):
+            raise TypeError(
+                f"health must be a fps_tpu.obs.HealthMonitor, got "
+                f"{type(health).__name__}"
+            )
+        if resilience.as_guard(self.config.guard) is None:
+            raise ValueError(
+                "a HealthMonitor needs the health channel: set "
+                "TrainerConfig.guard ('observe' to run cheap until the "
+                "monitor escalates to mask, or 'mask' outright)"
+            )
+
+    def _record_health(self, rec, metrics) -> int:
+        """Fold one HOST metrics pytree's health channel into the recorder
+        (per-table counters) and return the total poisoned-row count the
+        HealthMonitor thresholds (nonfinite + norm tiers)."""
+        h = (metrics.get(resilience.HEALTH_KEY)
+             if isinstance(metrics, Mapping) else None)
+        if not h:
+            return 0
+        poison = 0
+        for table, counters in h.items():
+            nf = int(np.sum(np.asarray(counters.get("nonfinite", 0))))
+            nm = int(np.sum(np.asarray(counters.get("norm", 0))))
+            mk = int(np.sum(np.asarray(counters.get("masked", 0))))
+            if rec is not None:
+                # Zero increments too: a clean guarded run's digest should
+                # show the table at 0, not pretend the guard was off.
+                rec.inc("health.nonfinite_rows", nf, table=table)
+                rec.inc("health.norm_rows", nm, table=table)
+                rec.inc("health.masked_rows", mk, table=table)
+            poison += nf + nm
+        return poison
+
+    def _fold_metrics_accounting(self, rec, metrics, ev=None) -> int:
+        """The one per-chunk/epoch telemetry fold for a HOST metrics tree:
+        per-table health counters (+ health.poisoned_chunks), example/step
+        counters from the ``"n"`` leaf, and — when a journal event dict is
+        given — its ``examples``/``poison_rows`` fields. One helper so the
+        sync / callback / deferred paths of both drivers cannot drift.
+        Returns the poisoned-row total (what HealthMonitor thresholds)."""
+        poison = self._record_health(rec, metrics)
+        if rec is not None:
+            if poison:
+                rec.inc("health.poisoned_chunks")
+                if ev is not None:
+                    ev["poison_rows"] = poison
+            if isinstance(metrics, Mapping) and "n" in metrics:
+                n = float(np.sum(metrics["n"]))
+                rec.inc("driver.examples", n)
+                rec.inc("driver.steps", int(np.shape(metrics["n"])[0]))
+                if ev is not None:
+                    ev["examples"] = n
+        return poison
+
+    def _apply_health_decision(self, health, rec, index, poison, what):
+        """Threshold step: feed the monitor and apply its decision —
+        escalate swaps this trainer's guard observe→mask (the next
+        chunk/epoch recompiles through the guard-keyed cache), abort
+        raises PoisonedStreamError after flushing telemetry."""
+        if health is None:
+            return
+        decision = health.update(index, poison)
+        if decision == HEALTH_ESCALATE:
+            guard = resilience.as_guard(self.config.guard)
+            if guard is not None and guard.mode == "observe":
+                self.config = dataclasses.replace(
+                    self.config,
+                    guard=dataclasses.replace(guard, mode="mask"),
+                )
+                _log.warning(
+                    "health monitor: escalating guard observe->mask at "
+                    "%s %d (%d poisoned rows >= %d)", what, index,
+                    health.poison_rows, health.escalate_after_rows,
+                )
+                if rec is not None:
+                    rec.event("guard_escalated", index=int(index), what=what,
+                              poison_rows=health.poison_rows)
+        elif decision == HEALTH_ABORT:
+            if rec is not None:
+                rec.event("health_abort", index=int(index), what=what,
+                          poisoned_chunks=health.poisoned_chunks,
+                          poison_rows=health.poison_rows)
+                rec.flush()
+            raise resilience.PoisonedStreamError(
+                f"health monitor abort at {what} {index}: "
+                f"{health.poisoned_chunks} poisoned {what}s (threshold "
+                f"{health.abort_after_chunks}), {health.poison_rows} "
+                "poisoned rows total"
+            )
+
     def _maybe_quarantine(self, rollback, last_good, metrics, index, what):
         """Shared rollback step for fit_stream (chunks) and run_indexed
         (epochs): host-sync the metrics and, when the health channel
@@ -763,11 +903,28 @@ class Trainer:
         rollback.record(index)
         return metrics, (tables, local_state)
 
+    def _get_indexed_fn(self, plan, mode: str):
+        """Compiled epoch program for the CURRENT config (looked up per
+        epoch, not per run: a HealthMonitor escalation swaps the guard
+        mid-run and the next epoch must recompile, keyed on the plan
+        object itself — its geometry is baked into the program as
+        constants, so identity is the correct key)."""
+        ck = ("indexed", mode, plan, ops.get_backend(),
+              self.config.push_delay, self.config.step_tap,
+              resilience.as_guard(self.config.guard),
+              self._server_logic_key())
+        if ck not in self._compiled:
+            self._compiled[ck] = self._build_indexed_fn(plan, mode)
+        return self._compiled[ck]
+
     def run_indexed(self, tables, local_state, plan, key, *, epochs: int = 1,
                     on_epoch=None, checkpointer=None,
                     checkpoint_every: int = 0, start_epoch: int = 0,
                     as_numpy: bool = True,
-                    rollback: RollbackPolicy | None = None):
+                    rollback: RollbackPolicy | None = None,
+                    recorder=None,
+                    health: HealthMonitor | None = None,
+                    watchdog: StepWatchdog | None = None):
         """Run ``epochs`` full passes with ingest fused into the jit.
 
         ``plan.sync_every`` must match the trainer's config. Pass a
@@ -795,56 +952,86 @@ class Trainer:
         derive from the epoch index, so the streams are unaffected by the
         skip. Forces a per-epoch host metrics sync and an on-device state
         copy per epoch (degradation mode, not a fast path).
+
+        Telemetry (``fps_tpu.obs``): ``recorder`` (default
+        ``self.recorder``) records phase timers (dispatch / host_sync /
+        checkpoint / callback — ingest is fused into the jit here), epoch
+        journal events, and per-table health counters; it never changes
+        sync behavior, so attaching one costs only host bookkeeping.
+        ``health`` (a :class:`~fps_tpu.obs.HealthMonitor`, requires a
+        guard) thresholds the health channel — escalating this trainer's
+        guard observe→mask or aborting with PoisonedStreamError — and
+        ``watchdog`` (a :class:`~fps_tpu.obs.StepWatchdog`) deadline-flags
+        each epoch's dispatch+sync region; both force a per-epoch host
+        metrics sync like ``rollback`` does (they must see the values as
+        they happen).
         """
         self._check_rollback(rollback)
+        self._check_health(health)
+        rec = recorder if recorder is not None else self.recorder
+        timer = PhaseTimer(rec) if rec is not None else None
+        sync_each = (rollback is not None or health is not None
+                     or watchdog is not None)
         saved_at = None  # step of the last periodic save (quarantine-aware)
         mode = "sync" if self.config.sync_every is None else "ssp"
         if (self.config.sync_every or None) != (plan.sync_every or None):
             raise ValueError("plan.sync_every must match TrainerConfig")
-        # Keyed on the plan object itself (its geometry is baked into the
-        # compiled program as constants, so identity is the correct key).
-        ck = ("indexed", mode, plan, ops.get_backend(),
-              self.config.push_delay, self.config.step_tap,
-              resilience.as_guard(self.config.guard),
-              self._server_logic_key())
-        if ck not in self._compiled:
-            self._compiled[ck] = self._build_indexed_fn(plan, mode)
-        fn = self._compiled[ck]
         T = plan.steps_per_epoch
         T_call = self._indexed_call_steps(plan)
         n_calls = -(-T // T_call)
         all_metrics = []
         end_epoch = start_epoch + epochs
         for e in range(start_epoch, end_epoch):
+            fn = self._get_indexed_fn(plan, mode)
             if rollback is not None:
                 last_good = (resilience.tree_copy(tables),
                              resilience.tree_copy(local_state))
             iargs = plan.epoch_args(e)
             parts = []
-            for ci in range(n_calls):
-                ckey = key_to_replicated(
-                    jax.random.fold_in(jax.random.fold_in(key, e), ci),
-                    self.mesh,
+            restored = None
+            with _watch(watchdog, "epoch", e):
+                for ci in range(n_calls):
+                    ckey = key_to_replicated(
+                        jax.random.fold_in(jax.random.fold_in(key, e), ci),
+                        self.mesh,
+                    )
+                    start = np.int32(ci * T_call)
+                    with _phase(timer, "dispatch"):
+                        tables, local_state, metrics = fn(
+                            tables, local_state, iargs, start, ckey
+                        )
+                    parts.append(metrics)
+                metrics = parts[0] if len(parts) == 1 else jax.tree.map(
+                    lambda *xs: jnp.concatenate(xs), *parts
                 )
-                start = np.int32(ci * T_call)
-                tables, local_state, metrics = fn(
-                    tables, local_state, iargs, start, ckey
-                )
-                parts.append(metrics)
-            metrics = parts[0] if len(parts) == 1 else jax.tree.map(
-                lambda *xs: jnp.concatenate(xs), *parts
-            )
-            # Drop phantom trailing steps from the last (padded) call so
-            # metrics always have exactly steps_per_epoch rows.
-            if n_calls * T_call > T:
-                metrics = jax.tree.map(lambda x: x[:T], metrics)
-            if rollback is not None:
-                metrics, restored = self._maybe_quarantine(
-                    rollback, last_good, metrics, e, "epoch"
-                )
+                # Drop phantom trailing steps from the last (padded) call so
+                # metrics always have exactly steps_per_epoch rows.
+                if n_calls * T_call > T:
+                    metrics = jax.tree.map(lambda x: x[:T], metrics)
+                if rollback is not None:
+                    with _phase(timer, "host_sync"):
+                        metrics, restored = self._maybe_quarantine(
+                            rollback, last_good, metrics, e, "epoch"
+                        )
+                elif sync_each:
+                    with _phase(timer, "host_sync"):
+                        metrics = jax.tree.map(np.asarray, metrics)
+            ev = {"index": e} if rec is not None else None
+            poison = 0
+            if sync_each and (rec is not None or health is not None):
+                poison = self._fold_metrics_accounting(rec, metrics, ev)
+            if rec is not None:
+                rec.inc("driver.epochs")
                 if restored is not None:
-                    tables, local_state = restored
-                    continue
+                    rec.inc("rollback.quarantined")
+                    ev["quarantined"] = True
+            self._apply_health_decision(health, rec, e, poison, "epoch")
+            if restored is not None:
+                if rec is not None:
+                    rec.event("epoch", phases=timer.chunk_summary(), **ev)
+                    rec.flush()
+                tables, local_state = restored
+                continue
             all_metrics.append(metrics)
             # The donated pre-call buffers are dead; repoint the store's
             # host-side view (lookup_host / predict_*_host) at the live
@@ -853,28 +1040,54 @@ class Trainer:
             # leaves the store consistent if on_epoch raises (early stop).
             self.store.tables = dict(tables)
             if on_epoch is not None:
-                host = jax.tree.map(np.asarray, metrics)
+                with _phase(timer, "host_sync"):
+                    host = jax.tree.map(np.asarray, metrics)
+                if rec is not None and not sync_each:
+                    # on_epoch already paid the host sync; fold the same
+                    # accounting the forced-sync paths get.
+                    self._fold_metrics_accounting(rec, host, ev)
                 all_metrics[-1] = host
-                on_epoch(e, host)
+                with _phase(timer, "callback"):
+                    on_epoch(e, host)
             if checkpointer is not None and checkpoint_every > 0 and (
                 (e + 1) % checkpoint_every == 0
             ):
-                self._save_checkpoint(checkpointer, e + 1, local_state)
+                with _phase(timer, "checkpoint"):
+                    self._save_checkpoint(checkpointer, e + 1, local_state)
                 saved_at = e + 1
+            if rec is not None:
+                # Emitted AFTER the callback/checkpoint phases so the
+                # epoch event's phase breakdown covers the whole epoch;
+                # flushed per boundary so the Prometheus exposition is
+                # live-scrapable mid-run and a kill loses at most one
+                # epoch of buffered JSONL.
+                rec.event("epoch", phases=timer.chunk_summary(), **ev)
+                rec.flush()
         self.store.tables = dict(tables)  # epochs == 0: loop never ran
         # End-of-run save whenever the last epoch's state isn't already on
         # disk — including when a quarantined final epoch skipped its
         # periodic save (the snapshot then holds the rolled-back state
         # under the final step number, so a resume skips the poison).
         if checkpointer is not None and epochs > 0 and saved_at != end_epoch:
-            self._save_checkpoint(checkpointer, end_epoch, local_state)
+            with _phase(timer, "checkpoint"):
+                self._save_checkpoint(checkpointer, end_epoch, local_state)
         if on_epoch is None and as_numpy:
-            all_metrics = [jax.tree.map(np.asarray, m) for m in all_metrics]
+            with _phase(timer, "host_sync"):
+                all_metrics = [jax.tree.map(np.asarray, m)
+                               for m in all_metrics]
+            if rec is not None and not sync_each:
+                # Deferred-sync runs still get whole-run health totals and
+                # example counts (per-epoch attribution needs a syncing
+                # consumer: on_epoch, rollback, health, watchdog).
+                for m in all_metrics:
+                    self._fold_metrics_accounting(rec, m)
+        if rec is not None:
+            rec.flush()
         return tables, local_state, all_metrics
 
     # -- host API ---------------------------------------------------------
 
-    def run_chunk(self, tables, local_state, batches, key):
+    def run_chunk(self, tables, local_state, batches, key, *, timer=None):
         """Run one compiled chunk.
 
         Args:
@@ -885,6 +1098,10 @@ class Trainer:
             or ``(R, s, B)`` (ssp) — ``B`` is the *global* batch size,
             divided across all workers.
           key: PRNG key (host scalar).
+          timer: optional :class:`fps_tpu.obs.PhaseTimer` — attributes the
+            host→device upload to ``place`` and the jitted call (enqueue +
+            first-call compile) to ``dispatch``. ``fit_stream`` passes its
+            own; standalone callers may too.
 
         Returns:
           (tables, local_state, metrics) — metrics leaves have leading dim
@@ -900,11 +1117,13 @@ class Trainer:
                 return x
             return host_to_sharded(x, sharding)
 
-        batches = jax.tree.map(place, batches)
-        key = key_to_replicated(key, self.mesh)
-        tables, local_state, metrics = self._get_compiled(mode)(
-            tables, local_state, batches, key
-        )
+        with _phase(timer, "place"):
+            batches = jax.tree.map(place, batches)
+            key = key_to_replicated(key, self.mesh)
+        with _phase(timer, "dispatch"):
+            tables, local_state, metrics = self._get_compiled(mode)(
+                tables, local_state, batches, key
+            )
         # The donated input buffers are dead now; keep the store's host-side
         # view (lookup_host / dump_model — the reference's model-out stream)
         # pointed at the live arrays.
@@ -928,6 +1147,9 @@ class Trainer:
         start_step: int = 0,
         on_chunk=None,
         rollback: RollbackPolicy | None = None,
+        recorder=None,
+        health: HealthMonitor | None = None,
+        watchdog: StepWatchdog | None = None,
     ):
         """Drive the compiled loop over a host-side stream of chunks.
 
@@ -959,30 +1181,81 @@ class Trainer:
         keys off the chunk index, so later chunks are unaffected by the
         skip. Forces a per-chunk host metrics sync and an on-device state
         copy per chunk (degradation mode, not a fast path).
+
+        Telemetry (``fps_tpu.obs``): ``recorder`` (default
+        ``self.recorder``) times each chunk's phases (ingest / place /
+        dispatch / host_sync / checkpoint / callback), journals chunk
+        events, and folds the health channel into per-table counters. It
+        never forces extra host syncs — phases cover whatever blocking the
+        loop already does, so a recorder costs only host bookkeeping.
+        ``health`` (a :class:`~fps_tpu.obs.HealthMonitor`, requires a
+        guard) thresholds the health channel: escalate this trainer's
+        guard observe→mask after N poisoned rows, abort (raising
+        PoisonedStreamError) after M poisoned chunks. ``watchdog`` (a
+        :class:`~fps_tpu.obs.StepWatchdog`) deadline-flags each chunk's
+        dispatch+sync region — the straggler tripwire. Health and
+        watchdog (like ``rollback``) force a per-chunk host metrics sync:
+        they must observe values as they happen.
         """
         self._check_rollback(rollback)
+        self._check_health(health)
+        rec = recorder if recorder is not None else self.recorder
+        timer = PhaseTimer(rec) if rec is not None else None
+        sync_each = (rollback is not None or health is not None
+                     or watchdog is not None)
         saved_at = None  # step of the last periodic save (quarantine-aware)
         all_metrics = []
+        it = iter(chunks)
         i = start_step - 1
-        for i, chunk in enumerate(chunks, start=start_step):
+        while True:
+            with _phase(timer, "ingest"):
+                chunk = next(it, _STREAM_END)
+            if chunk is _STREAM_END:
+                break
+            i += 1
             if rollback is not None:
                 last_good = (resilience.tree_copy(tables),
                              resilience.tree_copy(local_state))
             ckey = jax.random.fold_in(key, i)
-            tables, local_state, metrics = self.run_chunk(
-                tables, local_state, chunk, ckey
-            )
-            if rollback is not None:
-                metrics, restored = self._maybe_quarantine(
-                    rollback, last_good, metrics, i, "chunk"
+            restored = None
+            with _watch(watchdog, "chunk", i):
+                tables, local_state, metrics = self.run_chunk(
+                    tables, local_state, chunk, ckey, timer=timer
                 )
+                if rollback is not None:
+                    with _phase(timer, "host_sync"):
+                        metrics, restored = self._maybe_quarantine(
+                            rollback, last_good, metrics, i, "chunk"
+                        )
+                elif sync_each:
+                    with _phase(timer, "host_sync"):
+                        metrics = jax.tree.map(np.asarray, metrics)
+            ev = {"index": i} if rec is not None else None
+            poison = 0
+            if sync_each and (rec is not None or health is not None):
+                poison = self._fold_metrics_accounting(rec, metrics, ev)
+            if rec is not None:
+                rec.inc("driver.chunks")
                 if restored is not None:
-                    tables, local_state = restored
-                    continue
+                    rec.inc("rollback.quarantined")
+                    ev["quarantined"] = True
+            self._apply_health_decision(health, rec, i, poison, "chunk")
+            if restored is not None:
+                if rec is not None:
+                    rec.event("chunk", phases=timer.chunk_summary(), **ev)
+                    rec.flush()
+                tables, local_state = restored
+                continue
             if on_chunk is not None:
-                host_metrics = jax.tree.map(np.asarray, metrics)
+                with _phase(timer, "host_sync"):
+                    host_metrics = jax.tree.map(np.asarray, metrics)
+                if rec is not None and not sync_each:
+                    # on_chunk already paid the host sync; give the chunk
+                    # event the same accounting the forced-sync paths get.
+                    self._fold_metrics_accounting(rec, host_metrics, ev)
                 all_metrics.append(host_metrics)
-                on_chunk(i, host_metrics)
+                with _phase(timer, "callback"):
+                    on_chunk(i, host_metrics)
             else:
                 # Deferred conversion keeps the dispatch pipeline full, but
                 # an unbounded stream must not accumulate device buffers (or
@@ -990,22 +1263,44 @@ class Trainer:
                 # to host every few chunks.
                 all_metrics.append(metrics)
                 if (i - start_step) % 8 == 7:
-                    all_metrics[-8:] = [
-                        jax.tree.map(np.asarray, m) for m in all_metrics[-8:]
-                    ]
+                    with _phase(timer, "host_sync"):
+                        all_metrics[-8:] = [
+                            jax.tree.map(np.asarray, m)
+                            for m in all_metrics[-8:]
+                        ]
             if checkpointer is not None and checkpoint_every > 0 and (
                 (i + 1) % checkpoint_every == 0
             ):
-                self._save_checkpoint(checkpointer, i + 1, local_state)
+                with _phase(timer, "checkpoint"):
+                    self._save_checkpoint(checkpointer, i + 1, local_state)
                 saved_at = i + 1
+            if rec is not None:
+                # Emitted AFTER the checkpoint/callback phases so the
+                # chunk event's phase breakdown covers the whole chunk;
+                # flushed per boundary so the Prometheus exposition is
+                # live-scrapable mid-run and a kill loses at most one
+                # chunk of buffered JSONL.
+                rec.event("chunk", phases=timer.chunk_summary(), **ev)
+                rec.flush()
         # End-of-stream save whenever the last chunk's state isn't already
         # on disk — including when a quarantined final chunk skipped its
         # periodic save (the snapshot then holds the rolled-back state
         # under the final step number, so a resume skips the poison).
         if checkpointer is not None and i >= start_step and saved_at != i + 1:
-            self._save_checkpoint(checkpointer, i + 1, local_state)
+            with _phase(timer, "checkpoint"):
+                self._save_checkpoint(checkpointer, i + 1, local_state)
         if on_chunk is None:
-            all_metrics = [jax.tree.map(np.asarray, m) for m in all_metrics]
+            with _phase(timer, "host_sync"):
+                all_metrics = [jax.tree.map(np.asarray, m)
+                               for m in all_metrics]
+            if rec is not None and not sync_each:
+                # Deferred-sync streams still get whole-run health totals
+                # and example counts (per-chunk attribution needs a
+                # syncing consumer: on_chunk, rollback, health, watchdog).
+                for m in all_metrics:
+                    self._fold_metrics_accounting(rec, m)
+        if rec is not None:
+            rec.flush()
         if metrics_reduce is not None and all_metrics:
             return tables, local_state, metrics_reduce(all_metrics)
         return tables, local_state, all_metrics

@@ -225,7 +225,17 @@ class RollbackPolicy:
         (the driver) restore last-good state before calling this, so the
         raise never strands donated buffers."""
         self.quarantined.append(index)
+        # Journal trail (fps_tpu.obs.events — stdlib-only, no cycle; no-op
+        # when no process-default recorder is installed).
+        from fps_tpu.obs import events as _obs_events
+
+        _obs_events.emit("rollback", index=int(index),
+                         total=len(self.quarantined),
+                         budget=self.max_rollbacks)
         if len(self.quarantined) > self.max_rollbacks:
+            _obs_events.emit("poisoned_stream_abort",
+                             quarantined=list(self.quarantined),
+                             budget=self.max_rollbacks)
             raise PoisonedStreamError(
                 f"rollback budget exhausted ({self.max_rollbacks}); "
                 f"quarantined chunks: {self.quarantined}"

@@ -52,7 +52,48 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     ap.add_argument("--guard-norm-limit", type=float, default=None,
                     help="per-row L2 norm ceiling for push deltas "
                          "(requires --guard)")
+    ap.add_argument("--obs-dir", default=None, metavar="DIR",
+                    help="telemetry output (fps_tpu.obs): JSONL event log, "
+                         "per-process run journal, and Prometheus text "
+                         "exposition under DIR; render with "
+                         "tools/obs_report.py")
+    ap.add_argument("--obs-watchdog-s", type=float, default=None,
+                    help="flag any chunk whose dispatch+sync exceeds this "
+                         "many seconds (stalled dispatch / hung multi-host "
+                         "peer); forces a per-chunk metrics sync")
     return ap
+
+
+def attach_obs(args, trainer=None, *, workload: str | None = None):
+    """Resolve --obs-dir into an installed recorder (or None).
+
+    Opens the standard on-disk telemetry set under ``--obs-dir``
+    (``fps_tpu.obs.open_run``), stamps the run journal with the CLI args
+    as the config digest, installs it as the process-default recorder
+    (checkpoint/rollback events flow automatically), and attaches it to
+    ``trainer`` when given. Close via :func:`finish`.
+    """
+    if getattr(args, "obs_dir", None) is None:
+        if getattr(args, "obs_watchdog_s", None) is not None:
+            raise SystemExit("--obs-watchdog-s requires --obs-dir")
+        return None
+    from fps_tpu import obs
+
+    rec = obs.open_run(args.obs_dir, config=vars(args),
+                       meta={"workload": workload} if workload else None)
+    if trainer is not None:
+        trainer.recorder = rec
+    emit({"event": "obs", "dir": args.obs_dir, "run_id": rec.run_id})
+    return rec
+
+
+def make_watchdog(args, recorder):
+    """--obs-watchdog-s into a StepWatchdog bound to the run's recorder."""
+    if getattr(args, "obs_watchdog_s", None) is None:
+        return None
+    from fps_tpu.obs import StepWatchdog
+
+    return StepWatchdog(args.obs_watchdog_s, recorder=recorder)
 
 
 def make_guard(args):
@@ -144,13 +185,15 @@ def _py(v):
     return v
 
 
-def finish(args, store, trainer=None, local_state=None):
-    """Handle --export at end of run."""
+def finish(args, store, trainer=None, local_state=None, recorder=None):
+    """Handle --export and close the --obs-dir telemetry at end of run."""
     if args.export:
         from fps_tpu.core.checkpoint import export_model
 
         export_model(store, args.export)
         emit({"event": "export", "path": args.export})
+    if recorder is not None:
+        recorder.close()  # run_end journal record + final flush
 
 
 def maybe_checkpointer(args):

@@ -14,10 +14,12 @@ import jax
 import numpy as np
 
 from fps_tpu.examples.common import (
+    attach_obs,
     base_parser,
     emit,
     finish,
     make_mesh,
+    make_watchdog,
     maybe_checkpointer,
     maybe_profile,
     maybe_warm_start,
@@ -61,6 +63,10 @@ def main(argv=None) -> int:
                                          rank=args.rank, alpha=args.alpha,
                                          reg=args.reg))
     solver.init(jax.random.key(args.seed))
+    # iALS drives its own solver loop (no Trainer) — the recorder still
+    # journals the run and catches checkpoint events via the process
+    # default; epoch boundaries are emitted below.
+    rec = attach_obs(args, workload="ials")
     maybe_warm_start(args, solver.store, None)
     ckpt = maybe_checkpointer(args)
 
@@ -71,15 +77,21 @@ def main(argv=None) -> int:
     # consumed twice per epoch (one pass per side).
     source = make_epoch_source(args, mesh, train)
 
+    wd = make_watchdog(args, rec)
     for epoch in range(args.epochs):
         # --profile traces the first epoch only (one epoch is representative
         # and keeps the trace small).
         cm = maybe_profile(args) if epoch == 0 else contextlib.nullcontext()
-        with cm:
+        wcm = (wd.watch("epoch", epoch) if wd is not None
+               else contextlib.nullcontext())
+        with cm, wcm:
             solver.epoch(lambda: source(epoch, 1))
         loss = solver.weighted_loss(train["user"], train["item"],
                                     train["rating"])
         emit({"event": "epoch", "epoch": epoch, "weighted_loss": loss})
+        if rec is not None:
+            rec.inc("driver.epochs")
+            rec.event("epoch", index=epoch, weighted_loss=float(loss))
         if ckpt is not None and (epoch + 1) % args.checkpoint_every == 0:
             ckpt.save(epoch + 1, solver.store)
 
@@ -87,7 +99,7 @@ def main(argv=None) -> int:
                     k=args.topk, exclude=(train["user"], train["item"]))
     emit({"event": "done", f"recall_at_{args.topk}": r})
 
-    finish(args, solver.store)
+    finish(args, solver.store, recorder=rec)
     return 0
 
 

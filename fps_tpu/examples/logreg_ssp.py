@@ -12,9 +12,11 @@ import jax
 import numpy as np
 
 from fps_tpu.examples.common import (
+    attach_obs,
     base_parser,
     make_guard,
     make_chunks,
+    make_watchdog,
     maybe_profile,
     emit,
     finish,
@@ -94,6 +96,7 @@ def main(argv=None) -> int:
                        optimizer=args.optimizer, dense_features=dense)
     trainer, store = logistic_regression(
         mesh, cfg, sync_every=args.sync_every, guard=make_guard(args))
+    rec = attach_obs(args, trainer, workload="logreg_ssp")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
 
@@ -110,12 +113,13 @@ def main(argv=None) -> int:
             checkpointer=maybe_checkpointer(args),
             checkpoint_every=args.checkpoint_every,
             on_chunk=report,
+            watchdog=make_watchdog(args, rec),
         )
 
     p = predict_proba_host(store, test["feat_ids"], test["feat_vals"])
     acc = float(np.mean((p > 0.5) == (test["label"] > 0.5)))
     emit({"event": "done", "test_accuracy": acc})
-    finish(args, store)
+    finish(args, store, recorder=rec)
     return 0
 
 

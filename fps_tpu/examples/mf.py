@@ -13,11 +13,13 @@ import jax
 import numpy as np
 
 from fps_tpu.examples.common import (
+    attach_obs,
     base_parser,
     make_guard,
     emit,
     finish,
     make_chunks,
+    make_watchdog,
     make_mesh,
     maybe_checkpointer,
     maybe_profile,
@@ -84,6 +86,7 @@ def main(argv=None) -> int:
                 query_fn=mf_topk_query_fn(W, num_queries=2),
             ),
         )
+    rec = attach_obs(args, trainer, workload="mf")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
 
@@ -108,6 +111,7 @@ def main(argv=None) -> int:
             checkpointer=maybe_checkpointer(args),
             checkpoint_every=args.checkpoint_every,
             on_chunk=report,
+            watchdog=make_watchdog(args, rec),
         )
 
     uf = np.asarray(local_state)
@@ -124,7 +128,7 @@ def main(argv=None) -> int:
             emit({"event": "topk", "user": int(u), "items": row_i,
                   "scores": np.round(row_s, 4)})
 
-    finish(args, store)
+    finish(args, store, recorder=rec)
     return 0
 
 

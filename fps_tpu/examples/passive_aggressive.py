@@ -12,9 +12,11 @@ import jax
 import numpy as np
 
 from fps_tpu.examples.common import (
+    attach_obs,
     base_parser,
     make_guard,
     make_chunks,
+    make_watchdog,
     maybe_profile,
     emit,
     finish,
@@ -90,6 +92,7 @@ def main(argv=None) -> int:
                    variant=args.variant, C=args.C)
     trainer, store = passive_aggressive(
         mesh, cfg, sync_every=args.sync_every, guard=make_guard(args))
+    rec = attach_obs(args, trainer, workload="passive_aggressive")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
 
@@ -106,13 +109,14 @@ def main(argv=None) -> int:
             checkpointer=maybe_checkpointer(args),
             checkpoint_every=args.checkpoint_every,
             on_chunk=report,
+            watchdog=make_watchdog(args, rec),
         )
 
     pred = predict_host(store, test["feat_ids"], test["feat_vals"],
                         num_classes=args.num_classes)
     acc = float(np.mean(pred == test["label"]))
     emit({"event": "done", "test_accuracy": acc})
-    finish(args, store)
+    finish(args, store, recorder=rec)
     return 0
 
 

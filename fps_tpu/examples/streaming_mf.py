@@ -22,8 +22,8 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from fps_tpu.examples.common import (base_parser, emit, finish,
-                                     make_mesh, maybe_profile)
+from fps_tpu.examples.common import (attach_obs, base_parser, emit, finish,
+                                     make_mesh, make_watchdog, maybe_profile)
 
 
 class _TargetReached(Exception):
@@ -60,6 +60,7 @@ def main(argv=None) -> int:
     cfg = MFConfig(num_users=args.num_users, num_items=args.num_items,
                    rank=args.rank, learning_rate=args.learning_rate)
     trainer, store = online_mf(mesh, cfg, sync_every=args.sync_every)
+    rec = attach_obs(args, trainer, workload="streaming_mf")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
 
     source = streaming_rating_batches(
@@ -90,13 +91,14 @@ def main(argv=None) -> int:
             tables, local_state, _ = trainer.fit_stream(
                 tables, local_state, chunks, jax.random.key(args.seed),
                 on_chunk=on_chunk,
+                watchdog=make_watchdog(args, rec),
             )
         stopped = "stream_exhausted"
     except _TargetReached:
         stopped = "target_rmse"
 
     emit({"event": "done", "stopped_by": stopped, "records_seen": seen})
-    finish(args, store)
+    finish(args, store, recorder=rec)
     return 0
 
 

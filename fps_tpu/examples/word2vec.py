@@ -15,10 +15,12 @@ import jax
 import numpy as np
 
 from fps_tpu.examples.common import (
+    attach_obs,
     base_parser,
     emit,
     finish,
     make_mesh,
+    make_watchdog,
     maybe_checkpointer,
     maybe_profile,
     maybe_warm_start,
@@ -92,6 +94,7 @@ def main(argv=None) -> int:
     else:
         trainer, store = word2vec(mesh, cfg, uni, sync_every=args.sync_every,
                                   max_steps_per_call=256, step_tap=step_tap)
+    rec = attach_obs(args, trainer, workload="word2vec")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
 
@@ -124,6 +127,7 @@ def main(argv=None) -> int:
                 # --checkpoint-every counts chunks on the host path; the
                 # fused path snapshots per epoch when it is enabled at all.
                 checkpoint_every=1 if args.checkpoint_every > 0 else 0,
+                watchdog=make_watchdog(args, rec),
             )
         else:
             def all_epochs():
@@ -140,6 +144,7 @@ def main(argv=None) -> int:
                 checkpointer=maybe_checkpointer(args),
                 checkpoint_every=args.checkpoint_every,
                 on_chunk=report,
+                watchdog=make_watchdog(args, rec),
             )
     dt = time.perf_counter() - t0
     emit({"event": "done", "pairs_per_sec": total_pairs / max(dt, 1e-9),
@@ -159,7 +164,7 @@ def main(argv=None) -> int:
         emit({"event": "neighbors", "word": int(p), "nearest": row_i,
               "sims": np.round(row_s, 3)})
 
-    finish(args, store)
+    finish(args, store, recorder=rec)
     return 0
 
 

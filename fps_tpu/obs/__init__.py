@@ -1,0 +1,108 @@
+"""fps_tpu.obs — first-class telemetry for the TPU parameter server.
+
+One subsystem, four altitudes (see ``docs/observability.md``):
+
+* **schema** — :class:`MetricsRegistry` / :class:`MetricSpec` name and
+  type every metrics leaf; :class:`Recorder` validates emissions and fans
+  them out to pluggable sinks (:class:`JsonlSink`,
+  :class:`PrometheusSink`, :class:`MemorySink`).
+* **timing** — :class:`PhaseTimer` splits each chunk into host phases
+  (ingest/place/dispatch/host_sync/checkpoint/callback);
+  :class:`Throughput` and :func:`trace` complete the clock set.
+* **alerting** — :class:`HealthMonitor` thresholds the guard's health
+  channel (observe→mask escalation, poison abort);
+  :class:`StepWatchdog` deadline-flags stalled chunks/stragglers.
+* **journal** — :class:`RunJournal` writes the per-process run narrative
+  that ``tools/obs_report.py`` renders into a digest.
+
+Everything is host-side: attaching a recorder never changes the compiled
+program (tested), and ``recorder=None`` costs nothing.
+"""
+
+from __future__ import annotations
+
+import os
+
+from fps_tpu.obs import events
+from fps_tpu.obs.health import (
+    HEALTH_ABORT,
+    HEALTH_ESCALATE,
+    HEALTH_OK,
+    HealthMonitor,
+    StepWatchdog,
+)
+from fps_tpu.obs.journal import (
+    RunJournal,
+    config_digest,
+    new_run_id,
+    process_index,
+)
+from fps_tpu.obs.registry import (
+    MetricSpec,
+    MetricsRegistry,
+    Recorder,
+    default_registry,
+)
+from fps_tpu.obs.sinks import JsonlSink, MemorySink, PrometheusSink, Sink
+from fps_tpu.obs.timing import DRIVER_PHASES, PhaseTimer, Throughput, trace
+
+__all__ = [
+    "MetricSpec", "MetricsRegistry", "Recorder", "default_registry",
+    "Sink", "JsonlSink", "MemorySink", "PrometheusSink",
+    "PhaseTimer", "Throughput", "trace", "DRIVER_PHASES",
+    "HealthMonitor", "StepWatchdog",
+    "HEALTH_OK", "HEALTH_ESCALATE", "HEALTH_ABORT",
+    "RunJournal", "new_run_id", "config_digest", "process_index",
+    "events", "open_run",
+]
+
+
+def open_run(obs_dir: str, *, config=None, run_id: str | None = None,
+             meta: dict | None = None, registry: MetricsRegistry | None = None,
+             install: bool = True) -> Recorder:
+    """Standard on-disk telemetry for one training run (the ``--obs-dir``
+    CLI path): under ``obs_dir`` this process writes
+
+    * ``events-p<K>.jsonl``  — every metric sample + event (JSONL),
+    * ``journal-p<K>.jsonl`` — events only, bracketed run_start/run_end,
+    * ``metrics-p<K>.prom``  — Prometheus text exposition (rewritten at
+      flush; point a file scrape at it),
+
+    where ``<K>`` is the process index (multi-host: one set per process;
+    ``tools/obs_report.py`` joins on the shared run id). ``config`` is
+    digested into the journal's run_start record; ``install=True`` also
+    makes this the process-default recorder so checkpoint/rollback events
+    flow without explicit plumbing. Close (or ``with``-scope) the
+    recorder to get the run_end record and final flush.
+    """
+    run_id = run_id or new_run_id()
+    proc = process_index()
+    os.makedirs(obs_dir, exist_ok=True)
+    run_meta = {"process": proc, "config_digest": config_digest(config)}
+    if meta:
+        run_meta.update(meta)
+    journal = RunJournal(
+        os.path.join(obs_dir, f"journal-p{proc}.jsonl"),
+        run_id=run_id, meta=run_meta,
+    )
+    rec = Recorder(
+        registry,
+        sinks=[
+            JsonlSink(os.path.join(obs_dir, f"events-p{proc}.jsonl")),
+            PrometheusSink(os.path.join(obs_dir, f"metrics-p{proc}.prom")),
+            journal,
+        ],
+        run_id=run_id,
+        base_labels={"process": str(proc)},
+    )
+    if install:
+        events.set_default_recorder(rec)
+        _prev_close = rec.close
+
+        def close_and_uninstall():
+            if events.get_default_recorder() is rec:
+                events.set_default_recorder(None)
+            _prev_close()
+
+        rec.close = close_and_uninstall
+    return rec

@@ -1,0 +1,300 @@
+"""Typed metrics registry + recorder — the front door of the telemetry
+subsystem.
+
+The PR-1 health counters ride the training metrics stream as anonymous
+pytree leaves: nothing names them, nothing types them, and every consumer
+re-derives their meaning from the dict shape. This module replaces that
+with a declared schema: every metrics leaf the framework emits is a
+:class:`MetricSpec` (name, kind, unit, allowed labels) registered in a
+:class:`MetricsRegistry`, and every emission goes through a
+:class:`Recorder` that validates against the schema and fans the sample
+out to pluggable sinks (:mod:`fps_tpu.obs.sinks`: JSONL event log,
+Prometheus text exposition, in-memory ring for tests).
+
+Host-side only, stdlib-only: nothing here is ever traced into a compiled
+program, so attaching or detaching a recorder cannot change the XLA
+program (asserted by lowered-HLO comparison in ``tests/test_obs.py``).
+``recorder=None`` everywhere in the driver means zero calls into this
+module — the off state costs nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Iterable, Mapping
+
+METRIC_KINDS = ("counter", "gauge", "histogram")
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """One named, typed metrics leaf.
+
+    ``labels`` declares the allowed label KEYS (e.g. ``("table",)`` for a
+    per-table counter, ``("phase",)`` for the phase timer histogram) —
+    recording with an undeclared key raises, so a typo'd label surfaces at
+    the emission site instead of silently forking a new series.
+    """
+
+    name: str
+    kind: str
+    unit: str = ""
+    labels: tuple[str, ...] = ()
+    help: str = ""
+
+    def __post_init__(self):
+        if self.kind not in METRIC_KINDS:
+            raise ValueError(
+                f"metric {self.name!r}: kind {self.kind!r} — expected one "
+                f"of {METRIC_KINDS}"
+            )
+        if not self.name or any(c.isspace() for c in self.name):
+            raise ValueError(f"metric name {self.name!r} must be non-empty "
+                             "and whitespace-free")
+        object.__setattr__(self, "labels", tuple(self.labels))
+
+
+class MetricsRegistry:
+    """Name → :class:`MetricSpec` map; the single source of truth for what
+    the framework can emit. Duplicate registration with a different spec
+    raises (same spec is idempotent, so library + user code can both
+    declare shared leaves)."""
+
+    def __init__(self, specs: Iterable[MetricSpec] = ()):
+        self._specs: dict[str, MetricSpec] = {}
+        for s in specs:
+            self.register(s)
+
+    def register(self, spec: MetricSpec) -> MetricSpec:
+        have = self._specs.get(spec.name)
+        if have is not None and have != spec:
+            raise ValueError(
+                f"metric {spec.name!r} already registered as {have}"
+            )
+        self._specs[spec.name] = spec
+        return spec
+
+    def get(self, name: str) -> MetricSpec:
+        try:
+            return self._specs[name]
+        except KeyError:
+            raise KeyError(
+                f"unregistered metric {name!r} — declare it with "
+                "MetricsRegistry.register(MetricSpec(...)) (typed leaves, "
+                "not anonymous pytrees)"
+            ) from None
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._specs
+
+    @property
+    def specs(self) -> Mapping[str, MetricSpec]:
+        return dict(self._specs)
+
+
+def default_registry() -> MetricsRegistry:
+    """A fresh registry pre-declaring every leaf the framework emits."""
+    return MetricsRegistry([
+        # Driver progress.
+        MetricSpec("driver.chunks", "counter", unit="chunks",
+                   help="compiled chunks completed (fit_stream)"),
+        MetricSpec("driver.epochs", "counter", unit="epochs",
+                   help="epochs completed (run_indexed)"),
+        MetricSpec("driver.steps", "counter", unit="steps",
+                   help="scan steps completed"),
+        MetricSpec("driver.examples", "counter", unit="examples",
+                   help="examples consumed (sum of the 'n' metrics leaf)"),
+        # Phase timers (fps_tpu.obs.timing.PhaseTimer).
+        MetricSpec("driver.phase_seconds", "histogram", unit="s",
+                   labels=("phase",),
+                   help="host wall-clock per phase segment: ingest / place "
+                        "/ dispatch / host_sync / checkpoint / callback"),
+        # Health channel (thresholded by fps_tpu.obs.health.HealthMonitor).
+        MetricSpec("health.nonfinite_rows", "counter", unit="rows",
+                   labels=("table",),
+                   help="push rows dropped/flagged with non-finite values"),
+        MetricSpec("health.norm_rows", "counter", unit="rows",
+                   labels=("table",),
+                   help="push rows over the guard's norm_limit"),
+        MetricSpec("health.masked_rows", "counter", unit="rows",
+                   labels=("table",),
+                   help="push rows masked in guard='mask' mode"),
+        MetricSpec("health.poisoned_chunks", "counter", unit="chunks",
+                   help="chunks/epochs whose health channel reported poison"),
+        # Resilience / persistence events.
+        MetricSpec("rollback.quarantined", "counter", unit="chunks",
+                   help="chunks/epochs rolled back and quarantined"),
+        MetricSpec("checkpoint.saves", "counter", unit="snapshots"),
+        MetricSpec("checkpoint.save_seconds", "histogram", unit="s"),
+        MetricSpec("checkpoint.bytes", "gauge", unit="bytes",
+                   help="size of the last written snapshot"),
+        MetricSpec("checkpoint.fallbacks", "counter", unit="snapshots",
+                   help="corrupt snapshots quarantined by fallback restore"),
+        # Watchdog.
+        MetricSpec("watchdog.stalls", "counter", unit="stalls",
+                   help="chunk/epoch dispatches that overran the deadline"),
+    ])
+
+
+class Recorder:
+    """Validates samples against a registry and fans them out to sinks.
+
+    One record shape for everything (so a single JSONL stream interleaves
+    metrics and events in arrival order):
+
+    * metric sample: ``{"kind": "metric", "t": ..., "name": ...,
+      "mtype": "counter"|"gauge"|"histogram", "value": float,
+      "labels": {...}}``
+    * event: ``{"kind": "event", "t": ..., "event": <type>, **fields}``
+
+    The recorder also keeps in-memory aggregates (counter sums, last
+    gauge value, histogram count/sum/min/max) so tests and end-of-run
+    digests don't need to re-read a sink. Thread-safe: the watchdog timer
+    thread records through the same instance as the training loop.
+
+    ``run_id`` and ``base_labels`` stamp every record — in multi-host runs
+    each process opens its own recorder (and sink files), and the report
+    tool joins on ``run_id``.
+    """
+
+    def __init__(self, registry: MetricsRegistry | None = None,
+                 sinks: Iterable = (), *, run_id: str | None = None,
+                 base_labels: Mapping[str, str] | None = None,
+                 time_fn: Callable[[], float] = time.time):
+        self.registry = registry or default_registry()
+        self.sinks = list(sinks)
+        self.run_id = run_id
+        self.base = dict(base_labels or {})
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = {}
+        self._gauges: dict[tuple, float] = {}
+        self._hists: dict[tuple, dict] = {}
+        self.closed = False
+
+    # -- emission ---------------------------------------------------------
+
+    def _key(self, name: str, labels: dict) -> tuple:
+        return (name,) + tuple(sorted(labels.items()))
+
+    def _record(self, kind: str, name: str, value: float, labels: dict):
+        spec = self.registry.get(name)
+        if spec.kind != kind:
+            raise TypeError(
+                f"metric {name!r} is a {spec.kind}, recorded as a {kind}"
+            )
+        unknown = set(labels) - set(spec.labels)
+        if unknown:
+            raise ValueError(
+                f"metric {name!r}: undeclared labels {sorted(unknown)} "
+                f"(declared: {list(spec.labels)})"
+            )
+        value = float(value)
+        key = self._key(name, labels)
+        with self._lock:
+            if kind == "counter":
+                self._counters[key] = self._counters.get(key, 0.0) + value
+            elif kind == "gauge":
+                self._gauges[key] = value
+            else:
+                h = self._hists.setdefault(
+                    key, {"count": 0, "sum": 0.0, "min": None, "max": None}
+                )
+                h["count"] += 1
+                h["sum"] += value
+                h["min"] = value if h["min"] is None else min(h["min"], value)
+                h["max"] = value if h["max"] is None else max(h["max"], value)
+            rec = {"kind": "metric", "t": self._time(), "name": name,
+                   "mtype": kind, "value": value}
+            if self.run_id:
+                rec["run_id"] = self.run_id
+            if labels or self.base:
+                rec["labels"] = {**self.base, **labels}
+            for s in self.sinks:
+                s.write(rec)
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        """Add to a counter (monotonic; negative increments raise)."""
+        if value < 0:
+            raise ValueError(f"counter {name!r}: negative increment {value}")
+        self._record("counter", name, value, labels)
+
+    def set(self, name: str, value: float, **labels) -> None:
+        """Set a gauge to its current value."""
+        self._record("gauge", name, value, labels)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        """Record one histogram observation."""
+        self._record("histogram", name, value, labels)
+
+    def event(self, etype: str, **fields) -> None:
+        """Append a structured event (journal entries ride this)."""
+        rec = {"kind": "event", "t": self._time(), "event": etype, **fields}
+        if self.run_id:
+            rec.setdefault("run_id", self.run_id)
+        with self._lock:
+            for s in self.sinks:
+                s.write(rec)
+
+    # -- aggregates -------------------------------------------------------
+
+    def counter_value(self, name: str, **labels) -> float:
+        return self._counters.get(self._key(name, labels), 0.0)
+
+    def snapshot(self) -> dict:
+        """Aggregated view: ``{"counters": {...}, "gauges": {...},
+        "histograms": {...}}`` with ``name{k=v,...}`` flat keys."""
+        def flat(key):
+            name, *lbls = key
+            if not lbls:
+                return name
+            return name + "{" + ",".join(f"{k}={v}" for k, v in lbls) + "}"
+
+        with self._lock:
+            return {
+                "counters": {flat(k): v for k, v in self._counters.items()},
+                "gauges": {flat(k): v for k, v in self._gauges.items()},
+                "histograms": {flat(k): dict(v)
+                               for k, v in self._hists.items()},
+            }
+
+    def phase_totals(self) -> dict[str, dict]:
+        """Per-phase ``{"s": total_seconds, "n": count}`` from the
+        ``driver.phase_seconds`` histogram — the bench.py breakdown."""
+        out = {}
+        with self._lock:
+            for key, h in self._hists.items():
+                if key[0] != "driver.phase_seconds":
+                    continue
+                labels = dict(key[1:])
+                phase = labels.get("phase", "?")
+                out[phase] = {"s": round(h["sum"], 6), "n": h["count"]}
+        return out
+
+    # -- lifecycle --------------------------------------------------------
+
+    def flush(self) -> None:
+        # Under the lock: every sink WRITE happens under it (via
+        # _record/event), so flush — which e.g. iterates PrometheusSink's
+        # aggregate dicts to render the exposition — must serialize with
+        # concurrent writers (the watchdog timer thread flushes while the
+        # training thread records).
+        with self._lock:
+            for s in self.sinks:
+                s.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+            for s in self.sinks:
+                s.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

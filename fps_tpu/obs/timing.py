@@ -1,0 +1,170 @@
+"""Phase timers, throughput accounting, and device tracing.
+
+The reference has no tracing subsystem — only Flink's built-in operator
+metrics (SURVEY.md §5 tracing row). On TPU we get device-level tracing
+from ``jax.profiler`` for free; this module packages it plus the two
+host-side clocks the chunked driver makes natural:
+
+* :class:`PhaseTimer` — splits each chunk's host wall-clock into named
+  segments (``ingest`` / ``place`` / ``dispatch`` / ``host_sync`` /
+  ``checkpoint`` / ``callback``), so a BENCH regression is attributable
+  to a phase instead of a single opaque number. The compiled program
+  fuses pull/compute/push into one dispatch, so those sub-phases are
+  visible on the DEVICE timeline instead: the driver wraps them in
+  ``jax.named_scope`` (``fps.pull`` / ``fps.compute`` / ``fps.push``),
+  which costs nothing outside a profiler trace.
+* :class:`Throughput` — per-chunk wall-clock + examples/sec accounting
+  for ``Trainer.fit_stream(on_chunk=...)``.
+* :func:`trace` — context manager writing a Perfetto/XProf-compatible
+  trace of everything (XLA ops, collectives, host callbacks).
+
+(Grew out of ``fps_tpu/utils/profiling.py``, which remains as a compat
+shim.)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import numpy as np
+
+# Phase names the driver emits, in pipeline order. PhaseTimer accepts any
+# name (custom loops may add their own); these are the declared ones.
+DRIVER_PHASES = (
+    "ingest",      # pulling the next chunk from the host iterator
+    "place",       # host->device transfer (host_to_sharded)
+    "dispatch",    # the jitted call: enqueue + (first call) compile
+    "host_sync",   # blocked fetching metrics back to host
+    "checkpoint",  # snapshot save on the training thread
+    "callback",    # user on_chunk / on_epoch hooks
+)
+
+
+class PhaseTimer:
+    """Named wall-clock segments, accumulated per chunk and per run.
+
+    Feed it a :class:`~fps_tpu.obs.registry.Recorder` and every closed
+    phase lands one ``driver.phase_seconds{phase=...}`` histogram sample;
+    the per-chunk dict from :meth:`chunk_summary` rides the journal's
+    chunk/epoch events. Dispatch is asynchronous in jax, so ``dispatch``
+    measures enqueue (+ compile on the first call) and the device compute
+    surfaces in ``host_sync`` wherever the host loop actually blocks —
+    honest host-side attribution, not a guess at device internals.
+    """
+
+    def __init__(self, recorder=None):
+        self.recorder = recorder
+        self._chunk: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._chunk[name] = self._chunk.get(name, 0.0) + dt
+            if self.recorder is not None:
+                self.recorder.observe("driver.phase_seconds", dt, phase=name)
+
+    def chunk_summary(self, *, reset: bool = True) -> dict[str, float]:
+        """Seconds per phase since the last reset (one chunk's breakdown).
+        Whole-run totals live where every consumer already reads them:
+        ``Recorder.phase_totals()`` over the ``driver.phase_seconds``
+        histogram — the timer keeps no duplicate run-level state."""
+        out = {k: round(v, 6) for k, v in self._chunk.items()}
+        if reset:
+            self._chunk = {}
+        return out
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device+host profile under ``log_dir`` (view with XProf /
+    Perfetto). Usable around any training region::
+
+        with obs.trace("/tmp/trace"):
+            trainer.run_chunk(...)
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Throughput:
+    """Callable chunk hook accumulating wall-clock and example counts.
+
+    ``count_key`` names the metrics leaf holding per-step example counts
+    (every shipped model emits ``"n"``). The first chunk is recorded
+    separately (``first_s``) since it includes compilation.
+
+    Timing origin: :meth:`start` marks the stream start explicitly; when
+    it was never called, the first observation measures from CONSTRUCTION
+    time. (It used to fall back to "now", which recorded a zero-width
+    first chunk and understated compile time — the hook is conventionally
+    built immediately before ``fit_stream``, so construction time is the
+    honest origin; any setup between the two is attributed to the first
+    chunk, which already absorbs one-time costs by design. Call
+    ``start()`` right before the run when that setup is expensive, and
+    before any *second* stream reusing this hook, or the inter-run gap
+    lands in ``steady_s``.)
+    """
+
+    def __init__(self, count_key: str = "n"):
+        self.count_key = count_key
+        self.chunks = 0
+        self.first_s: float | None = None
+        self._first_examples = 0.0
+        self.steady_s = 0.0
+        self._steady_examples = 0.0
+        self._last: float | None = None
+        self._created = time.perf_counter()
+
+    def start(self) -> None:
+        """Mark the stream start (see the class docstring for when the
+        implicit construction-time origin is not what you want)."""
+        self._last = time.perf_counter()
+
+    def __call__(self, step: int, metrics) -> None:
+        now = time.perf_counter()
+        if self._last is None:
+            # No explicit start(): the stream began, as far as this hook
+            # can know, when the hook was constructed.
+            self._last = self._created
+        dt = now - self._last
+        self._last = now
+        count = (
+            float(np.sum(metrics[self.count_key]))
+            if self.count_key in metrics
+            else 0.0
+        )
+        if self.first_s is None:
+            self.first_s = dt
+            self._first_examples = count
+        else:
+            self.steady_s += dt
+            self._steady_examples += count
+        self.chunks += 1
+
+    @property
+    def examples(self) -> float:
+        return self._first_examples + self._steady_examples
+
+    @property
+    def examples_per_sec(self) -> float:
+        """Steady-state throughput (excludes the compile-laden first chunk)."""
+        return self._steady_examples / self.steady_s if self.steady_s else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "chunks": self.chunks,
+            "examples": self.examples,
+            "first_chunk_s": round(self.first_s or 0.0, 4),
+            "steady_s": round(self.steady_s, 4),
+            "examples_per_sec": round(self.examples_per_sec, 1),
+        }

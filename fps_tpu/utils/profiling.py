@@ -1,104 +1,13 @@
-"""Profiling & throughput observability.
+"""Compat shim — the profiling helpers grew into :mod:`fps_tpu.obs`.
 
-The reference has no tracing subsystem — only Flink's built-in operator
-metrics (SURVEY.md §5 tracing row). On TPU we get device-level tracing from
-``jax.profiler`` for free; this module packages it plus the host-side
-throughput accounting the framework's chunked driver makes natural.
-
-* :func:`trace` — context manager writing a Perfetto/XProf-compatible trace
-  of everything (XLA ops, collectives, host callbacks) under a directory.
-* :class:`Throughput` — per-chunk wall-clock + examples/sec accounting,
-  designed to plug into ``Trainer.fit_stream(on_chunk=...)``::
-
-      tp = Throughput(count_key="n")
-      trainer.fit_stream(..., on_chunk=tp)
-      print(tp.summary())
+``trace`` and ``Throughput`` now live in :mod:`fps_tpu.obs.timing`
+alongside the phase timers, recorder, and run journal; import them from
+``fps_tpu.obs`` going forward. This module re-exports them so existing
+call sites (and muscle memory) keep working.
 """
 
 from __future__ import annotations
 
-import contextlib
-import time
+from fps_tpu.obs.timing import Throughput, trace
 
-import numpy as np
-
-
-@contextlib.contextmanager
-def trace(log_dir: str):
-    """Capture a device+host profile under ``log_dir`` (view with XProf /
-    Perfetto). Usable around any training region::
-
-        with profiling.trace("/tmp/trace"):
-            trainer.run_chunk(...)
-    """
-    import jax
-
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-class Throughput:
-    """Callable chunk hook accumulating wall-clock and example counts.
-
-    ``count_key`` names the metrics leaf holding per-step example counts
-    (every shipped model emits ``"n"``). The first chunk is recorded
-    separately (``first_s``) since it includes compilation.
-    """
-
-    def __init__(self, count_key: str = "n"):
-        self.count_key = count_key
-        self.chunks = 0
-        self.first_s: float | None = None
-        self._first_examples = 0.0
-        self.steady_s = 0.0
-        self._steady_examples = 0.0
-        self._last: float | None = None
-
-    def start(self) -> None:
-        """Mark the stream start. Called lazily on the first chunk, so setup
-        time between constructing the hook and calling fit_stream is not
-        counted; call explicitly right before a *second* fit_stream reusing
-        this hook, or the inter-run gap lands in steady_s."""
-        self._last = time.perf_counter()
-
-    def __call__(self, step: int, metrics) -> None:
-        now = time.perf_counter()
-        if self._last is None:
-            # First observation with no start(): we cannot know when this
-            # chunk began, so count its examples but no wall time.
-            self._last = now
-        dt = now - self._last
-        self._last = now
-        count = (
-            float(np.sum(metrics[self.count_key]))
-            if self.count_key in metrics
-            else 0.0
-        )
-        if self.first_s is None:
-            self.first_s = dt
-            self._first_examples = count
-        else:
-            self.steady_s += dt
-            self._steady_examples += count
-        self.chunks += 1
-
-    @property
-    def examples(self) -> float:
-        return self._first_examples + self._steady_examples
-
-    @property
-    def examples_per_sec(self) -> float:
-        """Steady-state throughput (excludes the compile-laden first chunk)."""
-        return self._steady_examples / self.steady_s if self.steady_s else 0.0
-
-    def summary(self) -> dict:
-        return {
-            "chunks": self.chunks,
-            "examples": self.examples,
-            "first_chunk_s": round(self.first_s or 0.0, 4),
-            "steady_s": round(self.steady_s, 4),
-            "examples_per_sec": round(self.examples_per_sec, 1),
-        }
+__all__ = ["trace", "Throughput"]

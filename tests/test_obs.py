@@ -1,0 +1,490 @@
+"""Telemetry subsystem (fps_tpu.obs): registry/recorder contracts, sinks,
+phase timers, health alerting (monitor escalation + watchdog), run
+journal, and the driver wiring.
+
+Acceptance contract (ISSUE 2):
+
+* a logreg run with telemetry attached produces phase timings, per-table
+  health totals, and journal events (rendered end-to-end in
+  tests/test_obs_report.py);
+* HealthMonitor escalation observe→mask is exercised under chaos
+  poisoning, and its abort tier raises PoisonedStreamError;
+* recorder off ⇒ the compiled program is bit-identical to a
+  recorder-attached build (telemetry is host-side only).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from fps_tpu import obs
+from fps_tpu.core.driver import Trainer, TrainerConfig, num_workers_of
+from fps_tpu.core.resilience import GuardConfig, PoisonedStreamError
+from fps_tpu.core.store import ParamStore, TableSpec
+from fps_tpu.models.logistic_regression import (
+    LogRegConfig,
+    logistic_regression,
+)
+from fps_tpu.obs import events as obs_events
+from fps_tpu.parallel.mesh import make_ps_mesh
+from fps_tpu.testing import chaos
+from fps_tpu.testing.workloads import (
+    NF,
+    logreg_chunks as _logreg_chunks,
+    logreg_data as _logreg_data,
+    weights as _weights,
+)
+
+
+# ---------------------------------------------------------------------------
+# Registry + recorder contracts (pure host, no mesh needed).
+# ---------------------------------------------------------------------------
+
+def test_metric_spec_validation():
+    with pytest.raises(ValueError, match="kind"):
+        obs.MetricSpec("x", "timer")
+    with pytest.raises(ValueError, match="name"):
+        obs.MetricSpec("a b", "counter")
+    reg = obs.MetricsRegistry([obs.MetricSpec("x", "counter")])
+    reg.register(obs.MetricSpec("x", "counter"))  # same spec: idempotent
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register(obs.MetricSpec("x", "gauge"))
+    with pytest.raises(KeyError, match="unregistered"):
+        reg.get("nope")
+
+
+def test_recorder_typed_leaves_and_aggregates():
+    reg = obs.MetricsRegistry([
+        obs.MetricSpec("c", "counter", labels=("table",)),
+        obs.MetricSpec("g", "gauge"),
+        obs.MetricSpec("h", "histogram"),
+    ])
+    sink = obs.MemorySink()
+    rec = obs.Recorder(reg, sinks=[sink], run_id="r1")
+    rec.inc("c", 2, table="a")
+    rec.inc("c", 3, table="a")
+    rec.inc("c", 1, table="b")
+    rec.set("g", 7.5)
+    for v in (0.1, 0.3):
+        rec.observe("h", v)
+    # Typed: wrong kind / unknown name / undeclared label all fail loudly.
+    with pytest.raises(TypeError, match="counter"):
+        rec.set("c", 1, table="a")
+    with pytest.raises(KeyError):
+        rec.inc("unknown")
+    with pytest.raises(ValueError, match="undeclared"):
+        rec.inc("c", 1, shard="a")
+    with pytest.raises(ValueError, match="negative"):
+        rec.inc("c", -1, table="a")
+
+    assert rec.counter_value("c", table="a") == 5
+    snap = rec.snapshot()
+    assert snap["counters"]["c{table=b}"] == 1
+    assert snap["gauges"]["g"] == 7.5
+    h = snap["histograms"]["h"]
+    assert h["count"] == 2 and abs(h["sum"] - 0.4) < 1e-9
+    assert h["min"] == 0.1 and h["max"] == 0.3
+    # Every sample reached the sink, stamped with the run id.
+    ms = sink.metrics()
+    assert len(ms) == 6 and all(m["run_id"] == "r1" for m in ms)
+
+
+def test_memory_sink_ring_bound():
+    sink = obs.MemorySink(capacity=3)
+    for i in range(10):
+        sink.write({"kind": "event", "event": "e", "i": i})
+    assert [r["i"] for r in sink.records] == [7, 8, 9]
+
+
+def test_jsonl_sink_roundtrip(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    sink = obs.JsonlSink(path, flush_every=1)
+    sink.write({"kind": "metric", "name": "x", "value": np.float32(1.5)})
+    sink.write({"kind": "event", "event": "e", "arr": np.arange(2)})
+    sink.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["value"] == 1.5  # numpy degraded to plain JSON
+    assert lines[1]["arr"] == [0, 1]
+
+
+def test_prometheus_sink_exposition(tmp_path):
+    path = str(tmp_path / "m.prom")
+    sink = obs.PrometheusSink(path)
+    rec = obs.Recorder(sinks=[sink])
+    rec.inc("health.nonfinite_rows", 4, table="weights")
+    rec.set("checkpoint.bytes", 1024)
+    rec.observe("driver.phase_seconds", 0.25, phase="dispatch")
+    rec.flush()
+    text = open(path).read()
+    assert ('fps_tpu_health_nonfinite_rows{table="weights"} 4' in text)
+    assert "# TYPE fps_tpu_health_nonfinite_rows counter" in text
+    assert "fps_tpu_checkpoint_bytes 1024" in text
+    assert ('fps_tpu_driver_phase_seconds_count{phase="dispatch"} 1'
+            in text)
+    assert ('fps_tpu_driver_phase_seconds_sum{phase="dispatch"} 0.25'
+            in text)
+
+
+def test_phase_timer_accumulates_and_records():
+    rec = obs.Recorder(sinks=[])
+    t = obs.PhaseTimer(rec)
+    with t.phase("dispatch"):
+        pass
+    with t.phase("dispatch"):
+        pass
+    with t.phase("host_sync"):
+        pass
+    chunk = t.chunk_summary()
+    assert set(chunk) == {"dispatch", "host_sync"}
+    assert t.chunk_summary() == {}  # reset
+    # Run-level totals live on the recorder, the single source of truth.
+    assert rec.phase_totals()["dispatch"]["n"] == 2
+
+
+def test_throughput_first_chunk_covers_construction_gap():
+    """Satellite fix: auto-start on first observation used to record a
+    zero-width first chunk; it must now measure from construction."""
+    tp = obs.Throughput()
+    time.sleep(0.05)
+    tp(0, {"n": np.array([10.0])})
+    assert tp.first_s is not None and tp.first_s >= 0.045
+    tp(1, {"n": np.array([10.0])})
+    s = tp.summary()
+    # Keys stable (the documented contract).
+    assert set(s) == {"chunks", "examples", "first_chunk_s", "steady_s",
+                      "examples_per_sec"}
+    assert s["chunks"] == 2 and s["examples"] == 20.0
+    assert s["first_chunk_s"] >= 0.045
+    # Explicit start() still overrides the construction origin.
+    tp2 = obs.Throughput()
+    time.sleep(0.02)
+    tp2.start()
+    tp2(0, {"n": np.array([1.0])})
+    assert tp2.first_s < 0.02
+
+
+# ---------------------------------------------------------------------------
+# Health monitor + watchdog (pure policy).
+# ---------------------------------------------------------------------------
+
+def test_health_monitor_thresholds():
+    m = obs.HealthMonitor(escalate_after_rows=10, abort_after_chunks=3)
+    assert m.update(0, 0) == obs.HEALTH_OK
+    assert m.update(1, 4) == obs.HEALTH_OK
+    assert m.update(2, 7) == obs.HEALTH_ESCALATE  # 11 rows >= 10
+    assert m.escalated_at == 2
+    assert m.update(3, 5) == obs.HEALTH_ABORT  # 3rd poisoned chunk
+    assert m.aborted_at == 3
+    assert m.log == [(1, 4), (2, 7), (3, 5)]
+    with pytest.raises(ValueError):
+        obs.HealthMonitor(escalate_after_rows=0)
+
+
+def test_step_watchdog_flags_and_recovers():
+    sink = obs.MemorySink()
+    rec = obs.Recorder(sinks=[sink])
+    seen = []
+    wd = obs.StepWatchdog(0.05, on_stall=seen.append, recorder=rec)
+    with wd.watch("chunk", 3):
+        time.sleep(0.15)
+    assert len(wd.stalls) == 1
+    assert wd.stalls[0]["index"] == 3
+    assert wd.stalls[0]["elapsed_s"] >= 0.1  # recovery recorded real time
+    assert seen and seen[0]["what"] == "chunk"
+    assert rec.counter_value("watchdog.stalls") == 1
+    assert [e["event"] for e in sink.events()] == ["stall",
+                                                   "stall_recovered"]
+    # Fast region: timer cancelled, nothing fires.
+    with wd.watch("chunk", 4):
+        pass
+    time.sleep(0.08)
+    assert len(wd.stalls) == 1
+
+
+def test_watchdog_callback_exception_swallowed():
+    wd = obs.StepWatchdog(0.02, on_stall=lambda info: 1 / 0)
+    with wd.watch("chunk", 0):
+        time.sleep(0.06)
+    assert len(wd.stalls) == 1  # the run survived the broken callback
+
+
+# ---------------------------------------------------------------------------
+# Journal + open_run + process-default events.
+# ---------------------------------------------------------------------------
+
+def test_run_journal_keeps_events_only(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = obs.RunJournal(path, run_id="r9", meta={"process": 0})
+    j.write({"kind": "metric", "name": "x", "value": 1})
+    j.write({"kind": "event", "t": 1.0, "event": "chunk", "index": 0})
+    j.close()
+    j.close()  # idempotent
+    recs = [json.loads(l) for l in open(path)]
+    assert [r["event"] for r in recs] == ["run_start", "chunk", "run_end"]
+    assert recs[0]["run_id"] == "r9" and recs[0]["process"] == 0
+
+
+def test_config_digest_stable_and_discriminating():
+    a = obs.config_digest({"lr": 0.1, "mesh": (1, 8)})
+    assert a == obs.config_digest({"mesh": (1, 8), "lr": 0.1})  # order-free
+    assert a != obs.config_digest({"lr": 0.2, "mesh": (1, 8)})
+    assert obs.config_digest({"fn": open})  # non-JSON degrades, not raises
+
+
+def test_open_run_writes_standard_files_and_installs(tmp_path):
+    d = str(tmp_path / "obs")
+    rec = obs.open_run(d, config={"x": 1}, meta={"workload": "t"})
+    try:
+        assert obs_events.get_default_recorder() is rec
+        rec.inc("driver.chunks")
+        obs_events.emit("rollback", index=2, total=1, budget=8)
+        rec.flush()
+    finally:
+        rec.close()
+    assert obs_events.get_default_recorder() is None  # uninstalled on close
+    names = sorted(os.listdir(d))
+    assert names == ["events-p0.jsonl", "journal-p0.jsonl",
+                     "metrics-p0.prom"]
+    journal = [json.loads(l) for l in
+               open(os.path.join(d, "journal-p0.jsonl"))]
+    assert journal[0]["event"] == "run_start"
+    assert journal[0]["workload"] == "t"
+    assert journal[0]["config_digest"] == obs.config_digest({"x": 1})
+    assert [r["event"] for r in journal] == ["run_start", "rollback",
+                                             "run_end"]
+    # Base labels (process identity) ride every series.
+    assert 'fps_tpu_driver_chunks{process="0"} 1' in open(
+        os.path.join(d, "metrics-p0.prom")).read()
+
+
+def test_default_recorder_scoped_and_noop():
+    obs_events.emit("whatever")  # no recorder installed: silent no-op
+    sink = obs.MemorySink()
+    with obs_events.default_recorder(obs.Recorder(sinks=[sink])):
+        obs_events.emit("rollback", index=1)
+        obs_events.record_metric("inc", "rollback.quarantined", 1)
+    assert obs_events.get_default_recorder() is None
+    assert [e["event"] for e in sink.events()] == ["rollback"]
+    assert sink.metrics("rollback.quarantined")
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint + rollback event emission (the deep-layer trail).
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_save_and_fallback_events(tmp_path, devices8):
+    from fps_tpu.core.checkpoint import Checkpointer
+
+    mesh = make_ps_mesh(num_shards=1, num_data=1, devices=devices8[:1])
+    store = ParamStore(mesh, [TableSpec("t", 16, 2).zeros_init()])
+    store.init(jax.random.key(0))
+    sink = obs.MemorySink()
+    rec = obs.Recorder(sinks=[sink])
+    with obs_events.default_recorder(rec):
+        ckpt = Checkpointer(str(tmp_path / "c"), keep=2)
+        ckpt.save(1, store)
+        ckpt.save(2, store)
+        chaos.corrupt_latest_snapshot(str(tmp_path / "c"), "truncate")
+        _, step = ckpt.restore_tables(store)
+    assert step == 1
+    saves = sink.events("checkpoint_saved")
+    assert [e["step"] for e in saves] == [1, 2]
+    assert all(e["bytes"] > 0 and e["seconds"] >= 0 for e in saves)
+    fb = sink.events("checkpoint_fallback")
+    assert len(fb) == 1 and fb[0]["step"] == 2
+    assert rec.counter_value("checkpoint.saves") == 2
+    assert rec.counter_value("checkpoint.fallbacks") == 1
+
+
+# ---------------------------------------------------------------------------
+# Driver wiring (multi-device mesh).
+# ---------------------------------------------------------------------------
+
+def _poisoned_stream(W, kind="huge", idx=(1,), epochs=1, nchunks=None):
+    train, _ = _logreg_data()
+    clean = _logreg_chunks(train, W, epochs=epochs)
+    if nchunks is not None:
+        clean = clean[:nchunks]
+    out = iter(clean)
+    for i in sorted(idx):
+        out = chaos.poison_chunks(out, chunk_index=i, column="feat_vals",
+                                  kind=kind, frac=0.5, seed=1)
+    return list(out)
+
+
+def test_fit_stream_records_phases_health_and_events(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    chunks = _poisoned_stream(W, kind="nan", idx=(1,), nchunks=3)
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainer, store = logistic_regression(mesh, cfg, guard="mask")
+    sink = obs.MemorySink()
+    rec = obs.Recorder(sinks=[sink])
+    trainer.recorder = rec
+    tables, ls = trainer.init_state(jax.random.key(0))
+    trainer.fit_stream(tables, ls, iter(chunks), jax.random.key(1),
+                       on_chunk=lambda i, m: None)
+    assert rec.counter_value("driver.chunks") == 3
+    assert rec.counter_value("driver.examples") > 0
+    assert rec.counter_value("health.nonfinite_rows", table="weights") > 0
+    assert rec.counter_value("health.masked_rows", table="weights") > 0
+    assert rec.counter_value("health.poisoned_chunks") == 1
+    ev = sink.events("chunk")
+    assert [e["index"] for e in ev] == [0, 1, 2]
+    assert ev[1]["poison_rows"] > 0 and "poison_rows" not in ev[0]
+    for e in ev:
+        assert {"ingest", "place", "dispatch", "host_sync",
+                "callback"} <= set(e["phases"])
+    pt = rec.phase_totals()
+    assert pt["dispatch"]["n"] == 3 and pt["dispatch"]["s"] > 0
+
+
+def test_health_monitor_escalates_observe_to_mask(devices8):
+    """ISSUE acceptance: chaos-poisoned stream under guard='observe' +
+    HealthMonitor escalates to 'mask' after the row threshold. Paired
+    with rollback (the production posture): the pre-escalation poisoned
+    chunk is quarantined whole, the post-escalation one is ALSO masked
+    in-step — its poison never reaches the fold even before the
+    host-loop rollback decision lands."""
+    from fps_tpu.core.resilience import RollbackPolicy
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    chunks = _poisoned_stream(W, kind="huge", idx=(1, 3), nchunks=5)
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainer, store = logistic_regression(
+        mesh, cfg, guard=GuardConfig(mode="observe", norm_limit=100.0))
+    sink = obs.MemorySink()
+    rec = obs.Recorder(sinks=[sink])
+    monitor = obs.HealthMonitor(escalate_after_rows=1)
+    policy = RollbackPolicy(max_rollbacks=4)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    trainer.fit_stream(tables, ls, iter(chunks), jax.random.key(1),
+                       recorder=rec, health=monitor, rollback=policy)
+    # Escalated exactly at the first poisoned chunk...
+    assert monitor.escalated_at == 1
+    from fps_tpu.core import resilience
+    assert resilience.as_guard(trainer.config.guard).mode == "mask"
+    esc = sink.events("guard_escalated")
+    assert len(esc) == 1 and esc[0]["index"] == 1
+    # ...chunk 1's poison was observed-only, chunk 3's was masked in-step
+    # (mask mode still counts, so rollback quarantines both — documented
+    # mask+rollback semantics).
+    assert rec.counter_value("health.norm_rows", table="weights") > 0
+    assert rec.counter_value("health.masked_rows", table="weights") > 0
+    assert policy.quarantined == [1, 3]
+    assert rec.counter_value("rollback.quarantined") == 2
+    assert monitor.poisoned_chunks == 2
+    assert np.all(np.isfinite(_weights(store)))
+
+
+def test_health_monitor_abort_raises(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    chunks = _poisoned_stream(W, kind="nan", idx=(0, 1, 2), nchunks=3)
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainer, _ = logistic_regression(mesh, cfg, guard="mask")
+    sink = obs.MemorySink()
+    monitor = obs.HealthMonitor(abort_after_chunks=2)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    with pytest.raises(PoisonedStreamError, match="health monitor abort"):
+        trainer.fit_stream(tables, ls, iter(chunks), jax.random.key(1),
+                           recorder=obs.Recorder(sinks=[sink]),
+                           health=monitor)
+    assert monitor.poisoned_chunks == 2
+    assert sink.events("health_abort")
+
+
+def test_health_monitor_requires_guard(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainer, _ = logistic_regression(mesh, cfg)  # no guard
+    tables, ls = trainer.init_state(jax.random.key(0))
+    with pytest.raises(ValueError, match="health channel"):
+        trainer.fit_stream(tables, ls, iter([]), jax.random.key(1),
+                           health=obs.HealthMonitor())
+    with pytest.raises(TypeError, match="HealthMonitor"):
+        trainer.fit_stream(tables, ls, iter([]), jax.random.key(1),
+                           health=object())
+
+
+def test_watchdog_clean_run_no_stalls(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    train, _ = _logreg_data()
+    chunks = _logreg_chunks(train, W, epochs=1)[:2]
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainer, _ = logistic_regression(mesh, cfg)
+    wd = obs.StepWatchdog(120.0)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    trainer.fit_stream(tables, ls, iter(chunks), jax.random.key(1),
+                       watchdog=wd)
+    assert wd.stalls == []
+
+
+def test_run_indexed_records_epochs(devices8):
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    data = synthetic_ratings(57, 31, 800, seed=0)
+    cfg = MFConfig(num_users=57, num_items=31, rank=4, learning_rate=0.1)
+    trainer, store = online_mf(mesh, cfg, donate=False)
+    sink = obs.MemorySink()
+    rec = obs.Recorder(sinks=[sink])
+    tables, ls = trainer.init_state(jax.random.key(0))
+    plan = DeviceEpochPlan(DeviceDataset(mesh, data), num_workers=W,
+                           local_batch=32, route_key="user", seed=5)
+    trainer.run_indexed(tables, ls, plan, jax.random.key(1), epochs=2,
+                        recorder=rec)
+    assert rec.counter_value("driver.epochs") == 2
+    assert rec.counter_value("driver.examples") == 1600.0
+    ev = sink.events("epoch")
+    assert [e["index"] for e in ev] == [0, 1]
+    assert all("dispatch" in e["phases"] for e in ev)
+
+
+def test_recorder_off_and_on_compile_identically(devices8):
+    """ISSUE acceptance: the recorder is host-side only — attaching one
+    must not change the traced program at all (bit-identical lowered
+    text), unlike e.g. the guard which is part of the program."""
+    from fps_tpu.parallel.mesh import host_to_sharded, key_to_replicated
+
+    from fps_tpu.core.api import StepOutput, WorkerLogic
+
+    class _Pusher(WorkerLogic):
+        def pull_ids(self, batch):
+            return {"t": batch["id"].astype(np.int32)}
+
+        def step(self, batch, pulled, local_state, key):
+            return StepOutput(
+                pushes={"t": (batch["id"].astype(np.int32), batch["val"])},
+                local_state=local_state, out={},
+            )
+
+    def lowered_text(recorder):
+        mesh = make_ps_mesh(num_shards=1, num_data=1, devices=devices8[:1])
+        store = ParamStore(mesh, [TableSpec("t", 16, 2).zeros_init()])
+        trainer = Trainer(mesh, store, _Pusher(),
+                          config=TrainerConfig(donate=False),
+                          recorder=recorder)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        chunk = {
+            "id": np.zeros((1, 4), np.int32),
+            "val": np.zeros((1, 4, 2), np.float32),
+        }
+        sharding = trainer._batch_sharding_for("sync")
+        batches = jax.tree.map(lambda x: host_to_sharded(x, sharding), chunk)
+        key = key_to_replicated(jax.random.key(1), mesh)
+        return trainer._get_compiled("sync").lower(
+            tables, ls, batches, key).as_text()
+
+    assert lowered_text(None) == lowered_text(
+        obs.Recorder(sinks=[obs.MemorySink()]))

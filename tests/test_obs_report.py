@@ -1,0 +1,122 @@
+"""tools/obs_report.py smoke (ISSUE 2 acceptance + CI satellite): a
+2-chunk logreg `fit_stream` run with --obs-dir produces a JSONL event log
++ run journal that the report tool renders into a digest with per-phase
+timings, per-table health totals, and incident events."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+def _load_report():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "obs_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_report_digest_from_logreg_run(devices8, capsys, tmp_path):
+    from fps_tpu.examples import logreg_ssp
+
+    obs_dir = str(tmp_path / "obs")
+    rc = logreg_ssp.main([
+        "--epochs", "1", "--local-batch", "32", "--steps-per-chunk", "4",
+        "--num-examples", "2000", "--num-features", "500",
+        "--sync-every", "2", "--guard", "observe",
+        "--checkpoint-dir", str(tmp_path / "ck"), "--checkpoint-every", "2",
+        "--obs-dir", obs_dir, "--obs-watchdog-s", "300",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    events = [json.loads(l) for l in out.splitlines()]
+    assert any(e["event"] == "obs" and e["dir"] == obs_dir for e in events)
+
+    report = _load_report()
+    digest = report.render_digest(obs_dir)
+    # Required shape (REQUIRED_FIELDS is the tool's own contract).
+    for field in report.REQUIRED_FIELDS:
+        assert field in digest, field
+    assert digest["chunks"] == 2
+    assert digest["examples"] > 0
+    assert digest["run_complete"] is True
+    assert len(digest["run_ids"]) == 1 and digest["processes"] == [0]
+    # Per-phase timings: every driver phase observed, with real time.
+    for phase in ("ingest", "place", "dispatch", "host_sync", "checkpoint"):
+        assert phase in digest["phase_seconds"], phase
+        assert digest["phase_seconds"][phase]["n"] >= 1
+    assert digest["phase_seconds"]["dispatch"]["total_s"] > 0
+    # Per-table health totals: the guard watched (clean run => zeros).
+    assert digest["health"] == {
+        "weights": {"nonfinite": 0, "norm": 0, "masked": 0}
+    }
+    assert digest["checkpoint_saves"] >= 1
+    assert digest["watchdog_stalls"] == 0 and digest["incidents"] == {}
+    assert digest["wall_span_s"] >= 0
+
+    # main() prints the digest as one JSON line.
+    assert report.main([obs_dir]) == 0
+    line = capsys.readouterr().out.strip()
+    assert json.loads(line)["chunks"] == 2
+
+
+def test_obs_report_surfaces_incidents(tmp_path):
+    """Rollback / stall / escalation / checkpoint-fallback events written
+    by a run land in the digest's incident lists (synthetic event files —
+    the report tool is a pure JSONL consumer)."""
+    report = _load_report()
+    d = str(tmp_path)
+    with open(os.path.join(d, "events-p0.jsonl"), "w") as f:
+        for rec in [
+            {"kind": "metric", "t": 1.0, "name": "driver.chunks",
+             "mtype": "counter", "value": 1},
+            {"kind": "metric", "t": 1.2, "name": "rollback.quarantined",
+             "mtype": "counter", "value": 1},
+            {"kind": "event", "t": 1.2, "event": "rollback", "index": 4,
+             "total": 1, "budget": 8},
+            {"kind": "event", "t": 1.3, "event": "chunk", "index": 4,
+             "quarantined": True, "phases": {}},
+            {"kind": "event", "t": 1.4, "event": "stall", "what": "chunk",
+             "index": 5, "deadline_s": 2.0},
+            {"kind": "event", "t": 1.5, "event": "guard_escalated",
+             "index": 5, "what": "chunk", "poison_rows": 12},
+            {"kind": "event", "t": 1.6, "event": "checkpoint_fallback",
+             "step": 3, "error": "boom"},
+            "garbage that is not json",  # torn tail line must not break it
+        ]:
+            f.write(rec if isinstance(rec, str) else json.dumps(rec))
+            f.write("\n")
+    # Journal holds: a duplicate of the rollback (same record fanned to
+    # both sinks — must dedupe) plus a stall the buffered event sink LOST
+    # (SIGKILL before flush) — must still surface in the digest.
+    with open(os.path.join(d, "journal-p0.jsonl"), "w") as f:
+        for rec in [
+            {"kind": "event", "t": 0.5, "event": "run_start",
+             "run_id": "r", "process": 0},
+            {"kind": "event", "t": 1.2, "event": "rollback", "index": 4,
+             "total": 1, "budget": 8},
+            {"kind": "event", "t": 1.7, "event": "stall", "what": "chunk",
+             "index": 9, "deadline_s": 2.0},
+        ]:
+            f.write(json.dumps(rec) + "\n")
+    digest = report.render_digest(d)
+    assert digest["quarantined"] == [4]
+    assert digest["rollbacks"] == 1
+    assert [i["index"] for i in digest["incidents"]["rollback"]] == [4]
+    # The journal-only stall survived; the duplicated rollback didn't fork.
+    assert sorted(i["index"] for i in digest["incidents"]["stall"]) == [5, 9]
+    assert digest["incidents"]["guard_escalated"][0]["poison_rows"] == 12
+    assert digest["incidents"]["checkpoint_fallback"][0]["step"] == 3
+    assert digest["run_complete"] is False  # no journal run_end
+
+
+def test_obs_report_empty_dir_errors(tmp_path):
+    report = _load_report()
+    with pytest.raises(FileNotFoundError):
+        report.render_digest(str(tmp_path))
+    assert report.main([str(tmp_path)]) == 2

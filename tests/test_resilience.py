@@ -322,6 +322,71 @@ def test_rollback_run_indexed_epochs(devices8):
 
 
 # ---------------------------------------------------------------------------
+# Health channel under user-supplied metrics reductions.
+# ---------------------------------------------------------------------------
+
+def test_health_counters_survive_metrics_reduce(devices8):
+    """The health channel is ordinary metrics: a user metrics_reduce sees
+    it and can aggregate it like any other leaf — the counters must not
+    be stripped or zeroed on the way to the reduction."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    train, _ = _logreg_data()
+    clean = _logreg_chunks(train, W, epochs=1)[:3]
+    poisoned = list(chaos.poison_chunks(
+        iter(clean), chunk_index=1, column="feat_vals", kind="nan",
+        frac=0.5, seed=1,
+    ))
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainer, _ = logistic_regression(mesh, cfg, guard="mask")
+    tables, ls = trainer.init_state(jax.random.key(0))
+
+    def reduce_sum(ms):
+        assert len(ms) == 3  # the reduce sees every chunk, unreduced
+        assert all("health" in m for m in ms)
+        return jax.tree.map(lambda *xs: np.sum(xs), *ms)
+
+    _, _, reduced = trainer.fit_stream(
+        tables, ls, iter(poisoned), jax.random.key(1),
+        metrics_reduce=reduce_sum,
+    )
+    assert int(reduced["health"]["weights"]["nonfinite"]) > 0
+    assert int(reduced["health"]["weights"]["masked"]) > 0
+    assert int(reduced["health"]["weights"]["norm"]) == 0
+
+
+def test_maybe_quarantine_sees_unreduced_totals(devices8):
+    """_maybe_quarantine must act on the PER-CHUNK, unreduced health
+    totals: a metrics_reduce that strips the health channel entirely (the
+    most adversarial user reduction) must not blind the rollback path —
+    the quarantine decision happens before any user reduction runs."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    train, _ = _logreg_data()
+    clean = _logreg_chunks(train, W, epochs=1)[:3]
+    poisoned = list(chaos.poison_chunks(
+        iter(clean), chunk_index=1, column="feat_vals", kind="nan",
+        frac=0.5, seed=1,
+    ))
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainer, store = logistic_regression(mesh, cfg, guard="observe")
+    tables, ls = trainer.init_state(jax.random.key(0))
+    policy = RollbackPolicy()
+
+    def strip_health(ms):
+        return [{k: v for k, v in m.items() if k != "health"} for m in ms]
+
+    _, _, reduced = trainer.fit_stream(
+        tables, ls, iter(poisoned), jax.random.key(1),
+        rollback=policy, metrics_reduce=strip_health,
+    )
+    assert policy.quarantined == [1]  # the drop didn't blind the driver
+    assert len(reduced) == 2  # quarantined chunk contributes no entry
+    assert all("health" not in m for m in reduced)
+    assert np.all(np.isfinite(_weights(store)))
+
+
+# ---------------------------------------------------------------------------
 # Guard primitives + chaos injector determinism.
 # ---------------------------------------------------------------------------
 

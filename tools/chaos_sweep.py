@@ -56,6 +56,16 @@ def _finite(store):
     return bool(np.all(np.isfinite(weights(store))))
 
 
+def _health_totals(metrics, tables=("weights",)):
+    """Per-table health-counter totals over a run's metrics list — the
+    digest's evidence that the guard actually saw the poison."""
+    return {
+        t: {kind: health_sum(metrics, t, kind)
+            for kind in ("nonfinite", "norm", "masked")}
+        for t in tables
+    }
+
+
 def poison_scenario(mesh, chunks, test, acc_clean, kind):
     poisoned = list(chaos.poison_chunks(iter(chunks), chunk_index=1,
                                         column="feat_vals", kind=kind,
@@ -64,8 +74,9 @@ def poison_scenario(mesh, chunks, test, acc_clean, kind):
              if kind == "huge" else GuardConfig(mode="mask"))
     _, store, m = run_logreg(mesh, poisoned, guard=guard)
     tier = "norm" if kind == "huge" else "nonfinite"
-    return (_finite(store) and health_sum(m, "weights", tier) > 0
-            and abs(accuracy(store, test) - acc_clean) < 0.05)
+    ok = (_finite(store) and health_sum(m, "weights", tier) > 0
+          and abs(accuracy(store, test) - acc_clean) < 0.05)
+    return ok, {"health": _health_totals(m)}
 
 
 def rollback_scenario(mesh, chunks):
@@ -73,9 +84,15 @@ def rollback_scenario(mesh, chunks):
                                         column="feat_vals", kind="nan",
                                         frac=0.5, seed=1))
     policy = RollbackPolicy()
-    _, store, _ = run_logreg(mesh, poisoned, guard="observe",
+    _, store, m = run_logreg(mesh, poisoned, guard="observe",
                              rollback=policy)
-    return _finite(store) and policy.quarantined == [1]
+    ok = _finite(store) and policy.quarantined == [1]
+    # Quarantined chunks contribute no metrics entry, so the health totals
+    # here cover only the SURVIVING chunks (expected all-zero under
+    # observe+rollback — the poison was dropped whole).
+    return ok, {"health": _health_totals(m),
+                "quarantined": list(policy.quarantined),
+                "rollback_budget": policy.max_rollbacks}
 
 
 def ckpt_scenario(tmpdir, mesh, chunks, mode):
@@ -114,13 +131,15 @@ def main():
     acc_clean = accuracy(store_clean, test)
 
     results = {}
-    results["nan_mask"] = poison_scenario(mesh, chunks, test, acc_clean,
-                                          "nan")
-    results["inf_mask"] = poison_scenario(mesh, chunks, test, acc_clean,
-                                          "inf")
-    results["huge_norm_mask"] = poison_scenario(mesh, chunks, test,
-                                                acc_clean, "huge")
-    results["observe_rollback"] = rollback_scenario(mesh, chunks)
+    detail = {}
+    results["nan_mask"], detail["nan_mask"] = poison_scenario(
+        mesh, chunks, test, acc_clean, "nan")
+    results["inf_mask"], detail["inf_mask"] = poison_scenario(
+        mesh, chunks, test, acc_clean, "inf")
+    results["huge_norm_mask"], detail["huge_norm_mask"] = poison_scenario(
+        mesh, chunks, test, acc_clean, "huge")
+    results["observe_rollback"], detail["observe_rollback"] = (
+        rollback_scenario(mesh, chunks))
     for mode in ("truncate", "bitflip", "tmp_sweep"):
         with tempfile.TemporaryDirectory() as d:
             results[f"ckpt_{mode}" if mode != "tmp_sweep" else mode] = (
@@ -130,6 +149,10 @@ def main():
         "chaos_sweep": results,
         "survived": sum(results.values()),
         "total": len(results),
+        # Per-scenario evidence: per-table health-counter totals and the
+        # rollback/quarantine record (survival booleans alone said WHETHER
+        # we lived, not WHAT the defenses saw).
+        "detail": detail,
         "mesh": dict(mesh.shape),
         "clean_test_acc": round(acc_clean, 4),
     }

@@ -1,0 +1,215 @@
+"""Render an ``--obs-dir`` telemetry directory into one run digest.
+
+Reads every per-process ``events-p*.jsonl`` and ``journal-p*.jsonl``
+under the directory (multi-host runs write one pair per process; they
+join on ``run_id``) and prints a single JSON digest:
+
+* run identity — run ids, config digest, processes, wall-clock span;
+* progress — chunks/epochs/steps/examples, quarantined indices;
+* **per-phase timings** — total/mean/max seconds per host phase
+  (ingest / place / dispatch / host_sync / checkpoint / callback);
+* **per-table health totals** — nonfinite/norm/masked row counts;
+* **incidents** — rollbacks, watchdog stalls (+ recoveries), guard
+  escalations, health aborts, checkpoint fallbacks, checkpoint saves.
+
+Pure host tool: no jax import, safe to run on a login node against a
+live or finished run directory.
+
+Usage:
+  python tools/obs_report.py RUN_DIR [--pretty]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import sys
+
+# Event types surfaced verbatim (bounded lists) in the digest.
+_INCIDENT_EVENTS = (
+    "rollback",
+    "stall",
+    "stall_recovered",
+    "guard_escalated",
+    "health_abort",
+    "poisoned_stream_abort",
+    "checkpoint_fallback",
+)
+
+# Digest keys that must always be present (the smoke test asserts these —
+# consumers can rely on the shape even for an empty run).
+REQUIRED_FIELDS = (
+    "obs_dir", "run_ids", "processes", "chunks", "epochs", "steps",
+    "examples", "phase_seconds", "health", "incidents", "checkpoint_saves",
+    "quarantined", "wall_span_s",
+)
+
+
+def _read_jsonl(path: str):
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                # A torn final line (live run, killed writer) is expected;
+                # everything before it is still a valid prefix.
+                return
+
+
+def render_digest(obs_dir: str) -> dict:
+    """Digest dict from an obs directory (see module docstring)."""
+    event_files = sorted(glob.glob(os.path.join(obs_dir, "events-p*.jsonl")))
+    journal_files = sorted(
+        glob.glob(os.path.join(obs_dir, "journal-p*.jsonl")))
+    if not event_files and not journal_files:
+        raise FileNotFoundError(
+            f"no events-p*.jsonl / journal-p*.jsonl under {obs_dir!r} — "
+            "was the run started with --obs-dir (fps_tpu.obs.open_run)?"
+        )
+
+    counters: dict[str, float] = collections.defaultdict(float)
+    phases: dict[str, dict] = {}
+    health: dict[str, dict] = {}
+    incidents: dict[str, list] = {k: [] for k in _INCIDENT_EVENTS}
+    run_ids: set[str] = set()
+    processes: set[int] = set()
+    config_digests: set[str] = set()
+    quarantined: list[int] = []
+    t_min = t_max = None
+
+    def see_time(t):
+        nonlocal t_min, t_max
+        if t is None:
+            return
+        t_min = t if t_min is None else min(t_min, t)
+        t_max = t if t_max is None else max(t_max, t)
+
+    # Events appear in BOTH the event log and the journal (one Recorder
+    # emission fans out to every sink) — and after a crash the journal
+    # (flushed per record) can hold incidents the event log's buffered
+    # tail lost. Fold both sources, deduping on exact record content.
+    seen_events: set[str] = set()
+
+    def fold_event(rec):
+        key = json.dumps(rec, sort_keys=True, default=str)
+        if key in seen_events:
+            return
+        seen_events.add(key)
+        et = rec.get("event")
+        if et in incidents:
+            incidents[et].append(
+                {k: v for k, v in rec.items() if k != "kind"})
+        if et in ("chunk", "epoch") and rec.get("quarantined"):
+            quarantined.append(rec.get("index"))
+
+    for rec in (r for p in event_files for r in _read_jsonl(p)):
+        see_time(rec.get("t"))
+        if rec.get("run_id"):
+            run_ids.add(rec["run_id"])
+        kind = rec.get("kind")
+        if kind == "metric":
+            name = rec.get("name", "")
+            labels = rec.get("labels") or {}
+            v = float(rec.get("value", 0.0))
+            if name == "driver.phase_seconds":
+                ph = phases.setdefault(
+                    labels.get("phase", "?"),
+                    {"total_s": 0.0, "n": 0, "max_s": 0.0},
+                )
+                ph["total_s"] += v
+                ph["n"] += 1
+                ph["max_s"] = max(ph["max_s"], v)
+            elif name.startswith("health.") and name.endswith("_rows"):
+                table = labels.get("table", "?")
+                tier = name[len("health."):-len("_rows")]
+                health.setdefault(
+                    table, {"nonfinite": 0, "norm": 0, "masked": 0}
+                )[tier] += int(v)
+            elif rec.get("mtype") == "counter":
+                counters[name] += v
+        elif kind == "event":
+            fold_event(rec)
+
+    # Journals: run identity + anything the event files missed (a process
+    # may have died before its event sink flushed; journals flush per
+    # record, so their incident trail survives a SIGKILL).
+    started: set[str] = set()
+    ended: set[str] = set()
+    for rec in (r for p in journal_files for r in _read_jsonl(p)):
+        see_time(rec.get("t"))
+        if rec.get("run_id"):
+            run_ids.add(rec["run_id"])
+        fold_event(rec)
+        if rec.get("event") == "run_start":
+            started.add(rec.get("run_id"))
+            if "process" in rec:
+                processes.add(int(rec["process"]))
+            if rec.get("config_digest"):
+                config_digests.add(rec["config_digest"])
+        elif rec.get("event") == "run_end":
+            ended.add(rec.get("run_id"))
+
+    for ph in phases.values():
+        ph["total_s"] = round(ph["total_s"], 6)
+        ph["mean_s"] = round(ph["total_s"] / max(ph["n"], 1), 6)
+        ph["max_s"] = round(ph["max_s"], 6)
+
+    digest = {
+        "obs_dir": os.path.abspath(obs_dir),
+        "run_ids": sorted(run_ids),
+        "config_digests": sorted(config_digests),
+        "processes": sorted(processes) or [0],
+        "chunks": int(counters.get("driver.chunks", 0)),
+        "epochs": int(counters.get("driver.epochs", 0)),
+        "steps": int(counters.get("driver.steps", 0)),
+        "examples": counters.get("driver.examples", 0.0),
+        "phase_seconds": dict(sorted(phases.items())),
+        "health": dict(sorted(health.items())),
+        "poisoned_chunks": int(counters.get("health.poisoned_chunks", 0)),
+        "incidents": {k: v for k, v in incidents.items() if v},
+        "checkpoint_saves": int(counters.get("checkpoint.saves", 0)),
+        "checkpoint_fallbacks": int(
+            counters.get("checkpoint.fallbacks", 0)),
+        "watchdog_stalls": int(counters.get("watchdog.stalls", 0)),
+        "rollbacks": int(counters.get("rollback.quarantined", 0)),
+        "quarantined": sorted(q for q in quarantined if q is not None),
+        # Complete only when EVERY started run ended — a dir holding a
+        # finished first run and a killed second run is not complete.
+        "run_complete": bool(started) and started <= ended,
+        # Append-mode sinks stack re-runs into the same files; counts and
+        # phases above are then aggregates over all of them. Surfaced so
+        # consumers don't mistake a 2-run dir for one double-sized run.
+        "aggregated_runs": max(len(run_ids), 1),
+        "wall_span_s": (round(t_max - t_min, 3)
+                        if t_min is not None else None),
+    }
+    missing = [k for k in REQUIRED_FIELDS if k not in digest]
+    assert not missing, f"digest contract violated: missing {missing}"
+    return digest
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render an fps_tpu --obs-dir into a one-line run digest")
+    ap.add_argument("obs_dir", help="directory written by --obs-dir / "
+                                    "fps_tpu.obs.open_run")
+    ap.add_argument("--pretty", action="store_true",
+                    help="indent the JSON for humans")
+    args = ap.parse_args(argv)
+    try:
+        digest = render_digest(args.obs_dir)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    print(json.dumps(digest, indent=2 if args.pretty else None))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
