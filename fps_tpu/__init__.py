@@ -31,9 +31,11 @@ from fps_tpu.core.resilience import (
     RollbackPolicy,
     SnapshotCorruptionError,
 )
+from fps_tpu.core.checkpoint import AsyncCheckpointer, Checkpointer
 from fps_tpu.core.store import TableSpec, ParamStore
 from fps_tpu.parallel.mesh import init_distributed, make_ps_mesh
 from fps_tpu import obs
+from fps_tpu import supervise
 
 __version__ = "0.1.0"
 
@@ -54,6 +56,9 @@ __all__ = [
     "RollbackPolicy",
     "SnapshotCorruptionError",
     "PoisonedStreamError",
+    "Checkpointer",
+    "AsyncCheckpointer",
     "obs",
+    "supervise",
     "__version__",
 ]

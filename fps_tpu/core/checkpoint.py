@@ -21,6 +21,12 @@ expected upstream ``src/main/scala/hu/sztaki/ilab/ps/FlinkParameterServer.scala`
 Format: plain ``.npz``; no framework lock-in, loadable from numpy alone.
 Tables are saved in *logical* id order, so a checkpoint taken on an S-shard
 mesh restores onto any other shard count.
+
+:class:`AsyncCheckpointer` is the drop-in double-buffered variant: the
+device→host snapshot is captured synchronously, serialize+fsync+rename run
+on a background writer thread, and ``flush()`` is the durability barrier
+(the drivers call it at end of run). ``checkpoint_enqueued`` /
+``checkpoint_saved`` journal events mark acceptance vs. durability.
 """
 
 from __future__ import annotations
@@ -30,6 +36,8 @@ import os
 import re
 import struct
 import tempfile
+import threading
+import time
 import zipfile
 import zlib
 from typing import Any, Mapping
@@ -261,11 +269,47 @@ class Checkpointer:
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
         self._sweep_tmp()
+        self._sweep_corrupt()
 
     # A tmp file younger than this is treated as a LIVE write in progress
     # (another process mid-_atomic_savez) and left alone; older ones are
     # crash leftovers. Far above any realistic serialize+fsync time.
     TMP_SWEEP_AGE_S = 3600.0
+
+    # Quarantined ``*.corrupt`` files are forensic evidence, not live
+    # state — bound them (age + count, mirroring the tmp sweep) so a
+    # long-lived training dir with recurring disk faults doesn't
+    # accumulate dead snapshots forever: at most CORRUPT_KEEP files, none
+    # older than CORRUPT_SWEEP_AGE_S.
+    CORRUPT_KEEP = 4
+    CORRUPT_SWEEP_AGE_S = 7 * 24 * 3600.0
+
+    def _sweep_corrupt(self) -> None:
+        """Bound the ``*.corrupt`` quarantine: drop files older than
+        :attr:`CORRUPT_SWEEP_AGE_S`, and everything beyond the newest
+        :attr:`CORRUPT_KEEP` even when young (a fast corruption loop must
+        not fill the disk). Runs at construction and after each
+        quarantine."""
+        entries = []
+        for f in os.listdir(self.dir):
+            if not f.endswith(".corrupt"):
+                continue
+            path = os.path.join(self.dir, f)
+            try:
+                entries.append((os.path.getmtime(path), path))
+            except OSError:
+                continue
+        entries.sort(reverse=True)  # newest first
+        now = time.time()
+        for rank, (mtime, path) in enumerate(entries):
+            if rank < self.CORRUPT_KEEP and now - mtime < self.CORRUPT_SWEEP_AGE_S:
+                continue
+            try:
+                _log.warning("sweeping quarantined snapshot %s",
+                             os.path.basename(path))
+                os.remove(path)
+            except OSError:
+                pass
 
     def _sweep_tmp(self) -> None:
         """Remove partial ``.tmp.npz`` files left by a crash mid-save.
@@ -276,8 +320,6 @@ class Checkpointer:
         in-flight file (a monitoring process constructing a Checkpointer
         on a live training dir), so only files older than
         :attr:`TMP_SWEEP_AGE_S` are swept."""
-        import time
-
         now = time.time()
         for f in os.listdir(self.dir):
             if not f.endswith(".tmp.npz"):
@@ -294,15 +336,13 @@ class Checkpointer:
     def _path(self, step: int) -> str:
         return os.path.join(self.dir, SNAPSHOT_FMT.format(step=step))
 
-    def save(self, step: int, store: ParamStore, local_state: Pytree = None,
-             *, local_state_format: str = "raw") -> str:
-        """``local_state_format`` tags how the local-state leaves are laid
-        out: ``"raw"`` (device layout, restorable via :meth:`restore` at
-        the same worker count) or ``"exported"`` (the worker logic's
-        worker-count-independent form, written by the Trainer path and
-        restorable only via ``Trainer.restore_checkpoint``). The tag makes
-        a mismatched restore fail loudly instead of silently permuting
-        state when shapes happen to coincide."""
+    def _collect(self, store: ParamStore, local_state: Pytree,
+                 local_state_format: str) -> dict[str, np.ndarray]:
+        """Snapshot-point capture: every table + local-state leaf as HOST
+        arrays (the device→host dump, with its collectives in
+        multi-controller runs) — the part of a save that must happen
+        synchronously at the training step it describes. Serialization
+        (:meth:`_write`) can then run later/elsewhere."""
         arrays = _table_arrays(store)
         leaves, treedef = jax.tree.flatten(local_state)
         for i, leaf in enumerate(leaves):
@@ -318,11 +358,16 @@ class Checkpointer:
             arrays[f"ls{_SEP}{i}"] = np.asarray(leaf)
         arrays[f"meta{_SEP}ls_format"] = np.array(local_state_format)
         del treedef  # structure is supplied by local_state_like at restore
+        return arrays
+
+    def _write(self, step: int, arrays: dict[str, np.ndarray]) -> str:
+        """Serialize half of a save: CRC tags, atomic fsync'd write,
+        telemetry, retention GC. Runs on the caller's thread here; the
+        AsyncCheckpointer runs it on its writer thread."""
+        arrays = dict(arrays)
         for k in list(arrays):
             arrays[_CRC_PREFIX + k] = np.uint32(array_crc32(arrays[k]))
         path = self._path(step)
-        import time
-
         t0 = time.perf_counter()
         _atomic_savez(path, arrays)
         secs = time.perf_counter() - t0
@@ -338,6 +383,34 @@ class Checkpointer:
             _obs_metric("set", "checkpoint.bytes", nbytes)
         self._gc()
         return path
+
+    def save(self, step: int, store: ParamStore, local_state: Pytree = None,
+             *, local_state_format: str = "raw") -> str:
+        """``local_state_format`` tags how the local-state leaves are laid
+        out: ``"raw"`` (device layout, restorable via :meth:`restore` at
+        the same worker count) or ``"exported"`` (the worker logic's
+        worker-count-independent form, written by the Trainer path and
+        restorable only via ``Trainer.restore_checkpoint``). The tag makes
+        a mismatched restore fail loudly instead of silently permuting
+        state when shapes happen to coincide."""
+        return self._write(
+            step, self._collect(store, local_state, local_state_format)
+        )
+
+    def flush(self) -> None:
+        """Durability barrier — every accepted :meth:`save` is on disk
+        when this returns. The synchronous base class already is; the
+        :class:`AsyncCheckpointer` override waits for its writer."""
+
+    def close(self) -> None:
+        """Release writer resources (no-op here; see
+        :class:`AsyncCheckpointer`). Safe to call twice."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     def steps(self) -> list[int]:
         out = []
@@ -405,8 +478,14 @@ class Checkpointer:
         _obs_metric("inc", "checkpoint.fallbacks", 1)
         try:
             os.replace(path, path + ".corrupt")
+            # Age from NOW: the rename preserves the snapshot's original
+            # mtime, and an old-enough snapshot would otherwise be
+            # deleted by the very sweep below — the sweep's age bound is
+            # about time-in-quarantine, not snapshot age.
+            os.utime(path + ".corrupt")
         except OSError:
             pass
+        self._sweep_corrupt()  # keep the quarantine bounded (age + count)
 
     def read_snapshot(
         self, step: int | None = None, *, verify: bool = True
@@ -557,17 +636,177 @@ class Checkpointer:
                 pass
 
 
+class AsyncCheckpointer(Checkpointer):
+    """Double-buffered background snapshot writer.
+
+    :meth:`save` captures the snapshot point synchronously (device→host
+    dump of tables + local state — the part that must see the training
+    state as of ``step``) and returns; a single writer thread then does
+    the expensive half — CRC tags, serialize, fsync, atomic rename — off
+    the training thread. This shrinks both the per-save step-time hiccup
+    (the training loop no longer blocks on serialize+fsync) and the crash
+    window (the loop reaches its next step sooner).
+
+    Contracts:
+
+    * **double-buffered, at-most-one in-flight write** — one snapshot may
+      be queued while one is being written; a third :meth:`save` blocks
+      until the writer frees the slot, bounding host memory at two
+      snapshots.
+    * **publication is still atomic** — the writer goes through the same
+      ``_atomic_savez`` tmp+fsync+rename, so a SIGKILL mid-background-
+      write leaves at most a ``*.tmp.npz`` leftover, never a torn
+      published snapshot, and ``latest_valid_step`` stays monotone.
+    * **flush() is the durability barrier** — returns once every accepted
+      save is renamed into place (the drivers call it at end of run); a
+      background write failure is re-raised, once, from the next
+      ``save``/``flush``/``close`` on the caller's thread.
+    * **journal truth** — ``save`` emits ``checkpoint_enqueued``; the
+      writer emits ``checkpoint_saved`` only after the rename, so the
+      run journal's ``checkpoint_saved`` records remain TRUE durability
+      points for the supervisor and ``tools/obs_report.py``.
+    * the read side (:meth:`read_snapshot` and everything over it)
+      flushes first, so an in-process restore always sees the newest
+      accepted save. :meth:`steps` itself does NOT flush — the writer's
+      own retention GC runs on the writer thread and must not deadlock.
+    """
+
+    def __init__(self, directory: str, *, keep: int = 3):
+        super().__init__(directory, keep=keep)
+        self._cv = threading.Condition()
+        self._queued: tuple[int, dict] | None = None
+        self._writing = False
+        self._error: BaseException | None = None
+        self._closed = False
+        self._writer = threading.Thread(
+            target=self._writer_loop,
+            name=f"fps-ckpt-writer:{os.path.basename(directory)}",
+            daemon=True,  # flush()/close() are the orderly exits; a
+        )  # crashed main thread must not hang the interpreter on join
+        self._writer.start()
+
+    # -- caller side ------------------------------------------------------
+
+    def save(self, step: int, store: ParamStore, local_state: Pytree = None,
+             *, local_state_format: str = "raw") -> str:
+        arrays = self._collect(store, local_state, local_state_format)
+        # The writer consumes these arrays on another thread while the
+        # training loop runs on: every entry must OWN its memory. Dump
+        # paths normally produce fresh arrays (fancy indexing), but e.g.
+        # a CPU-backend jax leaf can surface as a zero-copy view of a
+        # device buffer that the next step donates away.
+        for k, v in arrays.items():
+            if isinstance(v, np.ndarray) and not v.flags["OWNDATA"]:
+                arrays[k] = np.array(v, copy=True)
+        with self._cv:
+            self._raise_pending_error()
+            while self._queued is not None and not self._closed:
+                self._cv.wait()
+                self._raise_pending_error()
+            if self._closed:
+                raise RuntimeError(
+                    f"AsyncCheckpointer for {self.dir} is closed")
+            self._queued = (int(step), arrays)
+            path = self._path(step)
+            # Emitted while still HOLDING the cv (the writer can't pop
+            # the slot until we release), so the journal's enqueued →
+            # saved ordering holds even for an instantaneous write. No
+            # lock cycle: the writer takes the recorder lock only from
+            # _write, never while waiting on this cv.
+            _obs_event("checkpoint_enqueued", step=int(step), path=path)
+            _obs_metric("inc", "checkpoint.enqueues", 1)
+            self._cv.notify_all()
+        return path
+
+    def flush(self) -> None:
+        with self._cv:
+            while self._queued is not None or self._writing:
+                self._cv.wait()
+            self._raise_pending_error()
+
+    def close(self) -> None:
+        try:
+            self.flush()
+        finally:
+            with self._cv:
+                self._closed = True
+                self._cv.notify_all()
+            self._writer.join(timeout=60.0)
+
+    def _raise_pending_error(self) -> None:
+        # Called under self._cv.
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                f"background checkpoint write failed under {self.dir}"
+            ) from err
+
+    # -- read side (must observe accepted saves) --------------------------
+
+    def read_snapshot(self, step: int | None = None, *, verify: bool = True):
+        self.flush()
+        return super().read_snapshot(step, verify=verify)
+
+    def verify_snapshot(self, step: int | None = None) -> bool:
+        self.flush()
+        return super().verify_snapshot(step)
+
+    def latest_valid_step(self) -> int | None:
+        self.flush()
+        return super().latest_valid_step()
+
+    # -- writer thread ----------------------------------------------------
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._cv:
+                while self._queued is None and not self._closed:
+                    self._cv.wait()
+                if self._queued is None:  # closed and drained
+                    return
+                step, arrays = self._queued
+                self._queued = None
+                self._writing = True
+                self._cv.notify_all()  # free the queue slot for save()
+            try:
+                self._write(step, arrays)
+            except BaseException as e:  # noqa: BLE001 - re-raised on caller
+                with self._cv:
+                    self._error = e
+            finally:
+                del arrays  # drop the buffer before blocking on the cv
+                with self._cv:
+                    self._writing = False
+                    self._cv.notify_all()
+
+
 # ---------------------------------------------------------------------------
 # Atomic file helpers (a torn write must not corrupt the latest snapshot).
 # ---------------------------------------------------------------------------
 
 def _atomic_savez(path: str, arrays: Mapping[str, np.ndarray]) -> None:
+    """Serialize + fsync + atomic rename: after this returns, ``path``
+    either holds the complete snapshot or (on a crash anywhere inside)
+    its previous content — never a torn file. The fsync BEFORE the rename
+    is what makes the rename a real durability point (a power loss after
+    an unfsync'd rename can publish an empty file); the directory fsync
+    after makes the rename itself survive."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
-    os.close(fd)
     try:
-        np.savez(tmp, **arrays)
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # best-effort: not every filesystem supports dir fsync
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)

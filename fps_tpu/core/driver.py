@@ -181,6 +181,13 @@ class Trainer:
                     f"store has {sorted(param_store.specs)}; a typo here "
                     "would silently disable the guard"
                 )
+        if (guard is not None and guard.local
+                and resilience.LOCAL_STATE_KEY in param_store.specs):
+            raise ValueError(
+                f"guard.local reserves the {resilience.LOCAL_STATE_KEY!r} "
+                "health-channel entry, but the store has a table of that "
+                "name — rename the table or disable the local guard"
+            )
         self.num_shards = mesh.shape[SHARD_AXIS]
         self.num_workers = num_workers_of(mesh)
 
@@ -424,12 +431,22 @@ class Trainer:
                     )
         with jax.named_scope("fps.compute"):
             out = self.logic.step(batch, pulled, local_state, key)
-        pushes, outch = out.pushes, out.out
+        pushes, outch, new_local = out.pushes, out.out, out.local_state
         guard = resilience.as_guard(self.config.guard)
         if guard is not None:
             # Trace-time static: guard=None compiles byte-identically to a
             # guard-free build (tested via lowered-HLO comparison).
             pushes, health = resilience.guard_pushes(pushes, guard)
+            if guard.local:
+                # Same screening for the worker-LOCAL plane: revert (mask)
+                # or count (observe) poisoned local-state rows, mounted on
+                # the health channel under the reserved "local_state" key
+                # (collision with a table name rejected at construction).
+                new_local, local_health = resilience.guard_local_state(
+                    local_state, new_local, guard
+                )
+                if local_health is not None:
+                    health[resilience.LOCAL_STATE_KEY] = local_health
             if health:
                 if not isinstance(outch, dict):
                     raise TypeError(
@@ -443,7 +460,7 @@ class Trainer:
                         "key — it would collide with the guard's counters"
                     )
                 outch = dict(outch, **{resilience.HEALTH_KEY: health})
-        return pushes, out.local_state, outch, hp
+        return pushes, new_local, outch, hp
 
     # -- delayed pushes (async in-flight emulation) ------------------------
 
@@ -779,7 +796,10 @@ class Trainer:
                 f"rollback must be a RollbackPolicy, got "
                 f"{type(rollback).__name__}"
             )
-        if resilience.as_guard(self.config.guard) is None:
+        if resilience.as_guard(self.config.guard) is None and not rollback.preset:
+            # Preset-only policies are legal without a guard: skipping
+            # already-adjudicated indices needs no health channel. Health-
+            # based quarantine does.
             raise ValueError(
                 "a rollback policy needs the health channel: set "
                 "TrainerConfig.guard ('observe' for pure quarantine "
@@ -970,7 +990,13 @@ class Trainer:
         self._check_health(health)
         rec = recorder if recorder is not None else self.recorder
         timer = PhaseTimer(rec) if rec is not None else None
-        sync_each = (rollback is not None or health is not None
+        # Health-based quarantine needs the guard's health channel; a
+        # preset-only policy (guard off) must not pay the per-epoch state
+        # copy + forced sync that the health path requires.
+        quarantine = (rollback if rollback is not None and
+                      resilience.as_guard(self.config.guard) is not None
+                      else None)
+        sync_each = (quarantine is not None or health is not None
                      or watchdog is not None)
         saved_at = None  # step of the last periodic save (quarantine-aware)
         mode = "sync" if self.config.sync_every is None else "ssp"
@@ -981,96 +1007,114 @@ class Trainer:
         n_calls = -(-T // T_call)
         all_metrics = []
         end_epoch = start_epoch + epochs
-        for e in range(start_epoch, end_epoch):
-            fn = self._get_indexed_fn(plan, mode)
-            if rollback is not None:
-                last_good = (resilience.tree_copy(tables),
-                             resilience.tree_copy(local_state))
-            iargs = plan.epoch_args(e)
-            parts = []
-            restored = None
-            with _watch(watchdog, "epoch", e):
-                for ci in range(n_calls):
-                    ckey = key_to_replicated(
-                        jax.random.fold_in(jax.random.fold_in(key, e), ci),
-                        self.mesh,
+        try:
+            for e in range(start_epoch, end_epoch):
+                if rollback is not None and e in rollback.preset:
+                    # Quarantined by a previous attempt (supervisor-carried):
+                    # consume the index without dispatching — PRNG/shuffle key
+                    # off e, so later epochs are unaffected by the skip.
+                    rollback.skip(e)
+                    if rec is not None:
+                        rec.inc("rollback.preset_skipped")
+                        rec.flush()
+                    continue
+                fn = self._get_indexed_fn(plan, mode)
+                if quarantine is not None:
+                    last_good = (resilience.tree_copy(tables),
+                                 resilience.tree_copy(local_state))
+                iargs = plan.epoch_args(e)
+                parts = []
+                restored = None
+                with _watch(watchdog, "epoch", e):
+                    for ci in range(n_calls):
+                        ckey = key_to_replicated(
+                            jax.random.fold_in(jax.random.fold_in(key, e), ci),
+                            self.mesh,
+                        )
+                        start = np.int32(ci * T_call)
+                        with _phase(timer, "dispatch"):
+                            tables, local_state, metrics = fn(
+                                tables, local_state, iargs, start, ckey
+                            )
+                        parts.append(metrics)
+                    metrics = parts[0] if len(parts) == 1 else jax.tree.map(
+                        lambda *xs: jnp.concatenate(xs), *parts
                     )
-                    start = np.int32(ci * T_call)
-                    with _phase(timer, "dispatch"):
-                        tables, local_state, metrics = fn(
-                            tables, local_state, iargs, start, ckey
-                        )
-                    parts.append(metrics)
-                metrics = parts[0] if len(parts) == 1 else jax.tree.map(
-                    lambda *xs: jnp.concatenate(xs), *parts
-                )
-                # Drop phantom trailing steps from the last (padded) call so
-                # metrics always have exactly steps_per_epoch rows.
-                if n_calls * T_call > T:
-                    metrics = jax.tree.map(lambda x: x[:T], metrics)
-                if rollback is not None:
-                    with _phase(timer, "host_sync"):
-                        metrics, restored = self._maybe_quarantine(
-                            rollback, last_good, metrics, e, "epoch"
-                        )
-                elif sync_each:
-                    with _phase(timer, "host_sync"):
-                        metrics = jax.tree.map(np.asarray, metrics)
-            ev = {"index": e} if rec is not None else None
-            poison = 0
-            if sync_each and (rec is not None or health is not None):
-                poison = self._fold_metrics_accounting(rec, metrics, ev)
-            if rec is not None:
-                rec.inc("driver.epochs")
-                if restored is not None:
-                    rec.inc("rollback.quarantined")
-                    ev["quarantined"] = True
-            self._apply_health_decision(health, rec, e, poison, "epoch")
-            if restored is not None:
+                    # Drop phantom trailing steps from the last (padded) call so
+                    # metrics always have exactly steps_per_epoch rows.
+                    if n_calls * T_call > T:
+                        metrics = jax.tree.map(lambda x: x[:T], metrics)
+                    if quarantine is not None:
+                        with _phase(timer, "host_sync"):
+                            metrics, restored = self._maybe_quarantine(
+                                quarantine, last_good, metrics, e, "epoch"
+                            )
+                    elif sync_each:
+                        with _phase(timer, "host_sync"):
+                            metrics = jax.tree.map(np.asarray, metrics)
+                ev = {"index": e} if rec is not None else None
+                poison = 0
+                if sync_each and (rec is not None or health is not None):
+                    poison = self._fold_metrics_accounting(rec, metrics, ev)
                 if rec is not None:
+                    rec.inc("driver.epochs")
+                    if restored is not None:
+                        rec.inc("rollback.quarantined")
+                        ev["quarantined"] = True
+                self._apply_health_decision(health, rec, e, poison, "epoch")
+                if restored is not None:
+                    if rec is not None:
+                        rec.event("epoch", phases=timer.chunk_summary(), **ev)
+                        rec.flush()
+                    tables, local_state = restored
+                    continue
+                all_metrics.append(metrics)
+                # The donated pre-call buffers are dead; repoint the store's
+                # host-side view (lookup_host / predict_*_host) at the live
+                # arrays BEFORE any callback runs — per-epoch validation via the
+                # store is the natural on_epoch pattern, and doing it here also
+                # leaves the store consistent if on_epoch raises (early stop).
+                self.store.tables = dict(tables)
+                if on_epoch is not None:
+                    with _phase(timer, "host_sync"):
+                        host = jax.tree.map(np.asarray, metrics)
+                    if rec is not None and not sync_each:
+                        # on_epoch already paid the host sync; fold the same
+                        # accounting the forced-sync paths get.
+                        self._fold_metrics_accounting(rec, host, ev)
+                    all_metrics[-1] = host
+                    with _phase(timer, "callback"):
+                        on_epoch(e, host)
+                if checkpointer is not None and checkpoint_every > 0 and (
+                    (e + 1) % checkpoint_every == 0
+                ):
+                    with _phase(timer, "checkpoint"):
+                        self._save_checkpoint(checkpointer, e + 1, local_state)
+                    saved_at = e + 1
+                if rec is not None:
+                    # Emitted AFTER the callback/checkpoint phases so the
+                    # epoch event's phase breakdown covers the whole epoch;
+                    # flushed per boundary so the Prometheus exposition is
+                    # live-scrapable mid-run and a kill loses at most one
+                    # epoch of buffered JSONL.
                     rec.event("epoch", phases=timer.chunk_summary(), **ev)
                     rec.flush()
-                tables, local_state = restored
-                continue
-            all_metrics.append(metrics)
-            # The donated pre-call buffers are dead; repoint the store's
-            # host-side view (lookup_host / predict_*_host) at the live
-            # arrays BEFORE any callback runs — per-epoch validation via the
-            # store is the natural on_epoch pattern, and doing it here also
-            # leaves the store consistent if on_epoch raises (early stop).
-            self.store.tables = dict(tables)
-            if on_epoch is not None:
-                with _phase(timer, "host_sync"):
-                    host = jax.tree.map(np.asarray, metrics)
-                if rec is not None and not sync_each:
-                    # on_epoch already paid the host sync; fold the same
-                    # accounting the forced-sync paths get.
-                    self._fold_metrics_accounting(rec, host, ev)
-                all_metrics[-1] = host
-                with _phase(timer, "callback"):
-                    on_epoch(e, host)
-            if checkpointer is not None and checkpoint_every > 0 and (
-                (e + 1) % checkpoint_every == 0
-            ):
+            self.store.tables = dict(tables)  # epochs == 0: loop never ran
+            # End-of-run save whenever the last epoch's state isn't already on
+            # disk — including when a quarantined final epoch skipped its
+            # periodic save (the snapshot then holds the rolled-back state
+            # under the final step number, so a resume skips the poison).
+            if checkpointer is not None and epochs > 0 and saved_at != end_epoch:
                 with _phase(timer, "checkpoint"):
-                    self._save_checkpoint(checkpointer, e + 1, local_state)
-                saved_at = e + 1
-            if rec is not None:
-                # Emitted AFTER the callback/checkpoint phases so the
-                # epoch event's phase breakdown covers the whole epoch;
-                # flushed per boundary so the Prometheus exposition is
-                # live-scrapable mid-run and a kill loses at most one
-                # epoch of buffered JSONL.
-                rec.event("epoch", phases=timer.chunk_summary(), **ev)
-                rec.flush()
-        self.store.tables = dict(tables)  # epochs == 0: loop never ran
-        # End-of-run save whenever the last epoch's state isn't already on
-        # disk — including when a quarantined final epoch skipped its
-        # periodic save (the snapshot then holds the rolled-back state
-        # under the final step number, so a resume skips the poison).
-        if checkpointer is not None and epochs > 0 and saved_at != end_epoch:
-            with _phase(timer, "checkpoint"):
-                self._save_checkpoint(checkpointer, end_epoch, local_state)
+                    self._save_checkpoint(checkpointer, end_epoch, local_state)
+        finally:
+            if checkpointer is not None:
+                # Durability barrier: an AsyncCheckpointer's in-flight
+                # write must be on disk before the run reports done
+                # (no-op for the synchronous base class) — in a finally
+                # so accepted saves survive a mid-run abort too.
+                with _phase(timer, "checkpoint"):
+                    checkpointer.flush()
         if on_epoch is None and as_numpy:
             with _phase(timer, "host_sync"):
                 all_metrics = [jax.tree.map(np.asarray, m)
@@ -1201,94 +1245,120 @@ class Trainer:
         self._check_health(health)
         rec = recorder if recorder is not None else self.recorder
         timer = PhaseTimer(rec) if rec is not None else None
-        sync_each = (rollback is not None or health is not None
+        # Health-based quarantine needs the guard's health channel; a
+        # preset-only policy (guard off) must not pay the per-chunk state
+        # copy + forced sync that the health path requires.
+        quarantine = (rollback if rollback is not None and
+                      resilience.as_guard(self.config.guard) is not None
+                      else None)
+        sync_each = (quarantine is not None or health is not None
                      or watchdog is not None)
         saved_at = None  # step of the last periodic save (quarantine-aware)
         all_metrics = []
         it = iter(chunks)
         i = start_step - 1
-        while True:
-            with _phase(timer, "ingest"):
-                chunk = next(it, _STREAM_END)
-            if chunk is _STREAM_END:
-                break
-            i += 1
-            if rollback is not None:
-                last_good = (resilience.tree_copy(tables),
-                             resilience.tree_copy(local_state))
-            ckey = jax.random.fold_in(key, i)
-            restored = None
-            with _watch(watchdog, "chunk", i):
-                tables, local_state, metrics = self.run_chunk(
-                    tables, local_state, chunk, ckey, timer=timer
-                )
-                if rollback is not None:
-                    with _phase(timer, "host_sync"):
-                        metrics, restored = self._maybe_quarantine(
-                            rollback, last_good, metrics, i, "chunk"
-                        )
-                elif sync_each:
-                    with _phase(timer, "host_sync"):
-                        metrics = jax.tree.map(np.asarray, metrics)
-            ev = {"index": i} if rec is not None else None
-            poison = 0
-            if sync_each and (rec is not None or health is not None):
-                poison = self._fold_metrics_accounting(rec, metrics, ev)
-            if rec is not None:
-                rec.inc("driver.chunks")
-                if restored is not None:
-                    rec.inc("rollback.quarantined")
-                    ev["quarantined"] = True
-            self._apply_health_decision(health, rec, i, poison, "chunk")
-            if restored is not None:
+        try:
+            while True:
+                with _phase(timer, "ingest"):
+                    chunk = next(it, _STREAM_END)
+                if chunk is _STREAM_END:
+                    break
+                i += 1
+                if rollback is not None and i in rollback.preset:
+                    # Quarantined by a previous attempt (supervisor-carried):
+                    # the chunk is consumed but never dispatched — the per-
+                    # chunk PRNG keys off i, so later chunks are unaffected.
+                    rollback.skip(i)
+                    if rec is not None:
+                        rec.inc("rollback.preset_skipped")
+                        rec.flush()
+                    continue
+                if quarantine is not None:
+                    last_good = (resilience.tree_copy(tables),
+                                 resilience.tree_copy(local_state))
+                ckey = jax.random.fold_in(key, i)
+                restored = None
+                with _watch(watchdog, "chunk", i):
+                    tables, local_state, metrics = self.run_chunk(
+                        tables, local_state, chunk, ckey, timer=timer
+                    )
+                    if quarantine is not None:
+                        with _phase(timer, "host_sync"):
+                            metrics, restored = self._maybe_quarantine(
+                                quarantine, last_good, metrics, i, "chunk"
+                            )
+                    elif sync_each:
+                        with _phase(timer, "host_sync"):
+                            metrics = jax.tree.map(np.asarray, metrics)
+                ev = {"index": i} if rec is not None else None
+                poison = 0
+                if sync_each and (rec is not None or health is not None):
+                    poison = self._fold_metrics_accounting(rec, metrics, ev)
                 if rec is not None:
+                    rec.inc("driver.chunks")
+                    if restored is not None:
+                        rec.inc("rollback.quarantined")
+                        ev["quarantined"] = True
+                self._apply_health_decision(health, rec, i, poison, "chunk")
+                if restored is not None:
+                    if rec is not None:
+                        rec.event("chunk", phases=timer.chunk_summary(), **ev)
+                        rec.flush()
+                    tables, local_state = restored
+                    continue
+                if on_chunk is not None:
+                    with _phase(timer, "host_sync"):
+                        host_metrics = jax.tree.map(np.asarray, metrics)
+                    if rec is not None and not sync_each:
+                        # on_chunk already paid the host sync; give the chunk
+                        # event the same accounting the forced-sync paths get.
+                        self._fold_metrics_accounting(rec, host_metrics, ev)
+                    all_metrics.append(host_metrics)
+                    with _phase(timer, "callback"):
+                        on_chunk(i, host_metrics)
+                else:
+                    # Deferred conversion keeps the dispatch pipeline full, but
+                    # an unbounded stream must not accumulate device buffers (or
+                    # run the host arbitrarily far ahead of the device): drain
+                    # to host every few chunks.
+                    all_metrics.append(metrics)
+                    if (i - start_step) % 8 == 7:
+                        with _phase(timer, "host_sync"):
+                            all_metrics[-8:] = [
+                                jax.tree.map(np.asarray, m)
+                                for m in all_metrics[-8:]
+                            ]
+                if checkpointer is not None and checkpoint_every > 0 and (
+                    (i + 1) % checkpoint_every == 0
+                ):
+                    with _phase(timer, "checkpoint"):
+                        self._save_checkpoint(checkpointer, i + 1, local_state)
+                    saved_at = i + 1
+                if rec is not None:
+                    # Emitted AFTER the checkpoint/callback phases so the
+                    # chunk event's phase breakdown covers the whole chunk;
+                    # flushed per boundary so the Prometheus exposition is
+                    # live-scrapable mid-run and a kill loses at most one
+                    # chunk of buffered JSONL.
                     rec.event("chunk", phases=timer.chunk_summary(), **ev)
                     rec.flush()
-                tables, local_state = restored
-                continue
-            if on_chunk is not None:
-                with _phase(timer, "host_sync"):
-                    host_metrics = jax.tree.map(np.asarray, metrics)
-                if rec is not None and not sync_each:
-                    # on_chunk already paid the host sync; give the chunk
-                    # event the same accounting the forced-sync paths get.
-                    self._fold_metrics_accounting(rec, host_metrics, ev)
-                all_metrics.append(host_metrics)
-                with _phase(timer, "callback"):
-                    on_chunk(i, host_metrics)
-            else:
-                # Deferred conversion keeps the dispatch pipeline full, but
-                # an unbounded stream must not accumulate device buffers (or
-                # run the host arbitrarily far ahead of the device): drain
-                # to host every few chunks.
-                all_metrics.append(metrics)
-                if (i - start_step) % 8 == 7:
-                    with _phase(timer, "host_sync"):
-                        all_metrics[-8:] = [
-                            jax.tree.map(np.asarray, m)
-                            for m in all_metrics[-8:]
-                        ]
-            if checkpointer is not None and checkpoint_every > 0 and (
-                (i + 1) % checkpoint_every == 0
-            ):
+            # End-of-stream save whenever the last chunk's state isn't already
+            # on disk — including when a quarantined final chunk skipped its
+            # periodic save (the snapshot then holds the rolled-back state
+            # under the final step number, so a resume skips the poison).
+            if checkpointer is not None and i >= start_step and saved_at != i + 1:
                 with _phase(timer, "checkpoint"):
                     self._save_checkpoint(checkpointer, i + 1, local_state)
-                saved_at = i + 1
-            if rec is not None:
-                # Emitted AFTER the checkpoint/callback phases so the
-                # chunk event's phase breakdown covers the whole chunk;
-                # flushed per boundary so the Prometheus exposition is
-                # live-scrapable mid-run and a kill loses at most one
-                # chunk of buffered JSONL.
-                rec.event("chunk", phases=timer.chunk_summary(), **ev)
-                rec.flush()
-        # End-of-stream save whenever the last chunk's state isn't already
-        # on disk — including when a quarantined final chunk skipped its
-        # periodic save (the snapshot then holds the rolled-back state
-        # under the final step number, so a resume skips the poison).
-        if checkpointer is not None and i >= start_step and saved_at != i + 1:
-            with _phase(timer, "checkpoint"):
-                self._save_checkpoint(checkpointer, i + 1, local_state)
+        finally:
+            if checkpointer is not None:
+                # Durability barrier: an AsyncCheckpointer's in-flight
+                # write must be on disk before the stream reports done
+                # (no-op for the synchronous base class) — in a finally
+                # so accepted (journaled checkpoint_enqueued) saves are
+                # never silently dropped when the run dies mid-stream
+                # (health abort, early-stop callback raise, ...).
+                with _phase(timer, "checkpoint"):
+                    checkpointer.flush()
         if on_chunk is None:
             with _phase(timer, "host_sync"):
                 all_metrics = [jax.tree.map(np.asarray, m)

@@ -41,6 +41,12 @@ Pytree = Any
 
 HEALTH_KEY = "health"
 
+# Health-channel entry name for the worker-LOCAL state plane (the guard's
+# second coverage surface — GuardConfig.local). Lives next to the
+# per-table entries; the driver rejects a store table with this name when
+# the local guard is on, so the two planes can never collide.
+LOCAL_STATE_KEY = "local_state"
+
 GUARD_MODES = ("observe", "mask")
 
 
@@ -89,6 +95,16 @@ class GuardConfig:
     # Restrict guarding to these tables (None = all). Tables outside the
     # set pass through untouched and report no health entry.
     tables: tuple[str, ...] | None = None
+    # Extend screening to worker-LOCAL state updates (MF user factors,
+    # any float leaf of the local_state pytree): after each step, rows
+    # whose NEW value is non-finite — or whose update delta exceeds
+    # ``norm_limit`` — are counted onto a ``"local_state"`` health entry
+    # and, in mask mode, reverted to their pre-step values. Closes the
+    # MF-style gap where mask mode screens PS pushes but the local
+    # scatter still absorbs NaN. Off by default: ``local=False`` traces
+    # the exact same program as before (the ``tables`` filter does not
+    # apply — local state has no table name).
+    local: bool = False
 
     def __post_init__(self):
         if self.mode not in GUARD_MODES:
@@ -174,6 +190,82 @@ def guard_pushes(
     return out_pushes, health
 
 
+def guard_local_state(
+    old: Pytree, new: Pytree, guard: GuardConfig
+) -> tuple[Pytree, dict[str, Array] | None]:
+    """Screen a step's worker-LOCAL state update; trace-time static policy.
+
+    The local plane has no ``(ids, deltas)`` stream to intercept — worker
+    logics scatter into their local arrays directly inside ``step`` — so
+    the guard screens the *effect*: for every inexact (float) leaf, a
+    "row" is one index along axis 0 (the whole array for 0-d leaves), and
+
+    * rows of ``new`` containing any non-finite element count as
+      ``nonfinite``;
+    * rows whose update delta ``new - old`` has L2 norm over
+      ``guard.norm_limit`` (when set) count as ``norm``;
+    * in ``mode="mask"`` offending rows REVERT to their pre-step values
+      (the scatter update degrades to a lost update, mirroring the push
+      guard's dropped rows); ``"observe"`` only counts.
+
+    Returns ``(guarded_new, counts)`` with the same scalar int32
+    ``{"nonfinite", "norm", "masked"}`` schema as :func:`guard_pushes`
+    (the driver mounts it under :data:`LOCAL_STATE_KEY`), or
+    ``(new, None)`` when the pytree has no inexact leaves — an empty
+    local state costs nothing and adds no health entry.
+
+    Caveat: the delta-norm tier is computed against ``old``; if an
+    earlier *observe*-mode step already let non-finite values into a row,
+    that row's delta is non-finite and lands in the ``nonfinite`` tier
+    (reverting cannot resurrect a row that was never finite).
+    """
+    old_leaves, treedef = jax.tree.flatten(old)
+    new_leaves, new_treedef = jax.tree.flatten(new)
+    if treedef != new_treedef:
+        raise ValueError(
+            "guard.local requires the worker step to preserve the "
+            f"local_state pytree structure (got {treedef} -> {new_treedef})"
+        )
+    zero = jnp.zeros((), jnp.int32)
+    counts = {"nonfinite": zero, "norm": zero, "masked": zero}
+    guarded = False
+    out_leaves = []
+    for o, n in zip(old_leaves, new_leaves):
+        if not (hasattr(n, "dtype") and jnp.issubdtype(n.dtype, jnp.inexact)):
+            out_leaves.append(n)
+            continue
+        guarded = True
+        axes = tuple(range(1, jnp.ndim(n)))
+        finite = jnp.all(jnp.isfinite(n), axis=axes)
+        nonfinite = ~finite
+        if guard.norm_limit is not None:
+            # Delta norm over zero-substituted rows, like guard_pushes:
+            # a non-finite row must not double-count through the norm tier.
+            delta = jnp.where(
+                finite if not axes else jnp.expand_dims(
+                    finite, tuple(range(1, jnp.ndim(n)))),
+                (n - o).astype(jnp.float32), 0.0,
+            )
+            sq = jnp.sum(delta * delta, axis=axes)
+            exploded = finite & (sq > guard.norm_limit**2)
+        else:
+            exploded = jnp.zeros_like(nonfinite)
+        bad = nonfinite | exploded
+        counts["nonfinite"] = counts["nonfinite"] + jnp.sum(
+            nonfinite, dtype=jnp.int32)
+        counts["norm"] = counts["norm"] + jnp.sum(exploded, dtype=jnp.int32)
+        if guard.mode == "mask":
+            revert = bad if not axes else jnp.expand_dims(
+                bad, tuple(range(1, jnp.ndim(n))))
+            n = jnp.where(revert, o, n).astype(n.dtype)
+            counts["masked"] = counts["masked"] + jnp.sum(
+                bad, dtype=jnp.int32)
+        out_leaves.append(n)
+    if not guarded:
+        return new, None
+    return jax.tree.unflatten(treedef, out_leaves), counts
+
+
 def health_total(metrics: Pytree) -> int:
     """Total poison events in a chunk/epoch's HOST metrics pytree.
 
@@ -210,6 +302,16 @@ class RollbackPolicy:
     masking at all). Each guarded chunk pays one on-device state copy
     (the pre-chunk snapshot must survive buffer donation) and one
     metrics host-sync — this is a degradation mode, not a fast path.
+
+    ``preset`` indices are skipped OUTRIGHT — the chunk/epoch is consumed
+    from the stream but never dispatched (no state copy, no metrics
+    entry); PRNG/shuffle streams key off the index, so later work is
+    unaffected. This is how quarantine decisions survive a process
+    restart: the run supervisor (``fps_tpu.supervise``) persists the
+    poisoned indices next to the checkpoint dir and the restarted child
+    preloads them here, so a *deterministic* poison batch cannot crash-
+    loop the run. A preset-only policy (no guard) is legal — it skips
+    without needing the health channel.
     """
 
     # Quarantine budget: exceeding it raises PoisonedStreamError (a stream
@@ -217,6 +319,26 @@ class RollbackPolicy:
     max_rollbacks: int = 8
     # Chunk/epoch indices rolled back so far (mutated by the driver).
     quarantined: list = dataclasses.field(default_factory=list)
+    # Indices quarantined by a PREVIOUS attempt (carried across restarts
+    # by the supervisor): skipped without dispatch.
+    preset: frozenset = frozenset()
+    # Preset indices actually skipped this run (mutated by the driver).
+    skipped: list = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        # Coerce lists/tuples (the supervisor state file round-trips
+        # through JSON) so membership tests are O(1) and hashable-safe.
+        self.preset = frozenset(int(i) for i in self.preset)
+
+    def skip(self, index: int) -> None:
+        """Record one preset-quarantined index skipped without dispatch
+        (journal-trailed like :meth:`record`, but no budget: these chunks
+        were already adjudicated by a previous attempt)."""
+        self.skipped.append(index)
+        from fps_tpu.obs import events as _obs_events
+
+        _obs_events.emit("preset_skip", index=int(index),
+                         total=len(self.skipped))
 
     def record(self, index: int) -> None:
         """Record a quarantined index; raises once the budget is exceeded.

@@ -32,6 +32,12 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--checkpoint-every", type=int, default=0,
                     help="snapshot every N chunks (requires --checkpoint-dir)")
+    ap.add_argument("--checkpoint-async", action="store_true",
+                    help="double-buffered background checkpoint writer "
+                         "(fps_tpu.core.checkpoint.AsyncCheckpointer): "
+                         "save() returns before serialize+fsync; the "
+                         "driver's end-of-run flush is the durability "
+                         "barrier")
     ap.add_argument("--warm-start", default=None,
                     help="initialize tables from a saved model .npz "
                          "(reference: transformWithModelLoad)")
@@ -52,6 +58,22 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     ap.add_argument("--guard-norm-limit", type=float, default=None,
                     help="per-row L2 norm ceiling for push deltas "
                          "(requires --guard)")
+    ap.add_argument("--guard-local", action="store_true",
+                    help="extend the guard to worker-LOCAL state updates "
+                         "(e.g. MF user factors): poisoned local rows are "
+                         "counted — and in mask mode reverted — like "
+                         "poisoned pushes (requires --guard)")
+    ap.add_argument("--rollback-budget", type=int, default=None,
+                    help="quarantine poisoned chunks via a host-loop "
+                         "RollbackPolicy with this budget (requires "
+                         "--guard); under a supervisor, indices "
+                         "quarantined by previous attempts are always "
+                         "carried in, budget flag or not")
+    ap.add_argument("--heartbeat", default=None, metavar="PATH",
+                    help="touch this progress-beacon file on every "
+                         "chunk/epoch boundary (default: the "
+                         "FPS_TPU_HEARTBEAT env var, set automatically "
+                         "by tools/supervise.py)")
     ap.add_argument("--obs-dir", default=None, metavar="DIR",
                     help="telemetry output (fps_tpu.obs): JSONL event log, "
                          "per-process run journal, and Prometheus text "
@@ -64,23 +86,54 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     return ap
 
 
+def _make_heartbeat(args):
+    """--heartbeat / the supervisor's FPS_TPU_HEARTBEAT env contract →
+    a Heartbeat, or None when this run is unsupervised."""
+    from fps_tpu.supervise import child
+
+    path = getattr(args, "heartbeat", None)
+    if path:
+        return child.Heartbeat(path)
+    return child.from_env()
+
+
 def attach_obs(args, trainer=None, *, workload: str | None = None):
-    """Resolve --obs-dir into an installed recorder (or None).
+    """Resolve --obs-dir (and the supervised-heartbeat contract) into an
+    installed recorder (or None).
 
     Opens the standard on-disk telemetry set under ``--obs-dir``
     (``fps_tpu.obs.open_run``), stamps the run journal with the CLI args
     as the config digest, installs it as the process-default recorder
     (checkpoint/rollback events flow automatically), and attaches it to
     ``trainer`` when given. Close via :func:`finish`.
+
+    When the run is supervised (``--heartbeat`` or the supervisor's
+    ``FPS_TPU_HEARTBEAT`` env var), a HeartbeatSink rides the recorder so
+    every chunk/epoch journal event doubles as the supervisor's liveness
+    signal; with no ``--obs-dir`` a minimal heartbeat-only recorder is
+    returned instead — attaching one never changes training behavior.
     """
+    hb = _make_heartbeat(args)
     if getattr(args, "obs_dir", None) is None:
         if getattr(args, "obs_watchdog_s", None) is not None:
             raise SystemExit("--obs-watchdog-s requires --obs-dir")
-        return None
+        if hb is None:
+            return None
+        from fps_tpu.obs import Recorder
+        from fps_tpu.supervise import child
+
+        rec = Recorder(sinks=[child.HeartbeatSink(hb)])
+        if trainer is not None:
+            trainer.recorder = rec
+        return rec
     from fps_tpu import obs
 
     rec = obs.open_run(args.obs_dir, config=vars(args),
                        meta={"workload": workload} if workload else None)
+    if hb is not None:
+        from fps_tpu.supervise import child
+
+        rec.sinks.append(child.HeartbeatSink(hb))
     if trainer is not None:
         trainer.recorder = rec
     emit({"event": "obs", "dir": args.obs_dir, "run_id": rec.run_id})
@@ -101,10 +154,35 @@ def make_guard(args):
     if args.guard is None:
         if args.guard_norm_limit is not None:
             raise SystemExit("--guard-norm-limit requires --guard")
+        if getattr(args, "guard_local", False):
+            raise SystemExit("--guard-local requires --guard")
         return None
     from fps_tpu.core.resilience import GuardConfig
 
-    return GuardConfig(mode=args.guard, norm_limit=args.guard_norm_limit)
+    return GuardConfig(mode=args.guard, norm_limit=args.guard_norm_limit,
+                       local=getattr(args, "guard_local", False))
+
+
+def make_rollback(args):
+    """--rollback-budget plus any supervisor-carried quarantine set into a
+    RollbackPolicy (or None). The preset alone (no budget flag, no guard)
+    is legal: a supervised restart must honor quarantine decisions even
+    when the operator never asked for health-based rollback."""
+    from fps_tpu.core.resilience import RollbackPolicy
+    from fps_tpu.supervise import child
+
+    preset = child.quarantined_from_env()
+    budget = getattr(args, "rollback_budget", None)
+    if budget is None and not preset:
+        return None
+    if budget is not None and args.guard is None:
+        raise SystemExit("--rollback-budget requires --guard")
+    policy = RollbackPolicy(preset=preset)
+    if budget is not None:
+        policy.max_rollbacks = budget
+    if preset:
+        emit({"event": "quarantine_carried", "indices": sorted(preset)})
+    return policy
 
 
 def make_epoch_source(args, mesh, data, *, route_key=None, num_workers=None):
@@ -198,9 +276,14 @@ def finish(args, store, trainer=None, local_state=None, recorder=None):
 
 def maybe_checkpointer(args):
     if args.checkpoint_dir and args.checkpoint_every > 0:
-        from fps_tpu.core.checkpoint import Checkpointer
+        from fps_tpu.core.checkpoint import AsyncCheckpointer, Checkpointer
 
-        return Checkpointer(args.checkpoint_dir)
+        cls = (AsyncCheckpointer if getattr(args, "checkpoint_async", False)
+               else Checkpointer)
+        return cls(args.checkpoint_dir)
+    if getattr(args, "checkpoint_async", False):
+        raise SystemExit("--checkpoint-async requires --checkpoint-dir "
+                         "and --checkpoint-every")
     return None
 
 

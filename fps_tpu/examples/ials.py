@@ -94,6 +94,11 @@ def main(argv=None) -> int:
             rec.event("epoch", index=epoch, weighted_loss=float(loss))
         if ckpt is not None and (epoch + 1) % args.checkpoint_every == 0:
             ckpt.save(epoch + 1, solver.store)
+    if ckpt is not None:
+        # iALS drives its own loop, so IT owns the durability barrier the
+        # Trainer drivers provide: an async writer's last snapshot must be
+        # on disk before the run reports done.
+        ckpt.flush()
 
     r = recall_at_k(solver, test["user"][:2000], test["item"][:2000],
                     k=args.topk, exclude=(train["user"], train["item"]))

@@ -19,6 +19,7 @@ from fps_tpu.examples.common import (
     emit,
     finish,
     make_chunks,
+    make_rollback,
     make_watchdog,
     make_mesh,
     maybe_checkpointer,
@@ -111,6 +112,7 @@ def main(argv=None) -> int:
             checkpointer=maybe_checkpointer(args),
             checkpoint_every=args.checkpoint_every,
             on_chunk=report,
+            rollback=make_rollback(args),
             watchdog=make_watchdog(args, rec),
         )
 

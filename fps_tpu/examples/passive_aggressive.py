@@ -16,6 +16,7 @@ from fps_tpu.examples.common import (
     base_parser,
     make_guard,
     make_chunks,
+    make_rollback,
     make_watchdog,
     maybe_profile,
     emit,
@@ -109,6 +110,7 @@ def main(argv=None) -> int:
             checkpointer=maybe_checkpointer(args),
             checkpoint_every=args.checkpoint_every,
             on_chunk=report,
+            rollback=make_rollback(args),
             watchdog=make_watchdog(args, rec),
         )
 

@@ -23,7 +23,8 @@ import jax
 import numpy as np
 
 from fps_tpu.examples.common import (attach_obs, base_parser, emit, finish,
-                                     make_mesh, make_watchdog, maybe_profile)
+                                     make_guard, make_mesh, make_rollback,
+                                     make_watchdog, maybe_profile)
 
 
 class _TargetReached(Exception):
@@ -59,7 +60,8 @@ def main(argv=None) -> int:
 
     cfg = MFConfig(num_users=args.num_users, num_items=args.num_items,
                    rank=args.rank, learning_rate=args.learning_rate)
-    trainer, store = online_mf(mesh, cfg, sync_every=args.sync_every)
+    trainer, store = online_mf(mesh, cfg, sync_every=args.sync_every,
+                               guard=make_guard(args))
     rec = attach_obs(args, trainer, workload="streaming_mf")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
 
@@ -91,6 +93,7 @@ def main(argv=None) -> int:
             tables, local_state, _ = trainer.fit_stream(
                 tables, local_state, chunks, jax.random.key(args.seed),
                 on_chunk=on_chunk,
+                rollback=make_rollback(args),
                 watchdog=make_watchdog(args, rec),
             )
         stopped = "stream_exhausted"

@@ -19,7 +19,9 @@ from fps_tpu.examples.common import (
     base_parser,
     emit,
     finish,
+    make_guard,
     make_mesh,
+    make_rollback,
     make_watchdog,
     maybe_checkpointer,
     maybe_profile,
@@ -90,10 +92,12 @@ def main(argv=None) -> int:
         trainer, store = word2vec_block(
             mesh, cfg, uni, block_len, sync_every=args.sync_every,
             max_steps_per_call=256, step_tap=step_tap,
+            guard=make_guard(args),
         )
     else:
         trainer, store = word2vec(mesh, cfg, uni, sync_every=args.sync_every,
-                                  max_steps_per_call=256, step_tap=step_tap)
+                                  max_steps_per_call=256, step_tap=step_tap,
+                                  guard=make_guard(args))
     rec = attach_obs(args, trainer, workload="word2vec")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)
@@ -127,6 +131,7 @@ def main(argv=None) -> int:
                 # --checkpoint-every counts chunks on the host path; the
                 # fused path snapshots per epoch when it is enabled at all.
                 checkpoint_every=1 if args.checkpoint_every > 0 else 0,
+                rollback=make_rollback(args),
                 watchdog=make_watchdog(args, rec),
             )
         else:
@@ -144,6 +149,7 @@ def main(argv=None) -> int:
                 checkpointer=maybe_checkpointer(args),
                 checkpoint_every=args.checkpoint_every,
                 on_chunk=report,
+                rollback=make_rollback(args),
                 watchdog=make_watchdog(args, rec),
             )
     dt = time.perf_counter() - t0

@@ -373,7 +373,8 @@ def make_store(mesh, cfg: W2VConfig) -> ParamStore:
 
 
 def _make_trainer(mesh, cfg: W2VConfig, worker, *, sync_every, donate,
-                  max_steps_per_call, push_delay=0, step_tap=None):
+                  max_steps_per_call, push_delay=0, step_tap=None,
+                  guard=None):
     from fps_tpu.core.api import MEAN_COMBINE
     from fps_tpu.core.driver import Trainer, TrainerConfig
 
@@ -409,7 +410,8 @@ def _make_trainer(mesh, cfg: W2VConfig, worker, *, sync_every, donate,
         mesh, store, worker, server_logic=MEAN_COMBINE,
         config=TrainerConfig(sync_every=sync_every, donate=donate,
                              max_steps_per_call=max_steps_per_call,
-                             push_delay=push_delay, step_tap=step_tap),
+                             push_delay=push_delay, step_tap=step_tap,
+                             guard=guard),
     )
     return trainer, store
 
@@ -417,7 +419,7 @@ def _make_trainer(mesh, cfg: W2VConfig, worker, *, sync_every, donate,
 def word2vec(mesh, cfg: W2VConfig, unigram_counts: np.ndarray, *,
              sync_every: int | None = None, donate: bool = True,
              max_steps_per_call: int | None = None, push_delay: int = 0,
-             step_tap=None):
+             step_tap=None, guard=None):
     """(trainer, store) — the analog of the reference's word2vec transform.
     ``sync_every``/``push_delay`` select SSP staleness brackets exactly as
     in :func:`fps_tpu.models.matrix_factorization.online_mf`."""
@@ -425,7 +427,7 @@ def word2vec(mesh, cfg: W2VConfig, unigram_counts: np.ndarray, *,
         mesh, cfg, Word2VecWorker(cfg, unigram_counts),
         sync_every=sync_every, donate=donate,
         max_steps_per_call=max_steps_per_call, push_delay=push_delay,
-        step_tap=step_tap,
+        step_tap=step_tap, guard=guard,
     )
 
 
@@ -433,7 +435,7 @@ def word2vec_block(mesh, cfg: W2VConfig, unigram_counts: np.ndarray,
                    block_len: int, *, sync_every: int | None = None,
                    donate: bool = True,
                    max_steps_per_call: int | None = None,
-                   push_delay: int = 0, step_tap=None):
+                   push_delay: int = 0, step_tap=None, guard=None):
     """(trainer, store) with the block-granularity worker — pair with a
     ``Word2VecDevicePlan(..., block_len=block_len, mode="block")``. Same
     tables, same SGNS objective; ~10x fewer sparse row transactions per
@@ -445,7 +447,7 @@ def word2vec_block(mesh, cfg: W2VConfig, unigram_counts: np.ndarray,
         mesh, cfg, Word2VecBlockWorker(cfg, unigram_counts, block_len),
         sync_every=sync_every, donate=donate,
         max_steps_per_call=max_steps_per_call, push_delay=push_delay,
-        step_tap=step_tap,
+        step_tap=step_tap, guard=guard,
     )
 
 

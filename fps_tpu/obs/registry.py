@@ -126,7 +126,13 @@ def default_registry() -> MetricsRegistry:
         # Resilience / persistence events.
         MetricSpec("rollback.quarantined", "counter", unit="chunks",
                    help="chunks/epochs rolled back and quarantined"),
+        MetricSpec("rollback.preset_skipped", "counter", unit="chunks",
+                   help="chunks/epochs skipped via a supervisor-carried "
+                        "quarantine preset (never dispatched)"),
         MetricSpec("checkpoint.saves", "counter", unit="snapshots"),
+        MetricSpec("checkpoint.enqueues", "counter", unit="snapshots",
+                   help="async snapshots accepted for background write "
+                        "(checkpoint.saves marks the durability point)"),
         MetricSpec("checkpoint.save_seconds", "histogram", unit="s"),
         MetricSpec("checkpoint.bytes", "gauge", unit="bytes",
                    help="size of the last written snapshot"),

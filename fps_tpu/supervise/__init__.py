@@ -1,0 +1,46 @@
+"""fps_tpu.supervise — external run supervision (deadline-abort layer).
+
+Two halves of one contract:
+
+* :mod:`fps_tpu.supervise.supervisor` — the parent side:
+  :class:`RunSupervisor` launches a training command, tails its heartbeat
+  / obs journal for liveness, deadline-aborts a wedged child (SIGTERM →
+  SIGKILL against the process group), restarts with exponential backoff
+  under a retry budget, and quarantines deterministically-poisoned
+  chunk/epoch indices across restarts (persisted next to the checkpoint
+  dir). Stdlib-only; ``tools/supervise.py`` is its CLI.
+* :mod:`fps_tpu.supervise.child` — the child side: :class:`Heartbeat`
+  (+ :class:`HeartbeatSink` for the obs Recorder) and the env-var
+  contract through which a supervised process finds its heartbeat path,
+  attempt number, and carried quarantine set.
+
+See ``docs/resilience.md`` for the failure model this closes.
+"""
+
+from fps_tpu.supervise.child import (
+    ATTEMPT_ENV,
+    HEARTBEAT_ENV,
+    STATE_ENV,
+    Heartbeat,
+    HeartbeatSink,
+    attempt_from_env,
+    from_env,
+    quarantined_from_env,
+)
+from fps_tpu.supervise.supervisor import (
+    RunSupervisor,
+    SupervisorConfig,
+)
+
+__all__ = [
+    "RunSupervisor",
+    "SupervisorConfig",
+    "Heartbeat",
+    "HeartbeatSink",
+    "from_env",
+    "attempt_from_env",
+    "quarantined_from_env",
+    "HEARTBEAT_ENV",
+    "STATE_ENV",
+    "ATTEMPT_ENV",
+]

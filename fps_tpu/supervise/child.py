@@ -1,0 +1,145 @@
+"""Child-process side of a supervised run: heartbeat + carried quarantine.
+
+A training process launched by :class:`fps_tpu.supervise.RunSupervisor`
+finds its contract in environment variables:
+
+* :data:`HEARTBEAT_ENV` — path of the heartbeat file this process should
+  touch on every progress boundary (the supervisor's liveness signal; a
+  stalled heartbeat is what triggers deadline-abort);
+* :data:`STATE_ENV` — path of the supervisor's persisted state file,
+  holding among other things the chunk/epoch indices quarantined by
+  PREVIOUS attempts (:func:`quarantined_from_env` feeds them into
+  ``RollbackPolicy(preset=...)`` so a deterministic poison batch cannot
+  crash-loop the run);
+* :data:`ATTEMPT_ENV` — zero-based attempt number, for logging.
+
+Everything here is stdlib-only and import-safe without jax: the same file
+is loaded by path from ``tools/supervise.py`` (which must never drag a
+TPU runtime into the supervisor process) and imported normally by
+training children (which already run jax).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+HEARTBEAT_ENV = "FPS_TPU_HEARTBEAT"
+STATE_ENV = "FPS_TPU_SUPERVISOR_STATE"
+ATTEMPT_ENV = "FPS_TPU_ATTEMPT"
+
+
+class Heartbeat:
+    """Progress beacon: one small JSON object, atomically replaced.
+
+    The supervisor keys liveness off the file's mtime and reads ``index``
+    to localize where an attempt died (two consecutive deaths at the same
+    index quarantine it). Atomic replace (tmp + rename in the same
+    directory) so the supervisor never reads a torn beat.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._dir = d
+
+    def beat(self, index: int | None = None, **fields) -> None:
+        rec = {"t": time.time(), "pid": os.getpid(), "index": index}
+        rec.update(fields)
+        fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".hb.tmp")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(rec, f)
+            os.replace(tmp, self.path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+
+    def on_chunk(self, inner=None):
+        """An ``on_chunk``/``on_epoch`` callback that beats and then
+        forwards to ``inner`` when given. Beats ``i + 1`` — the index
+        about to be attempted — so a death inside the NEXT chunk
+        attributes to that chunk on every attempt (the supervisor's
+        quarantine keys on the last indexed beat; see
+        :class:`HeartbeatSink`)."""
+
+        def cb(i, metrics):
+            self.beat(index=int(i) + 1)
+            if inner is not None:
+                inner(i, metrics)
+
+        return cb
+
+
+class HeartbeatSink:
+    """obs sink adapter: beats on run_start / chunk / epoch events.
+
+    Duck-typed against :class:`fps_tpu.obs.sinks.Sink` (write/flush/close)
+    so this module stays importable without the obs package. Attach it to
+    the run's Recorder and every chunk/epoch journal event doubles as a
+    liveness signal — no per-example callback wiring needed.
+
+    The beat carries the index ABOUT TO BE ATTEMPTED (chunk event ``i``
+    → beat ``i + 1``), not the one just finished: the supervisor
+    quarantines the index a crashing child was last working on, and a
+    crash MID-chunk ``i`` must attribute to ``i``, which only the
+    beat-before-work convention gives (the last indexed beat before the
+    death names the doomed chunk on every attempt). Children that resume
+    mid-stream and want exact attribution beat directly at chunk start
+    (the ``Heartbeat.on_chunk`` / supervised_demo pattern).
+    """
+
+    def __init__(self, heartbeat: Heartbeat):
+        self.heartbeat = heartbeat
+
+    def write(self, record: dict) -> None:
+        if record.get("kind") != "event":
+            return
+        if record.get("event") in ("run_start", "chunk", "epoch"):
+            idx = record.get("index")
+            self.heartbeat.beat(index=None if idx is None else int(idx) + 1)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def from_env() -> Heartbeat | None:
+    """The supervisor-provided heartbeat, or None when unsupervised."""
+    path = os.environ.get(HEARTBEAT_ENV)
+    return Heartbeat(path) if path else None
+
+
+def attempt_from_env() -> int:
+    try:
+        return int(os.environ.get(ATTEMPT_ENV, "0"))
+    except ValueError:
+        return 0
+
+
+def read_state(path: str) -> dict:
+    """The supervisor's persisted state ({} when absent/unreadable — a
+    child must start rather than crash on a torn state file)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def quarantined_from_env() -> frozenset[int]:
+    """Chunk/epoch indices quarantined by previous attempts (empty when
+    unsupervised) — feed into ``RollbackPolicy(preset=...)``."""
+    path = os.environ.get(STATE_ENV)
+    if not path:
+        return frozenset()
+    state = read_state(path)
+    try:
+        return frozenset(int(i) for i in state.get("quarantined", ()))
+    except (TypeError, ValueError):
+        return frozenset()

@@ -1,0 +1,407 @@
+"""External run supervisor: deadline-abort, retry/backoff, quarantine.
+
+The ROADMAP straggler item PR 2 left open: ``StepWatchdog`` can *flag* a
+stalled chunk, but Python cannot preempt a thread wedged in a collective
+— the training process itself has no lever left. Deadline-ABORT therefore
+lives one level up, in a separate OS process that:
+
+* launches the training run as a child (its own session/process group);
+* tails a **heartbeat file** (:mod:`fps_tpu.supervise.child`) and/or the
+  run's obs journal files as the liveness signal;
+* on a stalled signal or an exhausted wall-clock budget, aborts the child
+  with **SIGTERM → (grace) → SIGKILL** against the whole process group —
+  SIGKILL is the only signal a group wedged in a collective (or SIGSTOP'd
+  outright) cannot ignore;
+* restarts the child with **exponential backoff** under a bounded retry
+  budget — the child finds ``latest_valid_step`` in its checkpoint dir
+  and resumes (the framework's existing kill-resume contract);
+* **quarantines deterministic poison**: when consecutive attempts die at
+  the same progress index, that index is recorded in a state file
+  persisted next to the checkpoint dir and exported to the next attempt
+  (``RollbackPolicy(preset=...)`` skips it), so a poison batch that
+  crashes the worker cannot crash-loop the run.
+
+Stdlib-only by contract: the supervisor must run on a login node (or
+wrap a TPU job) without importing jax — ``tools/supervise.py`` loads this
+module by file path for exactly that reason. Every decision is journaled
+(JSONL, one fsync'd line per event) so ``tools/obs_report.py`` can fold
+the supervisor's narrative into the run digest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import json
+import os
+import signal
+import subprocess
+import tempfile
+import time
+
+STATE_FILENAME = "supervisor_state.json"
+HEARTBEAT_FILENAME = "heartbeat.json"
+JOURNAL_FILENAME = "journal-supervisor.jsonl"
+
+# Child env contract — MIRRORED in fps_tpu/supervise/child.py (which the
+# training child imports), because this module must stay loadable by file
+# path with zero fps_tpu imports (a package import would drag jax into
+# the supervisor process). tests/test_supervise.py asserts they match.
+HEARTBEAT_ENV = "FPS_TPU_HEARTBEAT"
+STATE_ENV = "FPS_TPU_SUPERVISOR_STATE"
+ATTEMPT_ENV = "FPS_TPU_ATTEMPT"
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorConfig:
+    """Deadline/retry policy knobs.
+
+    ``stall_timeout_s`` is the liveness deadline BETWEEN progress signals
+    (heartbeat mtime change or watched-file growth); ``startup_grace_s``
+    replaces it for the FIRST signal of each attempt, because a cold
+    start pays interpreter + jax import + XLA compile before the first
+    chunk can possibly beat (None: use ``stall_timeout_s``).
+    ``wall_deadline_s`` bounds the whole supervised run across attempts
+    and backoffs (None: unbounded). ``max_restarts`` is the retry budget
+    — the first launch is free, every relaunch spends one.
+    ``quarantine_after`` consecutive failures at the same progress index
+    quarantine that index (persisted, exported to later attempts).
+    """
+
+    stall_timeout_s: float = 120.0
+    startup_grace_s: float | None = None
+    wall_deadline_s: float | None = None
+    max_restarts: int = 3
+    backoff_base_s: float = 1.0
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 60.0
+    term_grace_s: float = 5.0
+    poll_interval_s: float = 0.25
+    quarantine_after: int = 2
+
+    def __post_init__(self):
+        if not self.stall_timeout_s > 0:
+            raise ValueError(
+                f"stall_timeout_s must be > 0, got {self.stall_timeout_s}")
+        if self.max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0, got {self.max_restarts}")
+        if self.quarantine_after < 1:
+            raise ValueError(
+                f"quarantine_after must be >= 1, got {self.quarantine_after}")
+
+    def backoff_s(self, restart: int) -> float:
+        """Deterministic exponential backoff before relaunch ``restart``
+        (0-based): base * factor**restart, capped."""
+        return min(self.backoff_max_s,
+                   self.backoff_base_s * self.backoff_factor ** restart)
+
+
+def _atomic_write_json(path: str, obj: dict) -> None:
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(obj, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+class RunSupervisor:
+    """Supervise one training command to completion or exhaustion.
+
+    Args:
+      cmd: argv of the training child — identical every attempt; the
+        child derives per-attempt behavior from its checkpoint dir
+        (``latest_valid_step`` resume) and the exported env contract
+        (:mod:`fps_tpu.supervise.child`).
+      state_dir: where the supervisor persists its state file, heartbeat,
+        journal, and per-attempt child logs — conventionally the
+        checkpoint dir itself or a sibling, so quarantine decisions live
+        (and survive) next to the snapshots they protect.
+      config: the :class:`SupervisorConfig` policy.
+      watch: extra glob patterns whose matched files' growth also counts
+        as liveness (point one at ``<obs-dir>/journal-p*.jsonl`` and the
+        run journal's per-boundary flushes become the signal, heartbeat
+        or no heartbeat).
+      env: extra environment for the child (merged over os.environ; the
+        heartbeat/state/attempt contract vars are always set on top).
+      cwd: child working directory.
+    """
+
+    def __init__(self, cmd: list[str], *, state_dir: str,
+                 config: SupervisorConfig | None = None,
+                 watch: tuple[str, ...] = (),
+                 env: dict | None = None, cwd: str | None = None):
+        self.cmd = list(cmd)
+        self.config = config or SupervisorConfig()
+        self.state_dir = state_dir
+        os.makedirs(state_dir, exist_ok=True)
+        self.state_path = os.path.join(state_dir, STATE_FILENAME)
+        self.heartbeat_path = os.path.join(state_dir, HEARTBEAT_FILENAME)
+        self.journal_path = os.path.join(state_dir, JOURNAL_FILENAME)
+        self.watch = tuple(watch)
+        self.env = dict(env or {})
+        self.cwd = cwd
+        self.state = self._load_state()
+
+    # -- persisted state ---------------------------------------------------
+
+    def _load_state(self) -> dict:
+        try:
+            with open(self.state_path, encoding="utf-8") as f:
+                state = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            state = {}
+        state.setdefault("restarts", 0)
+        state.setdefault("quarantined", [])
+        state.setdefault("attempts", [])
+        return state
+
+    def _save_state(self) -> None:
+        _atomic_write_json(self.state_path, self.state)
+
+    def _event(self, etype: str, **fields) -> None:
+        rec = {"kind": "event", "t": time.time(), "event": etype, **fields}
+        with open(self.journal_path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    # -- liveness ----------------------------------------------------------
+
+    def _read_heartbeat(self):
+        """(mtime, index) of the heartbeat file, or (None, None)."""
+        try:
+            mtime = os.path.getmtime(self.heartbeat_path)
+            with open(self.heartbeat_path, encoding="utf-8") as f:
+                rec = json.load(f)
+            return mtime, rec.get("index")
+        except (OSError, json.JSONDecodeError):
+            return None, None
+
+    def _watch_fingerprint(self):
+        """Size+mtime fingerprint over the watched globs — any change in
+        the run's journal/event files counts as life."""
+        fp = []
+        for pattern in self.watch:
+            for path in sorted(_glob.glob(pattern)):
+                try:
+                    st = os.stat(path)
+                    fp.append((path, st.st_size, st.st_mtime))
+                except OSError:
+                    continue
+        return tuple(fp)
+
+    # -- child control -----------------------------------------------------
+
+    def _spawn(self, attempt: int, log_path: str) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.update(self.env)
+        env[HEARTBEAT_ENV] = self.heartbeat_path
+        env[STATE_ENV] = self.state_path
+        env[ATTEMPT_ENV] = str(attempt)
+        logf = open(log_path, "ab")
+        try:
+            # Own session => own process group: the TERM/KILL escalation
+            # reaches every thread/grandchild, not just the leader.
+            return subprocess.Popen(
+                self.cmd, env=env, cwd=self.cwd, stdout=logf,
+                stderr=subprocess.STDOUT, start_new_session=True,
+            )
+        finally:
+            logf.close()  # the child holds its own fd now
+
+    def _signal_group(self, proc: subprocess.Popen, sig: int) -> None:
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def _abort(self, proc: subprocess.Popen, reason: str,
+               attempt: int) -> int:
+        """TERM → grace → KILL escalation against the child's group.
+
+        SIGTERM gives a healthy-but-slow child its atexit/flush; a child
+        wedged in a collective — or SIGSTOP'd, which *queues* SIGTERM
+        until continued — only dies to the SIGKILL. Returns the reaped
+        returncode."""
+        self._event("deadline_abort", attempt=attempt, reason=reason,
+                    pid=proc.pid, term_grace_s=self.config.term_grace_s)
+        self._signal_group(proc, signal.SIGTERM)
+        deadline = time.monotonic() + self.config.term_grace_s
+        while proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(min(0.05, self.config.poll_interval_s))
+        if proc.poll() is None:
+            self._signal_group(proc, signal.SIGKILL)
+        proc.wait()
+        return proc.returncode
+
+    # -- one attempt -------------------------------------------------------
+
+    def _run_attempt(self, attempt: int, run_deadline: float | None) -> dict:
+        """Launch + babysit one attempt. Returns the attempt record:
+        ``{"attempt", "rc", "aborted": None|"stall"|"wall_deadline",
+        "last_index", "runtime_s", "log"}``."""
+        cfg = self.config
+        log_path = os.path.join(self.state_dir, f"attempt-{attempt}.log")
+        # A stale heartbeat from the previous attempt must not count as
+        # this attempt's first signal.
+        try:
+            os.remove(self.heartbeat_path)
+        except OSError:
+            pass
+        t0 = time.monotonic()
+        proc = self._spawn(attempt, log_path)
+        self._event("attempt_start", attempt=attempt, pid=proc.pid,
+                    cmd=self.cmd,
+                    quarantined=list(self.state["quarantined"]))
+        last_signal = t0
+        deadline_s = (cfg.startup_grace_s if cfg.startup_grace_s is not None
+                      else cfg.stall_timeout_s)
+        hb_mtime, last_index = self._read_heartbeat()
+        watch_fp = self._watch_fingerprint()
+        aborted = None
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            now = time.monotonic()
+            new_mtime, idx = self._read_heartbeat()
+            new_fp = self._watch_fingerprint()
+            if new_mtime != hb_mtime or new_fp != watch_fp:
+                hb_mtime, watch_fp = new_mtime, new_fp
+                if idx is not None:
+                    last_index = idx
+                last_signal = now
+                deadline_s = cfg.stall_timeout_s  # startup grace spent
+            if run_deadline is not None and now >= run_deadline:
+                rc = self._abort(proc, "wall_deadline", attempt)
+                aborted = "wall_deadline"
+                break
+            if now - last_signal > deadline_s:
+                rc = self._abort(proc, "stall", attempt)
+                aborted = "stall"
+                break
+            time.sleep(cfg.poll_interval_s)
+        # Catch a final beat that landed between the last poll and exit.
+        _, idx = self._read_heartbeat()
+        if idx is not None:
+            last_index = idx
+        record = {
+            "attempt": attempt,
+            "rc": rc,
+            "aborted": aborted,
+            "last_index": last_index,
+            "runtime_s": round(time.monotonic() - t0, 3),
+            "log": log_path,
+        }
+        self._event("attempt_end", **record)
+        return record
+
+    # -- the supervision loop ----------------------------------------------
+
+    def run(self) -> dict:
+        """Supervise to completion. Returns the digest dict (also what
+        ``tools/supervise.py`` prints): success, attempts, restarts,
+        deadline aborts, quarantined indices, give-up reason."""
+        cfg = self.config
+        t0 = time.monotonic()
+        run_deadline = (t0 + cfg.wall_deadline_s
+                        if cfg.wall_deadline_s is not None else None)
+        self._event("supervisor_start", cmd=self.cmd,
+                    state_path=self.state_path,
+                    config=dataclasses.asdict(cfg))
+        attempt = len(self.state["attempts"])
+        restarts_this_run = 0
+        reason = None
+        success = False
+        while True:
+            record = self._run_attempt(attempt, run_deadline)
+            self.state["attempts"].append(record)
+            self._save_state()
+            if record["rc"] == 0 and record["aborted"] is None:
+                # rc alone is not success: a SIGTERM-trapping child may
+                # exit 0 from its graceful-shutdown handler after a
+                # deadline abort — that run is still incomplete.
+                success = True
+                break
+            self._maybe_quarantine(record)
+            if record["aborted"] == "wall_deadline":
+                reason = "wall_deadline"
+                break
+            if restarts_this_run >= cfg.max_restarts:
+                reason = "retry_budget_exhausted"
+                self._event("supervisor_give_up", attempts=attempt + 1,
+                            restarts=restarts_this_run, reason=reason)
+                break
+            backoff = cfg.backoff_s(restarts_this_run)
+            if run_deadline is not None and (
+                    time.monotonic() + backoff >= run_deadline):
+                reason = "wall_deadline"
+                break
+            self._event("supervisor_restart", attempt=attempt + 1,
+                        backoff_s=backoff,
+                        restarts=restarts_this_run + 1)
+            time.sleep(backoff)
+            restarts_this_run += 1
+            self.state["restarts"] = int(self.state["restarts"]) + 1
+            self._save_state()
+            attempt += 1
+        attempts = self.state["attempts"]
+        digest = {
+            "success": success,
+            "reason": reason,
+            "attempts": len(attempts),
+            "restarts": int(self.state["restarts"]),
+            "deadline_aborts": sum(
+                1 for a in attempts if a.get("aborted") == "stall"),
+            "wall_deadline_hit": any(
+                a.get("aborted") == "wall_deadline" for a in attempts),
+            "quarantined": list(self.state["quarantined"]),
+            "last_index": attempts[-1].get("last_index") if attempts else None,
+            "wall_s": round(time.monotonic() - t0, 3),
+            "state_path": self.state_path,
+            "journal_path": self.journal_path,
+        }
+        self._event("supervised_run_end", **{
+            k: v for k, v in digest.items() if k != "journal_path"})
+        return digest
+
+    def _maybe_quarantine(self, record: dict) -> None:
+        """Two (``quarantine_after``) consecutive CRASH failures at the
+        same progress index mark that index poisoned: persist it and
+        export it to the next attempt (the child's RollbackPolicy preset
+        skips it). Index None (died before any beat) never quarantines —
+        there is nothing addressable to skip. Deadline-ABORTED attempts
+        are not evidence either: a shared-filesystem hiccup stalling the
+        same chunk twice is environmental, and quarantining it would
+        silently drop healthy training data (the failure model scopes
+        quarantine to deterministic poison that CRASHES the worker)."""
+        idx = record.get("last_index")
+        if idx is None or record.get("aborted") is not None:
+            return
+        # Only the CONSECUTIVE trailing crash failures count: the
+        # persisted attempt history spans supervisor invocations, and two
+        # transient deaths at the same index with a fully successful run
+        # between them are coincidence, not determinism — a success
+        # resets the evidence (an interleaved stall-abort neither counts
+        # nor resets).
+        tail = []
+        for a in reversed(self.state["attempts"]):
+            if a.get("rc") == 0 and a.get("aborted") is None:
+                break
+            if a.get("aborted") is None:
+                tail.append(a)
+        tail = tail[:self.config.quarantine_after]
+        if (len(tail) >= self.config.quarantine_after
+                and all(a.get("last_index") == idx for a in tail)
+                and idx not in self.state["quarantined"]):
+            self.state["quarantined"].append(int(idx))
+            self._save_state()
+            self._event("chunk_quarantined", index=int(idx),
+                        after_attempts=len(tail))

@@ -11,7 +11,12 @@ Three injector families, composable by the tests (``tests/test_resilience.py``,
   (:mod:`fps_tpu.core.checkpoint`);
 * **process death** — SIGKILL helpers generalizing
   ``tests/_kill_resume_worker.py``: die at an epoch boundary, or die
-  mid-checkpoint-write leaving a partial ``.tmp.npz`` behind.
+  mid-checkpoint-write leaving a partial ``.tmp.npz`` behind;
+* **wedged processes** — stop making progress WITHOUT dying (SIGSTOP the
+  whole process, or sleep forever inside a chunk callback): the stall
+  class only an external supervisor (``fps_tpu.supervise``) can abort,
+  exercised end-to-end by ``tools/chaos_sweep.py``'s ``supervised``
+  scenario.
 
 Every injector is deterministic: corruption sites come from a seeded
 ``np.random.default_rng``, never from wall-clock or os entropy, so a
@@ -173,6 +178,52 @@ def kill_at_epoch(epoch: int):
     def cb(e, _metrics):
         if e == epoch:
             sigkill_self()
+
+    return cb
+
+
+def sigstop_self() -> None:
+    """Freeze NOW: every thread stops, the heartbeat stops, collectives
+    involving this process stall forever — but the process does NOT die,
+    and SIGTERM merely queues until a SIGCONT that never comes. The wedge
+    only the supervisor's SIGKILL escalation can clear."""
+    os.kill(os.getpid(), signal.SIGSTOP)
+
+
+def sleep_forever() -> None:
+    """Wedge the calling thread without stopping the process: the Python
+    loop stops driving dispatches while signal handlers stay live —
+    the 'quietly hung host loop' variant of a stall (a SIGTERM would
+    still kill this one; SIGSTOP models the harder case)."""
+    import time
+
+    while True:
+        time.sleep(3600)
+
+
+def wedge_at_chunk(index: int, mode: str = "sigstop", *,
+                   marker: str | None = None):
+    """``on_chunk``/``on_epoch`` callback that wedges the process after
+    chunk ``index`` finishes training but BEFORE its checkpoint lands —
+    the supervisor-scenario analog of :func:`kill_at_epoch`.
+
+    ``mode``: ``"sigstop"`` (freeze the whole process) or ``"sleep"``
+    (wedge the host loop). ``marker``: a file path making the wedge
+    once-only — the callback touches it before wedging, and a restarted
+    attempt that finds it proceeds cleanly (deterministic wedge-once, no
+    wall-clock or entropy involved).
+    """
+    if mode not in ("sigstop", "sleep"):
+        raise ValueError(f"unknown wedge mode {mode!r}")
+
+    def cb(i, _metrics):
+        if i != index:
+            return
+        if marker is not None:
+            if os.path.exists(marker):
+                return
+            open(marker, "w").close()
+        sigstop_self() if mode == "sigstop" else sleep_forever()
 
     return cb
 

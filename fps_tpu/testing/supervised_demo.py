@@ -1,0 +1,256 @@
+"""Tiny supervised training child: the real-jax end of the supervisor story.
+
+``python -m fps_tpu.testing.supervised_demo --ckpt-dir D --out W.npz ...``
+runs the standard tiny logreg workload (:mod:`fps_tpu.testing.workloads`)
+under the full supervised-child contract:
+
+* resumes from ``latest_valid_step`` in ``--ckpt-dir`` (fresh process,
+  the framework's kill-resume contract) with ``checkpoint_every=1``
+  through an :class:`~fps_tpu.core.checkpoint.AsyncCheckpointer`;
+* beats the supervisor heartbeat (env contract,
+  :mod:`fps_tpu.supervise.child`) on every chunk boundary;
+* preloads the supervisor-carried quarantine set into
+  ``RollbackPolicy(preset=...)``;
+* misbehaves on demand — ``--wedge-at K`` (SIGSTOP / sleep-forever after
+  chunk K trains, BEFORE its checkpoint lands: exactly one chunk of work
+  at risk) or ``--crash-at K`` (deterministic exit(3): the poison-crash
+  loop the supervisor must quarantine through). Both are once-only via a
+  marker file next to the checkpoints unless ``--always`` is given.
+
+Deterministic end to end: a supervised wedged run must reproduce the
+straight run's final weights BIT-FOR-BIT (asserted by
+``tools/chaos_sweep.py``'s ``supervised`` scenario and the slow test in
+``tests/test_supervise.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Shared by the straight and supervised runs of the scenario below —
+# bit-identity only means something when both children run the exact same
+# workload.
+SCENARIO_DEMO_ARGS = ("--examples", "8000", "--epochs", "2")
+SCENARIO_WEDGE_AT = 3
+
+
+def run_supervised_scenario(tmpdir: str, *, timeout: float = 600):
+    """THE end-to-end supervisor survival scenario, shared by
+    ``tools/chaos_sweep.py`` (``supervised``) and the slow test in
+    ``tests/test_supervise.py`` so the two cannot drift: SIGSTOP-wedge a
+    real training child mid-run; the supervisor must deadline-abort
+    (SIGTERM→SIGKILL), restart with backoff, resume from
+    ``latest_valid_step`` (exactly one chunk replayed), select no corrupt
+    snapshot, and reproduce the unsupervised straight run's final weights
+    bit-for-bit.
+
+    Returns ``(ok, detail)`` — ``detail`` carries the evidence either
+    caller surfaces (supervisor digest excerpt, restored step, the
+    bit-identity verdict, any ``*.corrupt`` files).
+    """
+    import numpy as np
+
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=_ROOT)
+    demo = [sys.executable, "-m", "fps_tpu.testing.supervised_demo",
+            *SCENARIO_DEMO_ARGS]
+    straight_dir = os.path.join(tmpdir, "straight")
+    sup_dir = os.path.join(tmpdir, "sup")
+    straight_out = os.path.join(tmpdir, "straight.npz")
+    sup_out = os.path.join(tmpdir, "sup.npz")
+
+    r = subprocess.run(
+        demo + ["--ckpt-dir", straight_dir, "--out", straight_out],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=timeout,
+    )
+    if r.returncode != 0:
+        return False, {"error": "straight run failed",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "supervise.py"),
+         "--state-dir", sup_dir, "--stall-timeout-s", "10",
+         "--startup-grace-s", "300", "--term-grace-s", "2",
+         "--backoff-base-s", "0.2", "--max-restarts", "2",
+         "--poll-s", "0.2", "--",
+         *demo, "--ckpt-dir", sup_dir, "--out", sup_out,
+         "--wedge-at", str(SCENARIO_WEDGE_AT), "--wedge-mode", "sigstop"],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=timeout,
+    )
+    try:
+        digest = json.loads(r.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return False, {"error": "no supervisor digest",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+    try:
+        with open(sup_out + ".meta.json", encoding="utf-8") as f:
+            meta = json.load(f)
+    except OSError:
+        meta = {}
+    bit_identical = (
+        os.path.exists(sup_out)
+        and np.array_equal(np.load(straight_out)["weights"],
+                           np.load(sup_out)["weights"])
+    )
+    detail = {
+        "supervisor": {k: digest.get(k) for k in
+                       ("success", "attempts", "restarts",
+                        "deadline_aborts", "quarantined")},
+        "restored_step": meta.get("restored_step"),
+        "bit_identical": bit_identical,
+        "corrupt_files": sorted(os.path.basename(p) for p in
+                                glob.glob(sup_dir + "/*.corrupt")),
+    }
+    ok = (r.returncode == 0 and digest.get("success")
+          and digest.get("deadline_aborts") == 1
+          and digest.get("restarts") == 1
+          # The wedge fires after chunk SCENARIO_WEDGE_AT trains (with
+          # the async writer flushed first), before its checkpoint
+          # lands: latest_valid_step == SCENARIO_WEDGE_AT means at most
+          # one chunk of work was lost and replayed.
+          and meta.get("restored_step") == SCENARIO_WEDGE_AT
+          and not detail["corrupt_files"]
+          and bit_identical)
+    return ok, detail
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="supervised tiny-logreg child (fps_tpu.supervise demo)")
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--out", required=True,
+                    help="final weights .npz (written on success)")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--examples", type=int, default=2000)
+    ap.add_argument("--wedge-at", type=int, default=None,
+                    help="wedge after this chunk trains, before its "
+                         "checkpoint lands (once, via marker file)")
+    ap.add_argument("--wedge-mode", default="sigstop",
+                    choices=["sigstop", "sleep"])
+    ap.add_argument("--crash-at", type=int, default=None,
+                    help="exit(3) at this chunk on every attempt not "
+                         "carrying it in the quarantine set")
+    ap.add_argument("--always", action="store_true",
+                    help="misbehave on every attempt (no marker)")
+    ap.add_argument("--sync-checkpointer", action="store_true",
+                    help="use the blocking Checkpointer instead of the "
+                         "async writer")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import jax
+
+    from fps_tpu.core.checkpoint import AsyncCheckpointer, Checkpointer
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.core.resilience import RollbackPolicy
+    from fps_tpu.models.logistic_regression import (
+        LogRegConfig,
+        logistic_regression,
+    )
+    from fps_tpu.parallel.mesh import make_ps_mesh
+    from fps_tpu.supervise import child
+    from fps_tpu.testing import chaos
+    from fps_tpu.testing.workloads import (
+        NF,
+        logreg_chunks,
+        logreg_data,
+        weights,
+    )
+
+    hb = child.from_env()
+    preset = child.quarantined_from_env()
+    attempt = child.attempt_from_env()
+
+    mesh = make_ps_mesh()
+    W = num_workers_of(mesh)
+    train, _ = logreg_data(args.examples)
+    chunks = logreg_chunks(train, W, epochs=args.epochs)
+
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainer, store = logistic_regression(mesh, cfg)
+    tables, ls = trainer.init_state(jax.random.key(0))
+
+    ckpt_cls = Checkpointer if args.sync_checkpointer else AsyncCheckpointer
+    ckpt = ckpt_cls(args.ckpt_dir, keep=3)
+    start = ckpt.latest_valid_step() or 0
+    if start:
+        tables, ls, start = trainer.restore_checkpoint(ckpt, ls)
+    if hb is not None:
+        # Beat-before-work: name the chunk about to be attempted BEFORE
+        # attempting it, so a crash inside the very first (resumed) chunk
+        # still attributes to it — without this, every resumed attempt
+        # dies index-less and the supervisor can never quarantine a
+        # deterministic mid-chunk poison (it would burn the whole retry
+        # budget instead).
+        hb.beat(index=start, attempt=attempt)
+    meta = {"attempt": attempt, "restored_step": start,
+            "quarantined": sorted(preset), "total_chunks": len(chunks)}
+    print(json.dumps({"event": "demo_start", **meta}), flush=True)
+
+    marker = os.path.join(args.ckpt_dir, "misbehave.done")
+    wedge = None
+    if args.wedge_at is not None:
+        wedge = chaos.wedge_at_chunk(
+            args.wedge_at, args.wedge_mode,
+            marker=None if args.always else marker,
+        )
+
+    def on_chunk(i, metrics):
+        # The last beat before this point named chunk i (beat-before-work:
+        # the post-restore beat, or the previous boundary's i-1 -> i).
+        if (args.crash_at is not None and i == args.crash_at
+                and i not in preset
+                and (args.always or not os.path.exists(marker))):
+            # A deterministic poison batch crashing the worker at chunk
+            # i: dying BEFORE beating i+1 leaves i as the attempt's
+            # last_index — the supervisor's quarantine evidence. No
+            # marker touch — unlike the wedge, this MUST recur until
+            # quarantined.
+            print(json.dumps({"event": "demo_crash", "index": int(i)}),
+                  flush=True)
+            sys.stdout.flush()
+            os._exit(3)
+        if wedge is not None and i == args.wedge_at:
+            # The scenario's exact ≤1-chunk-lost bound (restored_step ==
+            # wedge_at) needs prior snapshots DURABLE before the freeze —
+            # the async writer may still hold the latest save in flight,
+            # and a SIGSTOP'd writer never finishes. The wedge models a
+            # stall between chunks, so flushing first is faithful; a real
+            # mid-write freeze is covered by victim-async-midwrite (the
+            # bound there is the bit-identity contract, not a fixed step).
+            ckpt.flush()
+        if wedge is not None:
+            wedge(i, metrics)
+        if hb is not None:
+            hb.beat(index=int(i) + 1, attempt=attempt)
+
+    rollback = RollbackPolicy(preset=preset) if preset else None
+    tables, ls, _ = trainer.fit_stream(
+        tables, ls, chunks[start:], jax.random.key(1),
+        checkpointer=ckpt, checkpoint_every=1, start_step=start,
+        on_chunk=on_chunk, rollback=rollback,
+    )
+    ckpt.close()
+
+    np.savez(args.out, weights=weights(store))
+    meta.update(finished=True,
+                skipped=sorted(rollback.skipped) if rollback else [])
+    with open(args.out + ".meta.json", "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+    print(json.dumps({"event": "demo_done", **meta}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

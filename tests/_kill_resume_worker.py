@@ -24,6 +24,12 @@ tests/test_resilience.py):
   checkpoint write, leaving a partial ``.tmp.npz`` in the directory (the
   torn-write window of ``_atomic_savez``): snapshots 1 and 2 stay intact,
   step 3 never lands.
+* ``victim-async-midwrite`` — checkpoints through the
+  ``AsyncCheckpointer`` and SIGKILLs from INSIDE the background writer
+  while step 3's serialize is underway (partial tmp on disk, rename
+  never reached): the async writer's atomicity contract — a kill
+  mid-background-write publishes nothing torn, ``latest_valid_step``
+  stays 2, and resume-any still reproduces the straight run.
 * ``resume-any`` — FRESH process: restore whatever the newest *intact*
   snapshot is (fallback path — the parent may have corrupted the newest
   file first), continue to 4 total epochs, dump the model. The parent
@@ -105,6 +111,26 @@ def main() -> int:
         trainer.run_indexed(tables, ls, plan, key, epochs=4,
                             checkpointer=ckpt, checkpoint_every=1)
         raise AssertionError("victim-midwrite must never get here")
+
+    if mode == "victim-async-midwrite":
+        from fps_tpu.core import checkpoint as ck_mod
+        from fps_tpu.testing import chaos
+
+        ackpt = ck_mod.AsyncCheckpointer(ckdir, keep=2)
+        real_savez = ck_mod._atomic_savez
+
+        def dying_savez(path, arrays):
+            if path.endswith(ck_mod.SNAPSHOT_FMT.format(step=3)):
+                # Step 3's BACKGROUND write: partial tmp hits the disk,
+                # then SIGKILL — from the writer thread itself, i.e. the
+                # kill lands mid-serialize with the rename never reached.
+                chaos.partial_write_then_kill(ckdir)
+            return real_savez(path, arrays)
+
+        ck_mod._atomic_savez = dying_savez
+        trainer.run_indexed(tables, ls, plan, key, epochs=4,
+                            checkpointer=ackpt, checkpoint_every=1)
+        raise AssertionError("victim-async-midwrite must never get here")
 
     if mode in ("resume", "resume-any"):
         if mode == "resume":

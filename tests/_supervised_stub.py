@@ -1,0 +1,110 @@
+"""jax-free training stand-in for the supervisor's tier-1 smoke tests.
+
+Simulates the supervised-child contract at ~100x real speed: beats the
+supervisor heartbeat per "chunk", persists its progress ("checkpoint")
+after each chunk, resumes from it on restart, honors the carried
+quarantine set, and can misbehave on demand:
+
+* ``--wedge-at K``  — on the FIRST attempt only (marker file), stop
+  beating at chunk K and sleep forever: the deadline-abort path.
+* ``--wedge-mode sigstop`` — same, but SIGSTOP the whole process instead
+  (the queued-SIGTERM case: only the supervisor's SIGKILL escalation can
+  clear it).
+* ``--crash-at K`` — exit(3) at chunk K on EVERY attempt whose quarantine
+  set does not contain K: the deterministic-poison crash loop the
+  supervisor must break by quarantining K.
+
+Usage: python _supervised_stub.py --dir D --chunks N [flags]
+Writes ``result.json`` ({"done": N, "ran": [...]}) into --dir on success.
+
+Loads fps_tpu/supervise/child.py by file path (no fps_tpu package import,
+so no jax) — the same trick tools/supervise.py uses for the parent side.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import signal
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_child_module():
+    path = os.path.join(_ROOT, "fps_tpu", "supervise", "child.py")
+    spec = importlib.util.spec_from_file_location("_fps_child", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # 3.10 needs the registration pre-exec
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--chunks", type=int, default=8)
+    ap.add_argument("--chunk-s", type=float, default=0.05)
+    ap.add_argument("--wedge-at", type=int, default=None)
+    ap.add_argument("--wedge-mode", default="sleep",
+                    choices=["sleep", "sigstop"])
+    ap.add_argument("--wedge-always", action="store_true",
+                    help="wedge on EVERY attempt (no marker) — the "
+                         "unrecoverable-hang case for wall-deadline tests")
+    ap.add_argument("--trap-term", action="store_true",
+                    help="install a SIGTERM handler that exits 0 (a "
+                         "graceful-shutdown child): an ABORTED attempt "
+                         "ending rc=0 must still not count as success")
+    ap.add_argument("--crash-at", type=int, default=None)
+    args = ap.parse_args()
+
+    if args.trap_term:
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+
+    child = _load_child_module()
+    hb = child.from_env()
+    quarantined = child.quarantined_from_env()
+    os.makedirs(args.dir, exist_ok=True)
+    progress_path = os.path.join(args.dir, "progress.json")
+    marker = os.path.join(args.dir, "wedge.done")
+
+    start = 0
+    try:
+        with open(progress_path, encoding="utf-8") as f:
+            start = int(json.load(f)["next"])
+    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        pass
+
+    ran = []
+    for i in range(start, args.chunks):
+        if hb is not None:
+            hb.beat(index=i, attempt=child.attempt_from_env())
+        if i in quarantined:
+            continue  # carried quarantine: consume the index, skip the work
+        if args.crash_at is not None and i == args.crash_at:
+            print(f"stub: deterministic crash at chunk {i}", flush=True)
+            return 3
+        if args.wedge_at is not None and i == args.wedge_at \
+                and (args.wedge_always or not os.path.exists(marker)):
+            open(marker, "w").close()  # wedge once; the restart proceeds
+            print(f"stub: wedging ({args.wedge_mode}) at chunk {i}",
+                  flush=True)
+            if args.wedge_mode == "sigstop":
+                os.kill(os.getpid(), signal.SIGSTOP)
+            while True:  # sleep-forever wedge (also post-SIGCONT fallthrough)
+                time.sleep(3600)
+        time.sleep(args.chunk_s)
+        ran.append(i)
+        with open(progress_path, "w", encoding="utf-8") as f:
+            json.dump({"next": i + 1}, f)  # the stub's "checkpoint"
+
+    with open(os.path.join(args.dir, "result.json"), "w",
+              encoding="utf-8") as f:
+        json.dump({"done": args.chunks, "ran": ran,
+                   "attempt": child.attempt_from_env()}, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

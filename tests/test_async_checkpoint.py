@@ -1,0 +1,290 @@
+"""AsyncCheckpointer: the double-buffered background snapshot writer.
+
+Acceptance contract (ISSUE 3):
+
+* ``save()`` returns without blocking on serialize+fsync (asserted
+  against a slow-serialize fake);
+* publication stays atomic under the writer (flush barrier, enqueued vs
+  saved journal ordering, ``latest_valid_step`` monotone);
+* the compiled training program is bit-identical with the async writer
+  (and the supervised-heartbeat recorder) attached or detached;
+* a training run checkpointed through the async writer produces
+  byte-identical snapshots to the synchronous Checkpointer.
+
+The SIGKILL-mid-background-write end-to-end lives in
+``tests/test_checkpoint.py`` next to its kill-resume siblings (slow).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jaxmods():
+    import jax
+
+    from fps_tpu.core import checkpoint as ck
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.core.ingest import epoch_chunks
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.parallel.mesh import make_ps_mesh
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    return dict(jax=jax, ck=ck, num_workers_of=num_workers_of,
+                epoch_chunks=epoch_chunks, MFConfig=MFConfig,
+                online_mf=online_mf, make_ps_mesh=make_ps_mesh,
+                synthetic_ratings=synthetic_ratings)
+
+
+def _mf(jaxmods, num_shards=4):
+    jax = jaxmods["jax"]
+    mesh = jaxmods["make_ps_mesh"](
+        num_shards=num_shards, num_data=1,
+        devices=jax.devices()[:num_shards])
+    cfg = jaxmods["MFConfig"](num_users=32, num_items=24, rank=4)
+    trainer, store = jaxmods["online_mf"](mesh, cfg, donate=False)
+    return mesh, cfg, trainer, store
+
+
+def _chunks(jaxmods, W=4):
+    data = jaxmods["synthetic_ratings"](32, 24, 4 * W * 8 * 2, seed=3)
+    return list(jaxmods["epoch_chunks"](
+        data, num_workers=W, local_batch=8, steps_per_chunk=2,
+        route_key="user", seed=0))[:4]
+
+
+def _slow_savez(jaxmods, monkeypatch, delay_s, started=None):
+    """Monkeypatch the module-level _atomic_savez with a slow wrapper
+    (the writer thread resolves it at call time, so this slows the
+    BACKGROUND write, not the enqueue)."""
+    ck = jaxmods["ck"]
+    real = ck._atomic_savez
+
+    def slow(path, arrays):
+        if started is not None:
+            started.set()
+        time.sleep(delay_s)
+        return real(path, arrays)
+
+    monkeypatch.setattr(ck, "_atomic_savez", slow)
+    return real
+
+
+def test_save_returns_without_blocking(tmp_path, jaxmods, devices8,
+                                       monkeypatch):
+    """THE acceptance assertion: with serialize+fsync faked slow (1s),
+    save() returns in a fraction of that; flush() is what waits."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    _, _, trainer, store = _mf(jaxmods)
+    store.init(jax.random.key(0))
+    started = threading.Event()
+    _slow_savez(jaxmods, monkeypatch, 1.0, started)
+
+    with ck.AsyncCheckpointer(str(tmp_path / "c"), keep=3) as ckpt:
+        t0 = time.perf_counter()
+        ckpt.save(1, store, None)
+        enqueue_s = time.perf_counter() - t0
+        assert enqueue_s < 0.5, f"save blocked for {enqueue_s:.2f}s"
+        assert started.wait(5.0)  # the background write is really running
+        assert not os.path.exists(ckpt._path(1))  # not yet published
+        t0 = time.perf_counter()
+        ckpt.flush()
+        flush_s = time.perf_counter() - t0
+        assert flush_s > 0.3, "flush must be the barrier"
+        assert os.path.exists(ckpt._path(1))
+    assert ck.Checkpointer(str(tmp_path / "c")).verify_snapshot(1)
+
+
+def test_at_most_one_in_flight_write(tmp_path, jaxmods, devices8,
+                                     monkeypatch):
+    """Double buffering: one write in flight + one queued; the THIRD save
+    blocks until the slot frees. All three publish, in order."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    _, _, trainer, store = _mf(jaxmods)
+    store.init(jax.random.key(0))
+    _slow_savez(jaxmods, monkeypatch, 0.4)
+
+    with ck.AsyncCheckpointer(str(tmp_path / "c"), keep=5) as ckpt:
+        t0 = time.perf_counter()
+        ckpt.save(1, store, None)  # -> writer
+        ckpt.save(2, store, None)  # -> queue slot
+        two_saves_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        ckpt.save(3, store, None)  # must wait for save 1 to finish
+        third_save_s = time.perf_counter() - t0
+        assert two_saves_s < 0.3, two_saves_s
+        assert third_save_s > 0.1, third_save_s
+        ckpt.flush()
+        assert ckpt.steps() == [1, 2, 3]
+
+
+def test_writer_failure_surfaces_on_caller(tmp_path, jaxmods, devices8,
+                                           monkeypatch):
+    """A failed background write re-raises (once) from the next
+    flush/save on the training thread — never silently loses snapshots."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    _, _, trainer, store = _mf(jaxmods)
+    store.init(jax.random.key(0))
+
+    def boom(path, arrays):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(ck, "_atomic_savez", boom)
+    ckpt = ck.AsyncCheckpointer(str(tmp_path / "c"))
+    ckpt.save(1, store, None)
+    with pytest.raises(RuntimeError, match="background checkpoint"):
+        ckpt.flush()
+    # Error consumed; the writer thread survives for the next save.
+    monkeypatch.undo()
+    ckpt.save(2, store, None)
+    ckpt.flush()
+    assert ckpt.steps() == [2]
+    ckpt.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        ckpt.save(3, store, None)
+    ckpt.close()  # idempotent
+
+
+def test_async_snapshots_byte_identical_to_sync(tmp_path, jaxmods,
+                                                devices8):
+    """fit_stream + AsyncCheckpointer == fit_stream + Checkpointer: same
+    steps, same tables, same local state, same ls_format tag."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    chunks = _chunks(jaxmods)
+    dirs = {}
+    for name, cls in [("sync", ck.Checkpointer),
+                      ("async", ck.AsyncCheckpointer)]:
+        _, _, trainer, store = _mf(jaxmods)
+        tab, ls = trainer.init_state(jax.random.key(1))
+        ckpt = cls(str(tmp_path / name))
+        trainer.fit_stream(tab, ls, chunks, jax.random.key(5),
+                           checkpointer=ckpt, checkpoint_every=2)
+        ckpt.close()
+        dirs[name] = str(tmp_path / name)
+    a = ck.Checkpointer(dirs["sync"])
+    b = ck.Checkpointer(dirs["async"])
+    assert a.steps() == b.steps() == [2, 4]
+    for s in a.steps():
+        sa, ta, la, fa = a.read_snapshot(s)
+        sb, tb, lb, fb = b.read_snapshot(s)
+        assert (sa, fa) == (sb, fb)
+        assert set(ta) == set(tb)
+        for k in ta:
+            np.testing.assert_array_equal(ta[k], tb[k])
+        for x, y in zip(la, lb):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_enqueued_before_saved_and_read_side_flushes(tmp_path, jaxmods,
+                                                     devices8, monkeypatch):
+    """Journal ordering: checkpoint_enqueued precedes checkpoint_saved
+    for each step; latest_valid_step (read side) flushes first, so it is
+    monotone even while a slow write is in flight."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    from fps_tpu.obs import MemorySink, Recorder, events
+
+    _, _, trainer, store = _mf(jaxmods)
+    store.init(jax.random.key(0))
+    sink = MemorySink()
+    rec = Recorder(sinks=[sink])
+    _slow_savez(jaxmods, monkeypatch, 0.3)
+    with events.default_recorder(rec):
+        with ck.AsyncCheckpointer(str(tmp_path / "c"), keep=5) as ckpt:
+            ckpt.save(1, store, None)
+            ckpt.flush()
+            assert ckpt.latest_valid_step() == 1
+            ckpt.save(2, store, None)
+            # Read side flushes: sees 2 the moment the call returns.
+            assert ckpt.latest_valid_step() == 2
+    evs = [(r["event"], r.get("step")) for r in sink.records
+           if r.get("kind") == "event"]
+    for step in (1, 2):
+        assert evs.index(("checkpoint_enqueued", step)) < evs.index(
+            ("checkpoint_saved", step)), evs
+
+
+def test_accepted_saves_survive_midrun_exception(tmp_path, jaxmods,
+                                                 devices8, monkeypatch):
+    """The drivers flush in a finally: a run killed by a callback raise
+    (the sanctioned early-stop pattern) or a health abort must not
+    silently drop saves already journaled as checkpoint_enqueued."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    chunks = _chunks(jaxmods)
+    _, _, trainer, store = _mf(jaxmods)
+    tab, ls = trainer.init_state(jax.random.key(1))
+    _slow_savez(jaxmods, monkeypatch, 0.3)
+    ckpt = ck.AsyncCheckpointer(str(tmp_path / "c"), keep=5)
+
+    class _Stop(Exception):
+        pass
+
+    def stop_after_two(i, _m):
+        if i == 1:
+            raise _Stop
+
+    with pytest.raises(_Stop):
+        trainer.fit_stream(tab, ls, chunks, jax.random.key(5),
+                           checkpointer=ckpt, checkpoint_every=1,
+                           on_chunk=stop_after_two)
+    # Chunk 0's save (step 1) was accepted before the raise (chunk 1's
+    # callback raises BEFORE its own checkpoint); the finally-flush makes
+    # it durable despite the exception.
+    assert ckpt.steps() == [1]
+    assert ckpt.latest_valid_step() == 1
+    ckpt.close()
+
+
+def test_compiled_program_identical_with_async_writer_attached(
+        tmp_path, jaxmods, devices8):
+    """ISSUE acceptance: checkpointer + heartbeat recorder live entirely
+    on the host side — the lowered program must be bit-identical with
+    them attached or not."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    from fps_tpu.obs import Recorder
+    from fps_tpu.parallel.mesh import host_to_sharded, key_to_replicated
+    from fps_tpu.supervise import Heartbeat, HeartbeatSink
+
+    chunk = _chunks(jaxmods)[0]
+
+    def lowered_text(attach):
+        mesh, _, trainer, store = _mf(jaxmods)
+        tab, ls = trainer.init_state(jax.random.key(1))
+        if attach:
+            hb = Heartbeat(str(tmp_path / "hb.json"))
+            trainer.recorder = Recorder(sinks=[HeartbeatSink(hb)])
+            ck.AsyncCheckpointer(str(tmp_path / "att")).close()
+        sharding = trainer._batch_sharding_for("sync")
+        batches = jax.tree.map(lambda x: host_to_sharded(x, sharding), chunk)
+        key = key_to_replicated(jax.random.key(1), mesh)
+        return trainer._get_compiled("sync").lower(
+            tab, ls, batches, key).as_text()
+
+    assert lowered_text(False) == lowered_text(True)
+
+
+def test_corrupt_quarantine_sweep_bounded(tmp_path, jaxmods, devices8):
+    """Satellite: *.corrupt files are bounded by count AND age at
+    Checkpointer construction — they no longer accumulate forever."""
+    ck = jaxmods["ck"]
+    d = tmp_path / "c"
+    d.mkdir()
+    old = time.time() - 2 * ck.Checkpointer.CORRUPT_SWEEP_AGE_S
+    # 6 young corrupt files (count bound: newest 4 survive) + 1 ancient
+    # (age bound: goes regardless of rank).
+    for i in range(6):
+        p = d / (ck.SNAPSHOT_FMT.format(step=i) + ".corrupt")
+        p.write_bytes(b"junk")
+        os.utime(p, (time.time() - 60 * (6 - i),) * 2)
+    ancient = d / "ancient.npz.corrupt"
+    ancient.write_bytes(b"junk")
+    os.utime(ancient, (old, old))
+    ck.Checkpointer(str(d), keep=2)
+    left = sorted(f.name for f in d.iterdir() if f.name.endswith(".corrupt"))
+    assert len(left) == 4, left
+    assert ancient.name not in left
+    assert ck.SNAPSHOT_FMT.format(step=5) + ".corrupt" in left  # newest kept

@@ -492,6 +492,49 @@ def test_midwrite_crash_tmp_cleanup_and_resume(tmp_path):
     np.testing.assert_array_equal(a["user_factors"], b["user_factors"])
 
 
+@pytest.mark.slow
+def test_async_writer_sigkill_midwrite_never_publishes_torn(tmp_path):
+    """ISSUE 3 kill/resume contract under the ASYNC writer: SIGKILL
+    landing mid-background-write (inside the writer thread's serialize,
+    partial tmp on disk) publishes nothing — latest_valid_step stays
+    monotone at 2 — and a fresh process resumes to the straight run's
+    exact state."""
+    import glob
+    import os
+    import signal
+    import time
+
+    from fps_tpu.core.checkpoint import Checkpointer
+
+    ckdir = str(tmp_path / "roll")
+    straight = str(tmp_path / "straight.npz")
+    resumed = str(tmp_path / "resumed.npz")
+
+    r = _run_kill_worker("straight", ckdir, straight)
+    assert r.returncode == 0, r.stdout + r.stderr
+    v = _run_kill_worker("victim-async-midwrite", ckdir, "-")
+    assert v.returncode == -signal.SIGKILL, v.stdout + v.stderr
+
+    # Nothing torn was ever published: steps 1/2 intact and verified,
+    # step 3 only exists as tmp litter (the kill-site evidence).
+    ck = Checkpointer.__new__(Checkpointer)  # skip the sweeping __init__
+    ck.dir, ck.keep = ckdir, 2
+    assert ck.steps() == [1, 2]
+    assert ck.latest_valid_step() == 2
+    assert glob.glob(ckdir + "/*.tmp.npz"), "expected the torn tmp file"
+
+    # Age the leftover past the live-writer grace window, then resume.
+    past = time.time() - 2 * Checkpointer.TMP_SWEEP_AGE_S
+    for p in glob.glob(ckdir + "/*.tmp.npz"):
+        os.utime(p, (past, past))
+    r2 = _run_kill_worker("resume-any", ckdir, resumed)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+    a, b = np.load(straight), np.load(resumed)
+    np.testing.assert_array_equal(a["item_factors"], b["item_factors"])
+    np.testing.assert_array_equal(a["user_factors"], b["user_factors"])
+
+
 def test_sigkill_and_fresh_process_resume(tmp_path):
     """END-TO-END crash recovery: a training process is SIGKILLed mid-run
     (epoch 3 trained, not yet checkpointed), and a FRESH OS process

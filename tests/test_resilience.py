@@ -322,6 +322,222 @@ def test_rollback_run_indexed_epochs(devices8):
 
 
 # ---------------------------------------------------------------------------
+# Worker-LOCAL guard coverage (ISSUE 3: the MF-style mask-mode gap).
+# ---------------------------------------------------------------------------
+
+def _mf_poisoned(devices8):
+    """(mesh, cfg, poisoned chunk list, clean chunk list) for the standard
+    tiny MF workload with NaN ratings planted in chunk 1 — the poison that
+    reaches the LOCAL user factors, not just the item pushes."""
+    from fps_tpu.core.ingest import epoch_chunks
+    from fps_tpu.models.matrix_factorization import MFConfig
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    mesh = make_ps_mesh(num_shards=4, num_data=2)
+    W = num_workers_of(mesh)
+    data = synthetic_ratings(57, 31, 2000, seed=0)
+    cfg = MFConfig(num_users=57, num_items=31, rank=4, learning_rate=0.1)
+    clean = list(epoch_chunks(data, num_workers=W, local_batch=8,
+                              steps_per_chunk=4, route_key="user", seed=0))
+    poisoned = list(chaos.poison_chunks(iter(clean), chunk_index=1,
+                                        column="rating", kind="nan",
+                                        frac=0.5, seed=1))
+    return mesh, cfg, poisoned, clean
+
+
+def _run_mf(mesh, cfg, chunks, guard):
+    from fps_tpu.models.matrix_factorization import online_mf
+
+    trainer, store = online_mf(mesh, cfg, guard=guard)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    tables, ls, m = trainer.fit_stream(tables, ls, chunks, jax.random.key(1))
+    return store, np.asarray(ls), m
+
+
+def test_local_guard_masks_poisoned_local_state(devices8):
+    """ISSUE acceptance: a poisoned MF batch under guard='mask' WITHOUT
+    the local tier still NaNs the worker-local user factors (the
+    documented gap — negative control); with ``local=True`` the local
+    rows stay finite and the 'local_state' health entry counts them."""
+    mesh, cfg, poisoned, _ = _mf_poisoned(devices8)
+
+    # Negative control: push masking alone leaves the local plane exposed.
+    store, ls, _ = _run_mf(mesh, cfg, poisoned, GuardConfig(mode="mask"))
+    assert np.all(np.isfinite(store.dump_model("item_factors")[1]))
+    assert not np.all(np.isfinite(ls)), "expected the documented local gap"
+
+    store, ls, m = _run_mf(mesh, cfg, poisoned,
+                           GuardConfig(mode="mask", local=True))
+    assert np.all(np.isfinite(ls))
+    assert np.all(np.isfinite(store.dump_model("item_factors")[1]))
+    nf = _health_sum(m, "local_state", "nonfinite")
+    mk = _health_sum(m, "local_state", "masked")
+    assert nf > 0 and mk > 0
+    # The push-plane counters still fire independently.
+    assert _health_sum(m, "item_factors", "nonfinite") > 0
+
+
+def test_local_guard_observe_counts_without_touching_state(devices8):
+    """local + observe: the update stream (both planes) stays
+    byte-identical to a plain observe run; only the counters differ."""
+    mesh, cfg, poisoned, _ = _mf_poisoned(devices8)
+    store_a, ls_a, m_a = _run_mf(mesh, cfg, poisoned,
+                                 GuardConfig(mode="observe", local=True))
+    store_b, ls_b, _ = _run_mf(mesh, cfg, poisoned,
+                               GuardConfig(mode="observe"))
+    np.testing.assert_array_equal(ls_a, ls_b)
+    np.testing.assert_array_equal(store_a.dump_model("item_factors")[1],
+                                  store_b.dump_model("item_factors")[1])
+    assert _health_sum(m_a, "local_state", "nonfinite") > 0
+    assert _health_sum(m_a, "local_state", "masked") == 0
+
+
+def test_local_guard_free_when_no_local_state(devices8):
+    """A worker with no float local state (the pusher's empty tuple)
+    compiles the IDENTICAL program with local on or off — the tier only
+    costs where there is a local plane to guard, and no phantom
+    'local_state' health entry appears."""
+    from fps_tpu.parallel.mesh import host_to_sharded, key_to_replicated
+
+    def lowered_text(guard):
+        mesh, store, trainer = _pusher_trainer(devices8, guard)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        chunk = {"id": np.zeros((1, 4), np.int32),
+                 "val": np.zeros((1, 4, 2), np.float32)}
+        sharding = trainer._batch_sharding_for("sync")
+        batches = jax.tree.map(lambda x: host_to_sharded(x, sharding), chunk)
+        key = key_to_replicated(jax.random.key(1), mesh)
+        return trainer._get_compiled("sync").lower(
+            tables, ls, batches, key).as_text()
+
+    assert (lowered_text(GuardConfig(mode="mask")) ==
+            lowered_text(GuardConfig(mode="mask", local=True)))
+
+    _, _, trainer = _pusher_trainer(
+        devices8, GuardConfig(mode="mask", local=True))
+    tables, ls = trainer.init_state(jax.random.key(0))
+    _, _, m = trainer.run_chunk(
+        tables, ls,
+        {"id": np.zeros((1, 4), np.int32),
+         "val": np.zeros((1, 4, 2), np.float32)},
+        jax.random.key(1),
+    )
+    assert "local_state" not in m["health"]
+
+
+def test_guard_local_state_unit_semantics():
+    """Direct guard_local_state semantics: row-exact nonfinite + norm
+    tiers, revert-to-old masking, non-float leaves untouched, empty tree
+    reports None (no phantom health entry)."""
+    from fps_tpu.core.resilience import guard_local_state
+
+    old = {"f": jnp_arr([[0.0, 0.0], [1.0, 1.0], [2.0, 2.0]]),
+           "i": np.array([1, 2, 3], np.int32)}
+    new = {"f": jnp_arr([[np.nan, 0.0], [1.0, 1.5], [200.0, 2.0]]),
+           "i": np.array([4, 5, 6], np.int32)}
+    guard = GuardConfig(mode="mask", norm_limit=10.0, local=True)
+    guarded, counts = guard_local_state(old, new, guard)
+    # Row 0: nonfinite -> reverted; row 2: delta norm 198 > 10 -> reverted.
+    np.testing.assert_array_equal(
+        np.asarray(guarded["f"]),
+        np.array([[0.0, 0.0], [1.0, 1.5], [2.0, 2.0]], np.float32))
+    np.testing.assert_array_equal(np.asarray(guarded["i"]), new["i"])
+    assert int(counts["nonfinite"]) == 1
+    assert int(counts["norm"]) == 1
+    assert int(counts["masked"]) == 2
+
+    # Observe: counts only, state passes through untouched.
+    observed, counts = guard_local_state(
+        old, new, GuardConfig(mode="observe", norm_limit=10.0, local=True))
+    np.testing.assert_array_equal(np.asarray(observed["f"]),
+                                  np.asarray(new["f"]))
+    assert int(counts["masked"]) == 0
+
+    # No inexact leaves -> (new, None).
+    same, counts = guard_local_state((), (), guard)
+    assert same == () and counts is None
+
+
+def jnp_arr(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.asarray(x, np.float32))
+
+
+def test_local_guard_reserved_table_name_rejected(devices8):
+    """A store table literally named 'local_state' + guard.local would
+    collide on the health channel: rejected at Trainer construction."""
+    mesh = make_ps_mesh(num_shards=1, num_data=1, devices=devices8[:1])
+    store = ParamStore(mesh, [TableSpec("local_state", 16, 2).zeros_init()])
+    with pytest.raises(ValueError, match="local_state"):
+        Trainer(mesh, store, _Pusher(),
+                config=TrainerConfig(guard=GuardConfig(local=True)))
+
+
+# ---------------------------------------------------------------------------
+# Supervisor-carried quarantine: RollbackPolicy.preset.
+# ---------------------------------------------------------------------------
+
+def test_preset_skip_without_guard_fit_stream(devices8):
+    """A preset-only policy (no guard) is legal and skips exactly the
+    preset chunks without dispatching them — bit-identical to running
+    only the surviving chunks under their original stream keys."""
+    mesh, cfg, poisoned, clean = _mf_poisoned(devices8)
+    from fps_tpu.models.matrix_factorization import online_mf
+
+    policy = RollbackPolicy(preset=[1])
+    trainer, store = online_mf(mesh, cfg)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    tables, ls, m = trainer.fit_stream(
+        tables, ls, poisoned, jax.random.key(1), rollback=policy)
+    assert policy.skipped == [1]
+    assert policy.quarantined == []  # nothing health-based happened
+    assert len(m) == len(clean) - 1  # no metrics entry for the skip
+    assert np.all(np.isfinite(np.asarray(ls)))
+
+    trainer2, store2 = online_mf(mesh, cfg)
+    tables2, ls2 = trainer2.init_state(jax.random.key(0))
+    for i in [0] + list(range(2, len(clean))):
+        tables2, ls2, _ = trainer2.run_chunk(
+            tables2, ls2, clean[i], jax.random.fold_in(jax.random.key(1), i))
+    np.testing.assert_array_equal(np.asarray(ls), np.asarray(ls2))
+    np.testing.assert_array_equal(store.dump_model("item_factors")[1],
+                                  store2.dump_model("item_factors")[1])
+
+
+def test_preset_skip_run_indexed_epoch(devices8):
+    """run_indexed honors the preset at epoch granularity: epoch 0
+    skipped == starting the same run at epoch 1."""
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    data = synthetic_ratings(57, 31, 800, seed=0)
+    cfg = MFConfig(num_users=57, num_items=31, rank=4, learning_rate=0.1)
+
+    def fresh():
+        trainer, store = online_mf(mesh, cfg, donate=False)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        plan = DeviceEpochPlan(DeviceDataset(mesh, data), num_workers=W,
+                               local_batch=32, route_key="user", seed=5)
+        return trainer, store, tables, ls, plan
+
+    trainer, store, tables, ls, plan = fresh()
+    policy = RollbackPolicy(preset=[0])
+    trainer.run_indexed(tables, ls, plan, jax.random.key(1), epochs=2,
+                        rollback=policy)
+    assert policy.skipped == [0]
+    got = store.dump_model("item_factors")[1].copy()
+
+    trainer2, store2, tables2, ls2, plan2 = fresh()
+    trainer2.run_indexed(tables2, ls2, plan2, jax.random.key(1), epochs=1,
+                         start_epoch=1)
+    np.testing.assert_array_equal(got, store2.dump_model("item_factors")[1])
+
+
+# ---------------------------------------------------------------------------
 # Health channel under user-supplied metrics reductions.
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,312 @@
+"""External run supervisor (fps_tpu.supervise + tools/supervise.py).
+
+Tier-1 keeps the supervisor machinery honest at stub speed — a jax-free
+child (``tests/_supervised_stub.py``) that beats, checkpoints its
+progress, and misbehaves on demand, driven through the REAL CLI in a
+subprocess. The slow marker covers the full-stack version: a real jax
+training child (``fps_tpu.testing.supervised_demo``) SIGSTOP-wedged
+mid-run must be deadline-aborted, restarted with backoff, and reproduce
+the straight run bit-for-bit from ``latest_valid_step``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_STUB = os.path.join(_ROOT, "tests", "_supervised_stub.py")
+_CLI = os.path.join(_ROOT, "tools", "supervise.py")
+
+
+def _run_supervised(state_dir, child_cmd, *flags, timeout=120):
+    """tools/supervise.py round trip; returns (rc, digest dict)."""
+    r = subprocess.run(
+        [sys.executable, _CLI, "--state-dir", str(state_dir), *flags,
+         "--", *child_cmd],
+        capture_output=True, text=True, timeout=timeout, cwd=_ROOT,
+    )
+    assert r.stdout.strip(), r.stderr[-2000:]
+    return r.returncode, json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def _stub_cmd(workdir, *extra):
+    return [sys.executable, _STUB, "--dir", str(workdir), "--chunks", "8",
+            "--chunk-s", "0.05", *extra]
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 smoke: wedge -> deadline-abort -> backoff restart -> resume.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("wedge_mode", ["sigstop", "sleep"])
+def test_wedged_child_aborted_and_resumed(tmp_path, wedge_mode):
+    """A child that stops beating (SIGSTOP'd whole process, or a host
+    loop asleep forever) is deadline-aborted via the TERM->KILL
+    escalation, restarted after backoff, and completes from its persisted
+    progress with at most one chunk re-run."""
+    rc, digest = _run_supervised(
+        tmp_path / "state",
+        _stub_cmd(tmp_path / "work", "--wedge-at", "3",
+                  "--wedge-mode", wedge_mode),
+        "--stall-timeout-s", "1.0", "--startup-grace-s", "10",
+        "--term-grace-s", "0.5", "--backoff-base-s", "0.1",
+        "--max-restarts", "2", "--poll-s", "0.1",
+    )
+    assert rc == 0 and digest["success"], digest
+    assert digest["deadline_aborts"] == 1
+    assert digest["restarts"] == 1
+    assert digest["quarantined"] == []  # a wedge-once is not poison
+    with open(tmp_path / "work" / "result.json", encoding="utf-8") as f:
+        result = json.load(f)
+    assert result["done"] == 8
+    assert result["attempt"] == 1  # finished by the restarted attempt
+    # Resumed exactly at the wedged chunk: nothing before it re-ran.
+    assert result["ran"] == [3, 4, 5, 6, 7]
+    # The journal narrates the abort for obs_report.
+    events = [json.loads(line)["event"]
+              for line in open(tmp_path / "state" /
+                               "journal-supervisor.jsonl")]
+    for expected in ("supervisor_start", "deadline_abort",
+                     "supervisor_restart", "supervised_run_end"):
+        assert expected in events, events
+
+
+def test_deterministic_crash_is_quarantined(tmp_path):
+    """A child that exits nonzero at the same chunk on consecutive
+    attempts has that chunk quarantined (persisted, exported via the
+    state file) — the crash loop breaks and the run completes without
+    the poisoned chunk."""
+    rc, digest = _run_supervised(
+        tmp_path / "state",
+        _stub_cmd(tmp_path / "work", "--crash-at", "2"),
+        "--stall-timeout-s", "5", "--backoff-base-s", "0.05",
+        "--max-restarts", "3", "--poll-s", "0.05",
+    )
+    assert rc == 0 and digest["success"], digest
+    assert digest["quarantined"] == [2]
+    assert digest["restarts"] == 2  # crash, crash+quarantine, success
+    with open(tmp_path / "work" / "result.json", encoding="utf-8") as f:
+        result = json.load(f)
+    assert 2 not in result["ran"]  # the poison chunk was skipped
+    state = json.load(open(tmp_path / "state" / "supervisor_state.json"))
+    assert state["quarantined"] == [2]
+    assert [a["rc"] for a in state["attempts"]] == [3, 3, 0]
+
+
+def test_wall_deadline_gives_up(tmp_path):
+    """An unrecoverable hang (wedges every attempt; quarantine disabled
+    so nothing can be skipped around) exhausts the wall budget: the
+    supervisor stops restarting and reports failure."""
+    rc, digest = _run_supervised(
+        tmp_path / "state",
+        _stub_cmd(tmp_path / "work", "--wedge-at", "0", "--wedge-always"),
+        "--stall-timeout-s", "0.7", "--wall-deadline-s", "4",
+        "--term-grace-s", "0.3", "--backoff-base-s", "0.1",
+        "--max-restarts", "10", "--poll-s", "0.1",
+        "--quarantine-after", "99",
+    )
+    assert rc == 1 and not digest["success"]
+    assert digest["reason"] == "wall_deadline"
+    assert digest["wall_s"] < 15  # actually bounded, with abort slack
+
+
+def test_aborted_attempt_exiting_zero_is_not_success(tmp_path):
+    """A SIGTERM-trapping child exits 0 from its graceful-shutdown
+    handler when the stall abort fires — rc alone must not count as
+    success: the supervisor still restarts, and the run only succeeds
+    when an attempt finishes WITHOUT being aborted."""
+    rc, digest = _run_supervised(
+        tmp_path / "state",
+        _stub_cmd(tmp_path / "work", "--wedge-at", "3",
+                  "--wedge-mode", "sleep", "--trap-term"),
+        "--stall-timeout-s", "1.0", "--startup-grace-s", "10",
+        "--term-grace-s", "2", "--backoff-base-s", "0.1",
+        "--max-restarts", "2", "--poll-s", "0.1",
+    )
+    assert rc == 0 and digest["success"], digest
+    assert digest["deadline_aborts"] == 1
+    assert digest["restarts"] == 1  # the rc=0 aborted attempt restarted
+    state = json.load(open(tmp_path / "state" / "supervisor_state.json"))
+    assert state["attempts"][0]["rc"] == 0  # the graceful-exit trap fired
+    assert state["attempts"][0]["aborted"] == "stall"
+    assert os.path.exists(tmp_path / "work" / "result.json")
+
+
+def test_retry_budget_exhaustion(tmp_path):
+    """max-restarts bounds the crash loop when quarantine can't help
+    (child dies before any beat => no index to quarantine)."""
+    rc, digest = _run_supervised(
+        tmp_path / "state",
+        [sys.executable, "-c", "import sys; sys.exit(7)"],
+        "--stall-timeout-s", "5", "--backoff-base-s", "0.05",
+        "--max-restarts", "2", "--poll-s", "0.05",
+    )
+    assert rc == 1 and not digest["success"]
+    assert digest["reason"] == "retry_budget_exhausted"
+    assert digest["attempts"] == 3  # first launch + 2 restarts
+    assert digest["quarantined"] == []
+
+
+# ---------------------------------------------------------------------------
+# Library pieces (no subprocess).
+# ---------------------------------------------------------------------------
+
+def test_env_contract_mirrored():
+    """supervisor.py mirrors child.py's env-var names (it cannot import
+    them: the supervisor must load by file path with zero fps_tpu
+    imports). This is the tripwire for the mirror drifting."""
+    from fps_tpu.supervise import child, supervisor
+
+    assert supervisor.HEARTBEAT_ENV == child.HEARTBEAT_ENV
+    assert supervisor.STATE_ENV == child.STATE_ENV
+    assert supervisor.ATTEMPT_ENV == child.ATTEMPT_ENV
+
+
+def test_supervisor_module_loads_without_fps_tpu(tmp_path):
+    """The jax-free contract, enforced: loading supervisor.py by file
+    path in a bare interpreter must import neither fps_tpu nor jax."""
+    code = (
+        "import importlib.util, sys\n"
+        f"path = {os.path.join(_ROOT, 'fps_tpu', 'supervise', 'supervisor.py')!r}\n"
+        "spec = importlib.util.spec_from_file_location('_sup', path)\n"
+        "mod = importlib.util.module_from_spec(spec)\n"
+        "sys.modules[spec.name] = mod\n"
+        "spec.loader.exec_module(mod)\n"
+        "mod.SupervisorConfig(stall_timeout_s=1.0)\n"
+        "assert not any(m == 'jax' or m.startswith('jax.')"
+        " for m in sys.modules), 'jax imported'\n"
+        "assert not any(m == 'fps_tpu' or m.startswith('fps_tpu.')"
+        " for m in sys.modules), 'fps_tpu imported'\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=60)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_backoff_schedule_and_validation():
+    from fps_tpu.supervise import SupervisorConfig
+
+    cfg = SupervisorConfig(backoff_base_s=1.0, backoff_factor=2.0,
+                           backoff_max_s=5.0)
+    assert [cfg.backoff_s(i) for i in range(5)] == [1.0, 2.0, 4.0, 5.0, 5.0]
+    with pytest.raises(ValueError):
+        SupervisorConfig(stall_timeout_s=0)
+    with pytest.raises(ValueError):
+        SupervisorConfig(max_restarts=-1)
+    with pytest.raises(ValueError):
+        SupervisorConfig(quarantine_after=0)
+
+
+def test_heartbeat_beat_and_sink(tmp_path):
+    """Heartbeat writes an atomic JSON beacon; HeartbeatSink beats on
+    run_start/chunk/epoch events only — carrying the index ABOUT TO BE
+    ATTEMPTED (chunk i done -> beat i+1), so a mid-chunk death
+    attributes to the doomed chunk."""
+    from fps_tpu.supervise import Heartbeat, HeartbeatSink
+
+    hb = Heartbeat(str(tmp_path / "hb.json"))
+    hb.beat(index=4, extra="x")
+    rec = json.load(open(tmp_path / "hb.json"))
+    assert rec["index"] == 4 and rec["extra"] == "x" and rec["pid"]
+
+    sink = HeartbeatSink(hb)
+    sink.write({"kind": "metric", "name": "driver.chunks"})  # ignored
+    assert json.load(open(tmp_path / "hb.json"))["index"] == 4
+    sink.write({"kind": "event", "event": "chunk", "index": 7})
+    assert json.load(open(tmp_path / "hb.json"))["index"] == 8  # next up
+    sink.write({"kind": "event", "event": "checkpoint_saved", "index": 9})
+    assert json.load(open(tmp_path / "hb.json"))["index"] == 8  # not a beat
+    sink.write({"kind": "event", "event": "run_start"})
+    assert json.load(open(tmp_path / "hb.json"))["index"] is None
+
+    # Heartbeat.on_chunk follows the same beat-before-work convention.
+    hb.on_chunk()(3, {})
+    assert json.load(open(tmp_path / "hb.json"))["index"] == 4
+
+
+def test_quarantine_needs_consecutive_failures(tmp_path):
+    """A success between two same-index transient deaths resets the
+    quarantine evidence — only CONSECUTIVE trailing failures quarantine
+    (the attempt history persists across supervisor invocations, so two
+    coincidental preemptions in different runs must not poison a healthy
+    chunk)."""
+    from fps_tpu.supervise import RunSupervisor, SupervisorConfig
+
+    sup = RunSupervisor(["true"], state_dir=str(tmp_path),
+                        config=SupervisorConfig(quarantine_after=2))
+    fail = {"rc": 1, "last_index": 5}
+    sup.state["attempts"] = [dict(fail), {"rc": 0, "last_index": 9},
+                             dict(fail)]
+    sup._maybe_quarantine(dict(fail))
+    assert sup.state["quarantined"] == []  # success broke the streak
+    sup.state["attempts"].append(dict(fail))  # now two consecutive
+    sup._maybe_quarantine(dict(fail))
+    assert sup.state["quarantined"] == [5]
+    # Deaths before any indexed beat never quarantine.
+    sup.state["quarantined"] = []
+    sup.state["attempts"] = [{"rc": 1, "last_index": None}] * 3
+    sup._maybe_quarantine({"rc": 1, "last_index": None})
+    assert sup.state["quarantined"] == []
+    # Deadline-aborted attempts are environment, not poison: stalls at
+    # the same index never quarantine (healthy data must not be dropped).
+    stall = {"rc": -9, "last_index": 4, "aborted": "stall"}
+    sup.state["attempts"] = [dict(stall)] * 3
+    sup._maybe_quarantine(dict(stall))
+    assert sup.state["quarantined"] == []
+    # ...and an interleaved stall neither counts nor resets a CRASH
+    # streak: crash, stall, crash at the same index still quarantines.
+    sup.state["attempts"] = [dict(fail), dict(stall), dict(fail)]
+    sup._maybe_quarantine(dict(fail))
+    assert sup.state["quarantined"] == [5]
+
+
+def test_quarantine_round_trip_through_env(tmp_path, monkeypatch):
+    """child.quarantined_from_env reads what the supervisor persists."""
+    from fps_tpu.supervise import child, supervisor
+
+    state_path = tmp_path / "supervisor_state.json"
+    state_path.write_text(json.dumps({"quarantined": [3, 5]}))
+    monkeypatch.setenv(child.STATE_ENV, str(state_path))
+    assert child.quarantined_from_env() == frozenset({3, 5})
+    monkeypatch.setenv(child.STATE_ENV, str(tmp_path / "missing.json"))
+    assert child.quarantined_from_env() == frozenset()
+    del supervisor  # only imported for the mirrored-constant neighbors
+
+
+def test_heartbeat_only_recorder_via_common(tmp_path, monkeypatch):
+    """examples/common.attach_obs: a supervised run without --obs-dir
+    still gets a (heartbeat-only) recorder so chunk events beat."""
+    import argparse
+
+    from fps_tpu.examples import common
+    from fps_tpu.supervise import child
+
+    hb_path = tmp_path / "hb.json"
+    monkeypatch.setenv(child.HEARTBEAT_ENV, str(hb_path))
+    args = argparse.Namespace(obs_dir=None, obs_watchdog_s=None,
+                              heartbeat=None)
+    rec = common.attach_obs(args)
+    assert rec is not None
+    rec.event("chunk", index=11)
+    assert json.load(open(hb_path))["index"] == 12  # beat-before-work
+
+
+# ---------------------------------------------------------------------------
+# Full stack (slow): real jax child, SIGSTOP wedge, bit-identical resume.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_supervised_logreg_resumes_bit_identical(tmp_path):
+    """The ISSUE acceptance scenario end-to-end: a SIGSTOP-wedged real
+    training run is deadline-aborted, restarted with backoff, resumes
+    from latest_valid_step (exactly one chunk of lost work, replayed),
+    selects no corrupt snapshot, and lands on final weights BIT-IDENTICAL
+    to an unsupervised straight run. One shared implementation with
+    tools/chaos_sweep.py's ``supervised`` scenario."""
+    from fps_tpu.testing.supervised_demo import run_supervised_scenario
+
+    ok, detail = run_supervised_scenario(str(tmp_path))
+    assert ok, detail

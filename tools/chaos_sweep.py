@@ -17,6 +17,12 @@ fps_tpu.testing.workloads):
   snapshots: survives iff restore falls back to the older one.
 * ``tmp_sweep``                — stale mid-write tmp file: survives iff a
   fresh Checkpointer sweeps it and restores normally.
+* ``supervised``               — a SIGSTOP-wedged child under
+  ``tools/supervise.py``: survives iff the supervisor deadline-aborts
+  (SIGTERM→SIGKILL), restarts with backoff, the resumed run restores
+  ``latest_valid_step`` (at most one chunk of lost work), no corrupt
+  snapshot is ever selected, and the final weights are BIT-IDENTICAL to
+  an unsupervised straight run.
 
 Run (CPU mesh, like the test suite):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -121,6 +127,18 @@ def ckpt_scenario(tmpdir, mesh, chunks, mode):
     return ok and step == 1 and _finite(store)
 
 
+def supervised_scenario(tmpdir):
+    """End-to-end supervisor survival: wedge a real training child with
+    SIGSTOP mid-run; the supervisor must abort + restart it and the
+    resumed run must reproduce the straight run bit-for-bit. One shared
+    implementation with the slow test in tests/test_supervise.py
+    (fps_tpu.testing.supervised_demo.run_supervised_scenario) so the two
+    cannot drift."""
+    from fps_tpu.testing.supervised_demo import run_supervised_scenario
+
+    return run_supervised_scenario(tmpdir)
+
+
 def main():
     import tempfile
 
@@ -144,6 +162,8 @@ def main():
         with tempfile.TemporaryDirectory() as d:
             results[f"ckpt_{mode}" if mode != "tmp_sweep" else mode] = (
                 ckpt_scenario(d, mesh, chunks, mode))
+    with tempfile.TemporaryDirectory() as d:
+        results["supervised"], detail["supervised"] = supervised_scenario(d)
 
     digest = {
         "chaos_sweep": results,

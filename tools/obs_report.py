@@ -28,15 +28,24 @@ import json
 import os
 import sys
 
-# Event types surfaced verbatim (bounded lists) in the digest.
+# Event types surfaced verbatim (bounded lists) in the digest. The last
+# five come from the run SUPERVISOR's journal (journal-supervisor.jsonl,
+# written by tools/supervise.py into its --state-dir) — point this tool
+# at a dir holding both and the digest narrates the whole supervised run.
 _INCIDENT_EVENTS = (
     "rollback",
+    "preset_skip",
     "stall",
     "stall_recovered",
     "guard_escalated",
     "health_abort",
     "poisoned_stream_abort",
     "checkpoint_fallback",
+    "deadline_abort",
+    "supervisor_restart",
+    "chunk_quarantined",
+    "supervisor_give_up",
+    "supervised_run_end",
 )
 
 # Digest keys that must always be present (the smoke test asserts these —
@@ -65,8 +74,10 @@ def _read_jsonl(path: str):
 def render_digest(obs_dir: str) -> dict:
     """Digest dict from an obs directory (see module docstring)."""
     event_files = sorted(glob.glob(os.path.join(obs_dir, "events-p*.jsonl")))
+    # journal-* (not journal-p*): also picks up journal-supervisor.jsonl
+    # when the supervisor's --state-dir is (or is joined into) this dir.
     journal_files = sorted(
-        glob.glob(os.path.join(obs_dir, "journal-p*.jsonl")))
+        glob.glob(os.path.join(obs_dir, "journal-*.jsonl")))
     if not event_files and not journal_files:
         raise FileNotFoundError(
             f"no events-p*.jsonl / journal-p*.jsonl under {obs_dir!r} — "
@@ -174,10 +185,14 @@ def render_digest(obs_dir: str) -> dict:
         "poisoned_chunks": int(counters.get("health.poisoned_chunks", 0)),
         "incidents": {k: v for k, v in incidents.items() if v},
         "checkpoint_saves": int(counters.get("checkpoint.saves", 0)),
+        # Async writer: enqueued > saved means a write was still in
+        # flight at the last flush — saves are the TRUE durability points.
+        "checkpoint_enqueues": int(counters.get("checkpoint.enqueues", 0)),
         "checkpoint_fallbacks": int(
             counters.get("checkpoint.fallbacks", 0)),
         "watchdog_stalls": int(counters.get("watchdog.stalls", 0)),
         "rollbacks": int(counters.get("rollback.quarantined", 0)),
+        "preset_skips": int(counters.get("rollback.preset_skipped", 0)),
         "quarantined": sorted(q for q in quarantined if q is not None),
         # Complete only when EVERY started run ended — a dir holding a
         # finished first run and a killed second run is not complete.

@@ -1,0 +1,128 @@
+"""Supervise a training command: deadline-abort + retry/backoff + quarantine.
+
+The CLI over :class:`fps_tpu.supervise.RunSupervisor` — run a training
+child under an external supervisor that aborts it when its heartbeat /
+obs journal stalls (SIGTERM → SIGKILL on the process group), restarts it
+with exponential backoff from ``latest_valid_step``, and quarantines
+chunk/epoch indices that kill consecutive attempts (persisted in
+``supervisor_state.json`` under ``--state-dir`` and exported to the child
+via the ``FPS_TPU_SUPERVISOR_STATE`` env var).
+
+The child signals progress by either
+
+* running with ``--heartbeat``/``FPS_TPU_HEARTBEAT`` support (every
+  example CLI beats per chunk when supervised — ``fps_tpu.examples.common``
+  wires it automatically), or
+* writing an obs run journal that the supervisor watches via ``--watch``
+  (``--watch 'OBSDIR/journal-p*.jsonl'`` — the per-boundary flushes count
+  as life).
+
+Usage:
+  python tools/supervise.py --state-dir CKPT_DIR [policy flags] -- CMD...
+
+Prints the one-line JSON digest (attempts, restarts, deadline aborts,
+quarantined indices, success) and exits 0 only on child success.
+
+No jax import: the supervisor module is loaded by file path, so this
+process stays a few-MB pure-python babysitter even when the child owns
+every TPU chip on the host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_supervisor_module():
+    """Load fps_tpu/supervise/supervisor.py WITHOUT importing the fps_tpu
+    package (whose __init__ pulls jax — the supervisor must never drag a
+    TPU runtime into this process; same pattern as tests/conftest.py)."""
+    path = os.path.join(_ROOT, "fps_tpu", "supervise", "supervisor.py")
+    spec = importlib.util.spec_from_file_location("_fps_supervisor", path)
+    mod = importlib.util.module_from_spec(spec)
+    # Registered BEFORE exec: dataclass creation resolves its module via
+    # sys.modules on 3.10.
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Run a training command under the fps_tpu deadline-abort "
+                    "supervisor",
+        usage="%(prog)s [flags] -- CMD [ARG...]",
+    )
+    ap.add_argument("--state-dir", required=True,
+                    help="directory for supervisor_state.json, heartbeat, "
+                         "supervisor journal, and per-attempt child logs "
+                         "(conventionally the checkpoint dir: quarantine "
+                         "state lives next to the snapshots it protects)")
+    ap.add_argument("--stall-timeout-s", type=float, default=120.0,
+                    help="liveness deadline between progress signals")
+    ap.add_argument("--startup-grace-s", type=float, default=None,
+                    help="deadline for the FIRST signal of each attempt "
+                         "(covers interpreter + jax import + XLA compile; "
+                         "default: --stall-timeout-s)")
+    ap.add_argument("--wall-deadline-s", type=float, default=None,
+                    help="whole-run budget across attempts and backoffs")
+    ap.add_argument("--max-restarts", type=int, default=3,
+                    help="retry budget (the first launch is free)")
+    ap.add_argument("--backoff-base-s", type=float, default=1.0)
+    ap.add_argument("--backoff-factor", type=float, default=2.0)
+    ap.add_argument("--backoff-max-s", type=float, default=60.0)
+    ap.add_argument("--term-grace-s", type=float, default=5.0,
+                    help="seconds between SIGTERM and SIGKILL on abort")
+    ap.add_argument("--poll-s", type=float, default=0.25)
+    ap.add_argument("--quarantine-after", type=int, default=2,
+                    help="consecutive same-index failures before that "
+                         "chunk/epoch index is quarantined")
+    ap.add_argument("--watch", action="append", default=[],
+                    metavar="GLOB",
+                    help="file glob whose growth also counts as liveness "
+                         "(repeatable; e.g. 'OBSDIR/journal-p*.jsonl')")
+    ap.add_argument("--pretty", action="store_true",
+                    help="indent the digest JSON")
+    # Split at the first literal "--" BEFORE parsing: parse_known_args
+    # would route a typo'd supervisor flag into the child command and fail
+    # later with a raw Popen FileNotFoundError instead of a usage error.
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" in argv:
+        cut = argv.index("--")
+        argv, cmd = argv[:cut], argv[cut + 1:]
+    else:
+        cmd = []
+    args = ap.parse_args(argv)
+    if not cmd:
+        ap.error("no child command given (append it after --)")
+
+    sup_mod = _load_supervisor_module()
+    config = sup_mod.SupervisorConfig(
+        stall_timeout_s=args.stall_timeout_s,
+        startup_grace_s=args.startup_grace_s,
+        wall_deadline_s=args.wall_deadline_s,
+        max_restarts=args.max_restarts,
+        backoff_base_s=args.backoff_base_s,
+        backoff_factor=args.backoff_factor,
+        backoff_max_s=args.backoff_max_s,
+        term_grace_s=args.term_grace_s,
+        poll_interval_s=args.poll_s,
+        quarantine_after=args.quarantine_after,
+    )
+    supervisor = sup_mod.RunSupervisor(
+        cmd, state_dir=args.state_dir, config=config,
+        watch=tuple(args.watch),
+    )
+    digest = supervisor.run()
+    print(json.dumps(digest, indent=2 if args.pretty else None), flush=True)
+    return 0 if digest["success"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
