@@ -38,6 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from fps_tpu import ops
 from fps_tpu.core import resilience
 from fps_tpu.core.api import ServerLogic, WorkerLogic
+from fps_tpu.core.prefetch import ChunkPrefetcher, PlacedChunk
 from fps_tpu.core.resilience import GuardConfig, RollbackPolicy
 from fps_tpu.core.store import ParamStore, id_to_phys, pull, pull_local, push
 from fps_tpu.obs.health import (
@@ -73,6 +74,30 @@ def _phase(timer: PhaseTimer | None, name: str):
 def _watch(watchdog: StepWatchdog | None, what: str, index: int):
     return (watchdog.watch(what, index) if watchdog is not None
             else contextlib.nullcontext())
+
+
+def _find_heartbeat(rec):
+    """The supervised-run progress beacon riding ``rec``'s sinks, if any.
+
+    Duck-typed on the sink's ``heartbeat`` attribute (the
+    ``fps_tpu.supervise.child.HeartbeatSink`` shape) so the driver never
+    imports the supervise package. With a beacon in hand the drivers beat
+    at SUB-chunk boundaries (prefetch wait / dispatch) with a ``phase``
+    field, so a death between chunk boundaries attributes to the right
+    sub-phase in the supervisor's quarantine evidence."""
+    for s in getattr(rec, "sinks", ()) if rec is not None else ():
+        hb = getattr(s, "heartbeat", None)
+        if hb is not None and hasattr(hb, "beat"):
+            return hb
+    return None
+
+
+def _beat(hb, index: int, phase: str) -> None:
+    """Sub-phase liveness beat (no-op without a beacon). Carries the
+    index being worked on — the beat-before-work convention the
+    supervisor's quarantine keys on — plus the sub-phase name."""
+    if hb is not None:
+        hb.beat(index=int(index), phase=phase)
 
 
 def worker_index() -> Array:
@@ -131,6 +156,31 @@ class TrainerConfig:
     # step_tap). Part of the compile-cache key.
     guard: GuardConfig | str | None = None
     donate: bool = True
+    # --- host-pipeline knobs (fps_tpu.core.prefetch; docs/performance.md).
+    # None of these touch the traced program or the compile cache: the
+    # compiled HLO is identical whatever their values (tested).
+    #
+    # Depth of the background prefetch+place pipeline feeding fit_stream:
+    # chunk assembly and host->device placement run up to this many chunks
+    # ahead on a worker thread, so the device never idles waiting on host
+    # ingest. 0 (default) keeps the fully synchronous host loop; numerics
+    # and chunk order are bit-identical either way.
+    prefetch: int = 0
+    # Staleness (in chunks) of the forced host metrics sync that health /
+    # watchdog / rollback consumers require: 0 (default) inspects chunk
+    # i's metrics before dispatching i+1 (today's serial behavior); 1
+    # inspects chunk i-1's metrics WHILE chunk i computes (bounded-
+    # staleness health, the paper's SSP semantics applied to the control
+    # plane). Quarantine under lag restores the pre-(i-1) snapshot and
+    # deterministically recomputes chunk i from it, so lag on/off produce
+    # identical tables and metrics (tested).
+    health_lag: int = 0
+    # Deferred-metrics drain cadence for fit_stream without a per-chunk
+    # syncing consumer: every N chunks the buffered device metrics are
+    # pulled to host so an unbounded stream cannot accumulate device
+    # buffers (was a hardcoded 8). 0 = never drain mid-stream (bounded
+    # streams whose caller wants zero mid-stream syncs).
+    metrics_drain_every: int = 8
     # Upper bound on scan steps per compiled call in run_indexed. A single
     # device program must not run for minutes (the TPU runtime enforces a
     # per-dispatch execution deadline — observed ~45s on tunneled chips,
@@ -228,17 +278,33 @@ class Trainer:
 
         return jax.tree.map(to_host, local_state)
 
-    def _save_checkpoint(self, checkpointer, step: int, local_state) -> None:
+    def _save_checkpoint(self, checkpointer, step: int, local_state, *,
+                         tables=None) -> None:
         """Snapshot tables + local state, with the local state in the
         logic's worker-count-independent export form (default: the raw
-        layout, tagged either way so a mismatched restore fails loudly)."""
-        checkpointer.save(
-            step, self.store,
-            self.logic.export_local_state(
-                self._host_local_state(local_state)
-            ),
-            local_state_format="exported",
-        )
+        layout, tagged either way so a mismatched restore fails loudly).
+
+        ``tables``: optional on-device boundary copies to snapshot from
+        instead of the live store — the overlapped pipeline takes them at
+        the chunk boundary and runs the save after the NEXT dispatch, by
+        which time the live tables already hold a later chunk's state.
+        The store's table view is swapped in for the duration of the dump
+        (single-threaded: only the driver thread touches the store)."""
+        prev = None
+        if tables is not None:
+            prev = self.store.tables
+            self.store.tables = dict(tables)
+        try:
+            checkpointer.save(
+                step, self.store,
+                self.logic.export_local_state(
+                    self._host_local_state(local_state)
+                ),
+                local_state_format="exported",
+            )
+        finally:
+            if prev is not None:
+                self.store.tables = prev
 
     def restore_checkpoint(self, checkpointer, local_state_like, *,
                            step: int | None = None):
@@ -990,6 +1056,7 @@ class Trainer:
         self._check_health(health)
         rec = recorder if recorder is not None else self.recorder
         timer = PhaseTimer(rec) if rec is not None else None
+        hb = _find_heartbeat(rec)
         # Health-based quarantine needs the guard's health channel; a
         # preset-only policy (guard off) must not pay the per-epoch state
         # copy + forced sync that the health path requires.
@@ -1025,6 +1092,7 @@ class Trainer:
                 iargs = plan.epoch_args(e)
                 parts = []
                 restored = None
+                _beat(hb, e, "dispatch")
                 with _watch(watchdog, "epoch", e):
                     for ci in range(n_calls):
                         ckey = key_to_replicated(
@@ -1152,17 +1220,13 @@ class Trainer:
           equal to the number of steps in the chunk (global sums per step).
         """
         mode = "sync" if self.config.sync_every is None else "ssp"
-        sharding = self._batch_sharding_for(mode)
-
-        def place(x):
-            if isinstance(x, jax.Array) and not x.is_fully_addressable:
-                # Device-ingest chunks are already global arrays on the
-                # mesh (multi-controller); leave them where they are.
-                return x
-            return host_to_sharded(x, sharding)
-
         with _phase(timer, "place"):
-            batches = jax.tree.map(place, batches)
+            if isinstance(batches, PlacedChunk):
+                # The prefetch pipeline already ran _place_chunk on its
+                # worker thread — same function, same sharded arrays.
+                batches = batches.batches
+            else:
+                batches = self._place_chunk(batches, mode)
             key = key_to_replicated(key, self.mesh)
         with _phase(timer, "dispatch"):
             tables, local_state, metrics = self._get_compiled(mode)(
@@ -1178,6 +1242,24 @@ class Trainer:
         nlead = 1 if mode == "sync" else 2
         spec = P(*([None] * nlead), WORKER_AXES)
         return NamedSharding(self.mesh, spec)
+
+    def _place_chunk(self, batches, mode: str | None = None):
+        """Place one chunk's batches onto the batch sharding — the
+        host→device upload both the synchronous path (run_chunk) and the
+        background pipeline's worker thread run, so prefetch on/off
+        produces byte-identical device inputs by construction."""
+        if mode is None:
+            mode = "sync" if self.config.sync_every is None else "ssp"
+        sharding = self._batch_sharding_for(mode)
+
+        def place(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                # Device-ingest chunks are already global arrays on the
+                # mesh (multi-controller); leave them where they are.
+                return x
+            return host_to_sharded(x, sharding)
+
+        return jax.tree.map(place, batches)
 
     def fit_stream(
         self,
@@ -1240,26 +1322,203 @@ class Trainer:
         dispatch+sync region — the straggler tripwire. Health and
         watchdog (like ``rollback``) force a per-chunk host metrics sync:
         they must observe values as they happen.
+
+        Host pipeline (``TrainerConfig``, ``docs/performance.md``):
+        ``prefetch=N`` moves chunk assembly + placement onto a background
+        worker running N chunks ahead (:mod:`fps_tpu.core.prefetch`) —
+        numerics, chunk order, and the compiled program are identical;
+        every exit path joins the worker. ``health_lag=1`` makes the
+        forced sync the syncing consumers above require lag-by-one:
+        chunk ``i-1``'s host metrics are inspected while chunk ``i``
+        computes (a quarantined ``i-1`` restores its pre-chunk snapshot
+        and chunk ``i`` is deterministically recomputed from it, so
+        guard/quarantine results match ``health_lag=0`` bit for bit;
+        consumers — and ``on_chunk``/store readers — see state one chunk
+        late). Two lag caveats: a HealthMonitor's observe→mask
+        escalation lands one DISPATCH later than at lag 0 (a run where
+        escalation fires mid-stream is not bit-identical across lag
+        settings — one more chunk runs unmasked), and journal chunk
+        events attribute concurrently-running phase segments to the
+        adjudication boundary, so chunk ``i-1``'s event carries chunk
+        ``i``'s dispatch time (overlap makes per-chunk attribution
+        inherently fuzzy; run-level phase totals stay exact). With
+        either knob on, boundary checkpoints dump from on-device copies
+        taken at the boundary and run after the next dispatch, so the
+        stream no longer stalls on the device→host ``jax.device_get``
+        (the crash window grows by at most one chunk; the end-of-stream
+        flush is unchanged).
         """
         self._check_rollback(rollback)
         self._check_health(health)
+        cfg = self.config
+        if cfg.prefetch < 0:
+            raise ValueError(
+                f"TrainerConfig.prefetch must be >= 0, got {cfg.prefetch}")
+        if cfg.health_lag not in (0, 1):
+            raise ValueError(
+                f"TrainerConfig.health_lag must be 0 or 1, got "
+                f"{cfg.health_lag}")
+        if cfg.metrics_drain_every < 0:
+            raise ValueError(
+                f"TrainerConfig.metrics_drain_every must be >= 0, got "
+                f"{cfg.metrics_drain_every}")
         rec = recorder if recorder is not None else self.recorder
         timer = PhaseTimer(rec) if rec is not None else None
+        hb = _find_heartbeat(rec)
         # Health-based quarantine needs the guard's health channel; a
         # preset-only policy (guard off) must not pay the per-chunk state
         # copy + forced sync that the health path requires.
         quarantine = (rollback if rollback is not None and
-                      resilience.as_guard(self.config.guard) is not None
+                      resilience.as_guard(cfg.guard) is not None
                       else None)
         sync_each = (quarantine is not None or health is not None
                      or watchdog is not None)
+        # Lag-by-one control plane: only meaningful when something forces
+        # a per-chunk sync in the first place.
+        lag = 1 if (cfg.health_lag and sync_each) else 0
+        # Overlapped checkpoint dump: with the pipeline on, boundary saves
+        # run from on-device boundary copies after the NEXT dispatch;
+        # otherwise the save stays inline at the boundary (legacy timing:
+        # crash window of at most one chunk).
+        overlap_ckpt = (checkpointer is not None and checkpoint_every > 0
+                        and (cfg.prefetch > 0 or lag > 0))
         saved_at = None  # step of the last periodic save (quarantine-aware)
         all_metrics = []
         it = iter(chunks)
+        pf = None
+        if cfg.prefetch:
+            mode = "sync" if cfg.sync_every is None else "ssp"
+            pf = ChunkPrefetcher(
+                it, lambda b, _m=mode: self._place_chunk(b, _m),
+                depth=cfg.prefetch, recorder=rec, timer=timer,
+                start_index=start_step,
+                # Preset-quarantined chunks are consumed but never
+                # dispatched — don't pay their host→device upload.
+                skip_place=(rollback.preset if rollback is not None
+                            else frozenset()),
+            )
+            it = pf
         i = start_step - 1
+        pending = None       # lag-by-one: one dispatched, unadjudicated chunk
+        pending_save = None  # deferred (overlapped) boundary snapshot
+
+        def save_due(j):
+            return (checkpointer is not None and checkpoint_every > 0
+                    and (j + 1) % checkpoint_every == 0)
+
+        def boundary_copy(j):
+            """Post-chunk-``j`` state as fresh on-device buffers (futures —
+            no host block): the double-buffered snapshot the overlapped
+            dump writes from after the next dispatch."""
+            return (j + 1, resilience.tree_copy(tables),
+                    resilience.tree_copy(local_state))
+
+        def flush_save():
+            """Write the deferred boundary snapshot (when set, always a
+            clean, already-adjudicated boundary)."""
+            nonlocal pending_save, saved_at
+            if pending_save is None:
+                return
+            step, tb, lsd = pending_save
+            pending_save = None
+            with _phase(timer, "checkpoint"):
+                self._save_checkpoint(checkpointer, step, lsd, tables=tb)
+            saved_at = step
+
+        def sync_entry(entry):
+            """Forced host sync for one dispatched chunk; on poison,
+            _maybe_quarantine repoints the STORE at the restored state —
+            the loop's tables/local_state are swapped by account_entry.
+            Returns (metrics, restored_or_None)."""
+            metrics = entry["metrics"]
+            restored = None
+            if quarantine is not None:
+                with _phase(timer, "host_sync"):
+                    metrics, restored = self._maybe_quarantine(
+                        quarantine, entry["last_good"], metrics,
+                        entry["index"], "chunk"
+                    )
+            elif sync_each:
+                with _phase(timer, "host_sync"):
+                    metrics = jax.tree.map(np.asarray, metrics)
+            return metrics, restored
+
+        def account_entry(entry, metrics, restored):
+            """Accounting, callbacks, and boundary checkpoint for one
+            adjudicated chunk; returns True when it was quarantined (the
+            state is then already restored)."""
+            nonlocal tables, local_state, pending_save, saved_at
+            j = entry["index"]
+            ev = {"index": j} if rec is not None else None
+            poison = 0
+            if sync_each and (rec is not None or health is not None):
+                poison = self._fold_metrics_accounting(rec, metrics, ev)
+            if rec is not None:
+                rec.inc("driver.chunks")
+                if restored is not None:
+                    rec.inc("rollback.quarantined")
+                    ev["quarantined"] = True
+            self._apply_health_decision(health, rec, j, poison, "chunk")
+            if restored is not None:
+                if rec is not None:
+                    rec.event("chunk", phases=timer.chunk_summary(), **ev)
+                    rec.flush()
+                tables, local_state = restored
+                return True
+            if on_chunk is not None:
+                with _phase(timer, "host_sync"):
+                    host_metrics = jax.tree.map(np.asarray, metrics)
+                if rec is not None and not sync_each:
+                    # on_chunk already paid the host sync; give the chunk
+                    # event the same accounting the forced-sync paths get.
+                    self._fold_metrics_accounting(rec, host_metrics, ev)
+                all_metrics.append(host_metrics)
+                with _phase(timer, "callback"):
+                    on_chunk(j, host_metrics)
+            else:
+                # Deferred conversion keeps the dispatch pipeline full, but
+                # an unbounded stream must not accumulate device buffers (or
+                # run the host arbitrarily far ahead of the device): drain
+                # to host every metrics_drain_every chunks (0 = never).
+                all_metrics.append(metrics)
+                de = cfg.metrics_drain_every
+                if de and (j - start_step) % de == de - 1:
+                    with _phase(timer, "host_sync"):
+                        all_metrics[-de:] = [
+                            jax.tree.map(np.asarray, m)
+                            for m in all_metrics[-de:]
+                        ]
+            if save_due(j):
+                if entry.get("save") is not None:
+                    # Lag path: boundary copies were captured at dispatch
+                    # time (the live tables have moved on since).
+                    pending_save = entry["save"]
+                    flush_save()
+                elif overlap_ckpt:
+                    # Immediate-adjudication path: capture now, write after
+                    # the next dispatch — the dump's device_get then waits
+                    # alongside device compute instead of in front of it.
+                    pending_save = boundary_copy(j)
+                else:
+                    with _phase(timer, "checkpoint"):
+                        self._save_checkpoint(checkpointer, j + 1,
+                                              local_state)
+                    saved_at = j + 1
+            if rec is not None:
+                # Emitted AFTER the checkpoint/callback phases so the
+                # chunk event's phase breakdown covers the whole chunk;
+                # flushed per boundary so the Prometheus exposition is
+                # live-scrapable mid-run and a kill loses at most one
+                # chunk of buffered JSONL.
+                rec.event("chunk", phases=timer.chunk_summary(), **ev)
+                rec.flush()
+            return False
+
         try:
             while True:
                 with _phase(timer, "ingest"):
+                    _beat(hb, i + 1, "prefetch" if pf is not None
+                          else "ingest")
                     chunk = next(it, _STREAM_END)
                 if chunk is _STREAM_END:
                     break
@@ -1276,72 +1535,58 @@ class Trainer:
                 if quarantine is not None:
                     last_good = (resilience.tree_copy(tables),
                                  resilience.tree_copy(local_state))
-                ckey = jax.random.fold_in(key, i)
-                restored = None
-                with _watch(watchdog, "chunk", i):
-                    tables, local_state, metrics = self.run_chunk(
-                        tables, local_state, chunk, ckey, timer=timer
-                    )
-                    if quarantine is not None:
-                        with _phase(timer, "host_sync"):
-                            metrics, restored = self._maybe_quarantine(
-                                quarantine, last_good, metrics, i, "chunk"
-                            )
-                    elif sync_each:
-                        with _phase(timer, "host_sync"):
-                            metrics = jax.tree.map(np.asarray, metrics)
-                ev = {"index": i} if rec is not None else None
-                poison = 0
-                if sync_each and (rec is not None or health is not None):
-                    poison = self._fold_metrics_accounting(rec, metrics, ev)
-                if rec is not None:
-                    rec.inc("driver.chunks")
-                    if restored is not None:
-                        rec.inc("rollback.quarantined")
-                        ev["quarantined"] = True
-                self._apply_health_decision(health, rec, i, poison, "chunk")
-                if restored is not None:
-                    if rec is not None:
-                        rec.event("chunk", phases=timer.chunk_summary(), **ev)
-                        rec.flush()
-                    tables, local_state = restored
-                    continue
-                if on_chunk is not None:
-                    with _phase(timer, "host_sync"):
-                        host_metrics = jax.tree.map(np.asarray, metrics)
-                    if rec is not None and not sync_each:
-                        # on_chunk already paid the host sync; give the chunk
-                        # event the same accounting the forced-sync paths get.
-                        self._fold_metrics_accounting(rec, host_metrics, ev)
-                    all_metrics.append(host_metrics)
-                    with _phase(timer, "callback"):
-                        on_chunk(i, host_metrics)
                 else:
-                    # Deferred conversion keeps the dispatch pipeline full, but
-                    # an unbounded stream must not accumulate device buffers (or
-                    # run the host arbitrarily far ahead of the device): drain
-                    # to host every few chunks.
-                    all_metrics.append(metrics)
-                    if (i - start_step) % 8 == 7:
-                        with _phase(timer, "host_sync"):
-                            all_metrics[-8:] = [
-                                jax.tree.map(np.asarray, m)
-                                for m in all_metrics[-8:]
-                            ]
-                if checkpointer is not None and checkpoint_every > 0 and (
-                    (i + 1) % checkpoint_every == 0
-                ):
-                    with _phase(timer, "checkpoint"):
-                        self._save_checkpoint(checkpointer, i + 1, local_state)
-                    saved_at = i + 1
-                if rec is not None:
-                    # Emitted AFTER the checkpoint/callback phases so the
-                    # chunk event's phase breakdown covers the whole chunk;
-                    # flushed per boundary so the Prometheus exposition is
-                    # live-scrapable mid-run and a kill loses at most one
-                    # chunk of buffered JSONL.
-                    rec.event("chunk", phases=timer.chunk_summary(), **ev)
-                    rec.flush()
+                    last_good = None
+                ckey = jax.random.fold_in(key, i)
+                _beat(hb, i, "dispatch")
+                if lag:
+                    prev, pending = pending, None
+                    with _watch(watchdog, "chunk", i):
+                        tables, local_state, metrics = self.run_chunk(
+                            tables, local_state, chunk, ckey, timer=timer
+                        )
+                        save = boundary_copy(i) if save_due(i) else None
+                        # Adjudicate chunk i-1 NOW — its host sync waits
+                        # while the device is already busy with chunk i.
+                        pmetrics = prestored = None
+                        if prev is not None:
+                            pmetrics, prestored = sync_entry(prev)
+                    if prev is not None and account_entry(
+                            prev, pmetrics, prestored):
+                        # prev was poisoned and the pre-prev snapshot is
+                        # restored — chunk i ran on poisoned state, so
+                        # recompute it deterministically (same chunk, same
+                        # key) from the restored state: exactly what the
+                        # lag-0 path would have dispatched.
+                        if quarantine is not None:
+                            last_good = (resilience.tree_copy(tables),
+                                         resilience.tree_copy(local_state))
+                        with _watch(watchdog, "chunk", i):
+                            tables, local_state, metrics = self.run_chunk(
+                                tables, local_state, chunk, ckey, timer=timer
+                            )
+                        save = boundary_copy(i) if save_due(i) else None
+                    pending = {"index": i, "metrics": metrics,
+                               "last_good": last_good, "save": save}
+                else:
+                    with _watch(watchdog, "chunk", i):
+                        tables, local_state, metrics = self.run_chunk(
+                            tables, local_state, chunk, ckey, timer=timer
+                        )
+                        entry = {"index": i, "metrics": metrics,
+                                 "last_good": last_good, "save": None}
+                        metrics, restored = sync_entry(entry)
+                    flush_save()  # previous boundary's deferred dump —
+                    # overlapped: the device is already past that boundary
+                    account_entry(entry, metrics, restored)
+            # Lag-by-one: the final chunk is still unadjudicated. Its
+            # forced sync keeps watchdog coverage, like every other sync.
+            if pending is not None:
+                prev, pending = pending, None
+                with _watch(watchdog, "chunk", prev["index"]):
+                    pmetrics, prestored = sync_entry(prev)
+                account_entry(prev, pmetrics, prestored)
+            flush_save()
             # End-of-stream save whenever the last chunk's state isn't already
             # on disk — including when a quarantined final chunk skipped its
             # periodic save (the snapshot then holds the rolled-back state
@@ -1350,7 +1595,23 @@ class Trainer:
                 with _phase(timer, "checkpoint"):
                     self._save_checkpoint(checkpointer, i + 1, local_state)
         finally:
+            if pf is not None:
+                # Every exit path — normal end, raising on_chunk, health
+                # abort, quarantine-budget abort — joins the prefetch
+                # worker; no thread leaks (tested).
+                pf.close()
             if checkpointer is not None:
+                try:
+                    # A clean, accepted boundary snapshot must not vanish
+                    # just because the stream aborted before its deferred
+                    # dump ran (the inline path would already have it on
+                    # disk). Best-effort: teardown must not mask the
+                    # original exception.
+                    flush_save()
+                except Exception:
+                    _log.exception(
+                        "deferred checkpoint dump failed during stream "
+                        "teardown")
                 # Durability barrier: an AsyncCheckpointer's in-flight
                 # write must be on disk before the stream reports done
                 # (no-op for the synchronous base class) — in a finally

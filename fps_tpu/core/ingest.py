@@ -71,13 +71,17 @@ def epoch_chunks(
     for k, v in data.items():
         if len(v) != n:
             raise ValueError(f"column {k!r} length {len(v)} != {n}")
+    # Materialize every column ONCE — the per-chunk loop below used to
+    # re-run np.asarray on each column for every chunk, a full-array copy
+    # per chunk whenever the caller passed lists/memmaps.
+    arrays = {k: np.asarray(v) for k, v in data.items()}
 
     order = np.arange(n)
     if seed is not None:
         np.random.default_rng(seed).shuffle(order)
 
     if route_key is not None:
-        keys = np.asarray(data[route_key])[order]
+        keys = arrays[route_key][order]
         queues = [order[keys % num_workers == w] for w in range(num_workers)]
     else:
         queues = [order[w::num_workers] for w in range(num_workers)]
@@ -108,7 +112,7 @@ def epoch_chunks(
 
     for start in range(0, steps_total, steps_per_chunk):
         sl = slice(start, start + steps_per_chunk)
-        chunk = {k: np.asarray(v)[safe[sl]] for k, v in data.items()}
+        chunk = {k: a[safe[sl]] for k, a in arrays.items()}
         chunk["weight"] = weight[sl]
         if sync_every is not None:
             chunk = _to_ssp_shape(chunk, sync_every)

@@ -62,6 +62,12 @@ def main(argv=None) -> int:
     solver = IALSSolver(mesh, IALSConfig(num_users=nu, num_items=ni,
                                          rank=args.rank, alpha=args.alpha,
                                          reg=args.reg))
+    # --prefetch: overlapped assembly+placement of the interaction chunks
+    # (the solver drives its own loop, so the knob lands on it directly —
+    # same validation as the Trainer CLIs' apply_host_pipeline).
+    if args.prefetch < 0:
+        raise SystemExit(f"--prefetch must be >= 0, got {args.prefetch}")
+    solver.prefetch = args.prefetch
     solver.init(jax.random.key(args.seed))
     # iALS drives its own solver loop (no Trainer) — the recorder still
     # journals the run and catches checkpoint events via the process

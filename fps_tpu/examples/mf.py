@@ -13,6 +13,7 @@ import jax
 import numpy as np
 
 from fps_tpu.examples.common import (
+    apply_host_pipeline,
     attach_obs,
     base_parser,
     make_guard,
@@ -87,6 +88,7 @@ def main(argv=None) -> int:
                 query_fn=mf_topk_query_fn(W, num_queries=2),
             ),
         )
+    apply_host_pipeline(args, trainer)
     rec = attach_obs(args, trainer, workload="mf")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)

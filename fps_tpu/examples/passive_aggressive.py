@@ -12,6 +12,7 @@ import jax
 import numpy as np
 
 from fps_tpu.examples.common import (
+    apply_host_pipeline,
     attach_obs,
     base_parser,
     make_guard,
@@ -93,6 +94,7 @@ def main(argv=None) -> int:
                    variant=args.variant, C=args.C)
     trainer, store = passive_aggressive(
         mesh, cfg, sync_every=args.sync_every, guard=make_guard(args))
+    apply_host_pipeline(args, trainer)
     rec = attach_obs(args, trainer, workload="passive_aggressive")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
     maybe_warm_start(args, store, None)

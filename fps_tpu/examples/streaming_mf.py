@@ -22,9 +22,10 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from fps_tpu.examples.common import (attach_obs, base_parser, emit, finish,
-                                     make_guard, make_mesh, make_rollback,
-                                     make_watchdog, maybe_profile)
+from fps_tpu.examples.common import (apply_host_pipeline, attach_obs,
+                                     base_parser, emit, finish, make_guard,
+                                     make_mesh, make_rollback, make_watchdog,
+                                     maybe_profile)
 
 
 class _TargetReached(Exception):
@@ -62,6 +63,7 @@ def main(argv=None) -> int:
                    rank=args.rank, learning_rate=args.learning_rate)
     trainer, store = online_mf(mesh, cfg, sync_every=args.sync_every,
                                guard=make_guard(args))
+    apply_host_pipeline(args, trainer)
     rec = attach_obs(args, trainer, workload="streaming_mf")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))
 

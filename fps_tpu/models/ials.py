@@ -110,6 +110,11 @@ class IALSSolver:
         self._compiled_acc = {}
         self._compiled_solve = {}
         self._compiled_zeros = {}
+        # Overlapped host pipeline depth for half_epoch's chunk stream
+        # (fps_tpu.core.prefetch): chunk assembly + placement run this
+        # many chunks ahead on a worker thread. 0 = synchronous; the
+        # accumulate order (and so the solve) is identical either way.
+        self.prefetch = 0
 
     # -- state --------------------------------------------------------------
 
@@ -287,14 +292,27 @@ class IALSSolver:
                 x = jnp.asarray(np.asarray(x))
             return jax.device_put(x, sharding)
 
-        for chunk in chunks:
-            dev_chunk = {
+        def place(chunk):
+            return {
                 "solve_ids": to_dev(chunk[solve_col]),
                 "fixed_ids": to_dev(chunk[fixed_col]),
                 "rating": to_dev(chunk["rating"]),
                 "weight": to_dev(chunk["weight"]),
             }
-            A, b = acc(self.store.tables[fixed_name], A, b, dev_chunk)
+
+        it, pf = chunks, None
+        if self.prefetch:
+            from fps_tpu.core.prefetch import ChunkPrefetcher
+
+            it = pf = ChunkPrefetcher(chunks, place, depth=self.prefetch)
+        try:
+            for item in it:
+                # Prefetched items arrive pre-placed (PlacedChunk).
+                dev_chunk = item.batches if pf is not None else place(item)
+                A, b = acc(self.store.tables[fixed_name], A, b, dev_chunk)
+        finally:
+            if pf is not None:
+                pf.close()
 
         if solve_name not in self._compiled_solve:
             self._compiled_solve[solve_name] = self._solve_fn(solve_n, solve_rps)

@@ -111,6 +111,13 @@ def default_registry() -> MetricsRegistry:
                    labels=("phase",),
                    help="host wall-clock per phase segment: ingest / place "
                         "/ dispatch / host_sync / checkpoint / callback"),
+        # Host pipeline (fps_tpu.core.prefetch).
+        MetricSpec("prefetch.chunks", "counter", unit="chunks",
+                   help="chunks assembled+placed by the background "
+                        "prefetch pipeline"),
+        MetricSpec("prefetch.queue_depth", "gauge", unit="chunks",
+                   help="placed chunks buffered ahead of the driver "
+                        "(sampled at every pipeline put/get)"),
         # Health channel (thresholded by fps_tpu.obs.health.HealthMonitor).
         MetricSpec("health.nonfinite_rows", "counter", unit="rows",
                    labels=("table",),
@@ -134,6 +141,10 @@ def default_registry() -> MetricsRegistry:
                    help="async snapshots accepted for background write "
                         "(checkpoint.saves marks the durability point)"),
         MetricSpec("checkpoint.save_seconds", "histogram", unit="s"),
+        MetricSpec("checkpoint.dump_seconds", "histogram", unit="s",
+                   help="device->host snapshot capture time (the part of "
+                        "a save the training thread pays; the overlapped "
+                        "pipeline hides it behind the next dispatch)"),
         MetricSpec("checkpoint.bytes", "gauge", unit="bytes",
                    help="size of the last written snapshot"),
         MetricSpec("checkpoint.fallbacks", "counter", unit="snapshots",

@@ -25,6 +25,7 @@ shim.)
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 import numpy as np
@@ -32,7 +33,11 @@ import numpy as np
 # Phase names the driver emits, in pipeline order. PhaseTimer accepts any
 # name (custom loops may add their own); these are the declared ones.
 DRIVER_PHASES = (
-    "ingest",      # pulling the next chunk from the host iterator
+    "prefetch",    # background pipeline: chunk assembly + placement on
+                   # the worker thread (fps_tpu.core.prefetch) — OVERLAPS
+                   # the phases below, it is not part of their serial sum
+    "ingest",      # pulling the next chunk from the host iterator (with
+                   # the pipeline on: waiting on the prefetch buffer)
     "place",       # host->device transfer (host_to_sharded)
     "dispatch",    # the jitted call: enqueue + (first call) compile
     "host_sync",   # blocked fetching metrics back to host
@@ -56,6 +61,9 @@ class PhaseTimer:
     def __init__(self, recorder=None):
         self.recorder = recorder
         self._chunk: dict[str, float] = {}
+        # The prefetch worker thread folds its segments in via add()
+        # while the driver thread closes phases and takes summaries.
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -63,19 +71,29 @@ class PhaseTimer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self._chunk[name] = self._chunk.get(name, 0.0) + dt
-            if self.recorder is not None:
-                self.recorder.observe("driver.phase_seconds", dt, phase=name)
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float) -> None:
+        """Fold an externally-measured segment into the current chunk —
+        how the background prefetch worker reports its assemble+place
+        time (``prefetch``) without a context manager spanning threads.
+        A segment landing exactly at a chunk boundary may attribute to
+        either side of it; overlapped phases are inherently concurrent
+        with the driver's, so the ambiguity is real, not an artifact."""
+        with self._lock:
+            self._chunk[name] = self._chunk.get(name, 0.0) + seconds
+        if self.recorder is not None:
+            self.recorder.observe("driver.phase_seconds", seconds, phase=name)
 
     def chunk_summary(self, *, reset: bool = True) -> dict[str, float]:
         """Seconds per phase since the last reset (one chunk's breakdown).
         Whole-run totals live where every consumer already reads them:
         ``Recorder.phase_totals()`` over the ``driver.phase_seconds``
         histogram — the timer keeps no duplicate run-level state."""
-        out = {k: round(v, 6) for k, v in self._chunk.items()}
-        if reset:
-            self._chunk = {}
+        with self._lock:
+            out = {k: round(v, 6) for k, v in self._chunk.items()}
+            if reset:
+                self._chunk = {}
         return out
 
 

@@ -38,6 +38,12 @@ class Heartbeat:
     to localize where an attempt died (two consecutive deaths at the same
     index quarantine it). Atomic replace (tmp + rename in the same
     directory) so the supervisor never reads a torn beat.
+
+    Extra keyword fields ride the beat verbatim; the drivers use
+    ``phase`` (``prefetch`` / ``ingest`` / ``dispatch``) to beat at
+    SUB-chunk boundaries, so the supervisor's attempt records name the
+    sub-phase a death between chunk boundaries happened in
+    (``last_phase`` in ``supervisor_state.json``).
     """
 
     def __init__(self, path: str):

@@ -175,14 +175,19 @@ class RunSupervisor:
     # -- liveness ----------------------------------------------------------
 
     def _read_heartbeat(self):
-        """(mtime, index) of the heartbeat file, or (None, None)."""
+        """(mtime, index, phase) of the heartbeat file, or three Nones.
+
+        ``phase`` is the optional sub-chunk boundary the child last
+        crossed (the drivers beat ``prefetch``/``ingest``/``dispatch``
+        between chunk boundaries) — it sharpens where an attempt died
+        without changing the index-keyed quarantine logic."""
         try:
             mtime = os.path.getmtime(self.heartbeat_path)
             with open(self.heartbeat_path, encoding="utf-8") as f:
                 rec = json.load(f)
-            return mtime, rec.get("index")
+            return mtime, rec.get("index"), rec.get("phase")
         except (OSError, json.JSONDecodeError):
-            return None, None
+            return None, None, None
 
     def _watch_fingerprint(self):
         """Size+mtime fingerprint over the watched globs — any change in
@@ -263,7 +268,7 @@ class RunSupervisor:
         last_signal = t0
         deadline_s = (cfg.startup_grace_s if cfg.startup_grace_s is not None
                       else cfg.stall_timeout_s)
-        hb_mtime, last_index = self._read_heartbeat()
+        hb_mtime, last_index, last_phase = self._read_heartbeat()
         watch_fp = self._watch_fingerprint()
         aborted = None
         while True:
@@ -271,12 +276,21 @@ class RunSupervisor:
             if rc is not None:
                 break
             now = time.monotonic()
-            new_mtime, idx = self._read_heartbeat()
+            new_mtime, idx, phase = self._read_heartbeat()
             new_fp = self._watch_fingerprint()
             if new_mtime != hb_mtime or new_fp != watch_fp:
-                hb_mtime, watch_fp = new_mtime, new_fp
+                # Index on ANY fresh signal (it is monotone, and coarse
+                # filesystem mtimes can hide a fresh beat behind an
+                # unchanged mtime); phase only on a definitely-fresh
+                # beat, taken verbatim, None included — a boundary beat
+                # with no phase field must CLEAR a stale sub-phase, or a
+                # later death in e.g. a chunk callback would be
+                # attributed to the previous beat's 'dispatch'.
                 if idx is not None:
                     last_index = idx
+                if new_mtime != hb_mtime:
+                    last_phase = phase
+                hb_mtime, watch_fp = new_mtime, new_fp
                 last_signal = now
                 deadline_s = cfg.stall_timeout_s  # startup grace spent
             if run_deadline is not None and now >= run_deadline:
@@ -289,14 +303,20 @@ class RunSupervisor:
                 break
             time.sleep(cfg.poll_interval_s)
         # Catch a final beat that landed between the last poll and exit.
-        _, idx = self._read_heartbeat()
+        final_mtime, idx, phase = self._read_heartbeat()
         if idx is not None:
             last_index = idx
+        if final_mtime != hb_mtime:
+            last_phase = phase  # fresh beat: take its phase, None included
         record = {
             "attempt": attempt,
             "rc": rc,
             "aborted": aborted,
             "last_index": last_index,
+            # The sub-chunk boundary the child last crossed (prefetch /
+            # ingest / dispatch) — a death BETWEEN chunk boundaries now
+            # attributes to the right sub-phase in the persisted state.
+            "last_phase": last_phase,
             "runtime_s": round(time.monotonic() - t0, 3),
             "log": log_path,
         }
@@ -404,4 +424,5 @@ class RunSupervisor:
             self.state["quarantined"].append(int(idx))
             self._save_state()
             self._event("chunk_quarantined", index=int(idx),
-                        after_attempts=len(tail))
+                        after_attempts=len(tail),
+                        phase=record.get("last_phase"))

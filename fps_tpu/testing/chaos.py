@@ -228,6 +228,27 @@ def wedge_at_chunk(index: int, mode: str = "sigstop", *,
     return cb
 
 
+def kill_in_prefetch(chunks: Iterable, index: int, *,
+                     marker: str | None = None) -> Iterator:
+    """Re-yield ``chunks`` but die (SIGKILL, no flush) right before chunk
+    ``index`` is handed to the consumer — i.e. while the OVERLAPPED host
+    pipeline's worker thread (:mod:`fps_tpu.core.prefetch`) is mid-
+    assembly, typically several chunks ahead of the chunk the driver is
+    dispatching. The death-between-chunk-boundaries case the intra-chunk
+    heartbeat phases attribute and the supervisor must resume through.
+
+    ``marker``: a file path making the kill once-only across supervised
+    attempts (touched before dying — durable enough for a process kill,
+    where the page cache survives; NOT a power-loss guarantee)."""
+    for i, c in enumerate(chunks):
+        if i == index:
+            if marker is None or not os.path.exists(marker):
+                if marker is not None:
+                    open(marker, "w").close()
+                sigkill_self()
+        yield c
+
+
 def partial_write_then_kill(directory: str, nbytes: int = 4096) -> None:
     """Simulate dying MID-checkpoint-write: leave a partial ``.tmp.npz``
     (zip magic + junk) in ``directory`` — exactly what a crashed

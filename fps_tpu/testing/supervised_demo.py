@@ -40,6 +40,10 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 # workload.
 SCENARIO_DEMO_ARGS = ("--examples", "8000", "--epochs", "2")
 SCENARIO_WEDGE_AT = 3
+# Mid-prefetch SIGKILL scenario: pipeline depth and the chunk whose
+# background assembly the child dies in.
+SCENARIO_PREFETCH_DEPTH = 2
+SCENARIO_PREFETCH_KILL_AT = 4
 
 
 def run_supervised_scenario(tmpdir: str, *, timeout: float = 600):
@@ -124,6 +128,95 @@ def run_supervised_scenario(tmpdir: str, *, timeout: float = 600):
     return ok, detail
 
 
+def run_prefetch_kill_scenario(tmpdir: str, *, timeout: float = 600):
+    """SIGKILL mid-PREFETCH under the supervisor: the child runs with the
+    overlapped host pipeline on (``--prefetch 2``) and dies while the
+    background worker is assembling chunk ``SCENARIO_PREFETCH_KILL_AT``
+    (once, marker-gated) — a death BETWEEN chunk boundaries, several
+    chunks ahead of the one being dispatched. The supervisor must see the
+    crash, restart with backoff, and the resumed attempt (pipeline still
+    on, resuming from ``latest_valid_step``) must finish clean and
+    reproduce a straight pipeline-on run's final weights bit-for-bit.
+    A single crash must NOT quarantine anything (quarantine needs two
+    consecutive deaths at one index).
+
+    Returns ``(ok, detail)`` like :func:`run_supervised_scenario`.
+    """
+    import numpy as np
+
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=_ROOT)
+    demo = [sys.executable, "-m", "fps_tpu.testing.supervised_demo",
+            *SCENARIO_DEMO_ARGS, "--prefetch", str(SCENARIO_PREFETCH_DEPTH)]
+    straight_dir = os.path.join(tmpdir, "straight")
+    sup_dir = os.path.join(tmpdir, "sup")
+    straight_out = os.path.join(tmpdir, "straight.npz")
+    sup_out = os.path.join(tmpdir, "sup.npz")
+
+    r = subprocess.run(
+        demo + ["--ckpt-dir", straight_dir, "--out", straight_out],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=timeout,
+    )
+    if r.returncode != 0:
+        return False, {"error": "straight run failed",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "supervise.py"),
+         "--state-dir", sup_dir, "--stall-timeout-s", "60",
+         "--startup-grace-s", "300", "--term-grace-s", "2",
+         "--backoff-base-s", "0.2", "--max-restarts", "2",
+         "--poll-s", "0.2", "--",
+         *demo, "--ckpt-dir", sup_dir, "--out", sup_out,
+         "--kill-prefetch-at", str(SCENARIO_PREFETCH_KILL_AT)],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=timeout,
+    )
+    try:
+        digest = json.loads(r.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return False, {"error": "no supervisor digest",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+    bit_identical = (
+        os.path.exists(sup_out)
+        and np.array_equal(np.load(straight_out)["weights"],
+                           np.load(sup_out)["weights"])
+    )
+    # Sub-phase attribution evidence: the killed attempt's record must
+    # carry the sub-chunk boundary the child last crossed. Which one is
+    # timing-dependent (the worker dies while the driver is at its own
+    # boundary), but it must be one of the driver's phases, not null.
+    import json as _json
+
+    try:
+        with open(os.path.join(sup_dir, "supervisor_state.json"),
+                  encoding="utf-8") as f:
+            attempts = _json.load(f).get("attempts", [])
+        killed_phase = attempts[0].get("last_phase") if attempts else None
+    except (OSError, _json.JSONDecodeError, IndexError):
+        killed_phase = None
+    detail = {
+        "supervisor": {k: digest.get(k) for k in
+                       ("success", "attempts", "restarts",
+                        "deadline_aborts", "quarantined")},
+        "bit_identical": bit_identical,
+        "killed_attempt_phase": killed_phase,
+        "corrupt_files": sorted(os.path.basename(p) for p in
+                                glob.glob(sup_dir + "/*.corrupt")),
+    }
+    ok = (r.returncode == 0 and digest.get("success")
+          and digest.get("restarts") == 1
+          # A SIGKILL crash is a death, not a stall: no deadline abort.
+          and digest.get("deadline_aborts") == 0
+          # One crash at one index is not quarantine evidence.
+          and digest.get("quarantined") == []
+          and killed_phase in ("prefetch", "ingest", "dispatch")
+          and not detail["corrupt_files"]
+          and bit_identical)
+    return ok, detail
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="supervised tiny-logreg child (fps_tpu.supervise demo)")
@@ -145,6 +238,13 @@ def main(argv=None) -> int:
     ap.add_argument("--sync-checkpointer", action="store_true",
                     help="use the blocking Checkpointer instead of the "
                          "async writer")
+    ap.add_argument("--prefetch", type=int, default=0,
+                    help="overlapped host pipeline depth "
+                         "(TrainerConfig.prefetch)")
+    ap.add_argument("--kill-prefetch-at", type=int, default=None,
+                    help="SIGKILL while the prefetch worker assembles "
+                         "this (global) chunk index — once, via marker "
+                         "file, unless --always")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -172,6 +272,16 @@ def main(argv=None) -> int:
     preset = child.quarantined_from_env()
     attempt = child.attempt_from_env()
 
+    # A heartbeat-only recorder makes the DRIVER's sub-phase beats
+    # (prefetch/ingest/dispatch, with a phase field) flow: without it the
+    # only beats are this file's chunk-boundary ones and the supervisor
+    # would record last_phase=null for every mid-chunk death.
+    rec = None
+    if hb is not None:
+        from fps_tpu.obs import Recorder
+
+        rec = Recorder(sinks=[child.HeartbeatSink(hb)])
+
     mesh = make_ps_mesh()
     W = num_workers_of(mesh)
     train, _ = logreg_data(args.examples)
@@ -179,6 +289,11 @@ def main(argv=None) -> int:
 
     cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
     trainer, store = logistic_regression(mesh, cfg)
+    if args.prefetch:
+        import dataclasses
+
+        trainer.config = dataclasses.replace(trainer.config,
+                                             prefetch=args.prefetch)
     tables, ls = trainer.init_state(jax.random.key(0))
 
     ckpt_cls = Checkpointer if args.sync_checkpointer else AsyncCheckpointer
@@ -235,11 +350,22 @@ def main(argv=None) -> int:
         if hb is not None:
             hb.beat(index=int(i) + 1, attempt=attempt)
 
+    stream = chunks[start:]
+    if (args.kill_prefetch_at is not None
+            and args.kill_prefetch_at >= start):
+        # Die while the background worker assembles this chunk (indices
+        # in kill_in_prefetch are relative to the resumed stream).
+        stream = chaos.kill_in_prefetch(
+            iter(stream), args.kill_prefetch_at - start,
+            marker=None if args.always else os.path.join(
+                args.ckpt_dir, "prefetch_kill.done"),
+        )
+
     rollback = RollbackPolicy(preset=preset) if preset else None
     tables, ls, _ = trainer.fit_stream(
-        tables, ls, chunks[start:], jax.random.key(1),
+        tables, ls, stream, jax.random.key(1),
         checkpointer=ckpt, checkpoint_every=1, start_step=start,
-        on_chunk=on_chunk, rollback=rollback,
+        on_chunk=on_chunk, rollback=rollback, recorder=rec,
     )
     ckpt.close()
 

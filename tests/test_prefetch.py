@@ -1,0 +1,506 @@
+"""Overlapped host pipeline (fps_tpu.core.prefetch + driver wiring).
+
+The contracts under test, per docs/performance.md:
+
+* prefetch on/off is BIT-identical — tables, metrics, and the compiled
+  program (the pipeline is pure host plumbing);
+* lag-by-one health sync (TrainerConfig.health_lag) is bit-identical to
+  the immediate sync, including under quarantine (the poisoned chunk's
+  successor is deterministically recomputed);
+* worker-thread errors re-raise on the caller at the position they
+  occurred, and EVERY exit path of fit_stream joins the worker thread
+  (no leaks);
+* overlapped boundary checkpoints hold the same state the inline saves
+  would, and resume from them bit-identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from fps_tpu.core.checkpoint import AsyncCheckpointer, Checkpointer
+from fps_tpu.core.driver import num_workers_of
+from fps_tpu.core.ingest import multi_epoch_chunks
+from fps_tpu.core.prefetch import ChunkPrefetcher, PlacedChunk
+from fps_tpu.core.resilience import RollbackPolicy
+from fps_tpu.models.logistic_regression import (
+    LogRegConfig,
+    logistic_regression,
+)
+from fps_tpu.parallel.mesh import make_ps_mesh
+from fps_tpu.testing import chaos
+from fps_tpu.testing.workloads import (
+    NF,
+    logreg_chunks,
+    logreg_data,
+    weights,
+)
+
+
+def _tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def _no_prefetch_threads():
+    return not any(
+        t.name.startswith("fps-prefetch") for t in threading.enumerate()
+    )
+
+
+def _make_trainer(mesh, **cfg_over):
+    trainer, store = logistic_regression(
+        mesh, LogRegConfig(num_features=NF, learning_rate=0.5),
+        guard=cfg_over.pop("guard", None),
+        sync_every=cfg_over.pop("sync_every", None),
+    )
+    if cfg_over:
+        trainer.config = dataclasses.replace(trainer.config, **cfg_over)
+    return trainer, store
+
+
+# ---------------------------------------------------------------------------
+# ChunkPrefetcher unit contracts (no mesh needed).
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_preserves_order_and_completes():
+    items = [{"x": np.full(4, i)} for i in range(17)]
+    pf = ChunkPrefetcher(iter(items), depth=3)
+    got = list(pf)
+    pf.close()
+    assert len(got) == 17
+    for i, c in enumerate(got):
+        assert c["x"][0] == i
+    assert _no_prefetch_threads()
+
+
+def test_prefetcher_place_fn_wraps_and_runs_on_worker():
+    worker_names = []
+
+    def place(chunk):
+        worker_names.append(threading.current_thread().name)
+        return {k: v + 1 for k, v in chunk.items()}
+
+    with ChunkPrefetcher(iter([{"x": np.arange(3)}] * 4), place,
+                         depth=2) as pf:
+        got = list(pf)
+    assert all(isinstance(c, PlacedChunk) for c in got)
+    assert np.array_equal(got[0].batches["x"], np.arange(3) + 1)
+    assert set(worker_names) == {"fps-prefetch"}
+    assert _no_prefetch_threads()
+
+
+def test_prefetcher_error_propagates_at_position():
+    def source():
+        yield {"x": 0}
+        yield {"x": 1}
+        raise ValueError("poisoned source")
+
+    pf = ChunkPrefetcher(source(), depth=2)
+    assert next(pf)["x"] == 0
+    assert next(pf)["x"] == 1
+    with pytest.raises(ValueError, match="poisoned source"):
+        next(pf)
+    pf.close()
+    assert _no_prefetch_threads()
+
+
+def test_prefetcher_close_midstream_joins_thread():
+    def endless():
+        i = 0
+        while True:
+            yield {"x": i}
+            i += 1
+
+    pf = ChunkPrefetcher(endless(), depth=2)
+    assert next(pf)["x"] == 0
+    pf.close()
+    assert _no_prefetch_threads()
+    # Closed pipeline: close() is idempotent.
+    pf.close()
+
+
+def test_prefetcher_rejects_bad_depth():
+    with pytest.raises(ValueError, match="depth"):
+        ChunkPrefetcher(iter([]), depth=0)
+
+
+def test_prefetcher_depth_bounds_queue():
+    from fps_tpu import obs
+
+    rec = obs.Recorder(sinks=[])
+    # A consumer that never reads: the worker must stall at depth, not
+    # drain the source.
+    src = iter([{"x": i} for i in range(100)])
+    pf = ChunkPrefetcher(src, depth=2, recorder=rec)
+    deadline = time.time() + 5.0
+    while (rec.snapshot()["gauges"].get("prefetch.queue_depth", 0) < 2
+           and time.time() < deadline):
+        time.sleep(0.01)
+    pf.close()
+    snap = rec.snapshot()
+    assert snap["gauges"]["prefetch.queue_depth"] == 2
+    # depth chunks buffered + at most one in flight when close() hit.
+    assert snap["counters"]["prefetch.chunks"] <= 3
+    assert _no_prefetch_threads()
+
+
+# ---------------------------------------------------------------------------
+# fit_stream integration: bit-identity.
+# ---------------------------------------------------------------------------
+
+def test_fit_stream_prefetch_bit_identical_sync(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+
+    results = {}
+    for pf in (0, 2):
+        trainer, store = _make_trainer(mesh, prefetch=pf)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        tables, ls, m = trainer.fit_stream(
+            tables, ls, iter(chunks), jax.random.key(1)
+        )
+        results[pf] = (weights(store), m)
+        # The pipeline never adds a compiled program: one cache entry.
+        assert len(trainer._compiled) == 1
+    assert np.array_equal(results[0][0], results[2][0])
+    assert _tree_equal(results[0][1], results[2][1])
+    assert _no_prefetch_threads()
+
+
+def test_fit_stream_prefetch_bit_identical_ssp(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = list(multi_epoch_chunks(
+        train, 2, num_workers=num_workers_of(mesh), local_batch=32,
+        steps_per_chunk=8, sync_every=4, seed=3,
+    ))
+    results = {}
+    for pf in (0, 3):
+        trainer, store = _make_trainer(mesh, sync_every=4, prefetch=pf)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        tables, ls, m = trainer.fit_stream(
+            tables, ls, iter(chunks), jax.random.key(1)
+        )
+        results[pf] = (weights(store), m)
+    assert np.array_equal(results[0][0], results[3][0])
+    assert _tree_equal(results[0][1], results[3][1])
+
+
+def test_compiled_hlo_unchanged_by_pipeline(devices8):
+    """The pipeline is host plumbing: the lowered program text must be
+    byte-identical whatever the prefetch/health_lag knobs say."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunk = logreg_chunks(train, num_workers_of(mesh), epochs=1)[0]
+
+    def lowered(**cfg_over):
+        trainer, _ = _make_trainer(mesh, **cfg_over)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        batches = trainer._place_chunk(chunk, "sync")
+        key = jax.random.key(1)
+        return trainer._get_compiled("sync").lower(
+            tables, ls, batches, key
+        ).as_text()
+
+    base = lowered()
+    assert lowered(prefetch=2) == base
+    assert lowered(prefetch=2, health_lag=1, metrics_drain_every=0) == base
+
+
+# ---------------------------------------------------------------------------
+# fit_stream integration: exits join the worker.
+# ---------------------------------------------------------------------------
+
+def test_on_chunk_raise_joins_prefetch_thread(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+    trainer, _ = _make_trainer(mesh, prefetch=2)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    baseline_threads = threading.active_count()
+
+    def boom(i, metrics):
+        if i == 1:
+            raise RuntimeError("early stop")
+
+    with pytest.raises(RuntimeError, match="early stop"):
+        trainer.fit_stream(tables, ls, iter(chunks), jax.random.key(1),
+                           on_chunk=boom)
+    assert _no_prefetch_threads()
+    assert threading.active_count() <= baseline_threads
+
+
+def test_raising_iterator_propagates_through_fit_stream(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=1)
+
+    def source():
+        yield chunks[0]
+        yield chunks[1]
+        raise OSError("stream tore")
+
+    trainer, _ = _make_trainer(mesh, prefetch=2)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    with pytest.raises(OSError, match="stream tore"):
+        trainer.fit_stream(tables, ls, source(), jax.random.key(1))
+    assert _no_prefetch_threads()
+
+
+def test_health_abort_joins_prefetch_thread(devices8):
+    from fps_tpu.core.resilience import PoisonedStreamError
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+    poisoned = list(chaos.poison_chunks(
+        iter(chunks), chunk_index=1, column="feat_vals", kind="nan",
+        frac=0.5, seed=1))
+    trainer, _ = _make_trainer(mesh, guard="observe", prefetch=2)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    with pytest.raises(PoisonedStreamError):
+        trainer.fit_stream(
+            tables, ls, iter(poisoned), jax.random.key(1),
+            rollback=RollbackPolicy(max_rollbacks=0),
+        )
+    assert _no_prefetch_threads()
+
+
+# ---------------------------------------------------------------------------
+# Lag-by-one health sync.
+# ---------------------------------------------------------------------------
+
+def _run_guarded(mesh, chunks, *, lag, prefetch=0, guard="observe",
+                 rollback=None, checkpointer=None, checkpoint_every=0):
+    trainer, store = _make_trainer(
+        mesh, guard=guard, health_lag=lag, prefetch=prefetch)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    tables, ls, m = trainer.fit_stream(
+        tables, ls, iter(chunks), jax.random.key(1), rollback=rollback,
+        checkpointer=checkpointer, checkpoint_every=checkpoint_every,
+    )
+    return store, m
+
+
+def test_health_lag_bit_identical_clean_stream(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+    s0, m0 = _run_guarded(mesh, chunks, lag=0, rollback=RollbackPolicy())
+    s1, m1 = _run_guarded(mesh, chunks, lag=1, rollback=RollbackPolicy())
+    assert np.array_equal(weights(s0), weights(s1))
+    assert _tree_equal(m0, m1)
+
+
+def test_health_lag_quarantine_recompute_identical(devices8):
+    """A quarantined chunk under lag restores the pre-chunk snapshot and
+    deterministically recomputes its successor — results must match the
+    immediate-sync path bit for bit, with the same quarantine record."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+    poisoned = list(chaos.poison_chunks(
+        iter(chunks), chunk_index=1, column="feat_vals", kind="nan",
+        frac=0.5, seed=1))
+
+    runs = {}
+    for name, (lag, pf) in {"lag0": (0, 0), "lag1": (1, 0),
+                            "lag1_pf": (1, 2)}.items():
+        pol = RollbackPolicy()
+        store, m = _run_guarded(mesh, poisoned, lag=lag, prefetch=pf,
+                                rollback=pol)
+        runs[name] = (weights(store), m, pol.quarantined)
+
+    w0, m0, q0 = runs["lag0"]
+    assert q0 == [1]
+    for name in ("lag1", "lag1_pf"):
+        w, m, q = runs[name]
+        assert q == [1], name
+        assert np.array_equal(w0, w), name
+        assert _tree_equal(m0, m), name
+    assert _no_prefetch_threads()
+
+
+# ---------------------------------------------------------------------------
+# Overlapped boundary checkpoints.
+# ---------------------------------------------------------------------------
+
+def test_overlapped_checkpoint_snapshots_identical(tmp_path, devices8):
+    """With the pipeline on, boundary saves dump from on-device boundary
+    copies AFTER the next dispatch — the snapshots must still hold
+    exactly the state the inline saves would have written."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+
+    dirs = {}
+    for name, pf in (("off", 0), ("on", 2)):
+        d = tmp_path / name
+        trainer, store = _make_trainer(mesh, prefetch=pf)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        with AsyncCheckpointer(str(d)) as ckpt:
+            trainer.fit_stream(
+                tables, ls, iter(chunks), jax.random.key(1),
+                checkpointer=ckpt, checkpoint_every=2,
+            )
+        dirs[name] = d
+
+    off, on = Checkpointer(str(dirs["off"])), Checkpointer(str(dirs["on"]))
+    assert off.steps() == on.steps() and off.steps()
+    for step in off.steps():
+        _, t_off, ls_off, _ = off.read_snapshot(step)
+        _, t_on, ls_on, _ = on.read_snapshot(step)
+        assert sorted(t_off) == sorted(t_on)
+        for k in t_off:
+            assert np.array_equal(t_off[k], t_on[k]), (step, k)
+        assert len(ls_off) == len(ls_on)
+        for a, b in zip(ls_off, ls_on):
+            assert np.array_equal(a, b), step
+
+
+def test_resume_from_overlapped_snapshot_bit_identical(tmp_path, devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+
+    # Straight pipeline-on run.
+    trainer, store = _make_trainer(mesh, prefetch=2)
+    tables, ls = trainer.init_state(jax.random.key(0))
+    trainer.fit_stream(tables, ls, iter(chunks), jax.random.key(1))
+    want = weights(store)
+
+    # Interrupted run: checkpoint every chunk, stop after chunk 1, resume.
+    d = str(tmp_path / "ck")
+    trainer, store = _make_trainer(mesh, prefetch=2)
+    tables, ls = trainer.init_state(jax.random.key(0))
+
+    class Stop(Exception):
+        pass
+
+    def stop_at(i, _m):
+        if i == 1:
+            raise Stop
+
+    with Checkpointer(d) as ckpt:
+        with pytest.raises(Stop):
+            trainer.fit_stream(
+                tables, ls, iter(chunks), jax.random.key(1),
+                checkpointer=ckpt, checkpoint_every=1, on_chunk=stop_at,
+            )
+        start = ckpt.latest_valid_step()
+        assert start and start >= 1
+        tables, ls, start = trainer.restore_checkpoint(ckpt, ls)
+        trainer.fit_stream(
+            tables, ls, iter(chunks[start:]), jax.random.key(1),
+            start_step=start,
+        )
+    assert np.array_equal(weights(store), want)
+    assert _no_prefetch_threads()
+
+
+# ---------------------------------------------------------------------------
+# Satellites: metrics_drain_every knob, heartbeat sub-phase beats.
+# ---------------------------------------------------------------------------
+
+def test_metrics_drain_every_knob(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+    results = []
+    for de in (8, 2, 0):  # default cadence, tight cadence, never
+        trainer, store = _make_trainer(mesh, metrics_drain_every=de)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        _, _, m = trainer.fit_stream(
+            tables, ls, iter(chunks), jax.random.key(1)
+        )
+        # End-of-stream conversion happens regardless of cadence.
+        assert all(isinstance(leaf, np.ndarray) for leaf in jax.tree.leaves(m))
+        results.append((weights(store), m))
+    for w, m in results[1:]:
+        assert np.array_equal(results[0][0], w)
+        assert _tree_equal(results[0][1], m)
+
+
+def test_heartbeat_subphase_beats(tmp_path, devices8):
+    """With a supervised heartbeat riding the recorder, the driver beats
+    at sub-chunk boundaries with a phase field the supervisor parses."""
+    import json
+
+    from fps_tpu import obs
+    from fps_tpu.supervise import child
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=1)
+    hb_path = str(tmp_path / "hb.json")
+    phases_seen = set()
+
+    real_beat = child.Heartbeat.beat
+
+    class SpyHeartbeat(child.Heartbeat):
+        def beat(self, index=None, **fields):
+            if "phase" in fields:
+                phases_seen.add(fields["phase"])
+            real_beat(self, index, **fields)
+
+    hb = SpyHeartbeat(hb_path)
+    rec = obs.Recorder(sinks=[child.HeartbeatSink(hb)])
+    trainer, _ = _make_trainer(mesh, prefetch=2)
+    trainer.recorder = rec
+    tables, ls = trainer.init_state(jax.random.key(0))
+    trainer.fit_stream(tables, ls, iter(chunks), jax.random.key(1))
+
+    assert "dispatch" in phases_seen
+    assert "prefetch" in phases_seen  # pipeline on: the wait boundary
+    with open(hb_path, encoding="utf-8") as f:
+        last = json.load(f)
+    assert "index" in last and "phase" in last
+
+    # The supervisor's reader surfaces the phase alongside the index.
+    from fps_tpu.supervise.supervisor import RunSupervisor
+
+    sup = RunSupervisor.__new__(RunSupervisor)
+    sup.heartbeat_path = hb_path
+    mtime, idx, phase = sup._read_heartbeat()
+    assert mtime is not None and idx is not None
+    assert phase in phases_seen
+
+
+def test_prefetch_queue_gauge_recorded(devices8):
+    from fps_tpu import obs
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=1)
+    rec = obs.Recorder(sinks=[])
+    trainer, _ = _make_trainer(mesh, prefetch=2)
+    trainer.recorder = rec
+    tables, ls = trainer.init_state(jax.random.key(0))
+    trainer.fit_stream(tables, ls, iter(chunks), jax.random.key(1))
+    snap = rec.snapshot()
+    assert snap["counters"]["prefetch.chunks"] == len(chunks)
+    assert "prefetch.queue_depth" in snap["gauges"]
+    assert "prefetch" in rec.phase_totals()
+
+
+# ---------------------------------------------------------------------------
+# Supervised chaos: SIGKILL mid-prefetch (subprocess-heavy -> slow tier).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sigkill_mid_prefetch_resumes_clean(tmp_path):
+    from fps_tpu.testing.supervised_demo import run_prefetch_kill_scenario
+
+    ok, detail = run_prefetch_kill_scenario(str(tmp_path))
+    assert ok, detail

@@ -23,6 +23,12 @@ fps_tpu.testing.workloads):
   ``latest_valid_step`` (at most one chunk of lost work), no corrupt
   snapshot is ever selected, and the final weights are BIT-IDENTICAL to
   an unsupervised straight run.
+* ``prefetch_kill``            — SIGKILL while the overlapped host
+  pipeline's worker thread is assembling a chunk several indices ahead
+  of the dispatch point (``--prefetch 2``): survives iff the supervisor
+  restarts the child once, nothing is quarantined (one crash is not
+  determinism evidence), and the resumed pipeline-on run reproduces a
+  straight pipeline-on run bit-for-bit.
 
 Run (CPU mesh, like the test suite):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -164,6 +170,11 @@ def main():
                 ckpt_scenario(d, mesh, chunks, mode))
     with tempfile.TemporaryDirectory() as d:
         results["supervised"], detail["supervised"] = supervised_scenario(d)
+    with tempfile.TemporaryDirectory() as d:
+        from fps_tpu.testing.supervised_demo import run_prefetch_kill_scenario
+
+        results["prefetch_kill"], detail["prefetch_kill"] = (
+            run_prefetch_kill_scenario(d))
 
     digest = {
         "chaos_sweep": results,

@@ -7,7 +7,15 @@ join on ``run_id``) and prints a single JSON digest:
 * run identity — run ids, config digest, processes, wall-clock span;
 * progress — chunks/epochs/steps/examples, quarantined indices;
 * **per-phase timings** — total/mean/max seconds per host phase
-  (ingest / place / dispatch / host_sync / checkpoint / callback);
+  (prefetch / ingest / place / dispatch / host_sync / checkpoint /
+  callback — ``prefetch`` is the background pipeline's worker-thread
+  time, i.e. host work OVERLAPPED with the phases beside it);
+* **host pipeline** — chunks prefetched and the queue-depth gauge's
+  last/max (the gauge samples after every put/get, so with any traffic
+  the max is >= 1; a max STUCK at 1 means the driver drained each chunk
+  the moment it landed — assembly is the bottleneck, a deeper queue
+  won't help — while a max at the configured depth means the worker
+  kept the buffer full: the device-bound good case);
 * **per-table health totals** — nonfinite/norm/masked row counts;
 * **incidents** — rollbacks, watchdog stalls (+ recoveries), guard
   escalations, health aborts, checkpoint fallbacks, checkpoint saves.
@@ -53,7 +61,7 @@ _INCIDENT_EVENTS = (
 REQUIRED_FIELDS = (
     "obs_dir", "run_ids", "processes", "chunks", "epochs", "steps",
     "examples", "phase_seconds", "health", "incidents", "checkpoint_saves",
-    "quarantined", "wall_span_s",
+    "quarantined", "wall_span_s", "prefetch",
 )
 
 
@@ -85,6 +93,7 @@ def render_digest(obs_dir: str) -> dict:
         )
 
     counters: dict[str, float] = collections.defaultdict(float)
+    gauges: dict[str, dict] = {}  # name -> {"last": v, "max": v}
     phases: dict[str, dict] = {}
     health: dict[str, dict] = {}
     incidents: dict[str, list] = {k: [] for k in _INCIDENT_EVENTS}
@@ -144,6 +153,15 @@ def render_digest(obs_dir: str) -> dict:
                 )[tier] += int(v)
             elif rec.get("mtype") == "counter":
                 counters[name] += v
+            elif rec.get("mtype") == "gauge":
+                # "last" by record TIMESTAMP, not file-iteration order —
+                # a multi-process dir's files fold in name order.
+                t = float(rec.get("t") or 0.0)
+                g = gauges.setdefault(
+                    name, {"last": v, "last_t": t, "max": v})
+                if t >= g["last_t"]:
+                    g["last"], g["last_t"] = v, t
+                g["max"] = max(g["max"], v)
         elif kind == "event":
             fold_event(rec)
 
@@ -181,6 +199,15 @@ def render_digest(obs_dir: str) -> dict:
         "steps": int(counters.get("driver.steps", 0)),
         "examples": counters.get("driver.examples", 0.0),
         "phase_seconds": dict(sorted(phases.items())),
+        # Host pipeline (fps_tpu.core.prefetch): the 'prefetch' entry in
+        # phase_seconds is this worker's time, overlapped with the rest.
+        "prefetch": {
+            "chunks": int(counters.get("prefetch.chunks", 0)),
+            "queue_depth_last": gauges.get(
+                "prefetch.queue_depth", {}).get("last"),
+            "queue_depth_max": gauges.get(
+                "prefetch.queue_depth", {}).get("max"),
+        },
         "health": dict(sorted(health.items())),
         "poisoned_chunks": int(counters.get("health.poisoned_chunks", 0)),
         "incidents": {k: v for k, v in incidents.items() if v},
