@@ -74,9 +74,64 @@ def phase_summary(rec):
 # Driver-thread phases that serialize against dispatch — the host work
 # the overlapped pipeline (fps_tpu.core.prefetch) moves off the critical
 # path. 'prefetch' itself is worker-thread time and deliberately NOT in
-# this sum: it overlaps the phases below.
+# this sum: it overlaps the phases below. 'reconcile' is the two-tier
+# re-split at run entry (once per run, host-side).
 HOST_SERIAL_PHASES = ("ingest", "place", "host_sync", "checkpoint",
-                      "callback")
+                      "callback", "reconcile")
+
+
+# ---------------------------------------------------------------------------
+# Cross-shard collective accounting (two-tier A/B evidence).
+# ---------------------------------------------------------------------------
+
+import re as _re
+
+_COLL_RE = _re.compile(r"stablehlo\.(all_gather|all_reduce|all_to_all|"
+                       r"reduce_scatter|collective_permute)")
+_TENSOR_RE = _re.compile(r"tensor<([0-9]+(?:x[0-9]+)*)x([a-z]+[0-9]+)>")
+_GROUPS_RE = _re.compile(r"replica_groups = dense<[^>]*> : "
+                         r"tensor<[0-9]+x([0-9]+)xi64>")
+
+
+def count_collectives(text: str, min_bytes: int = 1024) -> int:
+    """Cross-shard collectives in a lowered (StableHLO) program whose
+    payload is at least ``min_bytes``.
+
+    Excluded: singleton replica groups (a size-1 mesh axis — no
+    communication at all) and sub-threshold payloads (the per-step
+    scalar metric psums), so the count tracks data-plane table/batch
+    traffic — the thing the two-tier A/B claims to reduce. Static per
+    compiled program: an op inside the step scan counts once, which is
+    exactly the per-chunk program the claim is about."""
+    def payload_of(line):
+        best = 0
+        for dims, dt in _TENSOR_RE.findall(line):
+            size = 1
+            for d in dims.split("x"):
+                size *= int(d)
+            best = max(best, size * (int(_re.sub(r"[a-z]+", "", dt)) // 8))
+        return best
+
+    n = 0
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        if not _COLL_RE.search(line):
+            continue
+        g = _GROUPS_RE.search(line)
+        if g and int(g.group(1)) <= 1:
+            continue
+        payload = payload_of(line)
+        if "({" in line and payload < min_bytes:
+            # Region-carrying op (all_reduce/reduce_scatter): the operand/
+            # result types sit on the region's CLOSING line, not the op
+            # line (whose only tensor<> is the replica-groups constant).
+            for j in range(i + 1, min(i + 12, len(lines))):
+                if "})" in lines[j]:
+                    payload = max(payload, payload_of(lines[j]))
+                    break
+        if payload >= min_bytes:
+            n += 1
+    return n
 
 
 def host_pipeline_ab(trainer, init_state, make_chunks, *, depth=2):
@@ -825,6 +880,174 @@ def run_pa(args):
 
 
 # ---------------------------------------------------------------------------
+# Two-tier storage A/B (zipf skew; replicated hot head vs sharded-only)
+# ---------------------------------------------------------------------------
+
+def _zipf_ratings(num_users, num_items, n, *, alpha=1.05, rank=3, seed=0):
+    """Planted low-rank ratings whose ITEM stream is zipf-skewed with
+    frequency-ranked ids (hottest first — the head convention every
+    tier/hot_ids consumer assumes; real ML20M/text8/Criteo streams have
+    exactly this shape)."""
+    rng = np.random.default_rng(seed)
+    p = 1.0 / np.arange(1, num_items + 1) ** alpha
+    p /= p.sum()
+    user = rng.integers(0, num_users, n).astype(np.int32)
+    item = rng.choice(num_items, size=n, p=p).astype(np.int32)
+    uf = rng.normal(0, 1.0 / rank ** 0.5, (num_users, rank))
+    vf = rng.normal(0, 1.0 / rank ** 0.5, (num_items, rank))
+    rating = ((uf[user] * vf[item]).sum(1)
+              + rng.normal(0, 0.1, n)).astype(np.float32)
+    return {"user": user, "item": item, "rating": rating}
+
+
+def _reexec_tiered_subprocess():
+    """Run ``--workload tiered`` in a cleaned 8-CPU-device subprocess
+    (same pattern as ``__graft_entry__``'s dryrun re-exec): the A/B is
+    specified over the 8-device mesh, and a single-chip TPU process
+    cannot widen itself in-place."""
+    import os
+    import subprocess
+
+    from fps_tpu.utils.hostenv import cpu_mesh_env, reexec_count
+
+    if reexec_count() >= 8:
+        raise RuntimeError(
+            "tiered A/B needs 8 devices, still short after re-exec")
+    root = os.path.dirname(os.path.abspath(__file__))
+    env = cpu_mesh_env(8)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [root] + [p for p in env["PYTHONPATH"].split(os.pathsep) if p]
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"),
+         "--workload", "tiered"],
+        env=env, cwd=root, capture_output=True, text=True, timeout=1500,
+    )
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError(
+        f"tiered re-exec produced no JSON; tail: "
+        f"{(r.stdout + r.stderr)[-800:]}")
+
+
+def run_tiered(args):
+    """Zipf-skew two-tier A/B on the 8-device mesh: the same chunk
+    stream trained twice — hot tier OFF (sharded-only: per-step
+    collective pull/push) and ON (replicated hot head, per-device delta
+    buffers, one psum reconcile per ``hot_sync_every`` window). Reports
+    per-chunk cross-shard collective count (from the lowered program;
+    see :func:`count_collectives`) and examples/s for both arms. The
+    acceptance signal: strictly fewer collectives AND no throughput
+    regression with the tier on."""
+    import dataclasses
+
+    import jax
+
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.core.ingest import epoch_chunks
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.parallel.mesh import (
+        default_mesh_shape, key_to_replicated, make_ps_mesh,
+    )
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        return _reexec_tiered_subprocess()
+    nd, ns = default_mesh_shape(8)
+    mesh = make_ps_mesh(num_shards=ns, num_data=nd, devices=devs[:8])
+    W = num_workers_of(mesh)
+
+    NU, NI, RANK = 4096, 4096, 16
+    E_SYNC = 4          # hot_sync_every: the parameter-plane SSP bound
+    LOCAL_BATCH, SPC, CHUNKS = 1024, 8, 12
+    data = _zipf_ratings(NU, NI, W * LOCAL_BATCH * SPC * CHUNKS, seed=0)
+
+    def make_chunks():
+        return epoch_chunks(data, num_workers=W, local_batch=LOCAL_BATCH,
+                            steps_per_chunk=SPC, route_key="user", seed=5)
+
+    out = {"hot_sync_every": E_SYNC, "hot_tier_rows": NI,
+           "zipf_alpha": 1.05, "mesh": dict(mesh.shape)}
+    rates = {}
+    for label, H in (("off", 0), ("on", NI)):
+        cfg = MFConfig(num_users=NU, num_items=NI, rank=RANK,
+                       learning_rate=0.05)
+        # Per-id mean combine: zipf-hot duplicate ids need the averaged
+        # step (run_mf's reasoning) — and it exercises the tier's
+        # windowed count-normalized reconcile.
+        trainer, store = online_mf(mesh, cfg, combine="mean")
+        if H:
+            store.specs["item_factors"] = dataclasses.replace(
+                store.specs["item_factors"], hot_tier=H)
+            trainer.config = dataclasses.replace(
+                trainer.config, hot_sync_every=E_SYNC)
+        from fps_tpu import obs
+
+        # Static collective count of the per-chunk program.
+        tables, ls = trainer.init_state(jax.random.key(0))
+        tables = trainer._attach_hot(tables)
+        chunk0 = next(make_chunks())
+        placed = trainer._place_chunk(chunk0, "sync")
+        key = key_to_replicated(jax.random.key(1), mesh)
+        hlo = trainer._get_compiled("sync").lower(
+            tables, ls, placed, key).as_text()
+        colls = count_collectives(hlo)
+
+        # Warm-up (compile), then timed run on fresh state with a fresh
+        # recorder — the hit-rate counters must scope the timed pass
+        # only, not the warm-up traffic.
+        from itertools import islice
+
+        trainer.fit_stream(tables, ls, islice(make_chunks(), 2),
+                           jax.random.key(9))
+        rec = obs.Recorder(sinks=[])
+        trainer.recorder = rec
+        tables, ls = trainer.init_state(jax.random.key(0))
+        t0 = time.perf_counter()
+        tables, ls, m = trainer.fit_stream(
+            tables, ls, make_chunks(), jax.random.key(1))
+        wall = time.perf_counter() - t0
+        n_ex = float(sum(np.asarray(mm["n"]).sum() for mm in m))
+        se = float(sum(np.asarray(mm["se"]).sum() for mm in m))
+        rates[label] = n_ex / wall
+        arm = {
+            "collectives_per_chunk": colls,
+            "examples_per_sec": round(n_ex / wall, 1),
+            "wall_s": round(wall, 4),
+            "train_rmse": round((se / max(n_ex, 1.0)) ** 0.5, 4),
+        }
+        if H:
+            hr = rec.counter_value("hot_tier.hot_rows",
+                                   table="item_factors")
+            pr = rec.counter_value("hot_tier.pulled_rows",
+                                   table="item_factors")
+            arm["hot_hit_rate"] = round(hr / pr, 4) if pr else None
+        out[label] = arm
+
+    off, on = out["off"], out["on"]
+    out["collectives_fewer"] = (on["collectives_per_chunk"]
+                                < off["collectives_per_chunk"])
+    out["speedup"] = round(rates["on"] / rates["off"], 3)
+    print(
+        f"tiered A/B: collectives/chunk {off['collectives_per_chunk']} -> "
+        f"{on['collectives_per_chunk']}, examples/s "
+        f"{off['examples_per_sec']:.0f} -> {on['examples_per_sec']:.0f}, "
+        f"hot hit rate {on.get('hot_hit_rate')}", file=sys.stderr)
+    return {
+        "metric": "zipf_mf_two_tier_examples_per_sec",
+        "value": on["examples_per_sec"],
+        "unit": "examples/s",
+        # The A/B's own ratio: tier-on throughput over tier-off on the
+        # same mesh/stream (no native-loop analog exists for this one).
+        "vs_baseline": out["speedup"],
+        **out,
+    }
+
+
+# ---------------------------------------------------------------------------
 # iALS (required extension; no reference baseline exists)
 # ---------------------------------------------------------------------------
 
@@ -897,7 +1120,7 @@ def run_ials(args):
 
 
 RUNNERS = {"mf": run_mf, "w2v": run_w2v, "logreg": run_logreg,
-           "pa": run_pa, "ials": run_ials}
+           "pa": run_pa, "ials": run_ials, "tiered": run_tiered}
 
 
 def compact_summary(results):
@@ -950,7 +1173,8 @@ def _enable_compilation_cache():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="all",
-                    choices=["all", "mf", "w2v", "logreg", "pa", "ials"])
+                    choices=["all", "mf", "w2v", "logreg", "pa", "ials",
+                             "tiered"])
     ap.add_argument("--scale", default="20m", choices=["100k", "1m", "20m"])
     ap.add_argument("--rank", type=int, default=10)
     ap.add_argument("--local-batch", type=int, default=32768)
@@ -975,7 +1199,7 @@ def main():
 
     if args.workload == "all":
         # Headline (mf) LAST among the per-workload lines.
-        order = ["w2v", "logreg", "pa", "ials", "mf"]
+        order = ["w2v", "logreg", "pa", "ials", "tiered", "mf"]
     else:
         order = [args.workload]
     results = {}

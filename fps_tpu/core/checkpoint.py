@@ -136,7 +136,23 @@ def _ls_format(z) -> str:
 # ---------------------------------------------------------------------------
 
 def _table_arrays(store: ParamStore) -> dict[str, np.ndarray]:
-    """All tables as npz entries, logical id order, padding stripped."""
+    """All tables as npz entries, logical id order, padding stripped.
+
+    Spec-driven by design: under two-tier hot storage the live tables
+    dict also carries replicated hot-head entries (``hot_key(name)``,
+    never in ``store.specs``) — a snapshot stays ONE canonical table per
+    spec. The drivers flush-reconcile every compiled call, so at any
+    save boundary the sharded table already folds all hot pushes;
+    restore re-splits via ``Trainer._attach_hot``. A checkpoint written
+    under the tier is therefore byte-compatible with (and restorable
+    by) an untiered run of the same state.
+    """
+    from fps_tpu.core.store import is_hot_key
+
+    assert not any(is_hot_key(name) for name in store.specs), (
+        "hot-replica entries must never be registered as specs — the "
+        "canonical sharded table is the only serialized form"
+    )
     return {
         f"table{_SEP}{name}": store.dump_model(name)[1] for name in store.specs
     }
@@ -208,6 +224,12 @@ def load_rows(
         store.tables[name] = jax.make_array_from_callback(
             host.shape, store.sharding, lambda idx: host[idx]
         )
+    # A live hot replica (two-tier storage) of this table is now stale —
+    # drop it; the next run entry re-splits from the rewritten canonical
+    # table.
+    from fps_tpu.core.store import hot_key
+
+    store.tables.pop(hot_key(name), None)
 
 
 def load_model(
@@ -577,6 +599,15 @@ class Checkpointer:
                     f"store spec ({spec.num_ids}, {spec.dim})"
                 )
             load_rows(store, name, np.arange(len(values)), values)
+        # Any live hot-replica entries (two-tier storage) are projections
+        # of the state just overwritten — stale now. Drop them so the
+        # run-entry re-split (Trainer._attach_hot) derives fresh replicas
+        # from the restored canonical tables instead of silently serving
+        # pre-restore values.
+        from fps_tpu.core.store import is_hot_key
+
+        for key in [k for k in store.tables if is_hot_key(k)]:
+            del store.tables[key]
         return dict(store.tables)
 
     def restore_tables(

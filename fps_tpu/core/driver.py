@@ -40,7 +40,22 @@ from fps_tpu.core import resilience
 from fps_tpu.core.api import ServerLogic, WorkerLogic
 from fps_tpu.core.prefetch import ChunkPrefetcher, PlacedChunk
 from fps_tpu.core.resilience import GuardConfig, RollbackPolicy
-from fps_tpu.core.store import ParamStore, id_to_phys, pull, pull_local, push
+from fps_tpu.core.store import (
+    ParamStore,
+    accumulate_hot,
+    hot_base,
+    hot_delta_init,
+    hot_key,
+    id_to_phys,
+    is_hot_key,
+    pull,
+    pull_hot,
+    pull_local,
+    push,
+    reconcile_hot,
+    split_hot,
+    split_hot_push,
+)
 from fps_tpu.obs.health import (
     HEALTH_ABORT,
     HEALTH_ESCALATE,
@@ -181,6 +196,23 @@ class TrainerConfig:
     # buffers (was a hardcoded 8). 0 = never drain mid-stream (bounded
     # streams whose caller wants zero mid-stream syncs).
     metrics_drain_every: int = 8
+    # Reconcile cadence, in steps, of the two-tier hot storage
+    # (TableSpec.hot_tier; docs/performance.md "Two-tier storage"): hot
+    # pushes accumulate into per-device delta buffers that one psum folds
+    # into the replica + the canonical table every hot_sync_every steps —
+    # the SSP staleness bound applied to the parameter plane. 1 (default)
+    # is the EXACT mode: the tier disengages and the driver lowers the
+    # identical untiered program (bit-identical tables/metrics/
+    # checkpoints by construction — a per-step psum reconcile could not
+    # reproduce the gathered scatter's summation order; see the store
+    # module docstring). In SSP mode the reconcile rides the sync_every
+    # round boundary (the snapshot gather must see reconciled head rows),
+    # so the effective parameter-plane bound there is sync_every; this
+    # knob still gates the tier on/off. Every compiled call ends with a
+    # flush reconcile, so chunk/epoch boundaries always hold one
+    # canonical table (checkpoints/rollback need no special casing).
+    # Part of the compile-cache key.
+    hot_sync_every: int = 1
     # Upper bound on scan steps per compiled call in run_indexed. A single
     # device program must not run for minutes (the TPU runtime enforces a
     # per-dispatch execution deadline — observed ~45s on tunneled chips,
@@ -401,6 +433,99 @@ class Trainer:
             )
         return bool(spec.dense_collectives)
 
+    def _resolve_hot_tier(self, spec) -> int:
+        """GLOBAL replicated-head row count for this table on this mesh
+        under the current config (0 = untiered). Static per compiled
+        program — keyed into the compile cache alongside hot_sync_every.
+
+        The tier engages only where it can win AND stay correct:
+
+        * multi-device meshes (a single device already pulls/pushes with
+          zero collectives);
+        * ``hot_sync_every > 1`` — 1 is the exact mode, implemented as
+          the untiered program itself (see TrainerConfig);
+        * additive ("sum") or "mean" server folds: windowed accumulation
+          needs delta sums (+ counts) to commute with the fold; apply_fn
+          and max/min/callable combines need per-push combine-then-apply
+          over the gathered union, so those tables keep the gathered
+          route untouched.
+        """
+        H = spec.hot_tier
+        if isinstance(H, str):
+            # Fail at the right altitude, like hot_ids/dense_collectives.
+            raise ValueError(
+                f"table {spec.name!r}: hot_tier={H!r} — expected an int"
+            )
+        if H < 0:
+            raise ValueError(
+                f"table {spec.name!r}: hot_tier={H} must be >= 0"
+            )
+        if not H:
+            return 0
+        if self.num_shards * self.mesh.shape[DATA_AXIS] == 1:
+            return 0
+        if self.config.hot_sync_every <= 1:
+            return 0
+        sl = self.server_logic[spec.name]
+        if sl.apply_fn is not None or sl.combine not in ("sum", "mean"):
+            return 0
+        return min(int(H), spec.num_ids)
+
+    def _hot_tier_map(self) -> dict[str, int]:
+        """{table: replicated head rows} for every table the tier resolves
+        ON for. Empty dict = the untiered program of old, byte-identical."""
+        tier = {}
+        for name, spec in self.store.specs.items():
+            H = self._resolve_hot_tier(spec)
+            if H:
+                tier[name] = H
+        if tier and self.config.push_delay:
+            raise ValueError(
+                "hot_tier and push_delay cannot combine: delayed delivery "
+                "would re-order the windowed reconcile against the ring "
+                "buffer. Disable one (hot tier tables: "
+                f"{sorted(tier)})"
+            )
+        return tier
+
+    def _attach_hot(self, tables, timer=None):
+        """Entry-point re-split: make ``tables`` carry exactly the replica
+        entries the current tier resolution calls for.
+
+        Replicas are derived from the canonical sharded table — valid at
+        any call boundary because every compiled call ends with a flush
+        reconcile. Covers every way state reaches a run: ``init_state``,
+        ``restore_checkpoint`` (a checkpoint is one canonical table;
+        this is the re-split), warm starts, and config changes between
+        runs (stale/resized replicas are dropped and re-derived; a tier
+        turned off strips its replica so the lowered program is the
+        untiered one again). Idempotent and O(specs) when nothing
+        changed, so the per-chunk call from ``run_chunk`` costs dict
+        lookups only.
+        """
+        tier = self._hot_tier_map()
+        if not tier and not any(is_hot_key(k) for k in tables):
+            return tables
+        out = {}
+        for k, v in tables.items():
+            if not is_hot_key(k):
+                out[k] = v
+                continue
+            name = hot_base(k)
+            if name in tier and v.shape[0] == tier[name]:
+                out[k] = v  # live, correctly-sized replica: keep as is
+        missing = [name for name in tier if hot_key(name) not in out]
+        if not missing:
+            return out
+        # Only an actual derivation pays (and records) the reconcile
+        # phase — the steady-state per-chunk call is pure dict checks.
+        with _phase(timer, "reconcile"):
+            for name in missing:
+                out[hot_key(name)] = self.store.head_replica(
+                    name, tier[name], out[name]
+                )
+        return out
+
     def _head_prefix(self, batch) -> dict:
         """Resolve the worker's head-prefix guarantee for this batch.
 
@@ -451,30 +576,71 @@ class Trainer:
             )
         return new_tables
 
-    def _compute_step(self, tables, snapshot, local_state, batch, key):
+    def _compute_step(self, tables, snapshot, local_state, batch, key,
+                      hot=None, tier=None):
         """Pull (from live tables, or the SSP ``snapshot`` when given), run
         the worker step, and return its pushes WITHOUT applying them,
-        plus the (static) head-prefix guarantee for those pushes."""
+        plus the (static) head-prefix guarantee for those pushes and the
+        hot-tier pull accounting ({} when the tier is off — nothing extra
+        is traced then).
+
+        ``hot``/``tier``: the replicated hot-head arrays and the resolved
+        {table: H} map. Sync-mode pulls partition on ``id < H``: hot rows
+        are a LOCAL replica gather (zero collectives — when H covers the
+        whole table the collective route is statically elided outright);
+        cold rows ride the existing routes with hot slots masked to -1
+        (the zero-row contract). SSP pulls already read a local snapshot
+        whose head rows match the replica (reconcile precedes each round's
+        gather), so they stay untouched.
+        """
+        tier = tier or {}
         key, prep_key = jax.random.split(key)
         batch = self.logic.prepare(batch, prep_key)
         ids = self.logic.pull_ids(batch)
         hp = self._head_prefix(batch)
+        hot_counts = {}
+        if snapshot is None:
+            # Hit-rate accounting only where the replica actually serves
+            # the reads: SSP pulls come from the per-round snapshot, so
+            # counting them would misattribute snapshot gathers as
+            # collective-free tier hits.
+            for name, tids in ids.items():
+                H = tier.get(name, 0)
+                if H:
+                    live = jnp.sum(tids >= 0, dtype=jnp.int32)
+                    nhot = jnp.sum((tids >= 0) & (tids < H),
+                                   dtype=jnp.int32)
+                    hot_counts[name] = {"hot_rows": nhot,
+                                        "pulled_rows": live}
         # fps.pull / fps.compute named scopes: device-timeline attribution
         # for the phases the host PhaseTimer cannot split (pull, worker
         # compute, and push fuse into one dispatch) — pure op metadata,
         # visible under obs.trace() / --profile, free otherwise.
         with jax.named_scope("fps.pull"):
             if snapshot is None:
-                pulled = {
-                    name: pull(
+                pulled = {}
+                for name, tids in ids.items():
+                    H = tier.get(name, 0)
+                    spec = self.store.specs[name]
+                    if H >= spec.num_ids:
+                        # Fully-replicated table: the collective route is
+                        # statically gone — a plain local gather.
+                        pulled[name] = ops.gather_rows(hot[name], tids)
+                        continue
+                    if H:
+                        hot_vals, hmask = pull_hot(hot[name], tids,
+                                                   hot_ids=H)
+                        tids = jnp.where(hmask,
+                                         jnp.asarray(-1, tids.dtype), tids)
+                    vals = pull(
                         tables[name], tids, num_shards=self.num_shards,
-                        dense=self._resolve_dense(self.store.specs[name]),
-                        hot_rows=self._resolve_hot_rows(
-                            self.store.specs[name]),
+                        dense=self._resolve_dense(spec),
+                        hot_rows=self._resolve_hot_rows(spec),
                         head_prefix=hp.get(name, 0),
                     )
-                    for name, tids in ids.items()
-                }
+                    if H:
+                        vals = jnp.where(hmask[:, None], hot_vals, vals)
+                    pulled[name] = vals
             else:
                 pulled = {}
                 for name, tids in ids.items():
@@ -526,7 +692,7 @@ class Trainer:
                         "key — it would collide with the guard's counters"
                     )
                 outch = dict(outch, **{resilience.HEALTH_KEY: health})
-        return pushes, new_local, outch, hp
+        return pushes, new_local, outch, hp, hot_counts
 
     # -- delayed pushes (async in-flight emulation) ------------------------
 
@@ -630,14 +796,138 @@ class Trainer:
 
         return lax.fori_loop(0, d, body, tables)
 
+    # -- two-tier hot storage (device-side step/window plumbing) ----------
+
+    def _hot_mean(self, name: str) -> bool:
+        return self.server_logic[name].combine == "mean"
+
+    def _init_hot_deltas(self, tables, tier):
+        """Fresh per-device pending-delta buffers ({} when untiered).
+        Created inside the traced call and flushed before it returns, so
+        they never exist at a host-visible boundary."""
+        return {
+            name: hot_delta_init(
+                H, tables[name].shape[1], tables[name].dtype,
+                mean=self._hot_mean(name),
+            )
+            for name, H in tier.items()
+        }
+
+    def _apply_hot_split(self, tables, delta, pushes, tier, hp):
+        """Partition each table's pushes on ``id < H``, apply the cold
+        part through the existing routes (statically elided when H covers
+        the table) and fold the hot part into the pending buffers."""
+        if not tier:
+            return self._apply_pushes(tables, pushes, hp), delta
+        cold_pushes = {}
+        new_delta = dict(delta)
+        with jax.named_scope("fps.hot_accumulate"):
+            for name, (pids, pdeltas) in pushes.items():
+                H = tier.get(name, 0)
+                if not H:
+                    cold_pushes[name] = (pids, pdeltas)
+                    continue
+                spec = self.store.specs[name]
+                if H >= spec.num_ids:
+                    hots = (pids, pdeltas)  # no cold residue to push
+                else:
+                    cold_pushes[name], hots = split_hot_push(
+                        pids, pdeltas, hot_ids=H
+                    )
+                new_delta[name] = accumulate_hot(
+                    delta[name], *hots, mean=self._hot_mean(name)
+                )
+        return self._apply_pushes(tables, cold_pushes, hp), new_delta
+
+    def _reconcile_carry(self, carry, tier):
+        """Window-boundary reconcile over every tiered table (identity
+        when untiered): one psum per table folds the pending buffers into
+        replica + canonical table and zeroes the buffers."""
+        if not tier:
+            return carry
+        tables, hot, delta = carry[0], carry[1], carry[2]
+        tables, hot, delta = dict(tables), dict(hot), dict(delta)
+        data_axis = DATA_AXIS if self.mesh.shape[DATA_AXIS] > 1 else None
+        with jax.named_scope("fps.reconcile"):
+            for name, H in tier.items():
+                tables[name], hot[name], delta[name] = reconcile_hot(
+                    tables[name], hot[name], delta[name],
+                    num_shards=self.num_shards,
+                    data_axis=data_axis,
+                    mean=self._hot_mean(name),
+                )
+        return (tables, hot, delta) + tuple(carry[3:])
+
+    def _windowed_scan(self, step, carry0, tier, *, head, tail):
+        """Scan in reconcile windows: ``head`` is the stacked xs of the
+        full windows (leading dims ``(R, E)``, or None when R == 0),
+        ``tail`` the ragged remainder's xs (or None). Each window — and
+        the tail — ends in a reconcile, so the final carry always holds
+        one canonical table. Shared by the chunked and indexed sync
+        builders so the window/flush semantics cannot drift between the
+        two drivers."""
+
+        def window_body(c, xs_w):
+            c, o = lax.scan(step, c, xs_w)
+            return self._reconcile_carry(c, tier), o
+
+        parts, carry = [], carry0
+        if head is not None:
+            carry, outs_h = lax.scan(window_body, carry, head)
+            parts.append(jax.tree.map(
+                lambda x: x.reshape((-1,) + x.shape[2:]), outs_h))
+        if tail is not None:
+            carry, outs_t = lax.scan(step, carry, tail)
+            carry = self._reconcile_carry(carry, tier)
+            parts.append(outs_t)
+        outs = parts[0] if len(parts) == 1 else jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *parts)
+        return carry, outs
+
+    def _mount_hot_channel(self, out, hot_counts, delta, tier):
+        """Attach the hot-tier telemetry to the worker out channel (the
+        health channel's transport): per-table hit counts plus the
+        pending-buffer magnitude — the parameter-plane staleness gauge.
+        Traced only when the tier is on; same dict/collision contract as
+        the guard's health entry."""
+        if not tier:
+            return out
+        if not isinstance(out, dict):
+            raise TypeError(
+                "TableSpec.hot_tier requires the worker's out channel to "
+                "be a dict so the hot-tier counters can ride it (got "
+                f"{type(out).__name__})"
+            )
+        if resilience.HOT_TIER_KEY in out:
+            raise ValueError(
+                "the worker's out channel already has a 'hot_tier' key — "
+                "it would collide with the tier's counters"
+            )
+        chan = {}
+        for name, H in tier.items():
+            counts = dict(hot_counts.get(name, {}))
+            buf = delta[name]
+            dim = buf.shape[1] - (1 if self._hot_mean(name) else 0)
+            # Per-device sum of squared pending deltas (psum'd with the
+            # rest of the out channel into the global magnitude).
+            counts["delta_sq"] = jnp.sum(
+                buf[:, :dim].astype(jnp.float32) ** 2
+            )
+            chan[name] = counts
+        return dict(out, **{resilience.HOT_TIER_KEY: chan})
+
     # -- compiled chunk runners ------------------------------------------
 
     def _build_chunk_fn(self, mode: str):
         nbatch_dims = 1 if mode == "sync" else 2
+        tier = self._hot_tier_map()
+        E = self.config.hot_sync_every
 
         def chunk_device(tables, local_state, batches, key):
             # Per-device key stream, decorrelated across workers.
             key = jax.random.fold_in(key, worker_index())
+            tables, hot = split_hot(tables)
+            delta = self._init_hot_deltas(tables, tier)
             bufs = None
             if self.config.push_delay:
                 batch0 = jax.tree.map(
@@ -648,25 +938,47 @@ class Trainer:
             hp_seen = {}
 
             def step_fn(carry, batch_t, snapshot=None):
-                tables, bufs, local_state, key, t = carry
+                tables, hot, delta, bufs, local_state, key, t = carry
                 key, sub = jax.random.split(key)
-                pushes, local_state, out, hp = self._compute_step(
-                    tables, snapshot, local_state, batch_t, sub
+                pushes, local_state, out, hp, hcounts = self._compute_step(
+                    tables, snapshot, local_state, batch_t, sub,
+                    hot=hot, tier=tier,
                 )
                 hp_seen.update(hp)  # static, identical every traced step
-                tables, bufs = self._apply_or_buffer(tables, bufs, t, pushes,
-                                                     hp)
+                if tier:
+                    tables, delta = self._apply_hot_split(
+                        tables, delta, pushes, tier, hp)
+                else:
+                    tables, bufs = self._apply_or_buffer(
+                        tables, bufs, t, pushes, hp)
+                out = self._mount_hot_channel(out, hcounts, delta, tier)
                 out = jax.tree.map(
                     lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
                 )
                 out = self._run_tap(out, tables, batch_t, local_state, t)
-                return (tables, bufs, local_state, key, t + 1), out
+                return (tables, hot, delta, bufs, local_state, key,
+                        t + 1), out
 
-            carry0 = (tables, bufs, local_state, key, jnp.int32(0))
+            carry0 = (tables, hot, delta, bufs, local_state, key,
+                      jnp.int32(0))
             if mode == "sync":
-                (tables, bufs, local_state, _, t), outs = lax.scan(
-                    step_fn, carry0, batches
-                )
+                if not tier:
+                    carry, outs = lax.scan(step_fn, carry0, batches)
+                else:
+                    # Windows of E steps, a flush reconcile on the ragged
+                    # tail: the call always returns one canonical table.
+                    T = jax.tree.leaves(batches)[0].shape[0]
+                    R, rem = divmod(T, E)
+                    carry, outs = self._windowed_scan(
+                        step_fn, carry0, tier,
+                        head=jax.tree.map(
+                            lambda x: x[:R * E].reshape(
+                                (R, E) + x.shape[1:]),
+                            batches) if R else None,
+                        tail=jax.tree.map(lambda x: x[R * E:], batches)
+                        if rem else None,
+                    )
+                (tables, hot, delta, bufs, local_state, _, t) = carry
             else:
                 # SSP: batches leaves are (R, s, B_local, ...).
                 def round_body(carry, batches_r):
@@ -675,20 +987,27 @@ class Trainer:
                         name: lax.all_gather(tb, SHARD_AXIS, tiled=True)
                         for name, tb in tables.items()
                     }
-                    return lax.scan(
-                        lambda c, b: step_fn(c, b, snapshot), carry, batches_r
+                    carry, outs = lax.scan(
+                        lambda c, b: step_fn(c, b, snapshot), carry,
+                        batches_r
                     )
+                    # Hot reconcile rides the round boundary: the next
+                    # round's snapshot gather must see reconciled head
+                    # rows (identity when untiered).
+                    return self._reconcile_carry(carry, tier), outs
 
-                (tables, bufs, local_state, _, t), outs = lax.scan(
-                    round_body, carry0, batches
-                )
+                (tables, hot, delta, bufs, local_state, _, t), outs = (
+                    lax.scan(round_body, carry0, batches))
                 outs = jax.tree.map(
                     lambda x: x.reshape((-1,) + x.shape[2:]), outs
                 )
             tables = self._flush_push_bufs(tables, bufs, t, hp_seen)
+            tables = {**tables,
+                      **{hot_key(n): v for n, v in hot.items()}}
             return tables, local_state, outs
 
         table_specs = {name: P(SHARD_AXIS, None) for name in self.store.specs}
+        table_specs.update({hot_key(name): P() for name in tier})
         ls_spec = P(WORKER_AXES)
 
         def specs_for_batches(batches):
@@ -737,7 +1056,8 @@ class Trainer:
         # effect on the next chunk, not be shadowed by the jit cache.
         key = (mode, ops.get_backend(), self.config.push_delay,
                self.config.step_tap, resilience.as_guard(self.config.guard),
-               self._server_logic_key())
+               self._server_logic_key(), self.config.hot_sync_every,
+               tuple(sorted(self._hot_tier_map().items())))
         if key not in self._compiled:
             self._compiled[key] = self._build_chunk_fn(mode)
         return self._compiled[key]
@@ -773,10 +1093,14 @@ class Trainer:
         traffic (:class:`fps_tpu.core.device_ingest.DeviceEpochPlan`)."""
         T = self._indexed_call_steps(plan)
         s = self.config.sync_every
+        tier = self._hot_tier_map()
+        E = self.config.hot_sync_every
 
         def epoch_device(tables, local_state, iargs, start, key):
             widx = worker_index()
             key = jax.random.fold_in(key, widx)
+            tables, hot = split_hot(tables)
+            delta = self._init_hot_deltas(tables, tier)
             bufs = None
             if self.config.push_delay:
                 # Probe batch for push shapes (unused value, DCE'd by XLA).
@@ -786,29 +1110,57 @@ class Trainer:
             hp_seen = {}
 
             def step_t(carry, t, snapshot=None):
-                tables, bufs, local_state, key = carry
+                tables, hot, delta, bufs, local_state, key = carry
                 key, sub = jax.random.split(key)
                 batch = plan.local_batch_at(iargs, widx, t)
-                pushes, local_state, out, hp = self._compute_step(
-                    tables, snapshot, local_state, batch, sub
+                pushes, local_state, out, hp, hcounts = self._compute_step(
+                    tables, snapshot, local_state, batch, sub,
+                    hot=hot, tier=tier,
                 )
                 hp_seen.update(hp)  # static, identical every traced step
-                tables, bufs = self._apply_or_buffer(tables, bufs, t, pushes,
-                                                     hp)
+                if tier:
+                    tables, delta = self._apply_hot_split(
+                        tables, delta, pushes, tier, hp)
+                else:
+                    tables, bufs = self._apply_or_buffer(
+                        tables, bufs, t, pushes, hp)
+                out = self._mount_hot_channel(out, hcounts, delta, tier)
                 out = jax.tree.map(
                     lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
                 )
                 out = self._run_tap(out, tables, batch, local_state, t)
-                return (tables, bufs, local_state, key), out
+                return (tables, hot, delta, bufs, local_state, key), out
 
-            carry0 = (tables, bufs, local_state, key)
-            if mode == "sync":
-                (tables, bufs, local_state, _), outs = lax.scan(
-                    step_t, carry0, start + jnp.arange(T, dtype=jnp.int32),
-                )
+            def finish(carry, outs):
+                tables, hot, delta, bufs, local_state, _ = carry
                 tables = self._flush_push_bufs(tables, bufs, start + T,
                                                hp_seen)
+                tables = {**tables,
+                          **{hot_key(n): v for n, v in hot.items()}}
                 return tables, local_state, outs
+
+            carry0 = (tables, hot, delta, bufs, local_state, key)
+            if mode == "sync":
+                if not tier:
+                    carry, outs = lax.scan(
+                        step_t, carry0,
+                        start + jnp.arange(T, dtype=jnp.int32),
+                    )
+                    return finish(carry, outs)
+                # Windows of E steps + a flush reconcile on the ragged
+                # tail — every call returns one canonical table. The
+                # scanned xs are the step indices themselves, stacked
+                # (R, E) for the full windows.
+                R, rem = divmod(T, E)
+                carry, outs = self._windowed_scan(
+                    step_t, carry0, tier,
+                    head=(start + jnp.arange(R * E, dtype=jnp.int32)
+                          .reshape(R, E)) if R else None,
+                    tail=(start + R * E
+                          + jnp.arange(rem, dtype=jnp.int32))
+                    if rem else None,
+                )
+                return finish(carry, outs)
 
             def round_body(carry, r):
                 tables = carry[0]
@@ -816,19 +1168,23 @@ class Trainer:
                     name: lax.all_gather(tb, SHARD_AXIS, tiled=True)
                     for name, tb in tables.items()
                 }
-                return lax.scan(
+                carry, outs = lax.scan(
                     lambda c, t: step_t(c, t, snapshot), carry,
                     start + r * s + jnp.arange(s, dtype=jnp.int32),
                 )
+                # Hot reconcile rides the round boundary (identity when
+                # untiered): the next snapshot gather sees reconciled
+                # head rows.
+                return self._reconcile_carry(carry, tier), outs
 
-            (tables, bufs, local_state, _), outs = lax.scan(
+            carry, outs = lax.scan(
                 round_body, carry0, jnp.arange(T // s, dtype=jnp.int32),
             )
-            tables = self._flush_push_bufs(tables, bufs, start + T, hp_seen)
             outs = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), outs)
-            return tables, local_state, outs
+            return finish(carry, outs)
 
         table_specs = {name: P(SHARD_AXIS, None) for name in self.store.specs}
+        table_specs.update({hot_key(name): P() for name in tier})
         ls_spec = P(WORKER_AXES)
 
         def run(tables, local_state, iargs, start, key):
@@ -917,6 +1273,28 @@ class Trainer:
         sync / callback / deferred paths of both drivers cannot drift.
         Returns the poisoned-row total (what HealthMonitor thresholds)."""
         poison = self._record_health(rec, metrics)
+        ht = (metrics.get(resilience.HOT_TIER_KEY)
+              if isinstance(metrics, Mapping) else None)
+        if ht and rec is not None:
+            for table, counters in ht.items():
+                # .get: a tiered table the worker pushes to but never
+                # pulls (or an SSP run, where reads come from the round
+                # snapshot, not the replica) carries no pull counters.
+                rec.inc("hot_tier.hot_rows",
+                        float(np.sum(np.asarray(
+                            counters.get("hot_rows", 0)))),
+                        table=table)
+                rec.inc("hot_tier.pulled_rows",
+                        float(np.sum(np.asarray(
+                            counters.get("pulled_rows", 0)))),
+                        table=table)
+                # Peak pending-delta magnitude across the call's steps —
+                # the parameter-plane staleness gauge (always 0 at the
+                # boundary itself: the flush reconcile drained it).
+                ds = np.asarray(counters.get("delta_sq", 0.0))
+                rec.set("hot_tier.pending_delta",
+                        float(np.sqrt(np.max(ds))) if ds.size else 0.0,
+                        table=table)
         if rec is not None:
             if poison:
                 rec.inc("health.poisoned_chunks")
@@ -998,7 +1376,8 @@ class Trainer:
         ck = ("indexed", mode, plan, ops.get_backend(),
               self.config.push_delay, self.config.step_tap,
               resilience.as_guard(self.config.guard),
-              self._server_logic_key())
+              self._server_logic_key(), self.config.hot_sync_every,
+              tuple(sorted(self._hot_tier_map().items())))
         if ck not in self._compiled:
             self._compiled[ck] = self._build_indexed_fn(plan, mode)
         return self._compiled[ck]
@@ -1074,6 +1453,9 @@ class Trainer:
         n_calls = -(-T // T_call)
         all_metrics = []
         end_epoch = start_epoch + epochs
+        # Two-tier re-split at run entry (restore/warm-start/config
+        # changes); per-epoch calls keep the attached structure.
+        tables = self._attach_hot(tables, timer)
         try:
             for e in range(start_epoch, end_epoch):
                 if rollback is not None and e in rollback.preset:
@@ -1220,6 +1602,10 @@ class Trainer:
           equal to the number of steps in the chunk (global sums per step).
         """
         mode = "sync" if self.config.sync_every is None else "ssp"
+        # Two-tier re-split (no-op dict bookkeeping when already attached
+        # or untiered): the compiled program's table structure must match
+        # the current hot-tier resolution exactly.
+        tables = self._attach_hot(tables, timer)
         with _phase(timer, "place"):
             if isinstance(batches, PlacedChunk):
                 # The prefetch pipeline already ran _place_chunk on its
@@ -1401,6 +1787,9 @@ class Trainer:
         i = start_step - 1
         pending = None       # lag-by-one: one dispatched, unadjudicated chunk
         pending_save = None  # deferred (overlapped) boundary snapshot
+        # Two-tier re-split at stream entry; run_chunk keeps the attached
+        # structure live across the loop.
+        tables = self._attach_hot(tables, timer)
 
         def save_due(j):
             return (checkpointer is not None and checkpoint_every > 0

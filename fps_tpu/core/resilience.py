@@ -47,6 +47,17 @@ HEALTH_KEY = "health"
 # the local guard is on, so the two planes can never collide.
 LOCAL_STATE_KEY = "local_state"
 
+# Out-channel entry name for the two-tier hot-storage telemetry
+# (per-table hot/pulled row counts + pending-delta magnitude — the
+# parameter-plane staleness gauge riding the health channel's transport).
+# Mounted by the driver with the same dict-out-channel + collision
+# contract as HEALTH_KEY; rollback snapshots taken under a hot tier
+# carry the replica entries too (``tree_copy`` over the whole tables
+# dict), so a quarantine restores replica, canonical table, and — by the
+# flush-reconcile boundary invariant — an implicitly empty delta buffer
+# as one consistent unit.
+HOT_TIER_KEY = "hot_tier"
+
 GUARD_MODES = ("observe", "mask")
 
 

@@ -33,6 +33,38 @@ Inside ``shard_map``:
 
 Everything is static-shape and jit-compatible; XLA lowers the collectives
 onto ICI when the mesh spans a pod slice.
+
+Two-tier hot storage (``TableSpec.hot_tier``)
+---------------------------------------------
+Real id streams are Zipf-skewed (ML20M users, text8 vocab, Criteo
+features), and NuPS (arxiv.org/pdf/2104.00501) shows the winning PS
+design manages hot and cold keys differently: **replicate the hot head,
+shard the tail**. A table with ``hot_tier = H > 0`` additionally keeps
+its leading ``H`` global ids as an ``(H, dim)`` array **replicated**
+across every device (stored beside the sharded table under
+``hot_key(name)``), plus a per-device pending-delta buffer inside the
+compiled loop:
+
+* :func:`pull_hot` serves ``id < H`` reads from the local replica —
+  **zero collectives**; cold ids ride the existing gathered/dense routes
+  with the hot slots masked to ``-1`` (the documented zero-row /
+  dropped-push contract).
+* :func:`accumulate_hot` folds ``id < H`` pushes into the local delta
+  buffer; :func:`reconcile_hot` ``psum``-reduces the buffers every
+  ``TrainerConfig.hot_sync_every`` steps and applies the combined delta
+  to the replica AND to the owner shard's head rows of the canonical
+  sharded table — the paper's SSP bound applied to the parameter plane.
+
+The sharded table stays the single source of truth: every compiled call
+ends with a flush reconcile, so at chunk/epoch boundaries the replica is
+a pure projection of the canonical table's head rows (checkpoints save
+one canonical table; restore re-splits — ``Trainer._attach_hot``).
+``hot_sync_every = 1`` is the exact mode: the driver lowers the
+IDENTICAL untiered program (a per-step psum reconcile could not be
+bit-identical to the gathered scatter's summation order — same
+reasoning as the dense push path's fixed-order NOTE below — so the
+exact mode is implemented as the untiered path itself, making its
+zero-cost claim provable by lowered-HLO comparison).
 """
 
 from __future__ import annotations
@@ -73,6 +105,153 @@ def id_to_phys(ids: Array, num_shards: int, rps: int) -> Array:
 def phys_to_id(phys: Array, num_shards: int, rps: int) -> Array:
     """Inverse of :func:`id_to_phys` (may exceed num_ids for padding rows)."""
     return (phys % rps) * num_shards + phys // rps
+
+
+# ---------------------------------------------------------------------------
+# Two-tier hot storage (replicated head + sharded tail; see module docstring).
+# ---------------------------------------------------------------------------
+
+# Replica entries ride the same tables dict as the sharded tables they
+# mirror, under a reserved key — checkpoint/export iterate ``store.specs``
+# and therefore never serialize them (the sharded table is canonical).
+HOT_KEY_SUFFIX = "::hot"
+
+
+def hot_key(name: str) -> str:
+    """Tables-dict key of ``name``'s replicated hot-head array."""
+    return name + HOT_KEY_SUFFIX
+
+
+def is_hot_key(key: str) -> bool:
+    return key.endswith(HOT_KEY_SUFFIX)
+
+
+def hot_base(key: str) -> str:
+    """Inverse of :func:`hot_key`."""
+    return key[: -len(HOT_KEY_SUFFIX)]
+
+
+def split_hot(tables: Mapping[str, Any]) -> tuple[dict, dict]:
+    """Split a tables dict into ``(cold_by_name, hot_by_name)``."""
+    cold = {k: v for k, v in tables.items() if not is_hot_key(k)}
+    hot = {hot_base(k): v for k, v in tables.items() if is_hot_key(k)}
+    return cold, hot
+
+
+def pull_hot(replica: Array, ids: Array, *, hot_ids: int) -> tuple[Array, Array]:
+    """Serve ``id < hot_ids`` reads from the local replica — no collectives.
+
+    Returns ``(values, hot_mask)``: ``values`` holds the replica rows for
+    hot ids and ZERO rows elsewhere (ids outside the head are gathered as
+    ``-1``, the zero-row contract), so the caller can ``where`` it against
+    the cold route's rows (which are zero exactly on the hot slots).
+    """
+    hot = (ids >= 0) & (ids < hot_ids)
+    return ops.gather_rows(replica, jnp.where(hot, ids, -1)), hot
+
+
+def split_hot_push(
+    ids: Array, deltas: Array, *, hot_ids: int
+) -> tuple[tuple[Array, Array], tuple[Array, Array]]:
+    """Partition one push stream on ``id < hot_ids``.
+
+    Returns ``((cold_ids, cold_deltas), (hot_ids_arr, hot_deltas))`` with
+    the other tier's slots masked to ``-1``/zero — both the collective
+    push and :func:`fps_tpu.ops.scatter_add` drop ``-1`` rows, and the
+    deltas are zeroed too so the lane-packed routes never multiply a live
+    indicator into a masked row's payload (same hazard the guard's mask
+    path documents).
+    """
+    hot = (ids >= 0) & (ids < hot_ids)
+    cold = (
+        jnp.where(hot, jnp.asarray(-1, ids.dtype), ids),
+        jnp.where(hot[:, None], 0, deltas).astype(deltas.dtype),
+    )
+    hots = (
+        jnp.where(hot, ids, jnp.asarray(-1, ids.dtype)),
+        jnp.where(hot[:, None], deltas, 0).astype(deltas.dtype),
+    )
+    return cold, hots
+
+
+def hot_delta_init(hot_rows: int, dim: int, dtype, *, mean: bool) -> Array:
+    """Fresh per-device pending-delta buffer for one tiered table.
+
+    Accumulates in at least f32 (never below the table's own precision —
+    same promotion rule as the non-"sum" combine folds in :func:`push`).
+    The ``mean`` combine carries a push-count column appended to the
+    payload so the reconcile can apply one count-normalized step per
+    touched row per window.
+    """
+    acc_dt = jnp.promote_types(dtype, jnp.float32)
+    return jnp.zeros((hot_rows, dim + (1 if mean else 0)), acc_dt)
+
+
+def accumulate_hot(
+    delta_buf: Array, hot_ids_arr: Array, hot_deltas: Array, *, mean: bool
+) -> Array:
+    """Fold one step's hot-tier pushes into the local pending buffer.
+
+    ``hot_ids_arr``/``hot_deltas`` come from :func:`split_hot_push` (cold
+    slots already ``-1``/zero, dropped by the scatter). Purely local —
+    the collective happens once per window, in :func:`reconcile_hot`.
+    """
+    vals = hot_deltas.astype(delta_buf.dtype)
+    if mean:
+        # One scatter carries values AND counts (appended ones column) —
+        # the same one-scatter trick as push()'s non-"sum" folds.
+        cnt = (hot_ids_arr >= 0).astype(delta_buf.dtype)[:, None]
+        vals = jnp.concatenate([vals, cnt], axis=1)
+    return ops.scatter_add(delta_buf, hot_ids_arr, vals)
+
+
+def reconcile_hot(
+    cold_shard: Array,
+    replica: Array,
+    delta_buf: Array,
+    *,
+    num_shards: int,
+    shard_axis: str = SHARD_AXIS,
+    data_axis: str | None = None,
+    mean: bool = False,
+) -> tuple[Array, Array, Array]:
+    """Window-end reconcile: psum the pending buffers, apply everywhere.
+
+    One ``psum`` over the worker axes replaces ``hot_sync_every`` steps'
+    worth of per-step push collectives for the head rows. The combined
+    delta is applied to the replica (identically on every device — psum
+    results are bitwise-identical across participants, so the replica
+    stays replicated by construction) AND to this shard's OWNED head
+    rows of the canonical table: under the owner-major cyclic layout,
+    global id ``h`` lives on shard ``h % S`` at local row ``h // S``, so
+    the shard's head ids occupy exactly local rows ``[0, ceil(H/S))``.
+
+    ``mean``: the buffer's appended count column turns the window's sum
+    into one count-normalized step per touched row (the windowed analog
+    of the "mean" combine's one-averaged-step-per-push; untouched rows
+    have count 0 and receive exactly zero).
+
+    Returns ``(new_cold_shard, new_replica, zeroed_delta_buf)``.
+    """
+    H, dim = replica.shape
+    g = lax.psum(delta_buf, shard_axis)
+    if data_axis is not None:
+        g = lax.psum(g, data_axis)
+    if mean:
+        counts = g[:, dim]
+        combined = g[:, :dim] * (1.0 / jnp.maximum(counts, 1.0))[:, None]
+    else:
+        combined = g
+    combined = combined.astype(replica.dtype)
+    new_replica = replica + combined
+    hl = -(-H // num_shards)  # local head rows on every shard
+    me = lax.axis_index(shard_axis)
+    # Global id of local head row j is j*S + me; rows past H (when S does
+    # not divide H) gather id -1 -> a zero row, i.e. no update.
+    gids = jnp.arange(hl, dtype=jnp.int32) * num_shards + me
+    mine = ops.gather_rows(combined, jnp.where(gids < H, gids, -1))
+    new_cold = cold_shard.at[:hl].add(mine.astype(cold_shard.dtype))
+    return new_cold, new_replica, jnp.zeros_like(delta_buf)
 
 
 # ---------------------------------------------------------------------------
@@ -388,6 +567,21 @@ class TableSpec:
     # Only the additive fold takes the dense write path; non-additive
     # folds keep gathered writes (reads may still go dense).
     dense_collectives: bool | str = "auto"
+    # Two-tier hot storage (module docstring; docs/performance.md): an
+    # int H > 0 replicates the leading H GLOBAL ids across the shard axis
+    # beside the sharded table. Hot reads become local gathers with zero
+    # collectives; hot pushes accumulate into a per-device delta buffer
+    # reconciled by one psum every ``TrainerConfig.hot_sync_every`` steps
+    # (bounded parameter-plane staleness). Meaningful when ids are
+    # frequency-ranked (hottest first — the same head convention as
+    # ``hot_ids``); H >= num_ids replicates the whole table (the NuPS
+    # small-hot-table regime) and statically elides the collective
+    # pull/push routes entirely. Engages only when the trainer resolves
+    # it on: multi-device mesh, ``hot_sync_every > 1``, and an additive
+    # ("sum") or "mean" server fold — otherwise (incl. the
+    # ``hot_sync_every = 1`` exact mode) the untiered program is lowered
+    # unchanged. Default 0: off.
+    hot_tier: int = 0
 
     def zeros_init(self) -> "TableSpec":
         return dataclasses.replace(
@@ -455,6 +649,7 @@ class ParamStore:
         self.num_shards = mesh.shape[SHARD_AXIS]
         self.sharding = NamedSharding(mesh, P(SHARD_AXIS, None))
         self.tables: dict[str, Array] = {}
+        self._head_replica_fns: dict = {}  # (name, hot_rows) -> jitted gather
 
     def init(self, key: Array) -> dict[str, Array]:
         """Materialize all tables directly in their sharded layout."""
@@ -471,6 +666,40 @@ class ParamStore:
             )
             self.tables[name] = jax.jit(make, out_shardings=self.sharding)()
         return self.tables
+
+    def head_replica(self, name: str, hot_rows: int, table: Array | None = None) -> Array:
+        """Replicated ``(hot_rows, dim)`` array of ``name``'s leading ids.
+
+        The re-split half of the two-tier contract: derives the hot
+        replica from the CANONICAL sharded table (valid at any compiled-
+        call boundary — pending deltas are always reconciled before a
+        call returns). Multi-controller: the replicating jit is a
+        COLLECTIVE; every process reaches the run entry together, same
+        as the checkpoint dump.
+        """
+        spec = self.specs[name]
+        if not 0 < hot_rows <= spec.num_ids:
+            raise ValueError(
+                f"table {name!r}: hot_rows={hot_rows} outside "
+                f"(0, {spec.num_ids}]"
+            )
+        table = self.tables[name] if table is None else table
+        fn = self._head_replica_fns.get((name, hot_rows))
+        if fn is None:
+            # Cache the jitted gather per (table, head size): repeat
+            # derivations (every restore / restart / warm-start) hit the
+            # jit cache instead of re-tracing the same trivial program.
+            rps = rows_per_shard(spec.num_ids, self.num_shards)
+            phys = np.asarray(
+                id_to_phys(np.arange(hot_rows, dtype=np.int64),
+                           self.num_shards, rps)
+            )
+            fn = jax.jit(
+                lambda t: t[phys],
+                out_shardings=NamedSharding(self.mesh, P()),
+            )
+            self._head_replica_fns[(name, hot_rows)] = fn
+        return fn(table)
 
     def table_specs_static(self) -> dict[str, tuple[int, int]]:
         """(num_shards, rows_per_shard) per table, for device-side code."""

@@ -57,6 +57,25 @@ def base_parser(description: str) -> argparse.ArgumentParser:
                          "never idles on host ingest; 0 = synchronous "
                          "host loop. Numerics are bit-identical either "
                          "way; 2 is the recommended depth")
+    ap.add_argument("--hot-tier", type=int, default=0, metavar="H",
+                    help="two-tier parameter storage "
+                         "(fps_tpu.core.store.TableSpec.hot_tier): "
+                         "replicate the leading H ids of every PS table "
+                         "across the shard axis — hot reads become "
+                         "collective-free local gathers, hot pushes "
+                         "accumulate locally and reconcile by one psum "
+                         "every --hot-sync-every steps. Ids must be "
+                         "frequency-ranked (hottest first; the shipped "
+                         "loaders are). Engages on multi-device meshes "
+                         "with --hot-sync-every > 1 and an additive/mean "
+                         "server fold; otherwise the exact untiered "
+                         "program runs")
+    ap.add_argument("--hot-sync-every", type=int, default=1, metavar="E",
+                    help="hot-tier reconcile cadence in steps "
+                         "(TrainerConfig.hot_sync_every): the SSP "
+                         "staleness bound applied to the parameter "
+                         "plane. 1 (default) = exact mode, bit-identical "
+                         "to the untiered path")
     ap.add_argument("--guard", default=None, choices=["observe", "mask"],
                     help="on-device push-delta health guard "
                          "(fps_tpu.core.resilience): 'mask' drops "
@@ -159,6 +178,43 @@ def apply_host_pipeline(args, trainer):
             raise SystemExit(f"--prefetch must be >= 0, got {args.prefetch}")
         trainer.config = dataclasses.replace(trainer.config,
                                              prefetch=args.prefetch)
+    return trainer
+
+
+def apply_hot_tier(args, trainer, store=None):
+    """Fold the two-tier storage CLI knobs (--hot-tier/--hot-sync-every)
+    into the trainer's store specs and config. Must run before the first
+    compiled call (the tier resolution is part of the compile key).
+
+    ``trainer=None`` (iALS: half-epoch normal-equation solves, no
+    pull/push Trainer to tier) accepts-and-reports the flag instead of
+    failing, so the CLI surface stays uniform across the six examples.
+    """
+    H = getattr(args, "hot_tier", 0)
+    E = getattr(args, "hot_sync_every", 1)
+    if E < 1:
+        raise SystemExit(f"--hot-sync-every must be >= 1, got {E}")
+    if H < 0:
+        raise SystemExit(f"--hot-tier must be >= 0, got {H}")
+    if not H and E == 1:
+        return trainer
+    if trainer is None:
+        emit({"event": "hot_tier_ignored",
+              "reason": "this workload has no pull/push trainer "
+                        "(iALS half-epoch solves)"})
+        return None
+    import dataclasses
+
+    store = store or trainer.store
+    if H:
+        for name, spec in store.specs.items():
+            store.specs[name] = dataclasses.replace(
+                spec, hot_tier=min(H, spec.num_ids))
+    trainer.config = dataclasses.replace(trainer.config, hot_sync_every=E)
+    tiered = sorted(trainer._hot_tier_map())  # also validates vs push_delay
+    emit({"event": "hot_tier", "hot_tier": H, "hot_sync_every": E,
+          "tiered_tables": tiered,
+          "exact_mode": E == 1 or not tiered})
     return trainer
 
 

@@ -14,6 +14,7 @@ import jax
 import numpy as np
 
 from fps_tpu.examples.common import (
+    apply_hot_tier,
     attach_obs,
     base_parser,
     emit,
@@ -68,6 +69,10 @@ def main(argv=None) -> int:
     if args.prefetch < 0:
         raise SystemExit(f"--prefetch must be >= 0, got {args.prefetch}")
     solver.prefetch = args.prefetch
+    # --hot-tier: accepted-and-reported (no pull/push Trainer to tier —
+    # the half-epoch normal-equation solves already read/write whole
+    # factor blocks, not Zipf-skewed id streams).
+    apply_hot_tier(args, None)
     solver.init(jax.random.key(args.seed))
     # iALS drives its own solver loop (no Trainer) — the recorder still
     # journals the run and catches checkpoint events via the process

@@ -13,6 +13,7 @@ import numpy as np
 
 from fps_tpu.examples.common import (
     apply_host_pipeline,
+    apply_hot_tier,
     attach_obs,
     base_parser,
     make_guard,
@@ -98,6 +99,7 @@ def main(argv=None) -> int:
                        optimizer=args.optimizer, dense_features=dense)
     trainer, store = logistic_regression(
         mesh, cfg, sync_every=args.sync_every, guard=make_guard(args))
+    apply_hot_tier(args, trainer)
     apply_host_pipeline(args, trainer)
     rec = attach_obs(args, trainer, workload="logreg_ssp")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))

@@ -14,6 +14,7 @@ import numpy as np
 
 from fps_tpu.examples.common import (
     apply_host_pipeline,
+    apply_hot_tier,
     attach_obs,
     base_parser,
     make_guard,
@@ -88,6 +89,7 @@ def main(argv=None) -> int:
                 query_fn=mf_topk_query_fn(W, num_queries=2),
             ),
         )
+    apply_hot_tier(args, trainer)
     apply_host_pipeline(args, trainer)
     rec = attach_obs(args, trainer, workload="mf")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))

@@ -22,7 +22,8 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from fps_tpu.examples.common import (apply_host_pipeline, attach_obs,
+from fps_tpu.examples.common import (apply_host_pipeline, apply_hot_tier,
+                                     attach_obs,
                                      base_parser, emit, finish, make_guard,
                                      make_mesh, make_rollback, make_watchdog,
                                      maybe_profile)
@@ -63,6 +64,7 @@ def main(argv=None) -> int:
                    rank=args.rank, learning_rate=args.learning_rate)
     trainer, store = online_mf(mesh, cfg, sync_every=args.sync_every,
                                guard=make_guard(args))
+    apply_hot_tier(args, trainer)
     apply_host_pipeline(args, trainer)
     rec = attach_obs(args, trainer, workload="streaming_mf")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))

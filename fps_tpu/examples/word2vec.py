@@ -16,6 +16,7 @@ import numpy as np
 
 from fps_tpu.examples.common import (
     apply_host_pipeline,
+    apply_hot_tier,
     attach_obs,
     base_parser,
     emit,
@@ -99,6 +100,7 @@ def main(argv=None) -> int:
         trainer, store = word2vec(mesh, cfg, uni, sync_every=args.sync_every,
                                   max_steps_per_call=256, step_tap=step_tap,
                                   guard=make_guard(args))
+    apply_hot_tier(args, trainer)
     apply_host_pipeline(args, trainer)
     rec = attach_obs(args, trainer, workload="word2vec")
     tables, local_state = trainer.init_state(jax.random.key(args.seed))

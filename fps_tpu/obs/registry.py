@@ -110,7 +110,8 @@ def default_registry() -> MetricsRegistry:
         MetricSpec("driver.phase_seconds", "histogram", unit="s",
                    labels=("phase",),
                    help="host wall-clock per phase segment: ingest / place "
-                        "/ dispatch / host_sync / checkpoint / callback"),
+                        "/ dispatch / host_sync / checkpoint / callback / "
+                        "reconcile"),
         # Host pipeline (fps_tpu.core.prefetch).
         MetricSpec("prefetch.chunks", "counter", unit="chunks",
                    help="chunks assembled+placed by the background "
@@ -118,6 +119,24 @@ def default_registry() -> MetricsRegistry:
         MetricSpec("prefetch.queue_depth", "gauge", unit="chunks",
                    help="placed chunks buffered ahead of the driver "
                         "(sampled at every pipeline put/get)"),
+        # Two-tier hot storage (TableSpec.hot_tier / TrainerConfig.
+        # hot_sync_every; docs/performance.md "Two-tier storage").
+        MetricSpec("hot_tier.hot_rows", "counter", unit="rows",
+                   labels=("table",),
+                   help="pulled rows served by the replicated hot tier "
+                        "(local gather, zero collectives)"),
+        MetricSpec("hot_tier.pulled_rows", "counter", unit="rows",
+                   labels=("table",),
+                   help="total live rows pulled from a tiered table "
+                        "(hot_rows / pulled_rows = the hit rate)"),
+        MetricSpec("hot_tier.pending_delta", "gauge", unit="l2",
+                   labels=("table",),
+                   help="peak within-call root-sum-square of the hot "
+                        "tier's per-device pending (un-reconciled) delta "
+                        "buffers — a parameter-plane staleness PROXY: "
+                        "the delta a reconcile actually applies is the "
+                        "psum, whose norm can exceed this by up to "
+                        "sqrt(num_devices) when device deltas align"),
         # Health channel (thresholded by fps_tpu.obs.health.HealthMonitor).
         MetricSpec("health.nonfinite_rows", "counter", unit="rows",
                    labels=("table",),

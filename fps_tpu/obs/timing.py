@@ -43,6 +43,7 @@ DRIVER_PHASES = (
     "host_sync",   # blocked fetching metrics back to host
     "checkpoint",  # snapshot save on the training thread
     "callback",    # user on_chunk / on_epoch hooks
+    "reconcile",   # two-tier re-split at run entry (hot replica derive)
 )
 
 

@@ -59,8 +59,6 @@ def make_ps_mesh(
         raise ValueError(
             f"mesh {num_data}x{num_shards} does not cover {n} devices"
         )
-    import numpy as np
-
     dev_grid = np.asarray(devices).reshape(num_data, num_shards)
     return Mesh(dev_grid, (DATA_AXIS, SHARD_AXIS))
 

@@ -228,15 +228,21 @@ class RunSupervisor:
             pass
 
     def _abort(self, proc: subprocess.Popen, reason: str,
-               attempt: int) -> int:
+               attempt: int, stall_kind: str | None = None) -> int:
         """TERM → grace → KILL escalation against the child's group.
 
         SIGTERM gives a healthy-but-slow child its atexit/flush; a child
         wedged in a collective — or SIGSTOP'd, which *queues* SIGTERM
-        until continued — only dies to the SIGKILL. Returns the reaped
-        returncode."""
+        until continued — only dies to the SIGKILL. ``stall_kind``
+        classifies a stall abort (see :meth:`_stall_kind`) and rides the
+        journal event so ``tools/obs_report.py`` can count source stalls
+        separately. Returns the reaped returncode."""
+        fields = {}
+        if stall_kind is not None:
+            fields["stall_kind"] = stall_kind
         self._event("deadline_abort", attempt=attempt, reason=reason,
-                    pid=proc.pid, term_grace_s=self.config.term_grace_s)
+                    pid=proc.pid, term_grace_s=self.config.term_grace_s,
+                    **fields)
         self._signal_group(proc, signal.SIGTERM)
         deadline = time.monotonic() + self.config.term_grace_s
         while proc.poll() is None and time.monotonic() < deadline:
@@ -245,6 +251,21 @@ class RunSupervisor:
             self._signal_group(proc, signal.SIGKILL)
         proc.wait()
         return proc.returncode
+
+    @staticmethod
+    def _stall_kind(last_phase) -> str:
+        """Classify a stall abort by the child's last sub-phase beat.
+
+        A heartbeat frozen in the ``prefetch`` phase means the driver
+        was healthy and WAITING on the ingest source (the prefetch
+        worker / chunk iterator) when progress stopped — a wedged
+        SOURCE, not a wedged driver. Keeping the two apart matters for
+        response: a source stall points at the data pipeline (filesystem,
+        generator, upstream service) while a driver stall points at the
+        device/collective path. Neither kind quarantines (stalls are
+        environmental, never poison evidence — see
+        :meth:`_maybe_quarantine`)."""
+        return "source_stall" if last_phase == "prefetch" else "driver_stall"
 
     # -- one attempt -------------------------------------------------------
 
@@ -271,6 +292,7 @@ class RunSupervisor:
         hb_mtime, last_index, last_phase = self._read_heartbeat()
         watch_fp = self._watch_fingerprint()
         aborted = None
+        stall_kind = None
         while True:
             rc = proc.poll()
             if rc is not None:
@@ -298,7 +320,9 @@ class RunSupervisor:
                 aborted = "wall_deadline"
                 break
             if now - last_signal > deadline_s:
-                rc = self._abort(proc, "stall", attempt)
+                stall_kind = self._stall_kind(last_phase)
+                rc = self._abort(proc, "stall", attempt,
+                                 stall_kind=stall_kind)
                 aborted = "stall"
                 break
             time.sleep(cfg.poll_interval_s)
@@ -317,6 +341,12 @@ class RunSupervisor:
             # ingest / dispatch) — a death BETWEEN chunk boundaries now
             # attributes to the right sub-phase in the persisted state.
             "last_phase": last_phase,
+            # Stall aborts only: "source_stall" when the last beat was
+            # the driver WAITING ON THE SOURCE (prefetch phase) — the
+            # wedged-ingest incident the ROADMAP item called out as
+            # indistinguishable from a wedged driver; "driver_stall"
+            # otherwise.
+            "stall_kind": stall_kind,
             "runtime_s": round(time.monotonic() - t0, 3),
             "log": log_path,
         }
@@ -380,6 +410,9 @@ class RunSupervisor:
             "restarts": int(self.state["restarts"]),
             "deadline_aborts": sum(
                 1 for a in attempts if a.get("aborted") == "stall"),
+            "source_stalls": sum(
+                1 for a in attempts
+                if a.get("stall_kind") == "source_stall"),
             "wall_deadline_hit": any(
                 a.get("aborted") == "wall_deadline" for a in attempts),
             "quarantined": list(self.state["quarantined"]),

@@ -182,6 +182,30 @@ def kill_at_epoch(epoch: int):
     return cb
 
 
+def kill_at_chunk(index: int, *, marker: str | None = None,
+                  before=None):
+    """``on_chunk``/``on_epoch`` callback that SIGKILLs (no flush) after
+    chunk ``index`` finishes training but before its checkpoint lands —
+    the marker-gated, supervisor-friendly variant of
+    :func:`kill_at_epoch` (once-only across restarted attempts, like
+    :func:`wedge_at_chunk`). ``before`` runs just before dying (e.g. an
+    async checkpointer ``flush()`` when the scenario's lost-work bound
+    requires prior snapshots durable)."""
+
+    def cb(i, _metrics):
+        if i != index:
+            return
+        if marker is not None:
+            if os.path.exists(marker):
+                return
+            open(marker, "w").close()
+        if before is not None:
+            before()
+        sigkill_self()
+
+    return cb
+
+
 def sigstop_self() -> None:
     """Freeze NOW: every thread stops, the heartbeat stops, collectives
     involving this process stall forever — but the process does NOT die,

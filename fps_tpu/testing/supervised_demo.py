@@ -44,6 +44,13 @@ SCENARIO_WEDGE_AT = 3
 # background assembly the child dies in.
 SCENARIO_PREFETCH_DEPTH = 2
 SCENARIO_PREFETCH_KILL_AT = 4
+# Hot-tier kill scenario: two-tier storage config and the chunk boundary
+# the child dies at (between hot-tier reconciles from the snapshot
+# trail's point of view: the chunk's own boundary reconcile ran, its
+# checkpoint never landed).
+SCENARIO_HOT_TIER = 64
+SCENARIO_HOT_SYNC = 3
+SCENARIO_HOT_KILL_AT = 3
 
 
 def run_supervised_scenario(tmpdir: str, *, timeout: float = 600):
@@ -217,6 +224,92 @@ def run_prefetch_kill_scenario(tmpdir: str, *, timeout: float = 600):
     return ok, detail
 
 
+def run_hot_tier_kill_scenario(tmpdir: str, *, timeout: float = 600):
+    """SIGKILL between hot-tier reconciles under the supervisor: the
+    child runs with two-tier storage on (``--hot-tier``/
+    ``--hot-sync-every``, replicated head + per-device pending deltas)
+    and dies at a chunk boundary before that chunk's checkpoint lands.
+    The restart must restore from the last durable snapshot — by the
+    flush-reconcile boundary invariant, always ONE canonical table with
+    every hot push folded in — re-split the hot replica from it, and
+    replay to final weights BIT-IDENTICAL to a straight (unkilled)
+    tiered run. A single crash must not quarantine anything.
+
+    Returns ``(ok, detail)`` like :func:`run_supervised_scenario`.
+    """
+    import numpy as np
+
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=_ROOT)
+    demo = [sys.executable, "-m", "fps_tpu.testing.supervised_demo",
+            *SCENARIO_DEMO_ARGS,
+            "--hot-tier", str(SCENARIO_HOT_TIER),
+            "--hot-sync-every", str(SCENARIO_HOT_SYNC)]
+    straight_dir = os.path.join(tmpdir, "straight")
+    sup_dir = os.path.join(tmpdir, "sup")
+    straight_out = os.path.join(tmpdir, "straight.npz")
+    sup_out = os.path.join(tmpdir, "sup.npz")
+
+    r = subprocess.run(
+        demo + ["--ckpt-dir", straight_dir, "--out", straight_out],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=timeout,
+    )
+    if r.returncode != 0:
+        return False, {"error": "straight tiered run failed",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "supervise.py"),
+         "--state-dir", sup_dir, "--stall-timeout-s", "60",
+         "--startup-grace-s", "300", "--term-grace-s", "2",
+         "--backoff-base-s", "0.2", "--max-restarts", "2",
+         "--poll-s", "0.2", "--",
+         *demo, "--ckpt-dir", sup_dir, "--out", sup_out,
+         "--kill-at", str(SCENARIO_HOT_KILL_AT)],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=timeout,
+    )
+    try:
+        digest = json.loads(r.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return False, {"error": "no supervisor digest",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+    try:
+        with open(sup_out + ".meta.json", encoding="utf-8") as f:
+            meta = json.load(f)
+    except OSError:
+        meta = {}
+    bit_identical = (
+        os.path.exists(sup_out)
+        and np.array_equal(np.load(straight_out)["weights"],
+                           np.load(sup_out)["weights"])
+    )
+    detail = {
+        "supervisor": {k: digest.get(k) for k in
+                       ("success", "attempts", "restarts",
+                        "deadline_aborts", "quarantined")},
+        "restored_step": meta.get("restored_step"),
+        "bit_identical": bit_identical,
+        "corrupt_files": sorted(os.path.basename(p) for p in
+                                glob.glob(sup_dir + "/*.corrupt")),
+    }
+    ok = (r.returncode == 0 and digest.get("success")
+          and digest.get("restarts") == 1
+          # A SIGKILL crash is a death, not a stall: no deadline abort.
+          and digest.get("deadline_aborts") == 0
+          # One crash at one index is not quarantine evidence.
+          and digest.get("quarantined") == []
+          # The kill fires after chunk SCENARIO_HOT_KILL_AT trains (the
+          # async writer flushed first) and before its checkpoint lands:
+          # restored_step == SCENARIO_HOT_KILL_AT means exactly one chunk
+          # was lost and replayed from a reconciled snapshot.
+          and meta.get("restored_step") == SCENARIO_HOT_KILL_AT
+          and not detail["corrupt_files"]
+          and bit_identical)
+    return ok, detail
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="supervised tiny-logreg child (fps_tpu.supervise demo)")
@@ -245,6 +338,16 @@ def main(argv=None) -> int:
                     help="SIGKILL while the prefetch worker assembles "
                          "this (global) chunk index — once, via marker "
                          "file, unless --always")
+    ap.add_argument("--kill-at", type=int, default=None,
+                    help="SIGKILL after this chunk trains (async writer "
+                         "flushed first), before its checkpoint lands — "
+                         "once, via marker file, unless --always")
+    ap.add_argument("--hot-tier", type=int, default=0,
+                    help="two-tier storage: replicate the leading H ids "
+                         "(TableSpec.hot_tier)")
+    ap.add_argument("--hot-sync-every", type=int, default=1,
+                    help="hot-tier reconcile cadence in steps "
+                         "(TrainerConfig.hot_sync_every)")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -294,6 +397,11 @@ def main(argv=None) -> int:
 
         trainer.config = dataclasses.replace(trainer.config,
                                              prefetch=args.prefetch)
+    # One tier-enable implementation repo-wide (validation + the
+    # push_delay-conflict check included).
+    from fps_tpu.examples.common import apply_hot_tier
+
+    apply_hot_tier(args, trainer, store)
     tables, ls = trainer.init_state(jax.random.key(0))
 
     ckpt_cls = Checkpointer if args.sync_checkpointer else AsyncCheckpointer
@@ -319,6 +427,16 @@ def main(argv=None) -> int:
         wedge = chaos.wedge_at_chunk(
             args.wedge_at, args.wedge_mode,
             marker=None if args.always else marker,
+        )
+    killer = None
+    if args.kill_at is not None:
+        # Flush first so the scenario's ≤1-chunk-lost bound holds under
+        # the async writer (same reasoning as the wedge's flush below).
+        killer = chaos.kill_at_chunk(
+            args.kill_at,
+            marker=None if args.always else os.path.join(
+                args.ckpt_dir, "kill_at.done"),
+            before=ckpt.flush,
         )
 
     def on_chunk(i, metrics):
@@ -347,6 +465,8 @@ def main(argv=None) -> int:
             ckpt.flush()
         if wedge is not None:
             wedge(i, metrics)
+        if killer is not None:
+            killer(i, metrics)
         if hb is not None:
             hb.beat(index=int(i) + 1, attempt=attempt)
 

@@ -230,11 +230,11 @@ def test_bench_combined_summary_line_contract(capsys):
     finally:
         _sys.argv = argv
     lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
-    # 4 x (per-workload line + cumulative digest) + final workload + rich
+    # 5 x (per-workload line + cumulative digest) + final workload + rich
     # combined + final digest (the last workload's digest IS the final
     # line): a killed run's final stdout line is ALWAYS a digest of what
     # completed.
-    assert len(lines) == 11
+    assert len(lines) == 13
 
     final = lines[-1]
     # The driver keeps a bounded tail; the final line must fit it with
@@ -242,7 +242,8 @@ def test_bench_combined_summary_line_contract(capsys):
     assert len(final.encode("utf-8")) <= 1000, len(final)
     digest = json.loads(final)
     assert {"metric", "value", "unit", "vs_baseline"} <= digest.keys()
-    assert set(digest["workloads"]) == {"mf", "w2v", "logreg", "pa", "ials"}
+    assert set(digest["workloads"]) == {"mf", "w2v", "logreg", "pa",
+                                        "ials", "tiered"}
     for name, res in digest["workloads"].items():
         assert set(res) == {"metric", "value", "unit", "vs_baseline"}
         assert res["metric"] == f"synthetic_{name}_examples_per_sec_per_chip_headline"
@@ -265,5 +266,6 @@ def test_bench_combined_summary_line_contract(capsys):
     # The rich combined line still precedes the final digest with the
     # full results.
     rich = json.loads(lines[-2])
-    assert set(rich["workloads"]) == {"mf", "w2v", "logreg", "pa", "ials"}
+    assert set(rich["workloads"]) == {"mf", "w2v", "logreg", "pa",
+                                      "ials", "tiered"}
     assert "baseline" in rich["workloads"]["mf"]

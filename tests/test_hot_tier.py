@@ -1,0 +1,482 @@
+"""Two-tier hot storage (TableSpec.hot_tier + TrainerConfig.hot_sync_every).
+
+The contracts under test, per docs/performance.md "Two-tier storage":
+
+* **exact mode is provably free** — with ``hot_sync_every=1`` (or the
+  tier off) the driver lowers the IDENTICAL untiered program; tables,
+  metrics, and checkpoint BYTES are bit-identical on MF, logreg, and
+  w2v;
+* **tiered runs keep one canonical table** — every compiled call ends
+  with a flush reconcile, so at any boundary the replicated hot head is
+  a pure projection of the sharded table (checkpoints need no special
+  casing; restore re-splits);
+* **full replication statically elides the collective routes** — a
+  fully-hot table's per-chunk program carries no pull/push
+  all_gather/all_to_all at all, only the windowed reconcile psum;
+* resilience composes: rollback quarantines restore replica+table as a
+  unit, checkpoint resume is bit-identical to a straight tiered run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+import numpy as np
+import pytest
+
+import jax
+
+from fps_tpu.core.checkpoint import Checkpointer
+from fps_tpu.core.driver import num_workers_of
+from fps_tpu.core.resilience import RollbackPolicy
+from fps_tpu.core.store import (
+    TableSpec,
+    hot_key,
+    id_to_phys,
+    rows_per_shard,
+)
+from fps_tpu.models.logistic_regression import (
+    LogRegConfig,
+    logistic_regression,
+)
+from fps_tpu.parallel.mesh import key_to_replicated, make_ps_mesh
+from fps_tpu.testing import chaos
+from fps_tpu.testing.workloads import (
+    NF,
+    logreg_chunks,
+    logreg_data,
+    weights,
+)
+
+
+def _tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def _make_trainer(mesh, *, hot_tier=0, hot_sync_every=1, sync_every=None,
+                  guard=None, **cfg_over):
+    trainer, store = logistic_regression(
+        mesh, LogRegConfig(num_features=NF, learning_rate=0.5),
+        guard=guard, sync_every=sync_every,
+    )
+    if hot_tier:
+        for name, spec in store.specs.items():
+            store.specs[name] = dataclasses.replace(
+                spec, hot_tier=min(hot_tier, spec.num_ids))
+    cfg_over["hot_sync_every"] = hot_sync_every
+    trainer.config = dataclasses.replace(trainer.config, **cfg_over)
+    return trainer, store
+
+
+def _fit(trainer, chunks, **kw):
+    tables, ls = trainer.init_state(jax.random.key(0))
+    return trainer.fit_stream(tables, ls, iter(chunks), jax.random.key(1),
+                              **kw)
+
+
+# ---------------------------------------------------------------------------
+# Exact mode: hot_sync_every=1 is bit-identical to the untiered path.
+# ---------------------------------------------------------------------------
+
+def test_exact_mode_bit_identical_logreg_with_checkpoint_bytes(
+        tmp_path, devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+    runs = {}
+    for name, (H, E) in {"untiered": (0, 1), "exact": (64, 1)}.items():
+        trainer, store = _make_trainer(mesh, hot_tier=H, hot_sync_every=E)
+        d = tmp_path / name
+        with Checkpointer(str(d)) as ckpt:
+            _, _, m = _fit(trainer, chunks, checkpointer=ckpt,
+                           checkpoint_every=2)
+        runs[name] = (weights(store), m, d)
+    w0, m0, d0 = runs["untiered"]
+    w1, m1, d1 = runs["exact"]
+    assert np.array_equal(w0, w1)
+    assert _tree_equal(m0, m1)
+    # Checkpoint BYTES identical: one canonical table per spec either way.
+    files0 = sorted(p.name for p in d0.iterdir() if p.suffix == ".npz")
+    files1 = sorted(p.name for p in d1.iterdir() if p.suffix == ".npz")
+    assert files0 == files1 and files0
+    for f in files0:
+        assert (d0 / f).read_bytes() == (d1 / f).read_bytes(), f
+
+
+def test_exact_mode_bit_identical_mf_indexed(devices8):
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    data = synthetic_ratings(48, 32, 64 * W, rank=3, seed=0)
+    runs = {}
+    for name, H in (("untiered", 0), ("exact", 12)):
+        trainer, store = online_mf(
+            mesh, MFConfig(num_users=48, num_items=32, rank=4))
+        if H:
+            store.specs["item_factors"] = dataclasses.replace(
+                store.specs["item_factors"], hot_tier=H)
+        # hot_sync_every stays 1: the exact mode.
+        ds = DeviceDataset(mesh, data)
+        plan = DeviceEpochPlan(ds, num_workers=W, local_batch=8,
+                               route_key="user")
+        tables, ls = trainer.init_state(jax.random.key(0))
+        tables, ls, m = trainer.run_indexed(tables, ls, plan,
+                                            jax.random.key(3))
+        runs[name] = (store.dump_model("item_factors")[1], m)
+    assert np.array_equal(runs["untiered"][0], runs["exact"][0])
+    assert _tree_equal(runs["untiered"][1], runs["exact"][1])
+
+
+def test_exact_mode_bit_identical_w2v(devices8):
+    from fps_tpu.models.word2vec import (
+        W2VConfig, Word2VecDevicePlan, word2vec_block,
+    )
+    from fps_tpu.utils.datasets import synthetic_corpus
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    tokens = synthetic_corpus(40, 1500, seed=0)
+    uni = np.bincount(tokens, minlength=40).astype(np.float64)
+    cfg = W2VConfig(vocab_size=40, dim=8, window=2, negatives=2,
+                    subsample_t=None)
+    runs = {}
+    for name, H in (("untiered", 0), ("exact", 10)):
+        trainer, store = word2vec_block(mesh, cfg, uni, 16,
+                                        max_steps_per_call=8)
+        if H:
+            for t in ("in_embeddings", "out_embeddings"):
+                store.specs[t] = dataclasses.replace(
+                    store.specs[t], hot_tier=H)
+        plan = Word2VecDevicePlan(tokens, uni, cfg, mesh, num_workers=W,
+                                  block_len=16, seed=0, mode="block")
+        tables, ls = trainer.init_state(jax.random.key(0))
+        tables, ls, m = trainer.run_indexed(tables, ls, plan,
+                                            jax.random.key(4))
+        runs[name] = (store.dump_model("in_embeddings")[1], m)
+    assert np.array_equal(runs["untiered"][0], runs["exact"][0])
+    assert _tree_equal(runs["untiered"][1], runs["exact"][1])
+
+
+def test_lowered_hlo_unchanged_when_tier_disengaged(devices8):
+    """Adding the tier machinery must not perturb the untiered program:
+    tier off, exact mode (H set, E=1), and E set with H=0 all lower to
+    byte-identical text — the zero-cost claim, proven at the same
+    altitude as tests/test_prefetch.py."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=1)
+
+    def lowered(**kw):
+        trainer, _ = _make_trainer(mesh, **kw)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        tables = trainer._attach_hot(tables)
+        batches = trainer._place_chunk(chunks[0], "sync")
+        key = key_to_replicated(jax.random.key(1), mesh)
+        return trainer._get_compiled("sync").lower(
+            tables, ls, batches, key).as_text()
+
+    base = lowered()
+    assert lowered(hot_tier=64, hot_sync_every=1) == base
+    assert lowered(hot_tier=0, hot_sync_every=4) == base
+
+
+# ---------------------------------------------------------------------------
+# Engaged tier: canonical-table invariant, routing, determinism.
+# ---------------------------------------------------------------------------
+
+def test_tiered_sync_invariant_and_determinism(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+    results = []
+    for _ in range(2):
+        trainer, store = _make_trainer(mesh, hot_tier=64, hot_sync_every=3)
+        tables, _, m = _fit(trainer, chunks)
+        results.append((weights(store), m))
+        # Boundary invariant: the replica is a pure projection of the
+        # canonical table's head rows after every compiled call.
+        assert hot_key("weights") in tables
+        rep = np.asarray(tables[hot_key("weights")])
+        assert np.array_equal(rep, store.lookup_host("weights",
+                                                     np.arange(64)))
+        assert np.isfinite(results[-1][0]).all()
+        # Telemetry channel: per-chunk hit counts ride the out stream.
+        assert "hot_tier" in m[0]
+        hot = np.sum(np.asarray(m[0]["hot_tier"]["weights"]["hot_rows"]))
+        pulled = np.sum(
+            np.asarray(m[0]["hot_tier"]["weights"]["pulled_rows"]))
+        assert 0 < hot <= pulled
+    assert np.array_equal(results[0][0], results[1][0])
+    assert _tree_equal(results[0][1], results[1][1])
+
+
+def test_tiered_full_replication_elides_collective_routes(devices8):
+    """H >= num_ids: the pull/push collective routes must be statically
+    GONE from the per-chunk program (only the reconcile psum and scalar
+    metric reductions remain) — the NuPS replicate-the-hot-table regime
+    and the source of the bench A/B's strictly-fewer-collectives win."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=1)
+
+    def lowered(**kw):
+        trainer, _ = _make_trainer(mesh, **kw)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        tables = trainer._attach_hot(tables)
+        batches = trainer._place_chunk(chunks[0], "sync")
+        key = key_to_replicated(jax.random.key(1), mesh)
+        return trainer._get_compiled("sync").lower(
+            tables, ls, batches, key).as_text()
+
+    pat = re.compile(r"stablehlo\.(all_gather|all_to_all|"
+                     r"collective_permute)")
+    n_off = len(pat.findall(lowered()))
+    n_on = len(pat.findall(lowered(hot_tier=NF, hot_sync_every=4)))
+    assert n_off > 0  # the untiered program really pays data collectives
+    assert n_on == 0, f"tiered program still carries {n_on} gather ops"
+
+
+def test_tiered_ssp_runs_and_reconciles_per_round(devices8):
+    from fps_tpu.core.ingest import multi_epoch_chunks
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = list(multi_epoch_chunks(
+        train, 2, num_workers=num_workers_of(mesh), local_batch=32,
+        steps_per_chunk=8, sync_every=4, seed=3))
+    trainer, store = _make_trainer(mesh, hot_tier=64, hot_sync_every=2,
+                                   sync_every=4)
+    tables, _, m = _fit(trainer, chunks)
+    w = weights(store)
+    assert np.isfinite(w).all()
+    rep = np.asarray(tables[hot_key("weights")])
+    assert np.array_equal(rep, store.lookup_host("weights", np.arange(64)))
+
+
+def test_tiered_mean_combine_windowed_reconcile(devices8):
+    from fps_tpu.core.ingest import epoch_chunks
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    trainer, store = online_mf(
+        mesh, MFConfig(num_users=32, num_items=24, rank=4), combine="mean")
+    store.specs["item_factors"] = dataclasses.replace(
+        store.specs["item_factors"], hot_tier=24)
+    trainer.config = dataclasses.replace(trainer.config, hot_sync_every=3)
+    data = synthetic_ratings(32, 24, 64 * W, rank=3, seed=0)
+    chunk = next(epoch_chunks(data, num_workers=W, local_batch=8,
+                              steps_per_chunk=4, route_key="user"))
+    tables, ls = trainer.init_state(jax.random.key(0))
+    tables, ls, m = trainer.run_chunk(tables, ls, chunk, jax.random.key(2))
+    vals = store.dump_model("item_factors")[1]
+    assert np.isfinite(vals).all()
+    rep = np.asarray(tables[hot_key("item_factors")])
+    assert np.array_equal(rep, store.lookup_host("item_factors",
+                                                 np.arange(24)))
+
+
+# ---------------------------------------------------------------------------
+# Resilience composition: rollback, checkpoint resume.
+# ---------------------------------------------------------------------------
+
+def test_tiered_rollback_quarantines_and_restores_unit(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+    poisoned = list(chaos.poison_chunks(
+        iter(chunks), chunk_index=1, column="feat_vals", kind="nan",
+        frac=0.5, seed=1))
+    pol = RollbackPolicy()
+    trainer, store = _make_trainer(mesh, hot_tier=64, hot_sync_every=3,
+                                   guard="observe")
+    tables, _, _ = _fit(trainer, poisoned, rollback=pol)
+    assert pol.quarantined == [1]
+    w = weights(store)
+    assert np.isfinite(w).all()
+    # The rollback restored replica + canonical table as one unit: the
+    # projection invariant still holds at the end of the stream.
+    rep = np.asarray(tables[hot_key("weights")])
+    assert np.array_equal(rep, store.lookup_host("weights", np.arange(64)))
+
+
+def test_tiered_checkpoint_resume_bit_identical(tmp_path, devices8):
+    """A checkpoint written under the tier is one canonical table;
+    restore re-splits the replica and the resumed run reproduces the
+    straight tiered run bit-for-bit."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+
+    trainer, store = _make_trainer(mesh, hot_tier=64, hot_sync_every=3)
+    _fit(trainer, chunks)
+    want = weights(store)
+
+    d = str(tmp_path / "ck")
+    trainer, store = _make_trainer(mesh, hot_tier=64, hot_sync_every=3)
+    tables, ls = trainer.init_state(jax.random.key(0))
+
+    class Stop(Exception):
+        pass
+
+    def stop_at(i, _m):
+        if i == 1:
+            raise Stop
+
+    with Checkpointer(d) as ckpt:
+        with pytest.raises(Stop):
+            trainer.fit_stream(
+                tables, ls, iter(chunks), jax.random.key(1),
+                checkpointer=ckpt, checkpoint_every=1, on_chunk=stop_at,
+            )
+        start = ckpt.latest_valid_step()
+        assert start and start >= 1
+        tables, ls, start = trainer.restore_checkpoint(ckpt, ls)
+        # restore hands back the canonical (cold-only) table set; the
+        # run entry re-splits it.
+        assert not any(k.endswith("::hot") for k in tables)
+        trainer.fit_stream(
+            tables, ls, iter(chunks[start:]), jax.random.key(1),
+            start_step=start,
+        )
+    assert np.array_equal(weights(store), want)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: recorder counters + gauge.
+# ---------------------------------------------------------------------------
+
+def test_hot_tier_recorder_counters(devices8):
+    from fps_tpu import obs
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=1)
+    trainer, _ = _make_trainer(mesh, hot_tier=64, hot_sync_every=3)
+    rec = obs.Recorder(sinks=[])
+    trainer.recorder = rec
+    _fit(trainer, chunks)
+    hot = rec.counter_value("hot_tier.hot_rows", table="weights")
+    pulled = rec.counter_value("hot_tier.pulled_rows", table="weights")
+    assert 0 < hot <= pulled
+    snap = rec.snapshot()
+    assert any(k.startswith("hot_tier.pending_delta")
+               for k in snap["gauges"])
+
+
+# ---------------------------------------------------------------------------
+# Resolution policy + satellite error paths (direct unit tests).
+# ---------------------------------------------------------------------------
+
+def _unit_trainer(devices8, **spec_over):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    trainer, store = _make_trainer(mesh)
+    spec = store.specs["weights"]
+    if spec_over:
+        spec = dataclasses.replace(spec, **spec_over)
+        store.specs["weights"] = spec
+    return trainer, spec
+
+
+def test_resolve_hot_rows_bad_string_raises(devices8):
+    trainer, spec = _unit_trainer(devices8, hot_ids="asuto")
+    with pytest.raises(ValueError, match="asuto"):
+        trainer._resolve_hot_rows(spec)
+
+
+def test_resolve_dense_bad_string_raises(devices8):
+    trainer, spec = _unit_trainer(devices8, dense_collectives="yes")
+    with pytest.raises(ValueError, match="yes"):
+        trainer._resolve_dense(spec)
+
+
+def test_resolve_hot_tier_bad_values_raise(devices8):
+    trainer, spec = _unit_trainer(devices8, hot_tier="asuto")
+    with pytest.raises(ValueError, match="asuto"):
+        trainer._resolve_hot_tier(spec)
+    trainer, spec = _unit_trainer(devices8, hot_tier=-1)
+    with pytest.raises(ValueError, match="-1"):
+        trainer._resolve_hot_tier(spec)
+
+
+def test_resolve_hot_tier_policy(devices8):
+    """The tier engages exactly where it can win and stay correct."""
+    trainer, spec = _unit_trainer(devices8, hot_tier=64)
+    assert trainer._resolve_hot_tier(spec) == 0  # E=1: exact mode
+    trainer.config = dataclasses.replace(trainer.config, hot_sync_every=4)
+    assert trainer._resolve_hot_tier(spec) == 64
+    # Over-asked H clamps to the table.
+    big = dataclasses.replace(spec, hot_tier=10 * NF)
+    assert trainer._resolve_hot_tier(big) == NF
+    # Single-device mesh: nothing to save.
+    mesh1 = make_ps_mesh(num_shards=1, num_data=1, devices=devices8[:1])
+    tr1, store1 = _make_trainer(mesh1, hot_tier=64, hot_sync_every=4)
+    assert tr1._resolve_hot_tier(store1.specs["weights"]) == 0
+    # Non-additive folds keep the gathered route.
+    from fps_tpu.core.api import ServerLogic
+    trainer.server_logic["weights"] = ServerLogic(combine="max")
+    assert trainer._resolve_hot_tier(spec) == 0
+
+
+def test_hot_tier_push_delay_rejected(devices8):
+    trainer, _ = _unit_trainer(devices8, hot_tier=64)
+    trainer.config = dataclasses.replace(
+        trainer.config, hot_sync_every=4, push_delay=2)
+    with pytest.raises(ValueError, match="push_delay"):
+        trainer._hot_tier_map()
+
+
+def test_owner_major_head_layout_invariant(devices8):
+    """Global id h lives in local row ``h // S`` on shard ``h % S`` —
+    pinned directly against per-id-deterministic init values, and the
+    derived head replica matches the canonical head rows."""
+    from fps_tpu.core.store import ParamStore
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    S, NIDS, H = 4, 10, 7
+
+    def init(key, ids):
+        return jax.numpy.stack(
+            [ids.astype(np.float32), ids.astype(np.float32) * 10.0], axis=1)
+
+    store = ParamStore(mesh, [TableSpec("t", NIDS, 2, init_fn=init,
+                                        hot_tier=H)])
+    store.init(jax.random.key(0))
+    rps = rows_per_shard(NIDS, S)
+    full = store._host_table("t")  # physical (owner-major) layout
+    for h in range(NIDS):
+        phys = (h % S) * rps + h // S
+        assert phys == int(id_to_phys(np.int32(h), S, rps))
+        assert np.array_equal(full[phys], [h, 10.0 * h]), h
+    # Shard s's block holds exactly the ids congruent to s (mod S).
+    for s in range(S):
+        block = full[s * rps:(s + 1) * rps]
+        for j in range(rps):
+            gid = j * S + s
+            if gid < NIDS:
+                assert block[j][0] == gid
+    rep = np.asarray(store.head_replica("t", H))
+    assert rep.shape == (H, 2)
+    assert np.array_equal(rep, store.lookup_host("t", np.arange(H)))
+    with pytest.raises(ValueError, match="hot_rows"):
+        store.head_replica("t", NIDS + 1)
+
+
+# ---------------------------------------------------------------------------
+# Chaos: SIGKILL between reconciles under the supervisor (slow tier).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sigkill_between_reconciles_resumes_bit_identical(tmp_path):
+    from fps_tpu.testing.supervised_demo import run_hot_tier_kill_scenario
+
+    ok, detail = run_hot_tier_kill_scenario(str(tmp_path))
+    assert ok, detail

@@ -1,0 +1,60 @@
+"""Mesh construction (fps_tpu.parallel.mesh): shape factoring and the
+non-divisible error paths — previously only exercised implicitly through
+the example CLIs."""
+
+from __future__ import annotations
+
+import pytest
+
+from fps_tpu.parallel.mesh import (
+    DATA_AXIS,
+    SHARD_AXIS,
+    default_mesh_shape,
+    make_ps_mesh,
+)
+
+
+@pytest.mark.parametrize("n, want", [
+    (1, (1, 1)),
+    (2, (1, 2)),
+    (4, (2, 2)),
+    (6, (2, 3)),
+    (7, (1, 7)),      # prime: all devices onto the shard axis
+    (8, (2, 4)),
+    (12, (3, 4)),
+    (16, (4, 4)),
+    (24, (4, 6)),
+])
+def test_default_mesh_shape_factoring(n, want):
+    assert default_mesh_shape(n) == want
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 12, 30, 64, 100])
+def test_default_mesh_shape_invariants(n):
+    """Covers the full factorization contract: the shape covers every
+    device and the shard axis (HBM, the scarce resource) never gets the
+    smaller side."""
+    d, s = default_mesh_shape(n)
+    assert d * s == n
+    assert s >= d >= 1
+
+
+def test_make_ps_mesh_shapes_and_axes(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=2, devices=devices8)
+    assert mesh.axis_names == (DATA_AXIS, SHARD_AXIS)
+    assert mesh.shape == {DATA_AXIS: 2, SHARD_AXIS: 4}
+    # num_shards defaulted from the device count.
+    mesh = make_ps_mesh(num_data=2, devices=devices8)
+    assert mesh.shape[SHARD_AXIS] == 4
+
+
+def test_make_ps_mesh_non_divisible_raises(devices8):
+    with pytest.raises(ValueError, match="not divisible"):
+        make_ps_mesh(num_data=3, devices=devices8)
+
+
+def test_make_ps_mesh_non_covering_raises(devices8):
+    with pytest.raises(ValueError, match="does not cover"):
+        make_ps_mesh(num_shards=3, num_data=2, devices=devices8)
+    with pytest.raises(ValueError, match="does not cover"):
+        make_ps_mesh(num_shards=16, num_data=1, devices=devices8)

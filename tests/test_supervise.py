@@ -294,6 +294,71 @@ def test_heartbeat_only_recorder_via_common(tmp_path, monkeypatch):
     assert json.load(open(hb_path))["index"] == 12  # beat-before-work
 
 
+def test_source_stall_classified_and_surfaced(tmp_path):
+    """A stalled ``prefetch``-phase heartbeat is a wedged SOURCE, not a
+    wedged driver (ROADMAP open item): the stall abort is classified as
+    ``source_stall`` in the attempt record (supervisor_state.json) and
+    the journal's ``deadline_abort`` event, counted in the digest, and
+    folded by tools/obs_report.py."""
+    child_code = (
+        "import json, os, time\n"
+        "p = os.environ['FPS_TPU_HEARTBEAT']\n"
+        "json.dump({'index': 2, 'phase': 'prefetch'}, open(p, 'w'))\n"
+        "time.sleep(120)\n"
+    )
+    rc, digest = _run_supervised(
+        tmp_path / "state", [sys.executable, "-c", child_code],
+        "--stall-timeout-s", "0.8", "--startup-grace-s", "10",
+        "--term-grace-s", "0.3", "--max-restarts", "0", "--poll-s", "0.1",
+        timeout=60,
+    )
+    assert digest["deadline_aborts"] == 1
+    assert digest["source_stalls"] == 1
+    with open(tmp_path / "state" / "supervisor_state.json",
+              encoding="utf-8") as f:
+        state = json.load(f)
+    assert state["attempts"][-1]["stall_kind"] == "source_stall"
+    assert state["attempts"][-1]["last_phase"] == "prefetch"
+    # A stall is environmental evidence, never poison: no quarantine.
+    assert digest["quarantined"] == []
+    events = [json.loads(line) for line in
+              open(tmp_path / "state" / "journal-supervisor.jsonl")]
+    aborts = [e for e in events if e.get("event") == "deadline_abort"]
+    assert aborts and aborts[0]["stall_kind"] == "source_stall"
+    # obs_report folds the supervisor journal into the run digest.
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(_ROOT, "tools", "obs_report.py"))
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+    folded = report.render_digest(str(tmp_path / "state"))
+    assert folded["source_stalls"] == 1
+
+
+def test_driver_stall_not_classified_as_source(tmp_path):
+    """A stall whose last beat was a dispatch-phase (or phase-less) beat
+    stays a driver stall — the classifier must not over-trigger."""
+    child_code = (
+        "import json, os, time\n"
+        "p = os.environ['FPS_TPU_HEARTBEAT']\n"
+        "json.dump({'index': 1, 'phase': 'dispatch'}, open(p, 'w'))\n"
+        "time.sleep(120)\n"
+    )
+    rc, digest = _run_supervised(
+        tmp_path / "state", [sys.executable, "-c", child_code],
+        "--stall-timeout-s", "0.8", "--startup-grace-s", "10",
+        "--term-grace-s", "0.3", "--max-restarts", "0", "--poll-s", "0.1",
+        timeout=60,
+    )
+    assert digest["deadline_aborts"] == 1
+    assert digest["source_stalls"] == 0
+    with open(tmp_path / "state" / "supervisor_state.json",
+              encoding="utf-8") as f:
+        state = json.load(f)
+    assert state["attempts"][-1]["stall_kind"] == "driver_stall"
+
+
 # ---------------------------------------------------------------------------
 # Full stack (slow): real jax child, SIGSTOP wedge, bit-identical resume.
 # ---------------------------------------------------------------------------

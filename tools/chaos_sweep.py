@@ -29,6 +29,13 @@ fps_tpu.testing.workloads):
   restarts the child once, nothing is quarantined (one crash is not
   determinism evidence), and the resumed pipeline-on run reproduces a
   straight pipeline-on run bit-for-bit.
+* ``hot_tier_kill``            — SIGKILL between hot-tier reconciles
+  under the supervisor (two-tier storage on, ``--hot-tier``/
+  ``--hot-sync-every``): survives iff the restart restores from the
+  last reconciled snapshot (one canonical table — the flush-reconcile
+  boundary invariant), re-splits the hot replica, replays exactly one
+  chunk, quarantines nothing, and reproduces a straight tiered run's
+  final weights bit-for-bit.
 
 Run (CPU mesh, like the test suite):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -175,6 +182,11 @@ def main():
 
         results["prefetch_kill"], detail["prefetch_kill"] = (
             run_prefetch_kill_scenario(d))
+    with tempfile.TemporaryDirectory() as d:
+        from fps_tpu.testing.supervised_demo import run_hot_tier_kill_scenario
+
+        results["hot_tier_kill"], detail["hot_tier_kill"] = (
+            run_hot_tier_kill_scenario(d))
 
     digest = {
         "chaos_sweep": results,

@@ -17,8 +17,16 @@ join on ``run_id``) and prints a single JSON digest:
   won't help — while a max at the configured depth means the worker
   kept the buffer full: the device-bound good case);
 * **per-table health totals** — nonfinite/norm/masked row counts;
+* **hot tier** — two-tier storage hit rate (rows served by the
+  replicated hot head over total pulled rows) and the last/max
+  pending-delta gauge (parameter-plane staleness;
+  `docs/performance.md` "Two-tier storage");
 * **incidents** — rollbacks, watchdog stalls (+ recoveries), guard
-  escalations, health aborts, checkpoint fallbacks, checkpoint saves.
+  escalations, health aborts, checkpoint fallbacks, checkpoint saves —
+  plus, from the supervisor journal, `deadline_abort` events whose
+  `stall_kind` is `source_stall` (a stalled `prefetch`-phase heartbeat:
+  the SOURCE wedged while the driver waited on it, a distinct incident
+  from a wedged driver) summarized as `source_stalls`.
 
 Pure host tool: no jax import, safe to run on a login node against a
 live or finished run directory.
@@ -61,7 +69,7 @@ _INCIDENT_EVENTS = (
 REQUIRED_FIELDS = (
     "obs_dir", "run_ids", "processes", "chunks", "epochs", "steps",
     "examples", "phase_seconds", "health", "incidents", "checkpoint_saves",
-    "quarantined", "wall_span_s", "prefetch",
+    "quarantined", "wall_span_s", "prefetch", "hot_tier", "source_stalls",
 )
 
 
@@ -208,6 +216,25 @@ def render_digest(obs_dir: str) -> dict:
             "queue_depth_max": gauges.get(
                 "prefetch.queue_depth", {}).get("max"),
         },
+        # Two-tier storage (labels fold across tables; the per-table
+        # split lives in the raw event files if needed).
+        "hot_tier": {
+            "hot_rows": int(counters.get("hot_tier.hot_rows", 0)),
+            "pulled_rows": int(counters.get("hot_tier.pulled_rows", 0)),
+            "hit_rate": (
+                round(counters["hot_tier.hot_rows"]
+                      / counters["hot_tier.pulled_rows"], 4)
+                if counters.get("hot_tier.pulled_rows") else None),
+            "pending_delta_last": gauges.get(
+                "hot_tier.pending_delta", {}).get("last"),
+            "pending_delta_max": gauges.get(
+                "hot_tier.pending_delta", {}).get("max"),
+        },
+        # Supervisor deadline aborts whose last heartbeat was a stalled
+        # 'prefetch'-phase beat: the SOURCE wedged, not the driver.
+        "source_stalls": sum(
+            1 for e in incidents.get("deadline_abort", ())
+            if e.get("stall_kind") == "source_stall"),
         "health": dict(sorted(health.items())),
         "poisoned_chunks": int(counters.get("health.poisoned_chunks", 0)),
         "incidents": {k: v for k, v in incidents.items() if v},
