@@ -82,56 +82,19 @@ HOST_SERIAL_PHASES = ("ingest", "place", "host_sync", "checkpoint",
 
 # ---------------------------------------------------------------------------
 # Cross-shard collective accounting (two-tier A/B evidence).
+#
+# The implementation grew into the static-analysis subsystem
+# (fps_tpu.analysis — HloProgram model + contract pass suite);
+# count_collectives is re-exported here for backward compatibility, and
+# collective_profile is its structured form: one (kind, payload_bytes,
+# replica_groups) entry per qualifying collective, so the A/B can report
+# payload BYTES moved per chunk alongside the op count.
 # ---------------------------------------------------------------------------
 
-import re as _re
-
-_COLL_RE = _re.compile(r"stablehlo\.(all_gather|all_reduce|all_to_all|"
-                       r"reduce_scatter|collective_permute)")
-_TENSOR_RE = _re.compile(r"tensor<([0-9]+(?:x[0-9]+)*)x([a-z]+[0-9]+)>")
-_GROUPS_RE = _re.compile(r"replica_groups = dense<[^>]*> : "
-                         r"tensor<[0-9]+x([0-9]+)xi64>")
-
-
-def count_collectives(text: str, min_bytes: int = 1024) -> int:
-    """Cross-shard collectives in a lowered (StableHLO) program whose
-    payload is at least ``min_bytes``.
-
-    Excluded: singleton replica groups (a size-1 mesh axis — no
-    communication at all) and sub-threshold payloads (the per-step
-    scalar metric psums), so the count tracks data-plane table/batch
-    traffic — the thing the two-tier A/B claims to reduce. Static per
-    compiled program: an op inside the step scan counts once, which is
-    exactly the per-chunk program the claim is about."""
-    def payload_of(line):
-        best = 0
-        for dims, dt in _TENSOR_RE.findall(line):
-            size = 1
-            for d in dims.split("x"):
-                size *= int(d)
-            best = max(best, size * (int(_re.sub(r"[a-z]+", "", dt)) // 8))
-        return best
-
-    n = 0
-    lines = text.splitlines()
-    for i, line in enumerate(lines):
-        if not _COLL_RE.search(line):
-            continue
-        g = _GROUPS_RE.search(line)
-        if g and int(g.group(1)) <= 1:
-            continue
-        payload = payload_of(line)
-        if "({" in line and payload < min_bytes:
-            # Region-carrying op (all_reduce/reduce_scatter): the operand/
-            # result types sit on the region's CLOSING line, not the op
-            # line (whose only tensor<> is the replica-groups constant).
-            for j in range(i + 1, min(i + 12, len(lines))):
-                if "})" in lines[j]:
-                    payload = max(payload, payload_of(lines[j]))
-                    break
-        if payload >= min_bytes:
-            n += 1
-    return n
+from fps_tpu.analysis import (  # noqa: F401  (count_collectives: re-export)
+    collective_profile,
+    count_collectives,
+)
 
 
 def host_pipeline_ab(trainer, init_state, make_chunks, *, depth=2):
@@ -949,9 +912,7 @@ def run_tiered(args):
     from fps_tpu.core.driver import num_workers_of
     from fps_tpu.core.ingest import epoch_chunks
     from fps_tpu.models.matrix_factorization import MFConfig, online_mf
-    from fps_tpu.parallel.mesh import (
-        default_mesh_shape, key_to_replicated, make_ps_mesh,
-    )
+    from fps_tpu.parallel.mesh import default_mesh_shape, make_ps_mesh
 
     devs = jax.devices()
     if len(devs) < 8:
@@ -987,20 +948,17 @@ def run_tiered(args):
         from fps_tpu import obs
 
         # Static collective count of the per-chunk program.
-        tables, ls = trainer.init_state(jax.random.key(0))
-        tables = trainer._attach_hot(tables)
-        chunk0 = next(make_chunks())
-        placed = trainer._place_chunk(chunk0, "sync")
-        key = key_to_replicated(jax.random.key(1), mesh)
-        hlo = trainer._get_compiled("sync").lower(
-            tables, ls, placed, key).as_text()
-        colls = count_collectives(hlo)
+        hlo = trainer.lowered_chunk_text(next(make_chunks()), "sync")
+        profile = collective_profile(hlo)
+        colls = len(profile)
+        coll_bytes = sum(c.payload_bytes for c in profile)
 
         # Warm-up (compile), then timed run on fresh state with a fresh
         # recorder — the hit-rate counters must scope the timed pass
         # only, not the warm-up traffic.
         from itertools import islice
 
+        tables, ls = trainer.init_state(jax.random.key(0))
         trainer.fit_stream(tables, ls, islice(make_chunks(), 2),
                            jax.random.key(9))
         rec = obs.Recorder(sinks=[])
@@ -1015,6 +973,11 @@ def run_tiered(args):
         rates[label] = n_ex / wall
         arm = {
             "collectives_per_chunk": colls,
+            # Payload bytes those collectives move per chunk program —
+            # the structured profile's sum (fps_tpu.analysis): the
+            # partial-head scaling cliff (ROADMAP) is a BYTES story the
+            # bare count can't show.
+            "collective_bytes_per_chunk": coll_bytes,
             "examples_per_sec": round(n_ex / wall, 1),
             "wall_s": round(wall, 4),
             "train_rmse": round((se / max(n_ex, 1.0)) ** 0.5, 4),
@@ -1030,10 +993,16 @@ def run_tiered(args):
     off, on = out["off"], out["on"]
     out["collectives_fewer"] = (on["collectives_per_chunk"]
                                 < off["collectives_per_chunk"])
+    out["collective_bytes_ratio"] = (
+        round(on["collective_bytes_per_chunk"]
+              / off["collective_bytes_per_chunk"], 4)
+        if off["collective_bytes_per_chunk"] else None)
     out["speedup"] = round(rates["on"] / rates["off"], 3)
     print(
         f"tiered A/B: collectives/chunk {off['collectives_per_chunk']} -> "
-        f"{on['collectives_per_chunk']}, examples/s "
+        f"{on['collectives_per_chunk']} "
+        f"({off['collective_bytes_per_chunk']} -> "
+        f"{on['collective_bytes_per_chunk']} bytes), examples/s "
         f"{off['examples_per_sec']:.0f} -> {on['examples_per_sec']:.0f}, "
         f"hot hit rate {on.get('hot_hit_rate')}", file=sys.stderr)
     return {

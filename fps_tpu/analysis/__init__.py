@@ -1,0 +1,69 @@
+"""fps_tpu.analysis — the program contract auditor.
+
+Static analysis over what the framework actually *compiles*, at two
+altitudes (see ``docs/analysis.md``):
+
+* **HLO passes** — :class:`HloProgram` parses a lowered StableHLO module
+  (ops, payload bytes, replica groups, donation markers) and the pass
+  suite (:mod:`fps_tpu.analysis.passes`) certifies it against a
+  :class:`ProgramContract`: collective count/byte budgets per kind, no
+  host transfers inside the step, canonical tables donated in-place, no
+  dtype drift, and the hot-tier reconcile psum present when tiering is
+  on. ``Trainer(audit=...)`` certifies every program it compiles;
+  ``tools/audit_programs.py`` certifies the example workloads and writes
+  the certificate JSON.
+* **AST linter** — :mod:`fps_tpu.analysis.lint` catches the jax-specific
+  source hazards that produce wrong programs (late-bound closures over
+  loop variables, bool branches on tracers, unsorted dict iteration in
+  compiled-fn builders, unsynchronized thread state, shim indirection);
+  ``tools/lint.py`` runs it over the package and a tier-1 test keeps it
+  at zero findings.
+
+Pure host-side: the analysis modules themselves never import jax (they
+parse text and source), so the tools work against saved ``.as_text()``
+dumps. On a jax-free login node, don't import this package directly
+(``fps_tpu/__init__`` imports jax) — ``tools/lint.py`` loads the linter
+by file path (the ``tools/supervise.py`` pattern) and
+``tools/audit_programs.py --hlo DUMP.txt`` loads the HLO layer through
+a stub root package, so both CLIs run without jax.
+"""
+
+from fps_tpu.analysis.contract import (
+    Certificate,
+    ContractViolationError,
+    ProgramAuditor,
+    ProgramContract,
+    Violation,
+    as_auditor,
+    certify,
+    contract_for_trainer,
+)
+from fps_tpu.analysis.hlo import (
+    Collective,
+    HloOp,
+    HloProgram,
+    collective_profile,
+    count_collectives,
+)
+from fps_tpu.analysis.lint import LintFinding, lint_paths, lint_source
+from fps_tpu.analysis.passes import (
+    DEFAULT_PASSES,
+    AnalysisPass,
+    CollectiveBudget,
+    DonationAudit,
+    DtypeDriftDetector,
+    HostTransferDetector,
+    ReplicaConsistency,
+)
+
+__all__ = [
+    "HloProgram", "HloOp", "Collective",
+    "collective_profile", "count_collectives",
+    "ProgramContract", "Violation", "Certificate",
+    "ContractViolationError", "ProgramAuditor", "as_auditor",
+    "certify", "contract_for_trainer",
+    "AnalysisPass", "CollectiveBudget", "HostTransferDetector",
+    "DonationAudit", "DtypeDriftDetector", "ReplicaConsistency",
+    "DEFAULT_PASSES",
+    "LintFinding", "lint_source", "lint_paths",
+]

@@ -1,0 +1,398 @@
+"""jax-hazard source linter — the AST layer of the contract auditor.
+
+The HLO passes certify what a program *lowered to*; this linter catches
+the Python-side hazards that produce wrong programs in the first place —
+each rule encodes a failure mode this codebase has hand-dodged (and in
+some cases shipped and fixed) before:
+
+* **FPS001 jit-closure-loop-var** — a closure defined inside a loop that
+  reads the loop variable late-binds it: every traced program sees the
+  LAST iteration's value (the classic "all my compiled fns use the same
+  table" bug). Bind it as a default argument (``lambda x, _v=v: ...``).
+* **FPS002 tracer-bool-context** — ``if jnp.any(...)`` / ``while
+  jnp.all(...)``: under tracing this raises TracerBoolConversionError;
+  on host values it silently forces a device sync per call. Use
+  ``lax.cond``/``jnp.where`` in traced code, ``np.*`` on host.
+* **FPS003 unsorted-traced-items** — dict iteration feeding tree
+  construction inside a compiled-fn builder (lexically within a
+  function whose subtree calls ``lax.scan`` / ``lax.fori_loop`` /
+  ``lax.while_loop`` / ``shard_map``). Insertion-order iteration bakes
+  dict construction *history* into the traced program — two processes
+  (or two code paths) that built the dict differently trace different
+  programs, the multi-controller determinism hazard. Iterate
+  ``sorted(d.items())``.
+* **FPS004 thread-shared-state** — a class that starts a
+  ``threading.Thread``/``Timer`` without any synchronization primitive
+  (Lock/Condition/Event/Queue/...) or an explicit ``thread-safety:``
+  note in its docstring. Prefetch/checkpoint-style background workers
+  sharing mutable state without a documented discipline is how torn
+  snapshots happen.
+* **FPS005 internal-shim-import** — importing the
+  ``fps_tpu.utils.profiling`` compat shim from inside the package.
+  Shims exist for EXTERNAL callers; internal indirection through a
+  deprecated alias hides the real dependency edge.
+
+Suppression: append ``# noqa: FPSNNN`` to the flagged line — but the
+tier-1 test runs this linter over ``fps_tpu/`` expecting zero findings,
+so in-tree fixes are the norm, suppressions the exception.
+
+Stdlib-only (ast + tokenize-free): safe anywhere, no jax import.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+__all__ = ["LintFinding", "RULES", "lint_source", "lint_paths",
+           "iter_py_files"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LintFinding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+# Rule id -> one-line rationale (the CLI's --explain output).
+RULES = {
+    "FPS001": "closure in a loop late-binds the loop variable — bind it "
+              "as a default argument",
+    "FPS002": "boolean branch on a jnp predicate — TracerBoolConversion "
+              "under jit, a hidden device sync on host",
+    "FPS003": "unsorted dict iteration building a tree inside a "
+              "compiled-fn builder — iterate sorted(d.items())",
+    "FPS004": "class starts a thread but declares no synchronization "
+              "primitive or thread-safety note",
+    "FPS005": "internal import of the fps_tpu.utils.profiling shim — "
+              "import from fps_tpu.obs",
+}
+
+# Calls whose presence makes a function (and everything lexically inside
+# it) a compiled-fn builder for FPS003.
+_TRACE_TRIGGERS = {"scan", "fori_loop", "while_loop", "shard_map"}
+
+# jnp predicates that return arrays — poison in a bool context.
+_TRACER_PREDICATES = {
+    "any", "all", "isnan", "isinf", "isfinite", "array_equal", "allclose",
+    "logical_and", "logical_or", "logical_not", "equal", "not_equal",
+    "less", "less_equal", "greater", "greater_equal",
+}
+
+_SYNC_PRIMITIVES = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Queue", "SimpleQueue", "LifoQueue",
+}
+_THREAD_STARTERS = {"Thread", "Timer"}
+
+
+def _attr_chain(node) -> str:
+    """Dotted name of an attribute/name chain ('' when not a chain)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _call_name(node) -> str:
+    return _attr_chain(node.func) if isinstance(node, ast.Call) else ""
+
+
+def _items_call(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("items", "keys", "values")
+            and not node.args)
+
+
+def _bound_names(fn) -> set[str]:
+    """Names a closure binds itself: parameters (defaults included by
+    construction — a default REBINDS the name at def time, which is the
+    sanctioned fix) plus names assigned in its body."""
+    out = set()
+    args = fn.args
+    for a in (list(args.posonlyargs) + list(args.args)
+              + list(args.kwonlyargs)):
+        out.add(a.arg)
+    if args.vararg:
+        out.add(args.vararg.arg)
+    if args.kwarg:
+        out.add(args.kwarg.arg)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Name) and isinstance(
+                    n.ctx, (ast.Store, ast.Del)):
+                out.add(n.id)
+    return out
+
+
+def _loop_target_names(node) -> set[str]:
+    out = set()
+    if isinstance(node, (ast.For, ast.AsyncFor)):
+        for n in ast.walk(node.target):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source_lines: list[str]):
+        self.path = path
+        self.lines = source_lines
+        self.findings: list[LintFinding] = []
+        self.is_shim = path.replace(os.sep, "/").endswith(
+            "fps_tpu/utils/profiling.py")
+        # FPS001: stack of (loop_node, target_names) we are inside of.
+        self._loops: list[tuple[ast.AST, set[str]]] = []
+        # FPS003: depth of enclosing compiled-fn-builder functions.
+        self._trace_depth = 0
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _add(self, rule: str, node, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        src = self.lines[line - 1] if line - 1 < len(self.lines) else ""
+        if f"noqa: {rule}" in src:
+            return
+        self.findings.append(LintFinding(rule, self.path, line, message))
+
+    # -- FPS005 -----------------------------------------------------------
+
+    def visit_Import(self, node):
+        if not self.is_shim:
+            for alias in node.names:
+                if alias.name == "fps_tpu.utils.profiling":
+                    self._add("FPS005", node,
+                              "import of the utils.profiling shim — use "
+                              "fps_tpu.obs (trace/Throughput live there)")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if not self.is_shim:
+            mod = node.module or ""
+            if mod == "fps_tpu.utils.profiling" or (
+                    mod == "fps_tpu.utils"
+                    and any(a.name == "profiling" for a in node.names)):
+                self._add("FPS005", node,
+                          "import of the utils.profiling shim — use "
+                          "fps_tpu.obs (trace/Throughput live there)")
+        self.generic_visit(node)
+
+    # -- FPS002 -----------------------------------------------------------
+
+    def _tracer_predicate(self, test):
+        """The jnp predicate call inside a bool-context test, if any."""
+        stack = [test]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.BoolOp):
+                stack.extend(n.values)
+            elif isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.Not):
+                stack.append(n.operand)
+            elif isinstance(n, ast.Call):
+                name = _call_name(n)
+                if name.startswith("jnp.") and (
+                        name.split(".", 1)[1] in _TRACER_PREDICATES):
+                    return name
+        return None
+
+    def _check_bool_context(self, node):
+        name = self._tracer_predicate(node.test)
+        if name:
+            self._add("FPS002", node,
+                      f"branch on {name}(...) — use lax.cond/jnp.where in "
+                      "traced code, np.* on host values")
+
+    def visit_If(self, node):
+        self._check_bool_context(node)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        self._check_bool_context(node)
+        self.generic_visit(node)
+
+    # -- FPS001 + loops ---------------------------------------------------
+
+    def visit_While(self, node):
+        self._check_bool_context(node)
+        self._visit_loop(node)
+
+    def visit_For(self, node):
+        self._visit_loop(node)
+
+    visit_AsyncFor = visit_For
+
+    def _visit_loop(self, node):
+        self._loops.append((node, _loop_target_names(node)))
+        self.generic_visit(node)
+        self._loops.pop()
+
+    def _check_closure(self, node):
+        """FPS001 on a def/lambda lexically inside >=1 loop."""
+        if not self._loops:
+            return
+        bound = _bound_names(node)
+        free: set[str] = set()
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                    free.add(n.id)
+        free -= bound
+        for _loop, targets in self._loops:
+            captured = sorted(free & targets)
+            if captured:
+                self._add("FPS001", node,
+                          f"closure captures loop variable(s) "
+                          f"{', '.join(captured)} by reference — bind as "
+                          "a default argument (late-binding traces every "
+                          "program against the last iteration's value)")
+                return
+
+    # -- FPS003 + function scopes ----------------------------------------
+
+    def _subtree_is_builder(self, node) -> bool:
+        for n in ast.walk(node):
+            name = _call_name(n)
+            if name and name.split(".")[-1] in _TRACE_TRIGGERS:
+                return True
+        return False
+
+    def visit_FunctionDef(self, node):
+        self._check_closure(node)
+        entered = False
+        if self._trace_depth == 0 and self._subtree_is_builder(node):
+            self._trace_depth += 1
+            entered = True
+        elif self._trace_depth:
+            self._trace_depth += 1
+            entered = True
+        self.generic_visit(node)
+        if entered:
+            self._trace_depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._check_closure(node)
+        self.generic_visit(node)
+
+    def _check_items_iter(self, iter_node, where):
+        if self._trace_depth == 0:
+            return
+        # A sorted()/reversed() wrapper never reaches here: the iter
+        # node is then a Name call, not the .items() Attribute call
+        # _items_call matches.
+        if _items_call(iter_node):
+            attr = iter_node.func.attr
+            self._add("FPS003", where,
+                      f"unsorted .{attr}() iteration inside a compiled-fn "
+                      "builder — tree construction must not depend on "
+                      "dict insertion history; iterate "
+                      f"sorted(....{attr}())")
+
+    def visit_comprehension(self, node):
+        self._check_items_iter(node.iter, node.iter)
+        self.generic_visit(node)
+
+    def _check_for_iter(self, node):
+        self._check_items_iter(node.iter, node)
+
+    # -- FPS004 -----------------------------------------------------------
+
+    def visit_ClassDef(self, node):
+        starts_thread = None
+        has_sync = False
+        for n in ast.walk(node):
+            name = _call_name(n)
+            if not name:
+                continue
+            leaf = name.split(".")[-1]
+            root = name.split(".")[0]
+            if leaf in _THREAD_STARTERS and root in ("threading", leaf):
+                starts_thread = starts_thread or n
+            if leaf in _SYNC_PRIMITIVES and root in ("threading", "queue",
+                                                     leaf):
+                has_sync = True
+        if starts_thread is not None and not has_sync:
+            doc = (ast.get_docstring(node) or "").lower()
+            if "thread-safety" not in doc and "thread safety" not in doc:
+                self._add(
+                    "FPS004", starts_thread,
+                    f"class {node.name} starts a thread but declares no "
+                    "synchronization primitive (Lock/Condition/Event/"
+                    "Queue) and no 'thread-safety:' docstring note")
+        self.generic_visit(node)
+
+
+def lint_source(source: str, path: str = "<string>") -> list[LintFinding]:
+    """Lint one Python source string; returns findings (empty = clean)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [LintFinding("FPS000", path, e.lineno or 1,
+                            f"syntax error: {e.msg}")]
+    linter = _Linter(path, source.splitlines())
+    # ast.NodeVisitor has no hook ordering for For.iter vs For body with
+    # the trace-depth state; run the main visit, then a focused second
+    # walk for for-loop iterables (comprehensions are handled inline).
+    linter.visit(tree)
+    _walk_for_iters(tree, linter)
+    return sorted(linter.findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def _walk_for_iters(tree, linter: _Linter) -> None:
+    """Second pass for FPS003 on ``for`` statements: re-derive the
+    trace-depth context per loop (statement position, not visit order)."""
+
+    def walk(node, depth):
+        for child in ast.iter_child_nodes(node):
+            d = depth
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if d or linter._subtree_is_builder(child):
+                    d += 1
+            if isinstance(child, (ast.For, ast.AsyncFor)) and d:
+                linter._trace_depth = d
+                linter._check_for_iter(child)
+                linter._trace_depth = 0
+            walk(child, d)
+
+    walk(tree, 0)
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def lint_paths(paths, select=None) -> list[LintFinding]:
+    """Lint every ``.py`` under ``paths``; ``select`` filters rule ids."""
+    findings: list[LintFinding] = []
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for finding in lint_source(src, path):
+            if select is None or finding.rule in select:
+                findings.append(finding)
+    return findings

@@ -240,6 +240,7 @@ class Trainer:
         server_logic: Mapping[str, ServerLogic] | ServerLogic = ServerLogic(),
         config: TrainerConfig | None = None,
         recorder=None,
+        audit=None,
     ):
         # Telemetry (fps_tpu.obs.Recorder) — host-side only, never part of
         # the traced program or the compile cache key; None (default) means
@@ -247,6 +248,27 @@ class Trainer:
         # (``trainer.recorder = rec``) and overridable per fit_stream /
         # run_indexed call.
         self.recorder = recorder
+        # Opt-in compile-time program certification (fps_tpu.analysis):
+        # a ProgramAuditor / ProgramContract / True / "strict". Every
+        # program this trainer compiles is lowered once more on its first
+        # call, run through the static-analysis pass suite against the
+        # contract (default: contract_for_trainer — donation, host
+        # transfers, dtype drift, and the hot-tier reconcile psum when
+        # tiering resolves on), and reported through the recorder as
+        # analysis.certified_programs / analysis.contract_violations
+        # metrics plus an analysis.contract_violation event per finding
+        # ("strict" raises ContractViolationError instead). Host-side
+        # only — the executed program is untouched. Set it BEFORE the
+        # first compiled call: like the guard, certification attaches at
+        # program build time (already-cached programs are not re-audited).
+        if audit is not None:
+            from fps_tpu import analysis
+
+            # Fail fast on typos here, not on the first dispatch;
+            # False normalizes to None (disabled), so boolean flags
+            # wire straight through.
+            audit = analysis.as_auditor(audit)
+        self.audit = audit
         self.mesh = mesh
         self.store = param_store
         self.logic = worker_logic
@@ -790,7 +812,7 @@ class Trainer:
                     lax.dynamic_index_in_dim(bids, slot, 0, keepdims=False),
                     lax.dynamic_index_in_dim(bdel, slot, 0, keepdims=False),
                 )
-                for name, (bids, bdel) in bufs.items()
+                for name, (bids, bdel) in sorted(bufs.items())
             }
             return self._apply_pushes(tables, pending, head_prefix)
 
@@ -985,7 +1007,7 @@ class Trainer:
                     tables = carry[0]
                     snapshot = {
                         name: lax.all_gather(tb, SHARD_AXIS, tiled=True)
-                        for name, tb in tables.items()
+                        for name, tb in sorted(tables.items())
                     }
                     carry, outs = lax.scan(
                         lambda c, b: step_fn(c, b, snapshot), carry,
@@ -1003,7 +1025,7 @@ class Trainer:
                 )
             tables = self._flush_push_bufs(tables, bufs, t, hp_seen)
             tables = {**tables,
-                      **{hot_key(n): v for n, v in hot.items()}}
+                      **{hot_key(n): v for n, v in sorted(hot.items())}}
             return tables, local_state, outs
 
         table_specs = {name: P(SHARD_AXIS, None) for name in self.store.specs}
@@ -1059,8 +1081,83 @@ class Trainer:
                self._server_logic_key(), self.config.hot_sync_every,
                tuple(sorted(self._hot_tier_map().items())))
         if key not in self._compiled:
-            self._compiled[key] = self._build_chunk_fn(mode)
+            self._compiled[key] = self._wrap_audit(
+                self._build_chunk_fn(mode), f"chunk/{mode}")
         return self._compiled[key]
+
+    # -- compile-time program certification (fps_tpu.analysis) ------------
+
+    def _wrap_audit(self, fn, label: str):
+        """Certify ``fn``'s lowered program on its first call (no-op
+        passthrough when ``self.audit`` is unset at build time).
+
+        The wrapper lowers once more — trace cost only, paid once per
+        compiled program — and hands the StableHLO text to the auditor;
+        the actual dispatch path is the unmodified jitted callable, so
+        donation/caching behavior is untouched. ``.lower`` passes
+        through for callers (bench.py) that inspect programs directly.
+        """
+        if self.audit is None:
+            return fn
+        state = {"done": False}
+
+        def audited(*args):
+            if not state["done"]:
+                state["done"] = True
+                self._audit_program(label, fn, args)
+            return fn(*args)
+
+        audited.lower = fn.lower
+        audited.__wrapped__ = fn
+        audited._fps_audited = True
+        return audited
+
+    def _audit_program(self, label: str, fn, args) -> None:
+        from fps_tpu import analysis
+
+        auditor = analysis.as_auditor(self.audit)
+        if auditor is None:  # disabled after the wrapper was installed
+            return
+        self.audit = auditor  # keep one auditor (and its certificates)
+        try:
+            text = fn.lower(*args).as_text()
+        except Exception:
+            # Lowering for audit must never take down a run the real
+            # dispatch would have survived (strict contract FAILURES, by
+            # contrast, raise from certify below — that is the point).
+            _log.exception("program audit: lowering %r failed; skipping "
+                           "certification", label)
+            return
+        contract = auditor.contract
+        if contract is None:
+            contract = analysis.contract_for_trainer(
+                self, label.split("/", 1)[-1])
+        auditor.certify(label, text, contract=contract,
+                        recorder=self.recorder)
+
+    def lowered_chunk_text(self, chunk, mode: str = "sync") -> str:
+        """StableHLO text of the exact per-chunk program ``fit_stream``
+        dispatches for ``chunk``: fresh state with hot replicas
+        attached, the chunk placed, the ``mode`` program lowered.
+
+        The one entry point for the static-analysis tools
+        (``tools/audit_programs.py``, ``tools/chaos_sweep.py``'s digest
+        certificate, ``bench.py``'s tiered A/B) — keeping the
+        init/attach/place/lower choreography in one place so a tiered
+        trainer can't be lowered without its hot replicas. Read-only on
+        the trainer: ``store.init`` writes fresh tables into
+        ``store.tables`` in place, so they are restored afterwards —
+        certifying after a run must not clobber the trained weights."""
+        saved = dict(self.store.tables)
+        try:
+            tables, ls = self.init_state(jax.random.key(0))
+            tables = self._attach_hot(tables)
+            placed = self._place_chunk(chunk, mode)
+            key = key_to_replicated(jax.random.key(1), self.mesh)
+            return self._get_compiled(mode).lower(
+                tables, ls, placed, key).as_text()
+        finally:
+            self.store.tables = saved
 
     # -- index-fed epochs (ingest fused into the compiled loop) -----------
 
@@ -1136,7 +1233,7 @@ class Trainer:
                 tables = self._flush_push_bufs(tables, bufs, start + T,
                                                hp_seen)
                 tables = {**tables,
-                          **{hot_key(n): v for n, v in hot.items()}}
+                          **{hot_key(n): v for n, v in sorted(hot.items())}}
                 return tables, local_state, outs
 
             carry0 = (tables, hot, delta, bufs, local_state, key)
@@ -1166,7 +1263,7 @@ class Trainer:
                 tables = carry[0]
                 snapshot = {
                     name: lax.all_gather(tb, SHARD_AXIS, tiled=True)
-                    for name, tb in tables.items()
+                    for name, tb in sorted(tables.items())
                 }
                 carry, outs = lax.scan(
                     lambda c, t: step_t(c, t, snapshot), carry,
@@ -1379,7 +1476,8 @@ class Trainer:
               self._server_logic_key(), self.config.hot_sync_every,
               tuple(sorted(self._hot_tier_map().items())))
         if ck not in self._compiled:
-            self._compiled[ck] = self._build_indexed_fn(plan, mode)
+            self._compiled[ck] = self._wrap_audit(
+                self._build_indexed_fn(plan, mode), f"indexed/{mode}")
         return self._compiled[ck]
 
     def run_indexed(self, tables, local_state, plan, key, *, epochs: int = 1,

@@ -377,7 +377,7 @@ def maybe_warm_start(args, store, key) -> None:
 def maybe_profile(args):
     """Context manager tracing the training region when --profile is set."""
     if getattr(args, "profile", None):
-        from fps_tpu.utils.profiling import trace
+        from fps_tpu.obs import trace
 
         emit({"event": "profile", "dir": args.profile})
         return trace(args.profile)

@@ -96,7 +96,7 @@ def main(argv=None) -> int:
         wcm = (wd.watch("epoch", epoch) if wd is not None
                else contextlib.nullcontext())
         with cm, wcm:
-            solver.epoch(lambda: source(epoch, 1))
+            solver.epoch(lambda _e=epoch: source(_e, 1))
         loss = solver.weighted_loss(train["user"], train["item"],
                                     train["rating"])
         emit({"event": "epoch", "epoch": epoch, "weighted_loss": loss})

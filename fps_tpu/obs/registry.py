@@ -171,6 +171,17 @@ def default_registry() -> MetricsRegistry:
         # Watchdog.
         MetricSpec("watchdog.stalls", "counter", unit="stalls",
                    help="chunk/epoch dispatches that overran the deadline"),
+        # Program contract auditor (fps_tpu.analysis; Trainer(audit=...)).
+        MetricSpec("analysis.certified_programs", "counter",
+                   unit="programs",
+                   help="compiled step programs certified clean against "
+                        "their ProgramContract at compile time"),
+        MetricSpec("analysis.contract_violations", "counter",
+                   unit="violations", labels=("rule",),
+                   help="static-analysis contract violations (per pass: "
+                        "collective_budget / host_transfer / donation / "
+                        "dtype_drift / replica_consistency) — each also "
+                        "emits an analysis.contract_violation event"),
     ])
 
 

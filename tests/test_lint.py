@@ -1,0 +1,290 @@
+"""The jax-hazard source linter: per-rule seeded sources + the CI gate.
+
+Every rule gets a positive (flagged) and a negative (clean) seed so no
+rule is vacuous, ``# noqa`` suppression is honored, and — the actual CI
+contract — the whole ``fps_tpu`` package lints to ZERO findings, so any
+new hazard fails tier-1 with its file:line and rationale.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from fps_tpu.analysis.lint import RULES, lint_paths, lint_source
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(src):
+    return [f.rule for f in lint_source(textwrap.dedent(src))]
+
+
+# ---------------------------------------------------------------------------
+# FPS001 — late-bound closure over a loop variable.
+# ---------------------------------------------------------------------------
+
+
+def test_fps001_flags_loop_closure():
+    src = """
+    def build(tables):
+        fns = []
+        for name in tables:
+            fns.append(lambda: step(name))
+        return fns
+    """
+    assert rules_of(src) == ["FPS001"]
+
+
+def test_fps001_default_arg_binding_is_clean():
+    src = """
+    def build(tables):
+        fns = []
+        for name in tables:
+            fns.append(lambda _n=name: step(_n))
+        return fns
+    """
+    assert rules_of(src) == []
+
+
+def test_fps001_def_inside_loop():
+    src = """
+    for epoch in range(3):
+        def thunk():
+            return source(epoch)
+        run(thunk)
+    """
+    assert rules_of(src) == ["FPS001"]
+
+
+def test_fps001_rebound_in_body_is_clean():
+    # The closure assigns the name itself — no free capture.
+    src = """
+    for i in range(3):
+        def thunk():
+            i = 0
+            return i
+    """
+    assert rules_of(src) == []
+
+
+# ---------------------------------------------------------------------------
+# FPS002 — boolean branch on a jnp predicate.
+# ---------------------------------------------------------------------------
+
+
+def test_fps002_flags_if_on_jnp_any():
+    src = """
+    def check(x):
+        if jnp.any(jnp.isnan(x)):
+            raise ValueError
+    """
+    assert rules_of(src) == ["FPS002"]
+
+
+def test_fps002_flags_while_and_assert():
+    src = """
+    def run(x):
+        while jnp.all(x > 0):
+            x = step(x)
+        assert jnp.isfinite(x)
+    """
+    assert rules_of(src) == ["FPS002", "FPS002"]
+
+
+def test_fps002_np_predicates_are_clean():
+    src = """
+    def check(x):
+        if np.any(np.isnan(x)):
+            raise ValueError
+    """
+    assert rules_of(src) == []
+
+
+# ---------------------------------------------------------------------------
+# FPS003 — unsorted dict iteration inside a compiled-fn builder.
+# ---------------------------------------------------------------------------
+
+
+def test_fps003_flags_items_in_builder():
+    src = """
+    def build_fn(tables):
+        def step(carry, batch):
+            out = {n: f(t) for n, t in tables.items()}
+            return carry, out
+        return lax.scan(step, tables, None)
+    """
+    assert rules_of(src) == ["FPS003"]
+
+
+def test_fps003_sorted_items_is_clean():
+    src = """
+    def build_fn(tables):
+        def step(carry, batch):
+            out = {n: f(t) for n, t in sorted(tables.items())}
+            return carry, out
+        return lax.scan(step, tables, None)
+    """
+    assert rules_of(src) == []
+
+
+def test_fps003_for_statement_in_builder():
+    src = """
+    def build_fn(tables):
+        acc = []
+        for n, t in tables.items():
+            acc.append(t)
+        return lax.scan(make_step(acc), tables, None)
+    """
+    assert rules_of(src) == ["FPS003"]
+
+
+def test_fps003_outside_builder_is_clean():
+    # No scan/fori/while/shard_map in the subtree: host-side dict
+    # iteration is fine (ingest, reporting, checkpointing).
+    src = """
+    def summarize(metrics):
+        return {k: sum(v) for k, v in metrics.items()}
+    """
+    assert rules_of(src) == []
+
+
+# ---------------------------------------------------------------------------
+# FPS004 — thread-starting class without synchronization.
+# ---------------------------------------------------------------------------
+
+
+def test_fps004_flags_unsynchronized_thread_class():
+    src = """
+    class Worker:
+        def start(self):
+            self.t = threading.Thread(target=self.run)
+            self.t.start()
+    """
+    assert rules_of(src) == ["FPS004"]
+
+
+def test_fps004_lock_is_clean():
+    src = """
+    class Worker:
+        def __init__(self):
+            self.lock = threading.Lock()
+        def start(self):
+            self.t = threading.Thread(target=self.run)
+    """
+    assert rules_of(src) == []
+
+
+def test_fps004_docstring_note_is_clean():
+    src = '''
+    class Worker:
+        """Background dumper.
+
+        thread-safety: the worker owns all state after start().
+        """
+        def start(self):
+            self.t = threading.Thread(target=self.run)
+    '''
+    assert rules_of(src) == []
+
+
+# ---------------------------------------------------------------------------
+# FPS005 — internal import of the utils.profiling shim.
+# ---------------------------------------------------------------------------
+
+
+def test_fps005_flags_shim_import():
+    assert rules_of("from fps_tpu.utils.profiling import trace") == [
+        "FPS005"]
+    assert rules_of("import fps_tpu.utils.profiling") == ["FPS005"]
+    assert rules_of("from fps_tpu.utils import profiling") == ["FPS005"]
+
+
+def test_fps005_obs_import_is_clean():
+    assert rules_of("from fps_tpu.obs import trace") == []
+
+
+def test_fps005_shim_itself_is_exempt():
+    src = "import fps_tpu.utils.profiling"
+    path = os.path.join("fps_tpu", "utils", "profiling.py")
+    assert [f.rule for f in lint_source(src, path)] == []
+
+
+# ---------------------------------------------------------------------------
+# Machinery: noqa, syntax errors, file walking, the CI gate.
+# ---------------------------------------------------------------------------
+
+
+def test_noqa_suppresses_exactly_that_rule():
+    src = "from fps_tpu.utils.profiling import trace  # noqa: FPS005"
+    assert lint_source(src) == []
+    other = "from fps_tpu.utils.profiling import trace  # noqa: FPS001"
+    assert [f.rule for f in lint_source(other)] == ["FPS005"]
+
+
+def test_syntax_error_reports_fps000():
+    findings = lint_source("def broken(:\n")
+    assert [f.rule for f in findings] == ["FPS000"]
+
+
+def test_findings_carry_location_and_str():
+    f = lint_source("import fps_tpu.utils.profiling", "x.py")[0]
+    assert (f.path, f.line) == ("x.py", 1)
+    assert str(f).startswith("x.py:1: FPS005")
+    assert f.to_json()["rule"] == "FPS005"
+
+
+def test_lint_paths_walks_and_selects(tmp_path):
+    (tmp_path / "a.py").write_text("import fps_tpu.utils.profiling\n")
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "b.py").write_text(
+        "def f(x):\n    if jnp.any(x):\n        pass\n")
+    (sub / "noise.txt").write_text("not python")
+    found = lint_paths([str(tmp_path)])
+    assert sorted(f.rule for f in found) == ["FPS002", "FPS005"]
+    only = lint_paths([str(tmp_path)], select={"FPS005"})
+    assert [f.rule for f in only] == ["FPS005"]
+
+
+def test_rule_table_is_complete():
+    assert set(RULES) == {"FPS001", "FPS002", "FPS003", "FPS004", "FPS005"}
+
+
+def test_package_lints_clean():
+    """THE CI gate: zero findings over the whole fps_tpu package. A new
+    hazard anywhere in the tree fails here with file:line + rationale
+    (fix it, or — deliberately — suppress with `# noqa: FPSNNN`)."""
+    findings = lint_paths([os.path.join(ROOT, "fps_tpu")])
+    assert findings == [], "\n".join(
+        [""] + [f"{f}  [{RULES.get(f.rule, '?')}]" for f in findings])
+
+
+def test_cli_json_and_exit_codes(tmp_path):
+    (tmp_path / "bad.py").write_text("import fps_tpu.utils.profiling\n")
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
+         str(tmp_path), "--json"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["count"] == 1
+    assert doc["findings"][0]["rule"] == "FPS005"
+    (tmp_path / "bad.py").write_text("x = 1\n")
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert r2.returncode == 0
+
+
+def test_cli_explain(tmp_path):
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
+         "--explain"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0
+    for rule in RULES:
+        assert rule in r.stdout

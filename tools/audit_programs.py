@@ -1,0 +1,410 @@
+"""Certify the example workloads' compiled step programs and write the
+machine-readable certificate JSON.
+
+The batch CLI over ``fps_tpu.analysis`` (``docs/analysis.md``): builds
+each of the six example workloads (mf, streaming_mf, logreg, w2v, pa,
+ials) plus the tiered/untiered MF pair on the 8-device CPU mesh at a
+small fixed audit scale, lowers the exact program the driver would
+dispatch (``Trainer._get_compiled(mode).lower(...)``; the iALS
+accumulate kernel for the solver workload), and runs the full pass
+suite against a PINNED :class:`~fps_tpu.analysis.ProgramContract` per
+``(workload, route, tiering)`` row — collective count/byte budgets,
+host-transfer freedom, table donation, dtype drift, and the hot-tier
+reconcile psum for the tiered row.
+
+The budgets in :data:`BUDGETS` are the certified collective structure
+of each program (the table in ``docs/analysis.md`` is generated from a
+run of this tool). They are exact counts, not ceilings-with-slack: a
+future PR that adds or removes a data-plane collective fails this audit
+until it re-pins the budget — which is the point (the diff becomes the
+review artifact).
+
+Usage:
+  python tools/audit_programs.py [--out CERTS.json] [--only mf,logreg]
+                                 [--measure]
+  python tools/audit_programs.py --hlo DUMP.txt [--hlo ...]
+                                 [--min-bytes N]
+
+``--measure`` prints each program's measured profile instead of
+enforcing budgets — the workflow for re-pinning after a deliberate
+program change. Exit status is 0 iff every selected program certifies
+clean.
+
+``--hlo`` profiles saved ``lower(...).as_text()`` dumps instead of
+building workloads: no jax, no mesh, no re-exec (the analysis package
+is loaded through a stub root so ``fps_tpu/__init__`` never imports
+jax) — the login-node workflow for programs lowered elsewhere.
+
+Like bench/conftest, re-execs itself into a cleaned 8-CPU-device
+environment when the current process cannot see 8 devices.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import importlib.util
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+# Audit scale: tiny but structurally faithful — every route (gathered
+# pull, push scatter, SSP snapshot, hot tier, iALS normal equations)
+# lowers the same op structure it has at bench scale; only the payload
+# bytes shrink. Fixed so the pinned budgets are deterministic.
+NU, NI, RANK = 96, 64, 8
+NF, NNZ = 400, 8
+VOCAB, W2V_DIM = 50, 8
+LOCAL_BATCH, STEPS = 32, 4
+
+
+def _reexec_if_needed() -> None:
+    """Re-exec into a cleaned 8-CPU-device process (conftest pattern):
+    the container's sitecustomize registers the single-chip TPU backend
+    at interpreter start, too early to widen from inside."""
+    spec = importlib.util.spec_from_file_location(
+        "_fps_hostenv", os.path.join(_ROOT, "fps_tpu", "utils",
+                                     "hostenv.py"))
+    hostenv = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(hostenv)
+    if hostenv.in_reexec():
+        return
+    env = hostenv.cpu_mesh_env(8)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_ROOT] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p])
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _load_analysis_offline():
+    """Import ``fps_tpu.analysis`` without executing ``fps_tpu/__init__``
+    (which imports jax): register a stub root package whose ``__path__``
+    points at the real package directory, then import the subpackage
+    normally — the analysis modules themselves are stdlib-only."""
+    import importlib
+    import types
+
+    if "fps_tpu" not in sys.modules:
+        stub = types.ModuleType("fps_tpu")
+        stub.__path__ = [os.path.join(_ROOT, "fps_tpu")]
+        sys.modules["fps_tpu"] = stub
+    return importlib.import_module("fps_tpu.analysis")
+
+
+def _offline_main(argv) -> int:
+    """``--hlo`` mode: profile saved ``.as_text()`` dumps — no jax, no
+    device mesh, no re-exec, so it runs on a login node against programs
+    lowered elsewhere."""
+    ap = argparse.ArgumentParser(
+        description="profile saved StableHLO dumps (fps_tpu.analysis, "
+                    "jax-free)")
+    ap.add_argument("--hlo", action="append", required=True, metavar="PATH",
+                    help="saved lower(...).as_text() dump (repeatable)")
+    ap.add_argument("--min-bytes", type=int, default=1024,
+                    help="collective payload threshold (default 1024)")
+    args = ap.parse_args(argv)
+    analysis = _load_analysis_offline()
+    out = {}
+    for path in args.hlo:
+        with open(path, encoding="utf-8") as f:
+            prof = analysis.collective_profile(f.read(), args.min_bytes)
+        out[path] = {
+            "collectives": len(prof),
+            "bytes": sum(c.payload_bytes for c in prof),
+            "profile": [{"kind": c.kind, "bytes": c.payload_bytes,
+                         "replica_groups": c.replica_groups}
+                        for c in prof],
+        }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__" and any(
+        a == "--hlo" or a.startswith("--hlo=") for a in sys.argv[1:]):
+    sys.exit(_offline_main(sys.argv[1:]))
+
+if __name__ == "__main__":
+    # Only the CLI re-execs (os.execve REPLACES the process — an
+    # importer reusing BUDGETS/builders must not be swallowed);
+    # importers are responsible for their own device mesh.
+    _reexec_if_needed()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from fps_tpu.analysis import ProgramContract, certify  # noqa: E402
+from fps_tpu.core.driver import num_workers_of  # noqa: E402
+from fps_tpu.core.ingest import multi_epoch_chunks  # noqa: E402
+from fps_tpu.parallel.mesh import make_ps_mesh  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Pinned per-program budgets: (max_collectives, max_collective_bytes,
+# per_kind_max). Measured at the audit scale above on the 8-device mesh
+# (``--measure`` re-derives them); docs/analysis.md carries the same
+# table with the rationale per row.
+# ---------------------------------------------------------------------------
+
+BUDGETS: dict[str, dict] = {
+    # Untiered sync MF: gathered pull (all_gather) + routed push
+    # (all_to_all) — the 2-collective data plane of BENCH r05.
+    "mf": dict(max_collectives=2, max_collective_bytes=4096,
+               per_kind_max={"all_gather": 1, "all_to_all": 1}),
+    # SSP MF (streaming example's mode): the data plane is the same two
+    # collectives — the sync-round snapshot all_gather lowers OUTSIDE
+    # the per-step window at this audit scale (sub-threshold per step).
+    "streaming_mf": dict(max_collectives=2, max_collective_bytes=4096,
+                         per_kind_max={"all_gather": 1, "all_to_all": 1}),
+    # Tiered MF (hot head replicated, E=2): cold routes keep their two
+    # collectives; the reconcile psum is the third — the all_reduce
+    # ReplicaConsistency certifies, payload H*rank*4 = 1024B exactly.
+    "mf_tiered": dict(max_collectives=3, max_collective_bytes=5120,
+                      per_kind_max={"all_gather": 1, "all_to_all": 1,
+                                    "all_reduce": 1}),
+    # Sparse logreg, gathered route + adagrad server fold.
+    "logreg": dict(max_collectives=2, max_collective_bytes=3200,
+                   per_kind_max={"all_gather": 1, "all_to_all": 1}),
+    # Word2vec: in/out vectors for center+context+negatives across two
+    # tables lower as six gathered pulls (pushes fold into the same
+    # gather/scatter route — no all_to_all at this scale).
+    "w2v": dict(max_collectives=6, max_collective_bytes=40448,
+                per_kind_max={"all_gather": 6}),
+    # Passive-aggressive shares logreg's route structure.
+    "pa": dict(max_collectives=2, max_collective_bytes=3200,
+               per_kind_max={"all_gather": 1, "all_to_all": 1}),
+    # iALS accumulate: the fixed factor table and per-step row gathers
+    # (5 all_gathers) feed the normal-equation fold; accumulators stay
+    # sharded through one reduce_scatter.
+    "ials": dict(max_collectives=6, max_collective_bytes=84992,
+                 per_kind_max={"all_gather": 5, "reduce_scatter": 1}),
+}
+
+
+def _mf_pieces(mesh, *, sync_every=None, hot_tier=0, hot_sync_every=1):
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    cfg = MFConfig(num_users=NU, num_items=NI, rank=RANK)
+    trainer, store = online_mf(mesh, cfg, sync_every=sync_every)
+    if hot_tier:
+        for name, spec in store.specs.items():
+            store.specs[name] = dataclasses.replace(
+                spec, hot_tier=min(hot_tier, spec.num_ids))
+        trainer.config = dataclasses.replace(
+            trainer.config, hot_sync_every=hot_sync_every)
+    data = synthetic_ratings(NU, NI, 2000, rank=3, seed=3)
+    chunks = multi_epoch_chunks(
+        data, 1, num_workers=num_workers_of(mesh), local_batch=LOCAL_BATCH,
+        steps_per_chunk=STEPS, route_key="user", sync_every=sync_every,
+        seed=11)
+    return trainer, chunks
+
+
+def _lower_chunk_program(trainer, chunks, mode="sync") -> str:
+    """The exact per-chunk program ``fit_stream`` dispatches."""
+    return trainer.lowered_chunk_text(next(iter(chunks)), mode)
+
+
+def build_mf(mesh) -> str:
+    return _lower_chunk_program(*_mf_pieces(mesh))
+
+
+def build_streaming_mf(mesh) -> str:
+    # The streaming example's distinct program is the SSP mode (chunked
+    # sync_every windows over an unbounded source).
+    trainer, chunks = _mf_pieces(mesh, sync_every=2)
+    return _lower_chunk_program(trainer, chunks, mode="ssp")
+
+
+def build_mf_tiered(mesh) -> str:
+    trainer, chunks = _mf_pieces(mesh, hot_tier=32, hot_sync_every=2)
+    return _lower_chunk_program(trainer, chunks)
+
+
+def build_logreg(mesh) -> str:
+    from fps_tpu.models.logistic_regression import (
+        LogRegConfig,
+        logistic_regression,
+    )
+    from fps_tpu.utils.datasets import synthetic_sparse_classification
+
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    trainer, _ = logistic_regression(mesh, cfg)
+    data = synthetic_sparse_classification(2000, NF, NNZ, seed=7)
+    data = dict(data, label=(data["label"] > 0).astype(np.float32))
+    chunks = multi_epoch_chunks(
+        data, 1, num_workers=num_workers_of(mesh), local_batch=LOCAL_BATCH,
+        steps_per_chunk=STEPS, seed=3)
+    return _lower_chunk_program(trainer, chunks)
+
+
+def build_w2v(mesh) -> str:
+    from fps_tpu.models.word2vec import (
+        W2VConfig,
+        skipgram_chunks,
+        word2vec,
+    )
+
+    rng = np.random.default_rng(5)
+    tokens = rng.integers(0, VOCAB, 20_000, dtype=np.int32)
+    uni = np.bincount(tokens, minlength=VOCAB).astype(np.float64)
+    cfg = W2VConfig(vocab_size=VOCAB, dim=W2V_DIM, window=2, negatives=2,
+                    subsample_t=None)
+    trainer, _ = word2vec(mesh, cfg, uni)
+    chunks = skipgram_chunks(
+        tokens, uni, cfg, num_workers=num_workers_of(mesh),
+        local_batch=LOCAL_BATCH, steps_per_chunk=STEPS, seed=9)
+    return _lower_chunk_program(trainer, chunks)
+
+
+def build_pa(mesh) -> str:
+    from fps_tpu.core.ingest import epoch_chunks
+    from fps_tpu.models.passive_aggressive import (
+        PAConfig,
+        passive_aggressive,
+    )
+    from fps_tpu.utils.datasets import synthetic_sparse_classification
+
+    cfg = PAConfig(num_features=NF, variant="PA-I", C=1.0)
+    trainer, _ = passive_aggressive(mesh, cfg)
+    data = synthetic_sparse_classification(2000, NF, NNZ, seed=7)
+    chunks = epoch_chunks(
+        data, num_workers=num_workers_of(mesh), local_batch=LOCAL_BATCH,
+        steps_per_chunk=STEPS, seed=3)
+    return _lower_chunk_program(trainer, chunks)
+
+
+def build_ials(mesh) -> str:
+    """The iALS accumulate kernel — the solver's streaming hot path."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from fps_tpu.core.store import rows_per_shard
+    from fps_tpu.models.ials import (
+        IALSConfig,
+        IALSSolver,
+        interaction_chunks,
+    )
+    from fps_tpu.parallel.mesh import DATA_AXIS, SHARD_AXIS
+    from fps_tpu.utils.datasets import synthetic_implicit
+
+    cfg = IALSConfig(num_users=NU, num_items=NI, rank=RANK)
+    solver = IALSSolver(mesh, cfg)
+    solver.init(jax.random.key(0))
+    data = synthetic_implicit(NU, NI, 2000, seed=3)
+    chunk = next(iter(interaction_chunks(
+        data, num_workers=num_workers_of(mesh), local_batch=LOCAL_BATCH,
+        steps_per_chunk=STEPS, seed=11)))
+    sharding = NamedSharding(mesh, P(None, (DATA_AXIS, SHARD_AXIS)))
+    dev = {
+        "solve_ids": jax.device_put(np.asarray(chunk["user"]), sharding),
+        "fixed_ids": jax.device_put(np.asarray(chunk["item"]), sharding),
+        "rating": jax.device_put(np.asarray(chunk["rating"]), sharding),
+        "weight": jax.device_put(np.asarray(chunk["weight"]), sharding),
+    }
+    rps = rows_per_shard(cfg.num_users, solver.num_shards)
+    A = solver._zeros_acc(rps * solver.num_shards, RANK * RANK)
+    b = solver._zeros_acc(rps * solver.num_shards, RANK)
+    acc = solver._accumulate_fn()
+    from fps_tpu.models.ials import ITEM_TABLE
+
+    return acc.lower(solver.store.tables[ITEM_TABLE], A, b, dev).as_text()
+
+
+BUILDERS = {
+    "mf": build_mf,
+    "streaming_mf": build_streaming_mf,
+    "mf_tiered": build_mf_tiered,
+    "logreg": build_logreg,
+    "w2v": build_w2v,
+    "pa": build_pa,
+    "ials": build_ials,
+}
+
+
+def contract_for(name: str) -> ProgramContract:
+    budget = BUDGETS[name]
+    tiered = name == "mf_tiered"
+    # H=32 head rows x RANK f32 (+1 mean-count column headroom is not
+    # needed: MF folds are sum) — the smallest tiered head's byte size.
+    hot_bytes = 32 * RANK * 4 if tiered else 0
+    return ProgramContract(
+        name=f"audit/{name}",
+        max_collectives=budget["max_collectives"],
+        max_collective_bytes=budget["max_collective_bytes"],
+        per_kind_max=budget["per_kind_max"],
+        # Counts are pinned EXACT (the docstring's "not
+        # ceilings-with-slack"): a removed collective or a new kind
+        # fails the audit until the budget is re-pinned.
+        exact_collectives=True,
+        donated_tables=True,
+        max_float_bits=32,
+        require_shard_psum=tiered,
+        hot_reconcile_bytes=hot_bytes,
+        shard_group_size=8 if tiered else None,
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="certify the example workloads' compiled programs "
+                    "(fps_tpu.analysis)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the certificate JSON here (default: "
+                         "stdout only)")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated workload subset "
+                         f"(default: all of {', '.join(BUILDERS)})")
+    ap.add_argument("--measure", action="store_true",
+                    help="print measured profiles without enforcing "
+                         "budgets (for re-pinning after a deliberate "
+                         "program change)")
+    args = ap.parse_args(argv)
+
+    names = (args.only.split(",") if args.only else list(BUILDERS))
+    unknown = [n for n in names if n not in BUILDERS]
+    if unknown:
+        ap.error(f"unknown workload(s): {', '.join(unknown)}")
+
+    mesh = make_ps_mesh(num_shards=8, num_data=1)
+    certs = {}
+    for name in names:
+        text = BUILDERS[name](mesh)
+        if args.measure:
+            contract = ProgramContract(name=f"measure/{name}")
+        else:
+            contract = contract_for(name)
+        cert = certify(text, contract, program=name)
+        certs[name] = cert
+        mark = "OK " if cert.ok else "FAIL"
+        print(f"[{mark}] {name}: {cert.collective_count} collectives, "
+              f"{cert.collective_bytes} bytes "
+              f"{json.dumps(cert.per_kind())}", file=sys.stderr)
+        for v in cert.violations:
+            print(f"       [{v.pass_name}] {v.summary}", file=sys.stderr)
+
+    ok = all(c.ok for c in certs.values())
+    doc = {
+        "audit_programs": {n: c.to_json() for n, c in certs.items()},
+        "ok": ok,
+        "mesh": {"shard": 8, "data": 1},
+        "scale": {"nu": NU, "ni": NI, "rank": RANK, "nf": NF,
+                  "vocab": VOCAB, "local_batch": LOCAL_BATCH,
+                  "steps_per_chunk": STEPS},
+    }
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        print(f"wrote {args.out}", file=sys.stderr)
+    print(json.dumps({
+        "audit": {n: {"ok": c.ok, "collectives": c.collective_count,
+                      "bytes": c.collective_bytes}
+                  for n, c in certs.items()},
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,12 @@ fps_tpu.testing.workloads):
   chunk, quarantines nothing, and reproduces a straight tiered run's
   final weights bit-for-bit.
 
+The digest also carries the clean run's program CERTIFICATE
+(``fps_tpu.analysis``, ``docs/analysis.md``): the compiled logreg step
+is audited against its derived contract, so a regression in collective
+structure / donation / host-transfer freedom fails the sweep even when
+every scenario still survives.
+
 Run (CPU mesh, like the test suite):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   PYTHONPATH=/root/repo python tools/chaos_sweep.py
@@ -73,6 +79,31 @@ from fps_tpu.testing.workloads import (
 
 def _finite(store):
     return bool(np.all(np.isfinite(weights(store))))
+
+
+def program_certificate(trainer, chunks) -> dict:
+    """Certify the exact compiled program the sweep's scenarios dispatch
+    (fps_tpu.analysis) and return the certificate JSON for the digest —
+    a regression in collective structure (an extra psum, a lost
+    donation, a stray host callback) shows up here next to the survival
+    booleans, even when every scenario still survives."""
+    import dataclasses
+
+    from fps_tpu.analysis import certify, contract_for_trainer
+
+    hlo = trainer.lowered_chunk_text(chunks[0], "sync")
+    # Pin the sweep program's collective structure exactly (counts, not
+    # bytes — payload scales with the harness): the gathered logreg
+    # route is one pull all_gather + one routed-push all_to_all, so an
+    # extra psum (or a lost route) fails the sweep, as promised above.
+    contract = dataclasses.replace(
+        contract_for_trainer(trainer, "sync"),
+        max_collectives=2,
+        per_kind_max={"all_gather": 1, "all_to_all": 1},
+        exact_collectives=True,
+    )
+    cert = certify(hlo, contract, program="chaos/logreg")
+    return cert.to_json()
 
 
 def _health_totals(metrics, tables=("weights",)):
@@ -158,8 +189,9 @@ def main():
     mesh = make_ps_mesh()
     train, test = logreg_data()
     chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
-    _, store_clean, _ = run_logreg(mesh, chunks)
+    trainer_clean, store_clean, _ = run_logreg(mesh, chunks)
     acc_clean = accuracy(store_clean, test)
+    certificate = program_certificate(trainer_clean, chunks)
 
     results = {}
     detail = {}
@@ -196,11 +228,15 @@ def main():
         # rollback/quarantine record (survival booleans alone said WHETHER
         # we lived, not WHAT the defenses saw).
         "detail": detail,
+        # The compiled program's contract certificate (fps_tpu.analysis):
+        # collective structure regressions surface next to survival.
+        "program_certificate": certificate,
         "mesh": dict(mesh.shape),
         "clean_test_acc": round(acc_clean, 4),
     }
     print(json.dumps(digest), flush=True)
-    return 0 if digest["survived"] == digest["total"] else 1
+    return 0 if (digest["survived"] == digest["total"]
+                 and certificate["ok"]) else 1
 
 
 if __name__ == "__main__":

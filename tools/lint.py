@@ -1,0 +1,83 @@
+"""Run the fps_tpu jax-hazard linter over the tree and report findings.
+
+The CLI over :mod:`fps_tpu.analysis.lint` — the AST layer of the program
+contract auditor (``docs/analysis.md``). Rules (FPS001–FPS005): late-
+bound closures over loop variables, boolean branches on jnp predicates,
+unsorted dict iteration inside compiled-fn builders, thread-starting
+classes without a synchronization primitive, and internal imports of the
+``utils.profiling`` compat shim.
+
+CI contract: ``tests/test_lint.py`` runs this over ``fps_tpu/`` as a
+tier-1 test expecting ZERO findings — a new hazard fails the suite with
+the file:line and the rule's rationale. Suppress a deliberate exception
+with ``# noqa: FPSNNN`` on the flagged line (the test suite's norm is
+fixes, not suppressions).
+
+No jax import: the linter module is loaded by file path (the
+``tools/supervise.py`` pattern), so this runs on a login node in
+milliseconds.
+
+Usage:
+  python tools/lint.py [PATHS...] [--json] [--select FPS003,FPS005]
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_linter():
+    """Load fps_tpu/analysis/lint.py WITHOUT importing the fps_tpu
+    package (whose __init__ pulls jax)."""
+    path = os.path.join(_ROOT, "fps_tpu", "analysis", "lint.py")
+    spec = importlib.util.spec_from_file_location("_fps_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fps_tpu jax-hazard source linter (fps_tpu.analysis)")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(_ROOT, "fps_tpu")],
+                    help="files/directories to lint (default: the "
+                         "fps_tpu package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="one JSON line: findings + rule table")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to enable "
+                         "(default: all)")
+    ap.add_argument("--explain", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    lint = load_linter()
+    if args.explain:
+        for rule, why in sorted(lint.RULES.items()):
+            print(f"{rule}: {why}")
+        return 0
+    select = (frozenset(args.select.split(",")) if args.select else None)
+    findings = lint.lint_paths(args.paths, select=select)
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in findings],
+            "count": len(findings),
+            "rules": dict(sorted(lint.RULES.items())),
+        }))
+    else:
+        for f in findings:
+            print(f)
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,7 +26,11 @@ join on ``run_id``) and prints a single JSON digest:
   plus, from the supervisor journal, `deadline_abort` events whose
   `stall_kind` is `source_stall` (a stalled `prefetch`-phase heartbeat:
   the SOURCE wedged while the driver waited on it, a distinct incident
-  from a wedged driver) summarized as `source_stalls`.
+  from a wedged driver) summarized as `source_stalls`;
+* **analysis** — program-contract certification (`Trainer(audit=...)`,
+  `fps_tpu.analysis`): programs certified clean, contract violations
+  found at compile time, and each `analysis.contract_violation` event
+  verbatim under `incidents` (`docs/analysis.md`).
 
 Pure host tool: no jax import, safe to run on a login node against a
 live or finished run directory.
@@ -62,6 +66,7 @@ _INCIDENT_EVENTS = (
     "chunk_quarantined",
     "supervisor_give_up",
     "supervised_run_end",
+    "analysis.contract_violation",
 )
 
 # Digest keys that must always be present (the smoke test asserts these —
@@ -70,6 +75,7 @@ REQUIRED_FIELDS = (
     "obs_dir", "run_ids", "processes", "chunks", "epochs", "steps",
     "examples", "phase_seconds", "health", "incidents", "checkpoint_saves",
     "quarantined", "wall_span_s", "prefetch", "hot_tier", "source_stalls",
+    "analysis",
 )
 
 
@@ -229,6 +235,14 @@ def render_digest(obs_dir: str) -> dict:
                 "hot_tier.pending_delta", {}).get("last"),
             "pending_delta_max": gauges.get(
                 "hot_tier.pending_delta", {}).get("max"),
+        },
+        # Program contract auditor (fps_tpu.analysis): certification
+        # totals; the per-violation events ride incidents verbatim.
+        "analysis": {
+            "certified_programs": int(
+                counters.get("analysis.certified_programs", 0)),
+            "contract_violations": int(
+                counters.get("analysis.contract_violations", 0)),
         },
         # Supervisor deadline aborts whose last heartbeat was a stalled
         # 'prefetch'-phase beat: the SOURCE wedged, not the driver.
