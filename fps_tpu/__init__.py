@@ -35,6 +35,7 @@ from fps_tpu.core.checkpoint import AsyncCheckpointer, Checkpointer
 from fps_tpu.core.store import TableSpec, ParamStore
 from fps_tpu.parallel.mesh import init_distributed, make_ps_mesh
 from fps_tpu import obs
+from fps_tpu import serve
 from fps_tpu import supervise
 
 __version__ = "0.1.0"
@@ -59,6 +60,7 @@ __all__ = [
     "Checkpointer",
     "AsyncCheckpointer",
     "obs",
+    "serve",
     "supervise",
     "__version__",
 ]

@@ -31,6 +31,12 @@ some cases shipped and fixed) before:
   ``fps_tpu.utils.profiling`` compat shim from inside the package.
   Shims exist for EXTERNAL callers; internal indirection through a
   deprecated alias hides the real dependency edge.
+* **FPS006 raw-snapshot-read** — ``open()`` / ``np.load`` of a
+  checkpoint/snapshot-flavored path outside the sanctioned readers
+  (``core/checkpoint.py``, ``core/snapshot_format.py``, ``serve/``).
+  Every snapshot read must go through the CRC-verified paths — a raw
+  ``np.load`` of a ``ckpt_*.npz`` silently accepts a torn or bit-rotted
+  file the integrity layer exists to reject.
 
 Suppression: append ``# noqa: FPSNNN`` to the flagged line — but the
 tier-1 test runs this linter over ``fps_tpu/`` expecting zero findings,
@@ -75,6 +81,9 @@ RULES = {
               "primitive or thread-safety note",
     "FPS005": "internal import of the fps_tpu.utils.profiling shim — "
               "import from fps_tpu.obs",
+    "FPS006": "raw open()/np.load of a checkpoint/snapshot path outside "
+              "the CRC-verified readers (core/checkpoint.py, "
+              "core/snapshot_format.py, serve/)",
 }
 
 # Calls whose presence makes a function (and everything lexically inside
@@ -87,6 +96,14 @@ _TRACER_PREDICATES = {
     "logical_and", "logical_or", "logical_not", "equal", "not_equal",
     "less", "less_equal", "greater", "greater_equal",
 }
+
+# FPS006: name/attribute/string tokens marking an expression as
+# checkpoint-flavored, and the files sanctioned to read snapshots raw
+# (they ARE the verified readers / the on-disk-contract owner).
+_CKPT_TOKENS = ("ckpt", "snapshot")
+_CKPT_READER_PATHS = ("fps_tpu/core/checkpoint.py",
+                      "fps_tpu/core/snapshot_format.py")
+_CKPT_READER_DIRS = ("fps_tpu/serve/",)
 
 _SYNC_PRIMITIVES = {
     "Lock", "RLock", "Condition", "Event", "Semaphore",
@@ -154,8 +171,12 @@ class _Linter(ast.NodeVisitor):
         self.path = path
         self.lines = source_lines
         self.findings: list[LintFinding] = []
-        self.is_shim = path.replace(os.sep, "/").endswith(
-            "fps_tpu/utils/profiling.py")
+        norm = path.replace(os.sep, "/")
+        self.is_shim = norm.endswith("fps_tpu/utils/profiling.py")
+        # FPS006 exemption: the sanctioned snapshot readers themselves.
+        self.is_ckpt_reader = (
+            any(norm.endswith(p) for p in _CKPT_READER_PATHS)
+            or any(d in norm for d in _CKPT_READER_DIRS))
         # FPS001: stack of (loop_node, target_names) we are inside of.
         self._loops: list[tuple[ast.AST, set[str]]] = []
         # FPS003: depth of enclosing compiled-fn-builder functions.
@@ -190,6 +211,38 @@ class _Linter(ast.NodeVisitor):
                 self._add("FPS005", node,
                           "import of the utils.profiling shim — use "
                           "fps_tpu.obs (trace/Throughput live there)")
+        self.generic_visit(node)
+
+    # -- FPS006 -----------------------------------------------------------
+
+    def _ckpt_flavored(self, node) -> bool:
+        """Any name/attribute/string in the call's arguments carrying a
+        checkpoint token — the heuristic that 'this path is a snapshot'."""
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for n in ast.walk(arg):
+                text = ""
+                if isinstance(n, ast.Name):
+                    text = n.id
+                elif isinstance(n, ast.Attribute):
+                    text = n.attr
+                elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    text = n.value
+                low = text.lower()
+                if any(tok in low for tok in _CKPT_TOKENS):
+                    return True
+        return False
+
+    def visit_Call(self, node):
+        if not self.is_ckpt_reader:
+            name = _call_name(node)
+            if (name in ("open", "np.load", "numpy.load")
+                    and self._ckpt_flavored(node)):
+                self._add(
+                    "FPS006", node,
+                    f"{name}() of a checkpoint/snapshot path — go through "
+                    "the CRC-verified readers (Checkpointer.read_snapshot, "
+                    "snapshot_format.verify_snapshot_file + "
+                    "map_snapshot_arrays, or fps_tpu.serve)")
         self.generic_visit(node)
 
     # -- FPS002 -----------------------------------------------------------

@@ -101,6 +101,22 @@ class WorkerLogic:
         """Fused onRecv/onPullRecv body — must be jit-traceable."""
         raise NotImplementedError
 
+    def touched_local_rows(self, batch: Pytree):
+        """Optional: which axis-0 rows of each local-state leaf this
+        batch's :meth:`step` can touch — the ids-aware refinement of the
+        local guard (``GuardConfig(local=True)``). Return a sequence with
+        ONE entry per flattened local-state leaf: an int id array
+        (``-1`` = no row, e.g. padding examples) restricting that leaf's
+        row screening to the touched rows, or ``None`` to screen every
+        row of that leaf. Default ``None``: no guarantee, the guard
+        screens (and in mask mode may revert) every row. Must be
+        jit-traceable (called inside the compiled step). Rows OUTSIDE the
+        returned set are still covered by the guard's leaf-tier
+        non-finite net — they can be *counted*, never *masked* (an
+        untouched row's pre-step value is its post-step value, so there
+        is nothing to revert to)."""
+        return None
+
     # -- checkpoint portability (optional overrides) -----------------------
 
     def export_local_state(self, local_state: Pytree) -> Pytree:

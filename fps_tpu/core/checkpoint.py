@@ -42,18 +42,15 @@ from __future__ import annotations
 
 import logging
 import os
-import re
-import struct
 import tempfile
 import threading
 import time
-import zipfile
-import zlib
 from typing import Any, Mapping
 
 import jax
 import numpy as np
 
+from fps_tpu.core import snapshot_format
 from fps_tpu.core.resilience import SnapshotCorruptionError, array_crc32
 from fps_tpu.core.store import ParamStore, id_to_phys, rows_per_shard
 
@@ -78,36 +75,16 @@ def _obs_metric(kind: str, name: str, value: float, **labels) -> None:
     events.record_metric(kind, name, value, **labels)
 
 
-_SEP = "::"  # npz key separator: kind::name
-
-# Snapshot filename contract — the single source of truth, shared with
-# the chaos injectors (fps_tpu.testing.chaos.snapshot_paths).
-SNAPSHOT_RE = re.compile(r"ckpt_(\d{12})\.npz")
-SNAPSHOT_FMT = "ckpt_{step:012d}.npz"
-
-# Per-array integrity tags: ``meta::crc::<key>`` holds the CRC-32 of
-# <key>'s raw bytes, written at save time and checked by read_snapshot —
-# the defense against silent bit rot that the zip container's own member
-# CRCs don't fully provide (numpy reads members lazily/partially).
-_CRC_PREFIX = f"meta{_SEP}crc{_SEP}"
-
-# Everything a torn/corrupted .npz throws on open or member read (zip
-# magic, central directory, member CRC, npy header parsing, ...).
-# Deliberately NOT OSError: transient environment failures (EMFILE,
-# EACCES, a flaky NFS mount) must surface as what they are, not be
-# classified as corruption — the auto-resolve restore path DESTRUCTIVELY
-# quarantines "corrupt" snapshots, and a transient would otherwise rename
-# every intact snapshot to *.corrupt before failing.
-_IO_ERRORS = (
-    EOFError,
-    KeyError,
-    IndexError,
-    ValueError,
-    struct.error,
-    zipfile.BadZipFile,
-    zipfile.LargeZipFile,
-    zlib.error,
-)
+# The on-disk contract (filename regex, npz key layout, per-array
+# ``meta::crc`` integrity tags, the torn-file error set) lives in the
+# jax-free :mod:`fps_tpu.core.snapshot_format` so the serving plane and
+# the chaos injectors can share it without importing this (jax-laden)
+# module; the historical names are re-exported here.
+_SEP = snapshot_format.SEP  # npz key separator: kind::name
+SNAPSHOT_RE = snapshot_format.SNAPSHOT_RE
+SNAPSHOT_FMT = snapshot_format.SNAPSHOT_FMT
+_CRC_PREFIX = snapshot_format.CRC_PREFIX
+_IO_ERRORS = snapshot_format.IO_ERRORS
 
 
 def _keys(z):

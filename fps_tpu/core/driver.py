@@ -696,8 +696,13 @@ class Trainer:
                 # or count (observe) poisoned local-state rows, mounted on
                 # the health channel under the reserved "local_state" key
                 # (collision with a table name rejected at construction).
+                # Logics that expose which rows a batch touches
+                # (touched_local_rows) get ids-aware screening: row
+                # masking restricted to the touched set, untouched rows
+                # still netted by the leaf-tier non-finite count.
                 new_local, local_health = resilience.guard_local_state(
-                    local_state, new_local, guard
+                    local_state, new_local, guard,
+                    touched=self.logic.touched_local_rows(batch),
                 )
                 if local_health is not None:
                     health[resilience.LOCAL_STATE_KEY] = local_health

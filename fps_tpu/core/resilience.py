@@ -29,7 +29,6 @@ when it is off (tested via compiled-HLO comparison in
 from __future__ import annotations
 
 import dataclasses
-import zlib
 from typing import Any, Mapping
 
 import jax
@@ -202,7 +201,7 @@ def guard_pushes(
 
 
 def guard_local_state(
-    old: Pytree, new: Pytree, guard: GuardConfig
+    old: Pytree, new: Pytree, guard: GuardConfig, touched=None
 ) -> tuple[Pytree, dict[str, Array] | None]:
     """Screen a step's worker-LOCAL state update; trace-time static policy.
 
@@ -218,6 +217,18 @@ def guard_local_state(
     * in ``mode="mask"`` offending rows REVERT to their pre-step values
       (the scatter update degrades to a lost update, mirroring the push
       guard's dropped rows); ``"observe"`` only counts.
+
+    ``touched`` (from ``WorkerLogic.touched_local_rows``): one entry per
+    flattened leaf — an int id array (``-1`` ignored) restricting that
+    leaf's ROW screening (nonfinite + norm tiers, and mask-mode reverts)
+    to the rows this step can actually write, or ``None`` for the
+    full-leaf screen. Untouched rows are still covered by a LEAF-tier
+    non-finite net: any non-finite row outside the touched set counts as
+    ``nonfinite`` (it cannot be masked — its pre-step value IS its
+    post-step value, so there is nothing to revert to), so a poisoned
+    row can never hide outside the ids. Duplicate touched ids count per
+    occurrence (the push guard's per-batch-row convention) and revert
+    deterministically — every occurrence writes the same row value.
 
     Returns ``(guarded_new, counts)`` with the same scalar int32
     ``{"nonfinite", "norm", "masked"}`` schema as :func:`guard_pushes`
@@ -237,44 +248,113 @@ def guard_local_state(
             "guard.local requires the worker step to preserve the "
             f"local_state pytree structure (got {treedef} -> {new_treedef})"
         )
+    if touched is not None:
+        touched = list(touched)
+        if len(touched) != len(new_leaves):
+            raise ValueError(
+                "touched_local_rows must return one entry per flattened "
+                f"local-state leaf ({len(new_leaves)}), got {len(touched)}"
+            )
     zero = jnp.zeros((), jnp.int32)
     counts = {"nonfinite": zero, "norm": zero, "masked": zero}
     guarded = False
     out_leaves = []
-    for o, n in zip(old_leaves, new_leaves):
+    for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
         if not (hasattr(n, "dtype") and jnp.issubdtype(n.dtype, jnp.inexact)):
             out_leaves.append(n)
             continue
         guarded = True
-        axes = tuple(range(1, jnp.ndim(n)))
-        finite = jnp.all(jnp.isfinite(n), axis=axes)
-        nonfinite = ~finite
-        if guard.norm_limit is not None:
-            # Delta norm over zero-substituted rows, like guard_pushes:
-            # a non-finite row must not double-count through the norm tier.
-            delta = jnp.where(
-                finite if not axes else jnp.expand_dims(
-                    finite, tuple(range(1, jnp.ndim(n)))),
-                (n - o).astype(jnp.float32), 0.0,
-            )
-            sq = jnp.sum(delta * delta, axis=axes)
-            exploded = finite & (sq > guard.norm_limit**2)
+        t = touched[i] if touched is not None else None
+        if t is not None and jnp.ndim(n) >= 1:
+            n, leaf_counts = _guard_rows_touched(o, n, guard, t)
         else:
-            exploded = jnp.zeros_like(nonfinite)
-        bad = nonfinite | exploded
-        counts["nonfinite"] = counts["nonfinite"] + jnp.sum(
-            nonfinite, dtype=jnp.int32)
-        counts["norm"] = counts["norm"] + jnp.sum(exploded, dtype=jnp.int32)
-        if guard.mode == "mask":
-            revert = bad if not axes else jnp.expand_dims(
-                bad, tuple(range(1, jnp.ndim(n))))
-            n = jnp.where(revert, o, n).astype(n.dtype)
-            counts["masked"] = counts["masked"] + jnp.sum(
-                bad, dtype=jnp.int32)
+            n, leaf_counts = _guard_rows_full(o, n, guard)
+        for k, v in leaf_counts.items():
+            counts[k] = counts[k] + v
         out_leaves.append(n)
     if not guarded:
         return new, None
     return jax.tree.unflatten(treedef, out_leaves), counts
+
+
+def _guard_rows_full(o, n, guard: GuardConfig):
+    """Whole-leaf row screen (every row; the ``touched=None`` path)."""
+    axes = tuple(range(1, jnp.ndim(n)))
+    finite = jnp.all(jnp.isfinite(n), axis=axes)
+    nonfinite = ~finite
+    if guard.norm_limit is not None:
+        # Delta norm over zero-substituted rows, like guard_pushes:
+        # a non-finite row must not double-count through the norm tier.
+        delta = jnp.where(
+            finite if not axes else jnp.expand_dims(
+                finite, tuple(range(1, jnp.ndim(n)))),
+            (n - o).astype(jnp.float32), 0.0,
+        )
+        sq = jnp.sum(delta * delta, axis=axes)
+        exploded = finite & (sq > guard.norm_limit**2)
+    else:
+        exploded = jnp.zeros_like(nonfinite)
+    bad = nonfinite | exploded
+    counts = {
+        "nonfinite": jnp.sum(nonfinite, dtype=jnp.int32),
+        "norm": jnp.sum(exploded, dtype=jnp.int32),
+        "masked": jnp.zeros((), jnp.int32),
+    }
+    if guard.mode == "mask":
+        revert = bad if not axes else jnp.expand_dims(
+            bad, tuple(range(1, jnp.ndim(n))))
+        n = jnp.where(revert, o, n).astype(n.dtype)
+        counts["masked"] = jnp.sum(bad, dtype=jnp.int32)
+    return n, counts
+
+
+def _guard_rows_touched(o, n, guard: GuardConfig, t):
+    """Ids-aware row screen: gather the touched rows, screen THEM
+    (nonfinite + norm + mask-mode revert via a drop-mode scatter), then
+    run the leaf-tier net — a non-finite row outside the touched set
+    still counts as ``nonfinite`` (but cannot be reverted; see
+    :func:`guard_local_state`)."""
+    rows = n.shape[0]
+    t = jnp.asarray(t).reshape(-1).astype(jnp.int32)
+    # Out-of-range ids are inert like -1: the clamped gather would
+    # otherwise screen (and count reverts against) the LAST row once
+    # per stray id while the drop-scatter discards the revert anyway.
+    valid = (t >= 0) & (t < rows)
+    safe = jnp.where(valid, t, 0)  # in-bounds gather index for -1 slots
+    idx = jnp.where(valid, t, rows)  # out-of-bounds -> dropped by scatter
+    n_t = jnp.take(n, safe, axis=0)
+    o_t = jnp.take(o, safe, axis=0)
+    axes = tuple(range(1, jnp.ndim(n_t)))
+    finite_t = jnp.all(jnp.isfinite(n_t), axis=axes)
+    nonfinite_t = valid & ~finite_t
+    if guard.norm_limit is not None:
+        delta = jnp.where(
+            jnp.expand_dims(finite_t, axes) if axes else finite_t,
+            (n_t - o_t).astype(jnp.float32), 0.0,
+        )
+        sq = jnp.sum(delta * delta, axis=axes)
+        exploded_t = valid & finite_t & (sq > guard.norm_limit**2)
+    else:
+        exploded_t = jnp.zeros_like(nonfinite_t)
+    bad_t = nonfinite_t | exploded_t
+    counts = {
+        "nonfinite": jnp.sum(nonfinite_t, dtype=jnp.int32),
+        "norm": jnp.sum(exploded_t, dtype=jnp.int32),
+        "masked": jnp.zeros((), jnp.int32),
+    }
+    if guard.mode == "mask":
+        revert = jnp.expand_dims(bad_t, axes) if axes else bad_t
+        repl = jnp.where(revert, o_t, n_t).astype(n.dtype)
+        n = n.at[idx].set(repl, mode="drop")
+        counts["masked"] = jnp.sum(bad_t, dtype=jnp.int32)
+    # Leaf-tier net: non-finite rows OUTSIDE the touched set (stale
+    # poison from an observe-mode step, a poisoned restore, bit rot in
+    # host staging) are counted — detection must not depend on the ids.
+    touched_mask = jnp.zeros((rows,), bool).at[idx].set(True, mode="drop")
+    finite_rows = jnp.all(jnp.isfinite(n), axis=tuple(range(1, jnp.ndim(n))))
+    counts["nonfinite"] = counts["nonfinite"] + jnp.sum(
+        ~finite_rows & ~touched_mask, dtype=jnp.int32)
+    return n, counts
 
 
 def health_total(metrics: Pytree) -> int:
@@ -385,15 +465,11 @@ def tree_copy(tree: Pytree) -> Pytree:
 
 # ---------------------------------------------------------------------------
 # Snapshot integrity primitives (shared by checkpoint.py and the tests).
+#
+# One implementation, owned by the jax-FREE on-disk-contract module so
+# the write path (checkpoint.py, via this re-export) and the serving
+# plane's verifier can never drift — a fork here would make every fresh
+# snapshot fail read-side verification.
 # ---------------------------------------------------------------------------
 
-def array_crc32(arr) -> int:
-    """CRC-32 of an array's raw bytes (dtype+shape-independent payload
-    checksum; the shape/dtype themselves are validated by the restore
-    paths' existing spec checks). Zero-copy: crc32 consumes the array's
-    buffer directly — a multi-hundred-MB table is not duplicated inside
-    the (already blocking) save path."""
-    a = np.asarray(arr)
-    if not a.flags.c_contiguous:
-        a = np.ascontiguousarray(a)
-    return zlib.crc32(a)
+from fps_tpu.core.snapshot_format import array_crc32  # noqa: E402,F401

@@ -1,0 +1,233 @@
+"""The on-disk snapshot contract — naming, integrity, zero-copy reads.
+
+One jax-FREE module (stdlib + numpy) owning everything three consumers
+must agree on about a published ``ckpt_*.npz`` snapshot:
+
+* the **training plane** (:mod:`fps_tpu.core.checkpoint`) writes
+  snapshots and restores them (it re-exports the names below, so nothing
+  upstream changed);
+* the **chaos injectors** (:mod:`fps_tpu.testing.chaos`) corrupt them by
+  the same filename contract;
+* the **serving plane** (:mod:`fps_tpu.serve`) — a jax-optional process
+  that must discover, CRC-verify, and map snapshots on a machine that
+  may not even have an accelerator runtime installed. Putting the
+  contract here (instead of importing the jax-laden checkpoint module)
+  is what makes that possible.
+
+Integrity is the checkpoint layer's scheme verbatim: every array entry
+``k`` carries a ``meta::crc::k`` CRC-32 tag written at save time;
+:func:`verify_snapshot_file` checks every entry the way
+``Checkpointer._read_verified`` does (structural read errors and
+checksum mismatches both fail), but reports ``(ok, reason)`` instead of
+raising the jax-layer's ``SnapshotCorruptionError``.
+
+Zero-copy reads: ``np.savez`` writes an UNCOMPRESSED zip of ``.npy``
+members, so each array's bytes sit contiguously at a knowable file
+offset. :func:`map_snapshot_arrays` parses the zip's local headers plus
+each member's npy header and returns read-only ``np.memmap`` views — a
+multi-GB table "loads" in microseconds and costs no resident memory
+until rows are touched. This is what makes a serving hot-swap a pointer
+flip whose latency is independent of table size.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import struct
+import zipfile
+import zlib
+
+import numpy as np
+
+__all__ = [
+    "SNAPSHOT_RE", "SNAPSHOT_FMT", "SEP", "TABLE_PREFIX", "LS_PREFIX",
+    "CRC_PREFIX", "IO_ERRORS", "array_crc32", "snapshot_path",
+    "snapshot_steps", "verify_snapshot_file", "latest_valid_snapshot",
+    "map_snapshot_arrays",
+]
+
+# Snapshot filename contract — the single source of truth (the
+# checkpoint layer and the chaos injectors import these from here or via
+# fps_tpu.core.checkpoint's re-export).
+SNAPSHOT_RE = re.compile(r"ckpt_(\d{12})\.npz")
+SNAPSHOT_FMT = "ckpt_{step:012d}.npz"
+
+# npz key layout: kind::name. ``table::<name>`` entries hold each table
+# in LOGICAL id order with padding rows stripped (``(num_ids, dim)``) —
+# a served row lookup is therefore a plain axis-0 index, no owner-major
+# physical mapping needed. ``ls::<i>`` entries are the flattened
+# worker-local-state leaves (the Trainer path writes them in the logic's
+# worker-count-independent EXPORT form, e.g. MF user factors in logical
+# user order — exactly what a serving user-side lookup wants).
+SEP = "::"
+TABLE_PREFIX = f"table{SEP}"
+LS_PREFIX = f"ls{SEP}"
+CRC_PREFIX = f"meta{SEP}crc{SEP}"
+
+# Everything a torn/corrupted .npz throws on open or member read (zip
+# magic, central directory, member CRC, npy header parsing, ...).
+# Deliberately NOT OSError: transient environment failures (EMFILE,
+# EACCES, a flaky NFS mount) must surface as what they are, not be
+# classified as corruption.
+IO_ERRORS = (
+    EOFError,
+    KeyError,
+    IndexError,
+    ValueError,
+    struct.error,
+    zipfile.BadZipFile,
+    zipfile.LargeZipFile,
+    zlib.error,
+)
+
+
+def array_crc32(arr) -> int:
+    """CRC-32 of an array's raw bytes (dtype+shape-independent payload
+    checksum; shapes/dtypes are validated by the restore paths' spec
+    checks). Zero-copy: crc32 consumes the array's buffer directly."""
+    a = np.asarray(arr)
+    if not a.flags.c_contiguous:
+        a = np.ascontiguousarray(a)
+    return zlib.crc32(a)
+
+
+def snapshot_path(directory: str, step: int) -> str:
+    return os.path.join(directory, SNAPSHOT_FMT.format(step=step))
+
+
+def snapshot_steps(directory: str) -> list[int]:
+    """Published snapshot steps under ``directory``, ascending. Missing
+    directory reads as empty (a watcher may start before the trainer's
+    first save)."""
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    out = []
+    for f in names:
+        m = SNAPSHOT_RE.fullmatch(f)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def verify_snapshot_file(path: str) -> tuple[bool, str | None]:
+    """Full integrity pass over one snapshot file: ``(True, None)`` iff
+    every entry reads back and matches its ``meta::crc`` tag; otherwise
+    ``(False, reason)``. Pre-integrity snapshots (no crc tags) still get
+    the structural checks — an unreadable zip fails either way.
+
+    Read-only and exception-free on corruption (unlike the checkpoint
+    layer's restore path, which quarantines): a serving process must be
+    able to reject a bad publish without mutating the training plane's
+    directory.
+    """
+    try:
+        with np.load(path) as z:
+            for k in z.files:
+                if k.startswith(CRC_PREFIX):
+                    continue
+                v = z[k]
+                ck = CRC_PREFIX + k
+                if ck in z.files and int(z[ck]) != array_crc32(v):
+                    return False, f"checksum mismatch on entry {k!r}"
+    except FileNotFoundError:
+        return False, "no such file"
+    except IO_ERRORS as e:
+        return False, f"unreadable: {e!r}"
+    return True, None
+
+
+def latest_valid_snapshot(directory: str) -> tuple[int, str] | None:
+    """Newest ``(step, path)`` whose snapshot passes
+    :func:`verify_snapshot_file`, scanning newest→oldest; ``None`` when
+    none does. Read-only (corrupt files are left in place — the training
+    plane's restore path owns quarantine)."""
+    for step in reversed(snapshot_steps(directory)):
+        path = snapshot_path(directory, step)
+        ok, _ = verify_snapshot_file(path)
+        if ok:
+            return step, path
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Zero-copy member mapping.
+# ---------------------------------------------------------------------------
+
+def _member_data_offset(f, zinfo) -> int:
+    """File offset of ``zinfo``'s raw data: past the LOCAL header, whose
+    name/extra lengths can differ from the central directory's (zip64
+    padding), so the local record must be parsed, not assumed."""
+    f.seek(zinfo.header_offset)
+    hdr = f.read(30)
+    if len(hdr) != 30 or hdr[:4] != b"PK\x03\x04":
+        raise ValueError(
+            f"member {zinfo.filename!r}: bad local file header")
+    nlen, elen = struct.unpack("<HH", hdr[26:30])
+    return zinfo.header_offset + 30 + nlen + elen
+
+
+def _read_npy_header(f):
+    """``(dtype, shape, fortran_order, data_offset_from_current)`` of the
+    npy stream at ``f``'s current position (format versions 1/2/3)."""
+    fmt = np.lib.format
+    version = fmt.read_magic(f)
+    if version == (1, 0):
+        shape, fortran, dtype = fmt.read_array_header_1_0(f)
+    elif version == (2, 0):
+        shape, fortran, dtype = fmt.read_array_header_2_0(f)
+    else:  # a future 3.x header parses like 2.0 (utf-8 header text)
+        shape, fortran, dtype = fmt.read_array_header_2_0(f)
+    return dtype, shape, fortran
+
+
+def map_snapshot_arrays(path: str, *, keys=None) -> dict[str, np.ndarray]:
+    """Read-only zero-copy views of a snapshot's array entries.
+
+    Returns ``{key: array}`` where each array is an ``np.memmap``
+    (``mode="r"``) straight onto the member's bytes inside the ``.npz``
+    — no decompression (``np.savez`` stores uncompressed), no copy, no
+    resident memory until rows are touched. ``keys`` optionally
+    restricts which entries are mapped (default: every ``table::`` and
+    ``ls::`` entry; ``meta::*`` tags are never mapped — they are read by
+    :func:`verify_snapshot_file`).
+
+    The maps stay valid as long as the FILE CONTENT at ``path``'s inode
+    survives; the checkpoint writer only ever publishes via atomic
+    rename (a new inode), so a mapped snapshot can never change under a
+    reader — deletion unlinks the name but the mapping keeps the pages.
+    Integrity is the caller's job (``verify_snapshot_file`` first): a
+    torn file fails verification before anything is mapped.
+
+    Raises ``ValueError`` for members this scheme cannot map (compressed
+    members, object dtypes, pickled entries) — none of which the
+    checkpoint writer produces.
+    """
+    out: dict[str, np.ndarray] = {}
+    with zipfile.ZipFile(path) as zf, open(path, "rb") as f:
+        for zinfo in zf.infolist():
+            name = zinfo.filename
+            key = name[:-4] if name.endswith(".npy") else name
+            if keys is not None:
+                if key not in keys:
+                    continue
+            elif not (key.startswith(TABLE_PREFIX)
+                      or key.startswith(LS_PREFIX)):
+                continue
+            if zinfo.compress_type != zipfile.ZIP_STORED:
+                raise ValueError(
+                    f"member {name!r} is compressed — zero-copy mapping "
+                    "needs np.savez (stored), not savez_compressed")
+            data_off = _member_data_offset(f, zinfo)
+            f.seek(data_off)
+            dtype, shape, fortran = _read_npy_header(f)
+            if dtype.hasobject:
+                raise ValueError(
+                    f"member {name!r} holds object dtype — not mappable")
+            out[key] = np.memmap(
+                path, dtype=dtype, mode="r", offset=f.tell(), shape=shape,
+                order="F" if fortran else "C",
+            )
+    return out

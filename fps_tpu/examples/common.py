@@ -101,6 +101,14 @@ def base_parser(description: str) -> argparse.ArgumentParser:
                          "chunk/epoch boundary (default: the "
                          "FPS_TPU_HEARTBEAT env var, set automatically "
                          "by tools/supervise.py)")
+    ap.add_argument("--serve-port", type=int, default=None, metavar="PORT",
+                    help="publish this run's snapshots to query traffic "
+                         "WHILE training (fps_tpu.serve, docs/serving.md): "
+                         "a SnapshotWatcher hot-swaps each new checkpoint "
+                         "into a line-JSON TCP ReadServer on "
+                         "127.0.0.1:PORT (0 = ephemeral; the bound port "
+                         "is emitted). Requires --checkpoint-dir and "
+                         "--checkpoint-every")
     ap.add_argument("--obs-dir", default=None, metavar="DIR",
                     help="telemetry output (fps_tpu.obs): JSONL event log, "
                          "per-process run journal, and Prometheus text "
@@ -216,6 +224,62 @@ def apply_hot_tier(args, trainer, store=None):
           "tiered_tables": tiered,
           "exact_mode": E == 1 or not tiered})
     return trainer
+
+
+def maybe_serve(args, recorder=None):
+    """Resolve ``--serve-port`` into a serve-while-train context manager.
+
+    Inside the ``with`` block a background thread polls
+    ``--checkpoint-dir`` (and the ``--obs-dir`` journal, when set — the
+    ``checkpoint_saved`` events carry path/step/bytes so no directory
+    re-stat is needed) and hot-swaps every new verified snapshot into a
+    TCP ``ReadServer``; exit stops the watcher, closes the socket, and
+    emits the serve stats. Returns a no-op context when the flag is off,
+    so call sites wrap the training region unconditionally.
+    """
+    if getattr(args, "serve_port", None) is None:
+        return contextlib.nullcontext()
+    if not (args.checkpoint_dir and args.checkpoint_every > 0):
+        raise SystemExit("--serve-port requires --checkpoint-dir and "
+                         "--checkpoint-every (serving reads published "
+                         "snapshots)")
+    import threading
+
+    from fps_tpu.serve import ReadServer, TcpServe
+
+    server, watcher = ReadServer.over(
+        args.checkpoint_dir, journal=getattr(args, "obs_dir", None),
+        recorder=recorder)
+    tcp = TcpServe(server, port=args.serve_port).start()
+    stop = threading.Event()
+    thread = threading.Thread(
+        target=watcher.run, kwargs={"interval_s": 0.5, "stop": stop},
+        name="fps-serve-watcher", daemon=True)
+    thread.start()
+    emit({"event": "serving", "host": tcp.host, "port": tcp.port,
+          "ckpt_dir": args.checkpoint_dir})
+
+    @contextlib.contextmanager
+    def running():
+        try:
+            yield server
+        finally:
+            stop.set()
+            thread.join(timeout=10.0)
+            tcp.close()
+            if not thread.is_alive():
+                # Final swap: the end-of-run flush's snapshot. Skipped
+                # if the watcher thread outlived the join timeout (a
+                # multi-GB verify can) — poll() is single-threaded by
+                # contract and must not run concurrently with it.
+                watcher.poll()
+            stats = server.stats()
+            stats.update(swaps=dict(watcher.swaps),
+                         rejected=watcher.rejected,
+                         write_to_servable_s=watcher.write_to_servable_s)
+            emit({"event": "served", **stats})
+
+    return running()
 
 
 def make_watchdog(args, recorder):

@@ -23,6 +23,7 @@ from fps_tpu.examples.common import (
     make_watchdog,
     maybe_checkpointer,
     maybe_profile,
+    maybe_serve,
     maybe_warm_start,
 )
 
@@ -89,27 +90,29 @@ def main(argv=None) -> int:
     source = make_epoch_source(args, mesh, train)
 
     wd = make_watchdog(args, rec)
-    for epoch in range(args.epochs):
-        # --profile traces the first epoch only (one epoch is representative
-        # and keeps the trace small).
-        cm = maybe_profile(args) if epoch == 0 else contextlib.nullcontext()
-        wcm = (wd.watch("epoch", epoch) if wd is not None
-               else contextlib.nullcontext())
-        with cm, wcm:
-            solver.epoch(lambda _e=epoch: source(_e, 1))
-        loss = solver.weighted_loss(train["user"], train["item"],
-                                    train["rating"])
-        emit({"event": "epoch", "epoch": epoch, "weighted_loss": loss})
-        if rec is not None:
-            rec.inc("driver.epochs")
-            rec.event("epoch", index=epoch, weighted_loss=float(loss))
-        if ckpt is not None and (epoch + 1) % args.checkpoint_every == 0:
-            ckpt.save(epoch + 1, solver.store)
-    if ckpt is not None:
-        # iALS drives its own loop, so IT owns the durability barrier the
-        # Trainer drivers provide: an async writer's last snapshot must be
-        # on disk before the run reports done.
-        ckpt.flush()
+    with maybe_serve(args, rec):
+        for epoch in range(args.epochs):
+            # --profile traces the first epoch only (one epoch is
+            # representative and keeps the trace small).
+            cm = (maybe_profile(args) if epoch == 0
+                  else contextlib.nullcontext())
+            wcm = (wd.watch("epoch", epoch) if wd is not None
+                   else contextlib.nullcontext())
+            with cm, wcm:
+                solver.epoch(lambda _e=epoch: source(_e, 1))
+            loss = solver.weighted_loss(train["user"], train["item"],
+                                        train["rating"])
+            emit({"event": "epoch", "epoch": epoch, "weighted_loss": loss})
+            if rec is not None:
+                rec.inc("driver.epochs")
+                rec.event("epoch", index=epoch, weighted_loss=float(loss))
+            if ckpt is not None and (epoch + 1) % args.checkpoint_every == 0:
+                ckpt.save(epoch + 1, solver.store)
+        if ckpt is not None:
+            # iALS drives its own loop, so IT owns the durability barrier
+            # the Trainer drivers provide: an async writer's last snapshot
+            # must be on disk before the run reports done.
+            ckpt.flush()
 
     r = recall_at_k(solver, test["user"][:2000], test["item"][:2000],
                     k=args.topk, exclude=(train["user"], train["item"]))

@@ -25,6 +25,7 @@ from fps_tpu.examples.common import (
     finish,
     make_mesh,
     maybe_checkpointer,
+    maybe_serve,
     maybe_warm_start,
 )
 
@@ -112,7 +113,7 @@ def main(argv=None) -> int:
               "logloss": float(np.sum(m["logloss"]) / n),
               "error_rate": float(np.sum(m["mistakes"]) / n)})
 
-    with maybe_profile(args):
+    with maybe_profile(args), maybe_serve(args, rec):
         tables, local_state, _ = trainer.fit_stream(
             tables, local_state, chunks, jax.random.key(args.seed),
             checkpointer=maybe_checkpointer(args),

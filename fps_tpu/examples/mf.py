@@ -26,6 +26,7 @@ from fps_tpu.examples.common import (
     make_mesh,
     maybe_checkpointer,
     maybe_profile,
+    maybe_serve,
     maybe_warm_start,
 )
 
@@ -110,7 +111,7 @@ def main(argv=None) -> int:
                       "users": users[t].reshape(-1),
                       "items": items[t].reshape(users[t].size, -1)})
 
-    with maybe_profile(args):
+    with maybe_profile(args), maybe_serve(args, rec):
         tables, local_state, _ = trainer.fit_stream(
             tables, local_state, chunks, jax.random.key(args.seed),
             checkpointer=maybe_checkpointer(args),

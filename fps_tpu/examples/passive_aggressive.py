@@ -25,6 +25,7 @@ from fps_tpu.examples.common import (
     finish,
     make_mesh,
     maybe_checkpointer,
+    maybe_serve,
     maybe_warm_start,
 )
 
@@ -108,7 +109,7 @@ def main(argv=None) -> int:
               "error_rate": float(np.sum(m["mistakes"]) / n),
               "hinge_loss": float(np.sum(m["loss"]) / n)})
 
-    with maybe_profile(args):
+    with maybe_profile(args), maybe_serve(args, rec):
         tables, local_state, _ = trainer.fit_stream(
             tables, local_state, chunks, jax.random.key(args.seed),
             checkpointer=maybe_checkpointer(args),

@@ -26,7 +26,8 @@ from fps_tpu.examples.common import (apply_host_pipeline, apply_hot_tier,
                                      attach_obs,
                                      base_parser, emit, finish, make_guard,
                                      make_mesh, make_rollback, make_watchdog,
-                                     maybe_profile)
+                                     maybe_checkpointer, maybe_profile,
+                                     maybe_serve)
 
 
 class _TargetReached(Exception):
@@ -93,9 +94,11 @@ def main(argv=None) -> int:
             raise _TargetReached
 
     try:
-        with maybe_profile(args):
+        with maybe_profile(args), maybe_serve(args, rec):
             tables, local_state, _ = trainer.fit_stream(
                 tables, local_state, chunks, jax.random.key(args.seed),
+                checkpointer=maybe_checkpointer(args),
+                checkpoint_every=args.checkpoint_every,
                 on_chunk=on_chunk,
                 rollback=make_rollback(args),
                 watchdog=make_watchdog(args, rec),

@@ -27,6 +27,7 @@ from fps_tpu.examples.common import (
     make_watchdog,
     maybe_checkpointer,
     maybe_profile,
+    maybe_serve,
     maybe_warm_start,
 )
 
@@ -120,7 +121,7 @@ def main(argv=None) -> int:
               "sgns_loss": float(np.sum(m["loss"]) / n)})
 
     t0 = time.perf_counter()
-    with maybe_profile(args):
+    with maybe_profile(args), maybe_serve(args, rec):
         if args.ingest == "device":
             # Fused path: tokens resident on device, subsampling/compaction
             # and pair generation inside the compiled epoch.

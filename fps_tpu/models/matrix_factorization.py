@@ -144,6 +144,16 @@ class MatrixFactorizationWorker(WorkerLogic):
             return {ITEM_TABLE: batch["all_items"].reshape(-1)}
         return {ITEM_TABLE: batch["item"].astype(jnp.int32)}
 
+    def touched_local_rows(self, batch):
+        """Ids-aware local-guard refinement: :meth:`step` scatters only
+        into the batch's own users' LOCAL rows (``u // num_workers`` —
+        ingest routes ``u % W == me``), so the guard's row screening can
+        be restricted to exactly those; padding examples (weight 0) touch
+        no row. One entry: the user-factor table is the only leaf."""
+        u = batch["user"].astype(jnp.int32)
+        live = batch["weight"].astype(jnp.float32) > 0
+        return (jnp.where(live, u // self.num_workers, -1),)
+
     def step(self, batch, pulled, local_state, key) -> StepOutput:
         cfg = self.cfg
         n = cfg.negative_samples

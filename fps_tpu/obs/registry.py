@@ -171,6 +171,36 @@ def default_registry() -> MetricsRegistry:
         # Watchdog.
         MetricSpec("watchdog.stalls", "counter", unit="stalls",
                    help="chunk/epoch dispatches that overran the deadline"),
+        # Read-path serving tier (fps_tpu.serve; docs/serving.md).
+        MetricSpec("serve.requests", "counter", unit="requests",
+                   labels=("op",),
+                   help="ReadServer queries answered (op: pull / score / "
+                        "topk)"),
+        MetricSpec("serve.rows", "counter", unit="rows",
+                   help="parameter rows served across all requests"),
+        MetricSpec("serve.request_seconds", "histogram", unit="s",
+                   labels=("op",),
+                   help="per-request service latency (p50/p99 over the "
+                        "retained window via ReadServer.latency_s)"),
+        MetricSpec("serve.snapshot_step", "gauge", unit="step",
+                   help="training step of the snapshot currently served"),
+        MetricSpec("serve.snapshot_lag_steps", "gauge", unit="steps",
+                   help="newest step the trainer has written minus the "
+                        "served step — the freshness SLO in steps (NaN "
+                        "when the served step was quarantined and nothing "
+                        "survives)"),
+        MetricSpec("serve.write_to_servable_s", "gauge", unit="s",
+                   help="durability (checkpoint_saved) to servable "
+                        "wall-clock lag of the last publish — the "
+                        "end-to-end write->servable freshness SLO"),
+        MetricSpec("serve.swaps", "counter", unit="swaps",
+                   labels=("direction",),
+                   help="snapshot hot-swaps published to the ReadServer "
+                        "(direction: forward, or backward when the "
+                        "trainer quarantined the served snapshot)"),
+        MetricSpec("serve.rejected_snapshots", "counter", unit="snapshots",
+                   help="snapshot candidates that failed CRC/structural "
+                        "verification and were never served"),
         # Program contract auditor (fps_tpu.analysis; Trainer(audit=...)).
         MetricSpec("analysis.certified_programs", "counter",
                    unit="programs",

@@ -21,6 +21,7 @@ from __future__ import annotations
 import collections
 import json
 import logging
+import math
 import os
 import re
 
@@ -82,7 +83,7 @@ class JsonlSink(Sink):
         if self._dead:
             return
         try:
-            self._f.write(json.dumps(record, default=_json_default) + "\n")
+            self._f.write(_strict_json_line(record) + "\n")
             self._n += 1
             if self._n % self.flush_every == 0:
                 self._f.flush()
@@ -104,6 +105,37 @@ class JsonlSink(Sink):
             self._f.close()
         except OSError:
             pass
+
+
+def scrub_nonfinite(x):
+    """Recursively replace non-finite floats with None (dict/list/tuple
+    containers, numpy scalars and arrays degraded first) — the one
+    shared spelling of the strict-JSON invariant; ``serve/net.py``
+    imports it for the wire, and ``tools/obs_report.py`` (deliberately
+    import-free) mirrors it for the digest."""
+    if isinstance(x, dict):
+        return {k: scrub_nonfinite(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [scrub_nonfinite(v) for v in x]
+    if not isinstance(x, (str, bytes)) and callable(
+            getattr(x, "tolist", None)):
+        return scrub_nonfinite(x.tolist())  # numpy scalar/array first
+    if isinstance(x, float) and not math.isfinite(x):
+        return None
+    return x
+
+
+def _strict_json_line(record: dict) -> str:
+    """One record as STRICT JSON: non-finite floats become null rather
+    than the Python-only NaN/Infinity tokens that every other JSON
+    parser rejects (the serving watcher legitimately sets a NaN gauge
+    when no snapshot survives — the artifact must stay machine-readable
+    to jq and non-Python consumers)."""
+    try:
+        return json.dumps(record, default=_json_default, allow_nan=False)
+    except ValueError:
+        return json.dumps(scrub_nonfinite(record), default=_json_default,
+                          allow_nan=False)
 
 
 def _json_default(v):

@@ -1,0 +1,35 @@
+"""fps_tpu.serve — the read path: publish snapshots to query traffic.
+
+The serving half of the parameter-server abstraction (Parameter Box,
+PAPERS.md): everything up to here trains; this subsystem answers. A
+:class:`SnapshotWatcher` turns the training plane's publish trail
+(atomic-rename ``ckpt_*.npz`` snapshots + ``checkpoint_saved`` journal
+events) into a stream of CRC-verified, read-only-mmapped
+:class:`ServableSnapshot` publications; a :class:`ReadServer` answers
+batched pull-by-id and model-head queries (MF top-k, logreg/PA scoring)
+against the current one, hot-swapping to each newer snapshot by a single
+reference flip — in-flight requests finish on the snapshot they started
+on, and the swap cost is independent of table size. ``docs/serving.md``
+is the architecture note; the freshness SLO ("write→servable" lag) and
+swap/rollback semantics live there.
+
+jax-optional by construction (stdlib + numpy; the on-disk contract comes
+from the jax-free :mod:`fps_tpu.core.snapshot_format`): ``tools/serve.py``
+runs this whole plane on a machine with no accelerator runtime.
+"""
+
+from fps_tpu.serve.net import JsonlClient, TcpServe, handle_request
+from fps_tpu.serve.server import NoSnapshotError, ReadServer
+from fps_tpu.serve.snapshot import ServableSnapshot, SnapshotRejected
+from fps_tpu.serve.watcher import SnapshotWatcher
+
+__all__ = [
+    "JsonlClient",
+    "NoSnapshotError",
+    "ReadServer",
+    "ServableSnapshot",
+    "SnapshotRejected",
+    "SnapshotWatcher",
+    "TcpServe",
+    "handle_request",
+]

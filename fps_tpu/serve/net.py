@@ -1,0 +1,183 @@
+"""Line-oriented JSON over TCP: the thinnest possible wire for ReadServer.
+
+One request per line, one response per line (both JSON objects) — the
+same framing as every other artifact in this repo (journals, event logs,
+bench digests), so the protocol needs no schema machinery and any
+language's socket + JSON can speak it:
+
+  {"op": "pull",  "table": "weights", "ids": [0, 5, 9]}
+  {"op": "score", "feat_ids": [[...]], "feat_vals": [[...]],
+   "table": "weights", "link": "sigmoid"}
+  {"op": "topk",  "users": [1, 2], "k": 10, "item_table": "item_factors"}
+  {"op": "stats"}
+
+Responses carry ``"ok": true`` plus the op's payload (every data op tags
+``"step"`` — the publish that answered), or ``"ok": false, "error": ...``
+for malformed requests; the connection survives bad requests (a serving
+endpoint must not let one typo'd client kill the socket).
+
+This is a test/bench/demo transport, deliberately not a production
+server (no TLS, no auth, no backpressure): the subsystem's contract is
+the :class:`~fps_tpu.serve.server.ReadServer` surface; production fronts
+would sit where :class:`TcpServe` sits.
+
+thread-safety: one daemon thread per connection plus the acceptor
+(``socketserver.ThreadingTCPServer``); all shared state lives in the
+ReadServer, whose read path is lock-free by design (see its docstring).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import socket
+import socketserver
+import threading
+
+import numpy as np
+
+from fps_tpu.obs.sinks import scrub_nonfinite
+from fps_tpu.serve.server import NoSnapshotError, ReadServer
+
+__all__ = ["TcpServe", "JsonlClient"]
+
+
+def _py(v):
+    # Non-finite floats serialize as null: json.dumps would otherwise emit
+    # Python-only NaN/Infinity tokens that strict parsers reject, and a
+    # published snapshot CAN hold non-finite rows (observe-mode guards
+    # count them without reverting).
+    if isinstance(v, np.ndarray):
+        out = v.tolist()
+        if v.dtype.kind == "f" and not np.isfinite(v).all():
+            out = scrub_nonfinite(out)
+        return out
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return None if not math.isfinite(v) else float(v)
+    return v
+
+
+def handle_request(server: ReadServer, req: dict) -> dict:
+    """One request → one response dict (transport-independent: the TCP
+    handler and the in-process client in tests both call this)."""
+    if not isinstance(req, dict):
+        # Valid JSON but not an object ('[1]', 'null'): still one error
+        # line, never a dropped connection.
+        return {"ok": False,
+                "error": f"request must be a JSON object, got "
+                         f"{type(req).__name__}"}
+    try:
+        op = req.get("op")
+        if op == "pull":
+            step, vals = server.pull(req["table"], req["ids"])
+            return {"ok": True, "step": step, "values": _py(vals)}
+        if op == "score":
+            step, scores = server.score_linear(
+                req["feat_ids"], req["feat_vals"],
+                table=req.get("table", "weights"),
+                link=req.get("link", "sigmoid"))
+            return {"ok": True, "step": step, "scores": _py(scores)}
+        if op == "topk":
+            step, items, scores = server.topk(
+                req["users"], int(req.get("k", 10)),
+                item_table=req.get("item_table", "item_factors"),
+                user_leaf=int(req.get("user_leaf", 0)))
+            return {"ok": True, "step": step, "items": _py(items),
+                    "scores": _py(scores)}
+        if op == "stats":
+            return {"ok": True, **server.stats()}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+    except NoSnapshotError as e:
+        return {"ok": False, "error": str(e), "retryable": True}
+    except (KeyError, IndexError, TypeError, ValueError) as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
+class TcpServe:
+    """Serve a :class:`ReadServer` on ``127.0.0.1:port`` (0 = ephemeral;
+    read the bound port from :attr:`port`). ``start()`` returns
+    immediately (daemon threads); ``close()`` shuts the socket down.
+
+    thread-safety: the handler threads share only the ReadServer, whose
+    read path is lock-free by design (snapshot bound once per request;
+    see its docstring) — this class itself owns no mutable state past
+    construction, and ``ThreadingTCPServer.shutdown`` is the only
+    cross-thread call."""
+
+    def __init__(self, server: ReadServer, *, host: str = "127.0.0.1",
+                 port: int = 0):
+        read_server = server
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        req = json.loads(line)
+                    except json.JSONDecodeError as e:
+                        resp = {"ok": False, "error": f"bad json: {e}"}
+                    else:
+                        resp = handle_request(read_server, req)
+                    try:
+                        payload = json.dumps(resp, allow_nan=False)
+                    except ValueError:
+                        # Belt-and-braces: _py() nulls non-finite floats,
+                        # so any stray NaN here is a protocol bug — fail
+                        # the one response, not the wire contract.
+                        payload = json.dumps(
+                            {"ok": False,
+                             "error": "non-finite value in response"})
+                    self.wfile.write((payload + "\n").encode("utf-8"))
+                    self.wfile.flush()
+
+        self._tcp = socketserver.ThreadingTCPServer(
+            (host, port), Handler, bind_and_activate=True)
+        self._tcp.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, name="fps-serve-tcp",
+            daemon=True)
+        self.host, self.port = self._tcp.server_address[:2]
+
+    def start(self) -> "TcpServe":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class JsonlClient:
+    """Blocking client for the line protocol (tests and the CLI's
+    ``--query`` mode)."""
+
+    def __init__(self, host: str, port: int, *, timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._rfile = self._sock.makefile("rb")
+
+    def request(self, req: dict) -> dict:
+        self._sock.sendall((json.dumps(req) + "\n").encode("utf-8"))
+        line = self._rfile.readline()
+        if not line:
+            raise ConnectionError("server closed the connection")
+        return json.loads(line)
+
+    def close(self) -> None:
+        self._rfile.close()
+        self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,241 @@
+"""ReadServer: answer pull-by-id and model-head queries over snapshots.
+
+The serving half of the parameter-server abstraction (Parameter Box,
+PAPERS.md): batched reads against *published* parameter state. One
+:class:`ReadServer` holds a reference to the current
+:class:`~fps_tpu.serve.snapshot.ServableSnapshot` and answers
+
+* ``pull(table, ids)``            — batched row lookup (the PS wire op);
+* ``score_linear(ids, vals)``     — sparse linear scores: logreg
+  probability / PA margin over a weight table (column 0 is the weight
+  for every optimizer, matching ``predict_proba_host``);
+* ``topk(users, k)``              — MF user×item dot-product top-k over
+  the item table and the snapshot's EXPORTED user factors;
+* ``stats()``                     — step, request/latency digest, swap
+  and freshness counters.
+
+**Hot-swap contract.** :meth:`swap_to` is a single attribute rebind — a
+pointer flip whose latency is independent of table size (no data moves;
+the snapshot was mapped when it was opened). Every request reads
+``self._snap`` exactly ONCE and runs entirely against that object, so an
+in-flight batched lookup completes on the snapshot it started on while
+later requests see the new one; old maps stay valid until their last
+reference drops (rename-only publication — see ``serve/snapshot.py``).
+No locks on the read path.
+
+Latency: every request is timed into a bounded reservoir (plus a
+``serve.request_seconds`` histogram and ``serve.requests`` /
+``serve.rows`` counters through ``fps_tpu.obs``); :meth:`latency_s`
+reports p50/p99 — the numbers ``bench.py serve`` publishes. With a
+recorder attached, that is three metric records PER REQUEST (a JSONL
+sink writes three lines each) — the price of exact sample-level
+quantiles in the obs digest. High-qps paths that only need the local
+digest pass ``recorder=None`` (as ``bench.py serve`` does) and read
+the reservoir through :meth:`stats`.
+
+thread-safety: the swap is a single reference assignment (atomic under
+the GIL) and requests bind it once; the latency reservoir and the
+request/row totals update under their own locks (post-lookup accounting
+only — the data path itself stays lock-free). Many request threads + one
+watcher thread is the intended topology.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from fps_tpu.serve.snapshot import ServableSnapshot
+from fps_tpu.serve.watcher import SnapshotWatcher, _emit_metric
+
+__all__ = ["ReadServer", "NoSnapshotError"]
+
+
+class NoSnapshotError(RuntimeError):
+    """No servable snapshot has been published yet."""
+
+
+class _LatencyReservoir:
+    """Bounded ring of request latencies with exact quantiles over the
+    retained window (the last ``capacity`` requests)."""
+
+    def __init__(self, capacity: int = 8192):
+        self.capacity = capacity
+        self._buf = np.zeros(capacity, np.float64)
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def add(self, seconds: float) -> None:
+        with self._lock:
+            self._buf[self._n % self.capacity] = seconds
+            self._n += 1
+
+    def quantiles(self, qs=(0.5, 0.99)) -> dict[str, float] | None:
+        with self._lock:
+            n = min(self._n, self.capacity)
+            if not n:
+                return None
+            window = np.sort(self._buf[:n].copy())
+        return {f"p{int(q * 100)}": float(
+            window[min(n - 1, int(q * (n - 1) + 0.5))]) for q in qs}
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+
+class ReadServer:
+    """Model-agnostic read server over a (possibly live) run directory.
+
+    Construct around an initial snapshot, or with none and let a
+    :class:`SnapshotWatcher` publish into :meth:`swap_to`.
+    :meth:`ReadServer.over` builds the common pairing in one call.
+    """
+
+    def __init__(self, snapshot: ServableSnapshot | None = None, *,
+                 recorder=None):
+        self._snap = snapshot
+        self.recorder = recorder
+        self.latency = _LatencyReservoir()
+        # Request/row totals mutate from every handler thread; the lock
+        # keeps them exact so stats() agrees with the obs counters
+        # (whose Recorder locks internally).
+        self._count_lock = threading.Lock()
+        self.requests = 0
+        self.rows_served = 0
+
+    @classmethod
+    def over(cls, ckpt_dir: str, *, journal: str | None = None,
+             recorder=None, verify: bool = True
+             ) -> tuple["ReadServer", SnapshotWatcher]:
+        """``(server, watcher)`` wired together over ``ckpt_dir``; call
+        ``watcher.poll()`` (or run it on a thread) to publish."""
+        server = cls(recorder=recorder)
+        watcher = SnapshotWatcher(
+            ckpt_dir, journal=journal, recorder=recorder,
+            on_swap=lambda snap, _direction: server.swap_to(snap),
+            verify=verify)
+        watcher.poll()
+        return server, watcher
+
+    # -- publication -------------------------------------------------------
+
+    def swap_to(self, snapshot: ServableSnapshot) -> None:
+        """Atomic hot swap: one reference rebind, no data movement — safe
+        to call (from the watcher thread) while requests are in flight;
+        each request keeps the snapshot it bound at entry."""
+        self._snap = snapshot
+
+    @property
+    def snapshot(self) -> ServableSnapshot:
+        snap = self._snap
+        if snap is None:
+            raise NoSnapshotError(
+                "no servable snapshot published yet — has the trainer "
+                "saved (and the watcher polled) at least once?")
+        return snap
+
+    # -- request plumbing --------------------------------------------------
+
+    def _done(self, op: str, t0: float, rows: int) -> None:
+        dt = time.perf_counter() - t0
+        self.latency.add(dt)
+        with self._count_lock:
+            self.requests += 1
+            self.rows_served += rows
+        _emit_metric(self.recorder, "inc", "serve.requests", 1, op=op)
+        _emit_metric(self.recorder, "inc", "serve.rows", max(rows, 0))
+        _emit_metric(self.recorder, "observe", "serve.request_seconds", dt,
+                     op=op)
+
+    # -- query surface -----------------------------------------------------
+
+    def pull(self, table: str, ids) -> tuple[int, np.ndarray]:
+        """Batched pull-by-id. Returns ``(step, values)`` — the step tags
+        which publish answered, so a client can reason about freshness."""
+        t0 = time.perf_counter()
+        snap = self.snapshot  # bound ONCE: in-flight work survives swaps
+        out = snap.lookup(table, ids)
+        self._done("pull", t0, int(np.asarray(ids).size))
+        return snap.step, out
+
+    def score_linear(self, feat_ids, feat_vals, *, table: str = "weights",
+                     link: str = "sigmoid") -> tuple[int, np.ndarray]:
+        """Sparse linear model scores (logreg ``link="sigmoid"``, PA /
+        raw margin ``link="none"``) — the serving twin of
+        ``predict_proba_host``: column 0 of the pulled rows is the
+        weight for every optimizer, padding ids contribute 0."""
+        t0 = time.perf_counter()
+        snap = self.snapshot
+        feat_ids = np.asarray(feat_ids, np.int64)
+        feat_vals = np.asarray(feat_vals)
+        rows = snap.lookup(table, feat_ids.reshape(-1))
+        w = rows[:, 0].reshape(feat_ids.shape)
+        logit = np.sum(w * feat_vals, axis=-1)
+        out = 1.0 / (1.0 + np.exp(-logit)) if link == "sigmoid" else logit
+        self._done("score", t0, int(feat_ids.size))
+        return snap.step, out
+
+    def topk(self, users, k: int = 10, *, item_table: str = "item_factors",
+             user_leaf: int = 0) -> tuple[int, np.ndarray, np.ndarray]:
+        """MF recommendation head: top-``k`` items per user by dot
+        product of the snapshot's exported user factors (``ls::<leaf>``,
+        logical user order — the Trainer checkpoint path's form) against
+        the item table. Returns ``(step, item_ids (U, k), scores (U, k))``.
+        """
+        t0 = time.perf_counter()
+        if k < 1:
+            # argpartition on k<=0 returns arbitrary columns claiming
+            # ok — loud refusal, like negative user ids and raw ls.
+            raise ValueError(f"k must be >= 1, got {k}")
+        snap = self.snapshot
+        if snap.local_state_format != "exported":
+            raise ValueError(
+                "topk needs user factors in the EXPORTED (logical-order) "
+                f"local-state form; snapshot step {snap.step} stores "
+                f"{snap.local_state_format!r} — checkpoint through the "
+                "Trainer path")
+        if user_leaf >= len(snap.local_state):
+            raise ValueError(
+                f"snapshot step {snap.step} has {len(snap.local_state)} "
+                f"local-state leaves, no leaf {user_leaf}")
+        users = np.asarray(users, np.int64)
+        factors = snap.local_state[user_leaf]
+        if users.size and (int(users.min(initial=0)) < 0
+                           or int(users.max(initial=-1))
+                           >= factors.shape[0]):
+            # No negative-index wraparound: serving user NU-1's items for
+            # user -1 would be silently wrong data, not an error.
+            raise IndexError(
+                f"user ids must be in [0, {factors.shape[0]}); got "
+                f"[{int(users.min())}, {int(users.max())}]")
+        p = factors[users]  # (U, rank)
+        q = snap.table(item_table)  # (I, rank)
+        scores = p @ np.asarray(q).T  # (U, I) — q stays the mapped pages
+        k = min(k, scores.shape[1])
+        top = np.argpartition(-scores, k - 1, axis=1)[:, :k]
+        order = np.argsort(
+            -np.take_along_axis(scores, top, axis=1), axis=1)
+        items = np.take_along_axis(top, order, axis=1)
+        self._done("topk", t0, int(users.size) * k)
+        return snap.step, items, np.take_along_axis(scores, items, axis=1)
+
+    # -- digest ------------------------------------------------------------
+
+    def latency_s(self) -> dict[str, float] | None:
+        """``{"p50": s, "p99": s}`` over the retained request window."""
+        return self.latency.quantiles()
+
+    def stats(self) -> dict:
+        snap = self._snap
+        lat = self.latency_s() or {}
+        return {
+            "step": None if snap is None else snap.step,
+            "tables": sorted(snap.tables) if snap is not None else [],
+            "requests": self.requests,
+            "rows_served": self.rows_served,
+            "latency_p50_s": lat.get("p50"),
+            "latency_p99_s": lat.get("p99"),
+        }

@@ -1,0 +1,167 @@
+"""One published snapshot, opened for reading: verify, map, look up.
+
+:class:`ServableSnapshot` is the read-path's unit of publication — one
+``ckpt_*.npz`` file that has passed the full CRC integrity pass
+(:func:`fps_tpu.core.snapshot_format.verify_snapshot_file`) and whose
+array entries are mapped read-only into this process
+(:func:`~fps_tpu.core.snapshot_format.map_snapshot_arrays`): ``np.memmap``
+views straight onto the member bytes, no decompression, no copy, no
+resident memory until rows are touched. Opening a multi-GB snapshot costs
+header parsing plus one CRC pass; *swapping* a server to an already-open
+snapshot is a pointer flip whose cost is independent of table size.
+
+Lifetime: the maps address the published file's INODE. The checkpoint
+writer only ever publishes via atomic rename (a fresh inode per save), so
+a mapped snapshot can never change underneath a reader; retention GC or a
+``*.corrupt`` quarantine merely unlinks the NAME — in-flight reads on the
+old map stay valid until the last reference drops. That property is what
+makes the serving hot-swap safe without any reader/writer locking.
+
+jax-free (stdlib + numpy): a serving process needs no accelerator
+runtime. Import through the real package or a stub root
+(``tools/serve.py``) — nothing here touches the training plane.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from fps_tpu.core import snapshot_format as fmt
+
+__all__ = ["ServableSnapshot", "SnapshotRejected"]
+
+
+class SnapshotRejected(RuntimeError):
+    """A snapshot failed integrity verification and was not opened.
+
+    Raised by :meth:`ServableSnapshot.open` — the serving analog of the
+    training plane's ``SnapshotCorruptionError``, separate so the serving
+    tier never needs the jax-laden resilience module."""
+
+
+class ServableSnapshot:
+    """A CRC-verified, read-only-mapped snapshot.
+
+    Construct via :meth:`open` (which verifies first — a torn or
+    bit-rotted file raises :class:`SnapshotRejected` before anything is
+    mapped). Tables are exposed in LOGICAL id order, padding stripped —
+    exactly as the checkpoint writer serializes them — so a served row
+    lookup is a plain axis-0 index, with no owner-major physical mapping
+    and no dependence on the training mesh shape.
+
+    Thread-safety: instances are immutable after ``open`` (plain reads of
+    read-only maps); any number of request threads may share one.
+    """
+
+    def __init__(self, step: int, path: str, tables: dict,
+                 local_state: list, local_state_format: str, *,
+                 verify_seconds: float = 0.0, src_id=None):
+        self.step = int(step)
+        self.path = path
+        self.tables = tables  # {name: (num_ids, dim) read-only array}
+        self.local_state = local_state  # exported ls:: leaves, in order
+        self.local_state_format = local_state_format
+        self.verify_seconds = verify_seconds
+        # (st_ino, st_mtime_ns) of the mapped file — the identity the
+        # watcher compares so an atomic re-publish of the SAME step
+        # (quarantine → rollback replay) is seen as a new snapshot.
+        self.src_id = src_id
+
+    @classmethod
+    def open(cls, path: str, *, step: int | None = None,
+             verify: bool = True) -> "ServableSnapshot":
+        """Verify ``path`` then map it. ``step`` defaults to the value
+        parsed from the filename; ``verify=False`` skips the CRC pass
+        (only for callers that just verified the same inode)."""
+        if step is None:
+            m = fmt.SNAPSHOT_RE.fullmatch(os.path.basename(path))
+            if not m:
+                raise ValueError(
+                    f"{path!r} does not match the snapshot naming contract "
+                    f"({fmt.SNAPSHOT_RE.pattern})")
+            step = int(m.group(1))
+        t0 = time.perf_counter()
+        if verify:
+            ok, reason = fmt.verify_snapshot_file(path)
+            if not ok:
+                raise SnapshotRejected(
+                    f"snapshot step {step} at {path}: {reason}")
+        verify_s = time.perf_counter() - t0
+        try:
+            st = os.stat(path)
+            arrays = fmt.map_snapshot_arrays(path)
+            ls_format = _ls_format(path)
+        except FileNotFoundError:
+            raise
+        except fmt.IO_ERRORS as e:
+            # verify→map is not atomic against a concurrent quarantine
+            # rename; surface the race as a rejection, not a crash.
+            raise SnapshotRejected(
+                f"snapshot step {step} at {path}: vanished or unreadable "
+                f"between verify and map ({e!r})") from e
+        tables = {k[len(fmt.TABLE_PREFIX):]: v for k, v in arrays.items()
+                  if k.startswith(fmt.TABLE_PREFIX)}
+        ls: list = []
+        while fmt.LS_PREFIX + str(len(ls)) in arrays:
+            ls.append(arrays[fmt.LS_PREFIX + str(len(ls))])
+        return cls(step, path, tables, ls, ls_format,
+                   verify_seconds=verify_s,
+                   src_id=(st.st_ino, st.st_mtime_ns))
+
+    # -- lookups -----------------------------------------------------------
+
+    def table(self, name: str) -> np.ndarray:
+        try:
+            return self.tables[name]
+        except KeyError:
+            raise KeyError(
+                f"snapshot step {self.step} has no table {name!r} "
+                f"(tables: {sorted(self.tables)})") from None
+
+    def lookup(self, name: str, ids) -> np.ndarray:
+        """Batched pull-by-id: rows ``ids`` of table ``name`` (logical id
+        order). Padding ids (``-1``) read as zero rows, matching the
+        training plane's dropped-row contract; out-of-range ids — above
+        the table or below the ``-1`` sentinel — raise."""
+        t = self.table(name)
+        ids = np.asarray(ids, np.int64)
+        if ids.size and ids.max(initial=-1) >= t.shape[0]:
+            raise IndexError(
+                f"table {name!r}: id {int(ids.max())} out of range "
+                f"({t.shape[0]} rows)")
+        if ids.size and ids.min(initial=0) < -1:
+            # Only -1 is the padding sentinel; any other negative is a
+            # client bug that must not silently read as a zero row.
+            raise IndexError(
+                f"table {name!r}: id {int(ids.min())} below the -1 "
+                f"padding sentinel")
+        live = ids >= 0
+        out = t[np.where(live, ids, 0)]
+        if not live.all():
+            out = np.where(live[..., None] if out.ndim > ids.ndim
+                           else live, out, 0).astype(t.dtype, copy=False)
+        return out
+
+    def manifest(self) -> dict:
+        """Shape/dtype summary (no data touched) — the publish manifest
+        the CLI and the obs digest surface."""
+        return {
+            "step": self.step,
+            "path": self.path,
+            "tables": {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                       for k, v in sorted(self.tables.items())},
+            "local_state": [{"shape": list(v.shape), "dtype": str(v.dtype)}
+                            for v in self.local_state],
+            "local_state_format": self.local_state_format,
+        }
+
+
+def _ls_format(path: str) -> str:
+    """The snapshot's ``meta::ls_format`` tag (``"raw"`` when absent) —
+    read through numpy's lazy member access (only this entry's bytes)."""
+    key = "meta" + fmt.SEP + "ls_format"
+    with np.load(path) as z:
+        return str(z[key]) if key in z.files else "raw"

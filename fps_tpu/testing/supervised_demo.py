@@ -310,6 +310,155 @@ def run_hot_tier_kill_scenario(tmpdir: str, *, timeout: float = 600):
     return ok, detail
 
 
+SCENARIO_SERVE_KILL_AT = 3
+
+
+def run_serve_while_train_scenario(tmpdir: str, *, timeout: float = 600):
+    """Serve-while-train survival (``fps_tpu.serve``, ``docs/serving.md``):
+    a concurrent ReadServer polls a supervised child's checkpoint dir the
+    whole run while the child is SIGKILLed after chunk
+    ``SCENARIO_SERVE_KILL_AT`` trains (before its checkpoint lands) and a
+    torn full-named snapshot candidate (a partial write that DID reach a
+    published name) is planted mid-run. The read-path contract under test:
+
+    * readers never observe a torn or CRC-failing table (the torn
+      candidate is rejected, never served; every served pull returns
+      finite rows from a verified snapshot);
+    * the served step is monotone FORWARD for the whole (quarantine-free)
+      run, kill and restart included, and ends on the newest valid
+      snapshot with bytes equal to that snapshot's table;
+    * when the final served snapshot is then quarantined (the trainer's
+      ``*.corrupt`` rename), the reader swaps BACKWARD to the surviving
+      snapshot — never keeps answering past the rollback.
+
+    Returns ``(ok, detail)`` like the other scenarios; shared by
+    ``tools/chaos_sweep.py`` (``serve_while_train``) and the slow test in
+    ``tests/test_serve.py`` so the two cannot drift.
+    """
+    import subprocess as sp
+    import time as _time
+
+    import numpy as np
+
+    from fps_tpu.core import snapshot_format as fmt
+    from fps_tpu.serve import ReadServer, SnapshotWatcher
+
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=_ROOT)
+    sup_dir = os.path.join(tmpdir, "sup")
+    sup_out = os.path.join(tmpdir, "sup.npz")
+    proc = sp.Popen(
+        [sys.executable, os.path.join(_ROOT, "tools", "supervise.py"),
+         "--state-dir", sup_dir, "--stall-timeout-s", "60",
+         "--startup-grace-s", "300", "--term-grace-s", "2",
+         "--backoff-base-s", "0.2", "--max-restarts", "2",
+         "--poll-s", "0.2", "--",
+         sys.executable, "-m", "fps_tpu.testing.supervised_demo",
+         *SCENARIO_DEMO_ARGS, "--ckpt-dir", sup_dir, "--out", sup_out,
+         "--kill-at", str(SCENARIO_SERVE_KILL_AT)],
+        env=env, cwd=_ROOT, stdout=sp.PIPE, stderr=sp.PIPE, text=True,
+    )
+
+    server = ReadServer()
+    swap_trail: list[tuple[str, int]] = []
+
+    def on_swap(snap, direction):
+        server.swap_to(snap)
+        swap_trail.append((direction, snap.step))
+
+    watcher = SnapshotWatcher(sup_dir, on_swap=on_swap)
+    violations: list[str] = []
+    served_steps: list[int] = []
+    torn_planted = None
+    deadline = _time.monotonic() + timeout
+    while proc.poll() is None and _time.monotonic() < deadline:
+        watcher.poll()
+        snap = server._snap
+        if snap is not None:
+            step, rows = server.pull("weights", np.arange(
+                snap.tables["weights"].shape[0]))
+            if not np.all(np.isfinite(rows)):
+                violations.append(f"non-finite rows served at step {step}")
+            if served_steps and step < served_steps[-1]:
+                violations.append(
+                    f"served step went backward without a quarantine: "
+                    f"{served_steps[-1]} -> {step}")
+            served_steps.append(step)
+            if torn_planted is None:
+                # The partial-write injection that DID reach a published
+                # name: a torn candidate NEWER than everything real. The
+                # watcher must reject it and keep serving; the restarted
+                # child's auto-resolve restore quarantines it.
+                torn_planted = fmt.snapshot_path(sup_dir, snap.step + 50)
+                with open(torn_planted, "wb") as f:
+                    f.write(b"PK\x03\x04" + b"\xde\xad" * 512)
+        _time.sleep(0.05)
+
+    try:
+        stdout, stderr = proc.communicate(timeout=max(
+            5.0, deadline - _time.monotonic()))
+    except sp.TimeoutExpired:
+        proc.kill()
+        return False, {"error": "supervised run timed out"}
+    try:
+        digest = json.loads(stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return False, {"error": "no supervisor digest",
+                       "tail": (stdout + stderr)[-1000:]}
+
+    # Final convergence: the reader must end on the newest valid snapshot
+    # with exactly its bytes.
+    watcher.poll()
+    final = fmt.latest_valid_snapshot(sup_dir)
+    final_consistent = False
+    if final is not None and server._snap is not None:
+        want = fmt.map_snapshot_arrays(final[1])["table::weights"]
+        _, got = server.pull("weights", np.arange(want.shape[0]))
+        final_consistent = bool(server._snap.step == final[0]
+                                and np.array_equal(got, want))
+
+    # Rollback leg: quarantine the served snapshot the way the trainer
+    # does (*.corrupt rename) — the reader must swap BACKWARD, not keep
+    # answering from rolled-back-past state.
+    backward_ok = False
+    if server._snap is not None:
+        quarantined_step = server._snap.step
+        path = fmt.snapshot_path(sup_dir, quarantined_step)
+        os.replace(path, path + ".corrupt")
+        watcher.poll()
+        snap = server._snap
+        backward_ok = bool(snap is not None
+                           and snap.step < quarantined_step
+                           and swap_trail[-1][0] == "backward"
+                           and np.all(np.isfinite(
+                               server.pull("weights", [0, 1])[1])))
+
+    detail = {
+        "supervisor": {k: digest.get(k) for k in
+                       ("success", "attempts", "restarts",
+                        "deadline_aborts", "quarantined")},
+        "polls_served": len(served_steps),
+        "served_step_span": ([served_steps[0], served_steps[-1]]
+                             if served_steps else None),
+        "swap_trail": swap_trail,
+        "rejected_snapshots": watcher.rejected,
+        "violations": violations,
+        "final_consistent": final_consistent,
+        "backward_swap_ok": backward_ok,
+    }
+    ok = bool(proc.returncode == 0 and digest.get("success")
+              and digest.get("restarts") == 1
+              and not violations
+              and len(served_steps) > 0
+              # The planted torn candidate was seen and refused.
+              and watcher.rejected >= 1
+              and final_consistent
+              and backward_ok)
+    return ok, detail
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="supervised tiny-logreg child (fps_tpu.supervise demo)")

@@ -230,11 +230,12 @@ def test_bench_combined_summary_line_contract(capsys):
     finally:
         _sys.argv = argv
     lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
-    # 5 x (per-workload line + cumulative digest) + final workload + rich
-    # combined + final digest (the last workload's digest IS the final
-    # line): a killed run's final stdout line is ALWAYS a digest of what
-    # completed.
-    assert len(lines) == 13
+    # (N-1) x (per-workload line + cumulative digest) + final workload +
+    # rich combined + final digest (the last workload's digest IS the
+    # final line): a killed run's final stdout line is ALWAYS a digest of
+    # what completed.
+    n_workloads = len(bench.RUNNERS)
+    assert len(lines) == 2 * (n_workloads - 1) + 3
 
     final = lines[-1]
     # The driver keeps a bounded tail; the final line must fit it with
@@ -242,10 +243,10 @@ def test_bench_combined_summary_line_contract(capsys):
     assert len(final.encode("utf-8")) <= 1000, len(final)
     digest = json.loads(final)
     assert {"metric", "value", "unit", "vs_baseline"} <= digest.keys()
-    assert set(digest["workloads"]) == {"mf", "w2v", "logreg", "pa",
-                                        "ials", "tiered"}
+    assert set(digest["workloads"]) == set(bench.RUNNERS)
+    assert digest["unit"] == "examples/s"
     for name, res in digest["workloads"].items():
-        assert set(res) == {"metric", "value", "unit", "vs_baseline"}
+        assert set(res) == {"metric", "value", "vs_baseline"}
         assert res["metric"] == f"synthetic_{name}_examples_per_sec_per_chip_headline"
         # floats rounded: json round-trip stays short
         assert res["value"] == 5355285.3333
@@ -266,6 +267,5 @@ def test_bench_combined_summary_line_contract(capsys):
     # The rich combined line still precedes the final digest with the
     # full results.
     rich = json.loads(lines[-2])
-    assert set(rich["workloads"]) == {"mf", "w2v", "logreg", "pa",
-                                      "ials", "tiered"}
+    assert set(rich["workloads"]) == set(bench.RUNNERS)
     assert "baseline" in rich["workloads"]["mf"]

@@ -212,6 +212,39 @@ def test_fps005_shim_itself_is_exempt():
 
 
 # ---------------------------------------------------------------------------
+# FPS006 — raw open()/np.load of checkpoint/snapshot paths.
+# ---------------------------------------------------------------------------
+
+
+def test_fps006_flags_raw_snapshot_reads():
+    assert rules_of("z = np.load(ckpt_path)") == ["FPS006"]
+    assert rules_of("f = open(snapshot_file, 'rb')") == ["FPS006"]
+    assert rules_of("z = numpy.load(run.ckpt_dir)") == ["FPS006"]
+    # The token may sit in a string literal (a hardcoded path).
+    assert rules_of("z = np.load('out/ckpt_000000000001.npz')") == [
+        "FPS006"]
+
+
+def test_fps006_generic_paths_and_other_calls_are_clean():
+    assert rules_of("z = np.load(path)") == []
+    assert rules_of("f = open(out_file, 'wb')") == []
+    # Non-read calls never flag, even on flavored names.
+    assert rules_of("os.remove(ckpt_path)") == []
+
+
+def test_fps006_sanctioned_readers_are_exempt():
+    src = "z = np.load(snapshot_path)"
+    for path in (
+        os.path.join("fps_tpu", "core", "checkpoint.py"),
+        os.path.join("fps_tpu", "core", "snapshot_format.py"),
+        os.path.join("fps_tpu", "serve", "snapshot.py"),
+    ):
+        assert [f.rule for f in lint_source(src, path)] == [], path
+    assert [f.rule for f in lint_source(
+        src, os.path.join("fps_tpu", "testing", "chaos.py"))] == ["FPS006"]
+
+
+# ---------------------------------------------------------------------------
 # Machinery: noqa, syntax errors, file walking, the CI gate.
 # ---------------------------------------------------------------------------
 
@@ -249,7 +282,8 @@ def test_lint_paths_walks_and_selects(tmp_path):
 
 
 def test_rule_table_is_complete():
-    assert set(RULES) == {"FPS001", "FPS002", "FPS003", "FPS004", "FPS005"}
+    assert set(RULES) == {"FPS001", "FPS002", "FPS003", "FPS004", "FPS005",
+                          "FPS006"}
 
 
 def test_package_lints_clean():

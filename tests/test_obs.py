@@ -112,6 +112,25 @@ def test_jsonl_sink_roundtrip(tmp_path):
     assert lines[1]["arr"] == [0, 1]
 
 
+def test_jsonl_sink_nonfinite_is_strict_json(tmp_path):
+    """The serving watcher legitimately sets a NaN gauge (orphaned
+    snapshot); the JSONL artifact must stay strict JSON — null, never
+    the Python-only NaN/Infinity tokens strict parsers reject."""
+    path = str(tmp_path / "ev.jsonl")
+    sink = obs.JsonlSink(path, flush_every=1)
+    sink.write({"kind": "metric", "name": "serve.snapshot_lag_steps",
+                "mtype": "gauge", "value": float("nan")})
+    sink.write({"kind": "metric", "name": "g", "mtype": "gauge",
+                "value": np.float32("inf")})
+    sink.write({"kind": "metric", "name": "ok", "value": 2.0})
+    sink.close()
+    raw = open(path).read()
+    assert "NaN" not in raw and "Infinity" not in raw
+    lines = [json.loads(l) for l in raw.splitlines()]
+    assert lines[0]["value"] is None and lines[1]["value"] is None
+    assert lines[2]["value"] == 2.0  # finite fast path untouched
+
+
 def test_prometheus_sink_exposition(tmp_path):
     path = str(tmp_path / "m.prom")
     sink = obs.PrometheusSink(path)
@@ -295,6 +314,14 @@ def test_checkpoint_save_and_fallback_events(tmp_path, devices8):
     saves = sink.events("checkpoint_saved")
     assert [e["step"] for e in saves] == [1, 2]
     assert all(e["bytes"] > 0 and e["seconds"] >= 0 for e in saves)
+    # The saved event must carry the published path (and the byte size
+    # above): the serving plane's SnapshotWatcher opens snapshots straight
+    # from these fields, no directory re-stat on the hot path.
+    from fps_tpu.core.checkpoint import SNAPSHOT_FMT
+
+    assert [e["path"] for e in saves] == [
+        str(tmp_path / "c" / SNAPSHOT_FMT.format(step=s)) for s in (1, 2)
+    ]
     fb = sink.events("checkpoint_fallback")
     assert len(fb) == 1 and fb[0]["step"] == 2
     assert rec.counter_value("checkpoint.saves") == 2

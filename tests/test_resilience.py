@@ -464,6 +464,107 @@ def jnp_arr(x):
     return jnp.asarray(np.asarray(x, np.float32))
 
 
+def test_guard_local_state_touched_unit_semantics():
+    """Ids-aware screening (touched_local_rows): row masking restricted
+    to the touched set; an untouched non-finite row is still CAUGHT at
+    the leaf tier (counted as nonfinite) but never masked — there is
+    nothing to revert it to."""
+    from fps_tpu.core.resilience import guard_local_state
+
+    # Row 0: pre-existing NaN in old AND new (untouched stale poison).
+    # Row 1: touched, this step wrote NaN. Row 3: touched, huge delta.
+    # Row 4: untouched, clean.
+    old = jnp_arr([[np.nan, 0.0], [1.0, 1.0], [2.0, 2.0],
+                   [3.0, 3.0], [4.0, 4.0]])
+    new = jnp_arr([[np.nan, 0.0], [np.nan, 1.0], [2.0, 2.0],
+                   [300.0, 3.0], [4.0, 4.0]])
+    guard = GuardConfig(mode="mask", norm_limit=10.0, local=True)
+    touched = (np.array([1, 3, -1], np.int32),)
+    guarded, counts = guard_local_state((old,), (new,), guard,
+                                        touched=touched)
+    got = np.asarray(guarded[0])
+    # Touched rows 1 and 3 reverted; untouched NaN row 0 NOT masked.
+    np.testing.assert_array_equal(got[1], [1.0, 1.0])
+    np.testing.assert_array_equal(got[3], [3.0, 3.0])
+    assert np.isnan(got[0, 0])
+    np.testing.assert_array_equal(got[4], [4.0, 4.0])
+    # nonfinite = touched row 1 + the leaf-tier net's untouched row 0.
+    assert int(counts["nonfinite"]) == 2
+    assert int(counts["norm"]) == 1
+    assert int(counts["masked"]) == 2
+
+    # Duplicate touched ids count per occurrence (the push guard's
+    # per-batch-row convention) and revert deterministically.
+    dup, counts = guard_local_state(
+        (old,), (new,), guard, touched=(np.array([1, 1], np.int32),))
+    np.testing.assert_array_equal(np.asarray(dup[0])[1], [1.0, 1.0])
+    assert int(counts["masked"]) == 2
+    # touched entry count must match the flattened leaves
+    with pytest.raises(ValueError, match="one entry per"):
+        guard_local_state((old,), (new,), guard, touched=())
+
+    # Out-of-range touched ids are inert like -1 (a WorkerLogic bug —
+    # e.g. global ids where local rows are expected — must not screen
+    # the clamped last row or count phantom reverts).
+    oor, counts = guard_local_state(
+        (old,), (new,), guard, touched=(np.array([99, 1], np.int32),))
+    np.testing.assert_array_equal(np.asarray(oor[0])[1], [1.0, 1.0])
+    np.testing.assert_array_equal(np.asarray(oor[0])[4], [4.0, 4.0])
+    # nonfinite = touched row 1 + leaf net's row 0; nothing from id 99.
+    assert int(counts["nonfinite"]) == 2
+    assert int(counts["masked"]) == 1
+
+
+def test_local_guard_ids_aware_untouched_rows_caught(devices8):
+    """ISSUE 7 satellite: MF exposes touched_local_rows, so the local
+    guard masks only rows the batch writes — and a NaN planted in an
+    UNTOUCHED user's local row is still caught by the leaf-tier net
+    (counted nonfinite every chunk, masked never: full-leaf screening
+    would have reported it as masked, which is the distinguishing
+    observable)."""
+    from fps_tpu.core.ingest import epoch_chunks
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+
+    mesh = make_ps_mesh(num_shards=4, num_data=2)
+    W = num_workers_of(mesh)
+    NU, POISON_USER = 57, 56
+    rng = np.random.default_rng(0)
+    n = 2000
+    data = {  # users only in [0, 40): users 40.. are never touched
+        "user": rng.integers(0, 40, n).astype(np.int32),
+        "item": rng.integers(0, 31, n).astype(np.int32),
+        "rating": rng.normal(0, 1, n).astype(np.float32),
+    }
+    cfg = MFConfig(num_users=NU, num_items=31, rank=4, learning_rate=0.1)
+    trainer, store = online_mf(mesh, cfg,
+                               guard=GuardConfig(mode="mask", local=True))
+    assert trainer.logic.touched_local_rows(
+        {"user": jnp_arr([3]), "weight": jnp_arr([1.0])}) is not None
+    tables, ls = trainer.init_state(jax.random.key(0))
+
+    # Plant NaN in the untouched user's local row (owner-major layout).
+    rps = -(-NU // W)
+    phys = (POISON_USER % W) * rps + POISON_USER // W
+    host = np.asarray(ls).copy()
+    host[phys] = np.nan
+    ls = jax.device_put(host, ls.sharding)
+
+    chunks = list(epoch_chunks(data, num_workers=W, local_batch=8,
+                               steps_per_chunk=4, route_key="user", seed=0))
+    tables, ls, m = trainer.fit_stream(tables, ls, iter(chunks),
+                                       jax.random.key(1))
+    nf = _health_sum(m, "local_state", "nonfinite")
+    mk = _health_sum(m, "local_state", "masked")
+    assert nf > 0, "untouched-row NaN must be caught at the leaf tier"
+    assert mk == 0, ("ids-aware screening must not mask outside the "
+                     "touched set (full-leaf screening would)")
+    out = np.asarray(ls)
+    assert np.all(np.isnan(out[phys])), "nothing can revert untouched NaN"
+    mask = np.ones(len(out), bool)
+    mask[phys] = False
+    assert np.all(np.isfinite(out[mask]))
+
+
 def test_local_guard_reserved_table_name_rejected(devices8):
     """A store table literally named 'local_state' + guard.local would
     collide on the health channel: rejected at Trainer construction."""

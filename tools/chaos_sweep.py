@@ -29,6 +29,14 @@ fps_tpu.testing.workloads):
   restarts the child once, nothing is quarantined (one crash is not
   determinism evidence), and the resumed pipeline-on run reproduces a
   straight pipeline-on run bit-for-bit.
+* ``serve_while_train``        — a concurrent ``fps_tpu.serve``
+  ReadServer polls the supervised child's checkpoint dir while the child
+  is SIGKILLed mid-run and a torn full-named snapshot candidate is
+  planted: survives iff readers never observe a torn, CRC-failing, or
+  backward-moving table, the torn candidate is rejected, the reader
+  converges on the newest valid snapshot byte-for-byte, and a post-run
+  quarantine of the served snapshot swaps the reader BACKWARD
+  (``docs/serving.md``).
 * ``hot_tier_kill``            — SIGKILL between hot-tier reconciles
   under the supervisor (two-tier storage on, ``--hot-tier``/
   ``--hot-sync-every``): survives iff the restart restores from the
@@ -219,6 +227,13 @@ def main():
 
         results["hot_tier_kill"], detail["hot_tier_kill"] = (
             run_hot_tier_kill_scenario(d))
+    with tempfile.TemporaryDirectory() as d:
+        from fps_tpu.testing.supervised_demo import (
+            run_serve_while_train_scenario,
+        )
+
+        results["serve_while_train"], detail["serve_while_train"] = (
+            run_serve_while_train_scenario(d))
 
     digest = {
         "chaos_sweep": results,

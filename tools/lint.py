@@ -1,11 +1,12 @@
 """Run the fps_tpu jax-hazard linter over the tree and report findings.
 
 The CLI over :mod:`fps_tpu.analysis.lint` — the AST layer of the program
-contract auditor (``docs/analysis.md``). Rules (FPS001–FPS005): late-
+contract auditor (``docs/analysis.md``). Rules (FPS001–FPS006): late-
 bound closures over loop variables, boolean branches on jnp predicates,
 unsorted dict iteration inside compiled-fn builders, thread-starting
-classes without a synchronization primitive, and internal imports of the
-``utils.profiling`` compat shim.
+classes without a synchronization primitive, internal imports of the
+``utils.profiling`` compat shim, and raw ``open()``/``np.load`` of
+checkpoint files outside the CRC-verified readers.
 
 CI contract: ``tests/test_lint.py`` runs this over ``fps_tpu/`` as a
 tier-1 test expecting ZERO findings — a new hazard fails the suite with

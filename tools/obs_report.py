@@ -21,6 +21,10 @@ join on ``run_id``) and prints a single JSON digest:
   replicated hot head over total pulled rows) and the last/max
   pending-delta gauge (parameter-plane staleness;
   `docs/performance.md` "Two-tier storage");
+* **serve** — read-path tier (`fps_tpu.serve`): requests/rows served,
+  exact p50/p99 request latency, the served step + step lag + the
+  write→servable freshness SLO gauges, forward/backward swap counts, and
+  rejected (CRC-failing) snapshot candidates (`docs/serving.md`);
 * **incidents** — rollbacks, watchdog stalls (+ recoveries), guard
   escalations, health aborts, checkpoint fallbacks, checkpoint saves —
   plus, from the supervisor journal, `deadline_abort` events whose
@@ -45,6 +49,7 @@ import argparse
 import collections
 import glob
 import json
+import math
 import os
 import sys
 
@@ -75,8 +80,17 @@ REQUIRED_FIELDS = (
     "obs_dir", "run_ids", "processes", "chunks", "epochs", "steps",
     "examples", "phase_seconds", "health", "incidents", "checkpoint_saves",
     "quarantined", "wall_span_s", "prefetch", "hot_tier", "source_stalls",
-    "analysis",
+    "analysis", "serve",
 )
+
+
+def _quantile(sorted_vals: list, q: float):
+    """Exact quantile over a sorted sample list (the ReadServer
+    reservoir's index formula, so the two reports agree)."""
+    n = len(sorted_vals)
+    if not n:
+        return None
+    return sorted_vals[min(n - 1, int(q * (n - 1) + 0.5))]
 
 
 def _read_jsonl(path: str):
@@ -108,6 +122,8 @@ def render_digest(obs_dir: str) -> dict:
 
     counters: dict[str, float] = collections.defaultdict(float)
     gauges: dict[str, dict] = {}  # name -> {"last": v, "max": v}
+    serve_latency: list[float] = []  # serve.request_seconds samples
+    swap_directions: dict[str, int] = collections.defaultdict(int)
     phases: dict[str, dict] = {}
     health: dict[str, dict] = {}
     incidents: dict[str, list] = {k: [] for k in _INCIDENT_EVENTS}
@@ -150,7 +166,10 @@ def render_digest(obs_dir: str) -> dict:
         if kind == "metric":
             name = rec.get("name", "")
             labels = rec.get("labels") or {}
-            v = float(rec.get("value", 0.0))
+            raw = rec.get("value", 0.0)
+            # A null value is the strict-JSON spelling of a non-finite
+            # sample (the serving watcher's orphaned-snapshot gauge).
+            v = math.nan if raw is None else float(raw)
             if name == "driver.phase_seconds":
                 ph = phases.setdefault(
                     labels.get("phase", "?"),
@@ -165,7 +184,11 @@ def render_digest(obs_dir: str) -> dict:
                 health.setdefault(
                     table, {"nonfinite": 0, "norm": 0, "masked": 0}
                 )[tier] += int(v)
+            elif name == "serve.request_seconds":
+                serve_latency.append(v)
             elif rec.get("mtype") == "counter":
+                if name == "serve.swaps":
+                    swap_directions[labels.get("direction", "?")] += int(v)
                 counters[name] += v
             elif rec.get("mtype") == "gauge":
                 # "last" by record TIMESTAMP, not file-iteration order —
@@ -175,7 +198,11 @@ def render_digest(obs_dir: str) -> dict:
                     name, {"last": v, "last_t": t, "max": v})
                 if t >= g["last_t"]:
                     g["last"], g["last_t"] = v, t
-                g["max"] = max(g["max"], v)
+                # Non-finite samples mark outages; they must not poison
+                # the max (which would turn order-dependently NaN).
+                if math.isfinite(v):
+                    g["max"] = (v if not math.isfinite(g["max"])
+                                else max(g["max"], v))
         elif kind == "event":
             fold_event(rec)
 
@@ -244,6 +271,29 @@ def render_digest(obs_dir: str) -> dict:
             "contract_violations": int(
                 counters.get("analysis.contract_violations", 0)),
         },
+        # Read-path serving tier (fps_tpu.serve; docs/serving.md): query
+        # volume, exact request-latency quantiles over every recorded
+        # sample, the freshness gauges (served step, step lag, the
+        # write->servable SLO), and the swap trail — backward swaps mean
+        # the trainer quarantined a served snapshot and readers rolled
+        # back with it.
+        "serve": {
+            "requests": int(counters.get("serve.requests", 0)),
+            "rows": int(counters.get("serve.rows", 0)),
+            "latency_p50_s": _quantile(sorted(serve_latency), 0.5),
+            "latency_p99_s": _quantile(sorted(serve_latency), 0.99),
+            "snapshot_step_last": gauges.get(
+                "serve.snapshot_step", {}).get("last"),
+            "snapshot_lag_steps_last": gauges.get(
+                "serve.snapshot_lag_steps", {}).get("last"),
+            "write_to_servable_s_last": gauges.get(
+                "serve.write_to_servable_s", {}).get("last"),
+            "write_to_servable_s_max": gauges.get(
+                "serve.write_to_servable_s", {}).get("max"),
+            "swaps": dict(sorted(swap_directions.items())),
+            "rejected_snapshots": int(
+                counters.get("serve.rejected_snapshots", 0)),
+        },
         # Supervisor deadline aborts whose last heartbeat was a stalled
         # 'prefetch'-phase beat: the SOURCE wedged, not the driver.
         "source_stalls": sum(
@@ -290,7 +340,21 @@ def main(argv=None) -> int:
     except FileNotFoundError as e:
         print(str(e), file=sys.stderr)
         return 2
-    print(json.dumps(digest, indent=2 if args.pretty else None))
+    # Strict JSON out: a NaN gauge (serving outage marker) prints as
+    # null, never the Python-only NaN token — the digest's consumers
+    # include jq and non-Python tooling. Mirrors
+    # fps_tpu.obs.sinks.scrub_nonfinite (this tool stays import-free).
+    def scrub(x):
+        if isinstance(x, dict):
+            return {k: scrub(v) for k, v in x.items()}
+        if isinstance(x, list):
+            return [scrub(v) for v in x]
+        if isinstance(x, float) and not math.isfinite(x):
+            return None
+        return x
+
+    print(json.dumps(scrub(digest), indent=2 if args.pretty else None,
+                     allow_nan=False))
     return 0
 
 

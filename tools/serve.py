@@ -1,0 +1,130 @@
+"""Serve a training run's snapshots to query traffic — no jax required.
+
+The CLI over :mod:`fps_tpu.serve` (``docs/serving.md``): point it at a
+run's ``--checkpoint-dir`` and it discovers, CRC-verifies, and mmaps the
+newest snapshot (``SnapshotWatcher``), answers pull-by-id / scoring /
+top-k queries over line-JSON TCP (``TcpServe``), and hot-swaps to every
+newer snapshot the trainer publishes — including swapping BACKWARD when
+the trainer quarantines the served one. Optionally tails the run's obs
+journal (``--journal OBS_DIR``) so new publishes are picked up from
+``checkpoint_saved`` events without directory re-stats.
+
+Modes:
+
+* default — serve forever: print one ``{"event": "serving", ...}`` JSON
+  line with the bound host/port, then poll every ``--poll-s`` seconds.
+* ``--once`` — poll once, print the served manifest (or an error), exit.
+* ``--query JSON`` — client mode: connect to ``--host``/``--port``, send
+  one request line, print the response. No server is started.
+
+No jax import anywhere on these paths: the fps_tpu package roots are
+stubbed (the ``tools/audit_programs.py --hlo`` pattern) so the serving
+process stays a few-MB pure-python/numpy reader even on a host whose
+training job owns every accelerator — and runs on machines with no
+accelerator runtime installed at all (asserted by a jax-poisoned
+subprocess test in ``tests/test_serve.py``).
+
+Usage:
+  python tools/serve.py CKPT_DIR [--journal OBS_DIR] [--port N]
+  python tools/serve.py CKPT_DIR --once
+  python tools/serve.py --query '{"op": "stats"}' --port N
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+import types
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_serve():
+    """Import ``fps_tpu.serve`` WITHOUT executing ``fps_tpu/__init__`` or
+    ``fps_tpu/core/__init__`` (both pull jax): stub root packages whose
+    ``__path__`` points at the real directories, then import the
+    subpackage normally — serve, core.snapshot_format, and obs are all
+    stdlib+numpy."""
+    for name, sub in (("fps_tpu", ()), ("fps_tpu.core", ("core",))):
+        if name not in sys.modules:
+            stub = types.ModuleType(name)
+            stub.__path__ = [os.path.join(_ROOT, "fps_tpu", *sub)]
+            sys.modules[name] = stub
+    return importlib.import_module("fps_tpu.serve")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Serve an fps_tpu run's snapshots over line-JSON TCP "
+                    "(fps_tpu.serve; jax-free)")
+    ap.add_argument("ckpt_dir", nargs="?", default=None,
+                    help="the run's --checkpoint-dir (required unless "
+                         "--query)")
+    ap.add_argument("--journal", default=None, metavar="PATH",
+                    help="obs journal file or --obs-dir directory to tail "
+                         "for checkpoint_saved events (the directory poll "
+                         "stays on as the source of truth)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="TCP port (0 = ephemeral; the bound port is "
+                         "printed in the 'serving' line)")
+    ap.add_argument("--poll-s", type=float, default=0.5,
+                    help="snapshot discovery poll interval")
+    ap.add_argument("--max-polls", type=int, default=None,
+                    help="stop after this many polls (tests; default: "
+                         "run until interrupted)")
+    ap.add_argument("--once", action="store_true",
+                    help="poll once, print the served manifest, exit "
+                         "(no TCP)")
+    ap.add_argument("--query", default=None, metavar="JSON",
+                    help="client mode: send one request line to "
+                         "--host/--port and print the response")
+    args = ap.parse_args(argv)
+
+    if args.query is not None:
+        serve = load_serve()
+        if not args.port:
+            ap.error("--query needs --port")
+        with serve.JsonlClient(args.host, args.port) as client:
+            print(json.dumps(client.request(json.loads(args.query))))
+        return 0
+
+    if args.ckpt_dir is None:
+        ap.error("ckpt_dir is required (or use --query)")
+    serve = load_serve()
+    server, watcher = serve.ReadServer.over(args.ckpt_dir,
+                                            journal=args.journal)
+    if args.once:
+        if watcher.current is None:
+            print(json.dumps({"event": "no_snapshot",
+                              "ckpt_dir": args.ckpt_dir,
+                              "rejected": watcher.rejected}))
+            return 1
+        print(json.dumps({"event": "manifest",
+                          **watcher.current.manifest(),
+                          "rejected": watcher.rejected}))
+        return 0
+
+    with serve.TcpServe(server, host=args.host, port=args.port) as tcp:
+        print(json.dumps({
+            "event": "serving", "host": tcp.host, "port": tcp.port,
+            "ckpt_dir": os.path.abspath(args.ckpt_dir),
+            "step": None if watcher.current is None
+            else watcher.current.step,
+        }), flush=True)
+        try:
+            watcher.run(interval_s=args.poll_s, max_polls=args.max_polls)
+        except KeyboardInterrupt:
+            pass
+    stats = server.stats()
+    stats.update(swaps=dict(watcher.swaps), rejected=watcher.rejected,
+                 write_to_servable_s=watcher.write_to_servable_s)
+    print(json.dumps({"event": "served", **stats}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
