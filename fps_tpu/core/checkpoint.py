@@ -576,14 +576,15 @@ class Checkpointer:
                     f"store spec ({spec.num_ids}, {spec.dim})"
                 )
             load_rows(store, name, np.arange(len(values)), values)
-        # Any live hot-replica entries (two-tier storage) are projections
-        # of the state just overwritten — stale now. Drop them so the
-        # run-entry re-split (Trainer._attach_hot) derives fresh replicas
-        # from the restored canonical tables instead of silently serving
-        # pre-restore values.
-        from fps_tpu.core.store import is_hot_key
+        # Any live tiering aux entries (hot replicas, adaptive slot maps,
+        # tracker sketches) are projections of — or windows over — the
+        # state just overwritten: stale now. Drop them all so the
+        # run-entry re-split (Trainer._attach_hot) derives fresh entries
+        # from the restored canonical tables (and the restored tracker
+        # state) instead of silently serving pre-restore values.
+        from fps_tpu.core.store import is_aux_key
 
-        for key in [k for k in store.tables if is_hot_key(k)]:
+        for key in [k for k in store.tables if is_aux_key(k)]:
             del store.tables[key]
         return dict(store.tables)
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import logging
+import warnings
 from typing import Any, Callable, Iterable, Mapping
 
 import jax
@@ -40,21 +41,32 @@ from fps_tpu.core import resilience
 from fps_tpu.core.api import ServerLogic, WorkerLogic
 from fps_tpu.core.prefetch import ChunkPrefetcher, PlacedChunk
 from fps_tpu.core.resilience import GuardConfig, RollbackPolicy
+from fps_tpu import sketch as _sketch
 from fps_tpu.core.store import (
+    IDS_KEY_SUFFIX,
+    MAP_KEY_SUFFIX,
+    SKETCH_KEY_SUFFIX,
     ParamStore,
     accumulate_hot,
     hot_base,
     hot_delta_init,
     hot_key,
+    hot_slot_map,
     id_to_phys,
+    ids_key,
+    is_aux_key,
     is_hot_key,
+    lookup_hot_slots,
+    map_key,
     pull,
     pull_hot,
-    pull_local,
     push,
     reconcile_hot,
-    split_hot,
+    reconcile_hot_mapped,
+    sketch_key,
     split_hot_push,
+    split_hot_push_slots,
+    split_tiering,
 )
 from fps_tpu.obs.health import (
     HEALTH_ABORT,
@@ -213,6 +225,17 @@ class TrainerConfig:
     # canonical table (checkpoints/rollback need no special casing).
     # Part of the compile-cache key.
     hot_sync_every: int = 1
+    # Adaptive tiering (fps_tpu.tiering; docs/performance.md "Adaptive
+    # tiering"): True auto-attaches a Retierer at run entry — online
+    # pulled-id frequency tracking (device-side count-min windows,
+    # psum-merged), an auto-tiering plan derived from the sketched
+    # densities after a warmup (per-table hot_tier / hot_sync_every /
+    # dense route — replaces hand-tuning those three knobs), and
+    # churn-triggered hot-set re-ranks that swap the replica + slot-map
+    # DATA without recompiling. Attach ``trainer.retierer`` directly for
+    # non-default cadences/thresholds/persistence. Host-only flag: the
+    # compile key derives from the retierer's resolution, not this bool.
+    auto_tier: bool = False
     # Upper bound on scan steps per compiled call in run_indexed. A single
     # device program must not run for minutes (the TPU runtime enforces a
     # per-dispatch execution deadline — observed ~45s on tunneled chips,
@@ -294,6 +317,13 @@ class Trainer:
             )
         self.num_shards = mesh.shape[SHARD_AXIS]
         self.num_workers = num_workers_of(mesh)
+        # Adaptive tiering (fps_tpu.tiering.Retierer) — host-side hot-set
+        # manager. Assignable after construction, BEFORE the first
+        # compiled call (mapped-tier/tracking resolution is part of the
+        # compile key, like the guard); TrainerConfig.auto_tier attaches
+        # a default one at run entry.
+        self.retierer = None
+        self._tier_warned: set[str] = set()
 
         self._table_sharding = NamedSharding(mesh, P(SHARD_AXIS, None))
         self._worker_sharding = NamedSharding(mesh, P(WORKER_AXES))
@@ -490,6 +520,27 @@ class Trainer:
             return 0
         sl = self.server_logic[spec.name]
         if sl.apply_fn is not None or sl.combine not in ("sum", "mean"):
+            # The one SURPRISING disengagement: single-device meshes and
+            # hot_sync_every=1 are documented expected states, but a
+            # requested tier silently falling back because of the server
+            # fold hides a real semantic limit (windowed delta sums
+            # cannot commute with apply_fn/max/min/callable combines) —
+            # say so once, explicitly.
+            if spec.name not in self._tier_warned:
+                self._tier_warned.add(spec.name)
+                fold = ("apply_fn" if sl.apply_fn is not None
+                        else f"combine={sl.combine!r}"
+                        if isinstance(sl.combine, str)
+                        else "a callable combine")
+                msg = (
+                    f"table {spec.name!r}: hot_tier={H} requested but the "
+                    f"non-additive server fold ({fold}) keeps the gathered "
+                    "route — windowed hot-delta accumulation only commutes "
+                    "with 'sum'/'mean' folds, so the tier is disabled for "
+                    "this table (the program lowers untiered)"
+                )
+                warnings.warn(msg, stacklevel=2)
+                _log.warning("%s", msg)
             return 0
         return min(int(H), spec.num_ids)
 
@@ -510,43 +561,152 @@ class Trainer:
             )
         return tier
 
+    def _mapped_tables(self) -> dict[str, int]:
+        """{table: H} for tables on the ADAPTIVE (mapped) tier: the
+        replica's membership is an arbitrary hot id set carried as
+        replicated slot-map/gid DATA arrays, so the attached Retierer
+        can re-rank without recompiling. Engages for tiered tables with
+        a partial head (0 < H < num_ids) under a Retierer; full
+        replication keeps the static elision (every id is hot — there
+        is nothing to re-rank), and without a Retierer the static
+        frequency-ranked head of old is lowered unchanged. Part of the
+        compile-cache key."""
+        if self.retierer is None:
+            return {}
+        out = {}
+        for name, H in sorted(self._hot_tier_map().items()):
+            if (H < self.store.specs[name].num_ids
+                    and self.retierer.manages(name)):
+                out[name] = H
+        return out
+
+    def _track_specs(self) -> dict:
+        """{table: CountMinSpec} for tables whose pulled ids the
+        compiled step sketches device-side (fps_tpu.sketch count-min
+        windows, psum-merged across the mesh at the end of each call).
+        Empty without a Retierer — the tracked program differs from the
+        untiered one, so this is part of the compile-cache key.
+
+        Sketching is paid only where a decision can consume it: during
+        an auto-plan warmup every managed table (the planner needs
+        densities for all of them); afterwards — or when the knobs were
+        set by hand — only tables the RESOLVED tier actually maps
+        (0 < H < num_ids, the re-rankable regime). Gating on the
+        resolution, not the raw spec, keeps the documented
+        disengagement states honest: hot_sync_every=1 / single-device /
+        non-additive folds still lower the exact untiered program even
+        with a Retierer attached (tested)."""
+        if self.retierer is None:
+            return {}
+        track = self.retierer.track_specs(self.store.specs)
+        if self.retierer.auto_plan and not self.retierer.planned:
+            return track
+        mapped = self._mapped_tables()
+        return {n: cm for n, cm in sorted(track.items()) if n in mapped}
+
     def _attach_hot(self, tables, timer=None):
-        """Entry-point re-split: make ``tables`` carry exactly the replica
-        entries the current tier resolution calls for.
+        """Entry-point re-split: make ``tables`` carry exactly the
+        tiering aux entries the current resolution calls for — hot
+        replicas (static AND mapped), the adaptive tier's slot-map/gid
+        arrays, and the tracker's device sketch windows.
 
         Replicas are derived from the canonical sharded table — valid at
         any call boundary because every compiled call ends with a flush
         reconcile. Covers every way state reaches a run: ``init_state``,
         ``restore_checkpoint`` (a checkpoint is one canonical table;
-        this is the re-split), warm starts, and config changes between
-        runs (stale/resized replicas are dropped and re-derived; a tier
-        turned off strips its replica so the lowered program is the
-        untiered one again). Idempotent and O(specs) when nothing
-        changed, so the per-chunk call from ``run_chunk`` costs dict
-        lookups only.
+        this is the re-split — mapped membership and sketch windows come
+        from the Retierer, sidecar-restored under supervision), warm
+        starts, and config changes between runs (stale/resized entries
+        are dropped and re-derived; a tier turned off strips its entries
+        so the lowered program is the untiered one again). Idempotent
+        and O(specs) when nothing changed, so the per-chunk call from
+        ``run_chunk`` costs dict lookups only.
         """
         tier = self._hot_tier_map()
-        if not tier and not any(is_hot_key(k) for k in tables):
+        mapped = self._mapped_tables()
+        track = self._track_specs()
+        if not (tier or track) and not any(is_aux_key(k) for k in tables):
             return tables
         out = {}
         for k, v in tables.items():
-            if not is_hot_key(k):
+            if not is_aux_key(k):
                 out[k] = v
-                continue
-            name = hot_base(k)
-            if name in tier and v.shape[0] == tier[name]:
-                out[k] = v  # live, correctly-sized replica: keep as is
-        missing = [name for name in tier if hot_key(name) not in out]
-        if not missing:
+            elif is_hot_key(k):
+                name = hot_base(k)
+                if name in tier and v.shape[0] == tier[name]:
+                    out[k] = v  # live, correctly-sized replica: keep
+            elif k.endswith(MAP_KEY_SUFFIX):
+                name = k[: -len(MAP_KEY_SUFFIX)]
+                if (name in mapped and v.shape[0]
+                        == self.store.specs[name].num_ids + 1):
+                    out[k] = v
+            elif k.endswith(IDS_KEY_SUFFIX):
+                name = k[: -len(IDS_KEY_SUFFIX)]
+                if name in mapped and v.shape[0] == mapped[name]:
+                    out[k] = v
+            elif k.endswith(SKETCH_KEY_SUFFIX):
+                name = k[: -len(SKETCH_KEY_SUFFIX)]
+                cm = track.get(name)
+                if cm is not None and v.shape == (cm.depth, cm.width):
+                    out[k] = v
+        missing_hot = [n for n in sorted(tier) if hot_key(n) not in out]
+        missing_map = [n for n in sorted(mapped)
+                       if map_key(n) not in out or ids_key(n) not in out]
+        missing_sk = [n for n in sorted(track)
+                      if sketch_key(n) not in out]
+        if not (missing_hot or missing_map or missing_sk):
             return out
         # Only an actual derivation pays (and records) the reconcile
         # phase — the steady-state per-chunk call is pure dict checks.
         with _phase(timer, "reconcile"):
-            for name in missing:
-                out[hot_key(name)] = self.store.head_replica(
-                    name, tier[name], out[name]
-                )
+            for name in missing_hot:
+                if name in mapped:
+                    gids = self.retierer.hot_ids_for(name, mapped[name])
+                    out[hot_key(name)] = self.store.rows_replica(
+                        name, gids, out[name])
+                else:
+                    out[hot_key(name)] = self.store.head_replica(
+                        name, tier[name], out[name])
+            for name in missing_map:
+                gids = self.retierer.hot_ids_for(name, mapped[name])
+                out[ids_key(name)] = jax.device_put(
+                    np.asarray(gids, np.int32), self._replicated)
+                out[map_key(name)] = jax.device_put(
+                    hot_slot_map(self.store.specs[name].num_ids, gids),
+                    self._replicated)
+            for name in missing_sk:
+                cm = track[name]
+                win = (self.retierer.device_window(name)
+                       if self.retierer is not None else None)
+                if win is None or win.shape != (cm.depth, cm.width):
+                    win = np.zeros((cm.depth, cm.width), np.float32)
+                out[sketch_key(name)] = jax.device_put(
+                    np.asarray(win, np.float32), self._replicated)
         return out
+
+    def _enter_tiering(self) -> None:
+        """Run-entry adaptive-tiering hook (both drivers): auto-attach
+        the default Retierer when ``TrainerConfig.auto_tier`` asks for
+        one, and re-apply a (sidecar-)restored plan so the tier
+        resolution — and with it the compile key — matches the
+        interrupted run before the first compiled call."""
+        if self.config.auto_tier and self.retierer is None:
+            from fps_tpu.tiering import Retierer
+
+            self.retierer = Retierer.auto_for(self)
+        if self.retierer is not None:
+            if self.retierer.auto_plan and self.config.push_delay:
+                # Same contract as the explicit hot_tier+push_delay
+                # rejection, enforced at run entry instead of blowing up
+                # at the first check boundary when the planner's tier
+                # lands mid-run.
+                raise ValueError(
+                    "auto_tier and push_delay cannot combine: the "
+                    "planner would enable a hot tier whose windowed "
+                    "reconcile re-orders against the delayed-push ring "
+                    "buffer. Disable one."
+                )
+            self.retierer.on_run_entry(self)
 
     def _head_prefix(self, batch) -> dict:
         """Resolve the worker's head-prefix guarantee for this batch.
@@ -599,41 +759,45 @@ class Trainer:
         return new_tables
 
     def _compute_step(self, tables, snapshot, local_state, batch, key,
-                      hot=None, tier=None):
+                      hot=None, tier=None, maps=None, track=None,
+                      sk=None):
         """Pull (from live tables, or the SSP ``snapshot`` when given), run
         the worker step, and return its pushes WITHOUT applying them,
-        plus the (static) head-prefix guarantee for those pushes and the
+        plus the (static) head-prefix guarantee for those pushes, the
         hot-tier pull accounting ({} when the tier is off — nothing extra
-        is traced then).
+        is traced then), and the updated sketch accumulators.
 
         ``hot``/``tier``: the replicated hot-head arrays and the resolved
-        {table: H} map. Sync-mode pulls partition on ``id < H``: hot rows
-        are a LOCAL replica gather (zero collectives — when H covers the
-        whole table the collective route is statically elided outright);
-        cold rows ride the existing routes with hot slots masked to -1
-        (the zero-row contract). SSP pulls already read a local snapshot
-        whose head rows match the replica (reconcile precedes each round's
-        gather), so they stay untouched.
+        {table: H} map. Sync-mode pulls partition on hot membership: hot
+        rows are a LOCAL replica gather (zero collectives — when H covers
+        the whole table the collective route is statically elided
+        outright); cold rows ride the existing routes with hot slots
+        masked to -1 (the zero-row contract). Membership is ``id < H``
+        on the static tier, or a replicated slot-map lookup on the
+        ADAPTIVE tier (``maps`` — arbitrary hot id set as DATA, so
+        re-ranks never recompile). SSP pulls already read a local
+        snapshot whose head rows match the replica (reconcile precedes
+        each round's gather), so they stay untouched.
+
+        ``track``/``sk``: online frequency tracking — every tracked
+        table's pulled ids fold into its count-min window accumulator
+        (a local scatter-add; the psum merge happens once per call).
         """
         tier = tier or {}
+        maps = maps or {}
+        track = track or {}
         key, prep_key = jax.random.split(key)
         batch = self.logic.prepare(batch, prep_key)
         ids = self.logic.pull_ids(batch)
         hp = self._head_prefix(batch)
+        if track:
+            sk = dict(sk)
+            with jax.named_scope("fps.sketch"):
+                for name in sorted(track):
+                    if name in ids:
+                        sk[name] = _sketch.cm_update(
+                            track[name], sk[name], ids[name])
         hot_counts = {}
-        if snapshot is None:
-            # Hit-rate accounting only where the replica actually serves
-            # the reads: SSP pulls come from the per-round snapshot, so
-            # counting them would misattribute snapshot gathers as
-            # collective-free tier hits.
-            for name, tids in ids.items():
-                H = tier.get(name, 0)
-                if H:
-                    live = jnp.sum(tids >= 0, dtype=jnp.int32)
-                    nhot = jnp.sum((tids >= 0) & (tids < H),
-                                   dtype=jnp.int32)
-                    hot_counts[name] = {"hot_rows": nhot,
-                                        "pulled_rows": live}
         # fps.pull / fps.compute named scopes: device-timeline attribution
         # for the phases the host PhaseTimer cannot split (pull, worker
         # compute, and push fuse into one dispatch) — pure op metadata,
@@ -644,16 +808,39 @@ class Trainer:
                 for name, tids in ids.items():
                     H = tier.get(name, 0)
                     spec = self.store.specs[name]
+                    # Hit-rate accounting only where the replica actually
+                    # serves the reads: SSP pulls come from the per-round
+                    # snapshot, so counting them would misattribute
+                    # snapshot gathers as collective-free tier hits.
+                    if H:
+                        live = jnp.sum(tids >= 0, dtype=jnp.int32)
                     if H >= spec.num_ids:
                         # Fully-replicated table: the collective route is
                         # statically gone — a plain local gather.
                         pulled[name] = ops.gather_rows(hot[name], tids)
+                        hot_counts[name] = {"hot_rows": live,
+                                            "pulled_rows": live}
                         continue
-                    if H:
+                    if H and name in maps:
+                        # Adaptive tier: membership by slot-map lookup.
+                        slot = lookup_hot_slots(maps[name], tids)
+                        hmask = slot >= 0
+                        hot_vals = ops.gather_rows(
+                            hot[name],
+                            jnp.where(hmask, slot,
+                                      jnp.asarray(-1, slot.dtype)))
+                        tids = jnp.where(hmask,
+                                         jnp.asarray(-1, tids.dtype), tids)
+                    elif H:
                         hot_vals, hmask = pull_hot(hot[name], tids,
                                                    hot_ids=H)
                         tids = jnp.where(hmask,
                                          jnp.asarray(-1, tids.dtype), tids)
+                    if H:
+                        hot_counts[name] = {
+                            "hot_rows": jnp.sum(hmask, dtype=jnp.int32),
+                            "pulled_rows": live,
+                        }
                     vals = pull(
                         tables[name], tids, num_shards=self.num_shards,
                         dense=self._resolve_dense(spec),
@@ -719,7 +906,7 @@ class Trainer:
                         "key — it would collide with the guard's counters"
                     )
                 outch = dict(outch, **{resilience.HEALTH_KEY: health})
-        return pushes, new_local, outch, hp, hot_counts
+        return pushes, new_local, outch, hp, hot_counts, sk
 
     # -- delayed pushes (async in-flight emulation) ------------------------
 
@@ -840,12 +1027,16 @@ class Trainer:
             for name, H in tier.items()
         }
 
-    def _apply_hot_split(self, tables, delta, pushes, tier, hp):
-        """Partition each table's pushes on ``id < H``, apply the cold
-        part through the existing routes (statically elided when H covers
-        the table) and fold the hot part into the pending buffers."""
+    def _apply_hot_split(self, tables, delta, pushes, tier, hp,
+                         maps=None):
+        """Partition each table's pushes on hot membership (``id < H``
+        statically, or the adaptive tier's slot-map lookup), apply the
+        cold part through the existing routes (statically elided when H
+        covers the table) and fold the hot part into the pending
+        buffers."""
         if not tier:
             return self._apply_pushes(tables, pushes, hp), delta
+        maps = maps or {}
         cold_pushes = {}
         new_delta = dict(delta)
         with jax.named_scope("fps.hot_accumulate"):
@@ -857,6 +1048,13 @@ class Trainer:
                 spec = self.store.specs[name]
                 if H >= spec.num_ids:
                     hots = (pids, pdeltas)  # no cold residue to push
+                elif name in maps:
+                    # Adaptive tier: the hot half lands in SLOT space —
+                    # the delta buffer is slot-indexed like the replica.
+                    slot = lookup_hot_slots(maps[name], pids)
+                    cold_pushes[name], hots = split_hot_push_slots(
+                        pids, pdeltas, slot
+                    )
                 else:
                     cold_pushes[name], hots = split_hot_push(
                         pids, pdeltas, hot_ids=H
@@ -866,26 +1064,41 @@ class Trainer:
                 )
         return self._apply_pushes(tables, cold_pushes, hp), new_delta
 
-    def _reconcile_carry(self, carry, tier):
+    def _reconcile_carry(self, carry, tier, gids=None):
         """Window-boundary reconcile over every tiered table (identity
         when untiered): one psum per table folds the pending buffers into
-        replica + canonical table and zeroes the buffers."""
+        replica + canonical table and zeroes the buffers. ``gids`` maps
+        adaptive-tier tables to their replicated slot->global-id arrays
+        (DATA — the mapped reconcile scatters into whichever canonical
+        rows the current ranking names, without recompiling)."""
         if not tier:
             return carry
+        gids = gids or {}
         tables, hot, delta = carry[0], carry[1], carry[2]
         tables, hot, delta = dict(tables), dict(hot), dict(delta)
         data_axis = DATA_AXIS if self.mesh.shape[DATA_AXIS] > 1 else None
         with jax.named_scope("fps.reconcile"):
             for name, H in tier.items():
-                tables[name], hot[name], delta[name] = reconcile_hot(
-                    tables[name], hot[name], delta[name],
-                    num_shards=self.num_shards,
-                    data_axis=data_axis,
-                    mean=self._hot_mean(name),
-                )
+                if name in gids:
+                    tables[name], hot[name], delta[name] = (
+                        reconcile_hot_mapped(
+                            tables[name], hot[name], delta[name],
+                            gids[name],
+                            num_shards=self.num_shards,
+                            data_axis=data_axis,
+                            mean=self._hot_mean(name),
+                        ))
+                else:
+                    tables[name], hot[name], delta[name] = reconcile_hot(
+                        tables[name], hot[name], delta[name],
+                        num_shards=self.num_shards,
+                        data_axis=data_axis,
+                        mean=self._hot_mean(name),
+                    )
         return (tables, hot, delta) + tuple(carry[3:])
 
-    def _windowed_scan(self, step, carry0, tier, *, head, tail):
+    def _windowed_scan(self, step, carry0, tier, *, head, tail,
+                       gids=None):
         """Scan in reconcile windows: ``head`` is the stacked xs of the
         full windows (leading dims ``(R, E)``, or None when R == 0),
         ``tail`` the ragged remainder's xs (or None). Each window — and
@@ -896,7 +1109,7 @@ class Trainer:
 
         def window_body(c, xs_w):
             c, o = lax.scan(step, c, xs_w)
-            return self._reconcile_carry(c, tier), o
+            return self._reconcile_carry(c, tier, gids), o
 
         parts, carry = [], carry0
         if head is not None:
@@ -905,7 +1118,7 @@ class Trainer:
                 lambda x: x.reshape((-1,) + x.shape[2:]), outs_h))
         if tail is not None:
             carry, outs_t = lax.scan(step, carry, tail)
-            carry = self._reconcile_carry(carry, tier)
+            carry = self._reconcile_carry(carry, tier, gids)
             parts.append(outs_t)
         outs = parts[0] if len(parts) == 1 else jax.tree.map(
             lambda *xs: jnp.concatenate(xs, axis=0), *parts)
@@ -943,18 +1156,42 @@ class Trainer:
             chan[name] = counts
         return dict(out, **{resilience.HOT_TIER_KEY: chan})
 
+    def _merge_sketches(self, sketches, sk):
+        """End-of-call sketch merge: psum each tracked table's LOCAL
+        window accumulator over the worker axes and fold it into the
+        (replicated) incoming window — exactly the sketch module's
+        additive psum-merge contract, once per compiled call (the
+        per-step updates are local scatter-adds). Returns the
+        ``::sketch``-keyed output entries; {} when tracking is off, so
+        untracked programs trace nothing extra."""
+        if not sk:
+            return {}
+        with jax.named_scope("fps.sketch_merge"):
+            return {
+                sketch_key(name): sketches[name] + lax.psum(
+                    lax.psum(sk[name], SHARD_AXIS), DATA_AXIS)
+                for name in sorted(sk)
+            }
+
     # -- compiled chunk runners ------------------------------------------
 
     def _build_chunk_fn(self, mode: str):
         nbatch_dims = 1 if mode == "sync" else 2
         tier = self._hot_tier_map()
+        mapped = self._mapped_tables()
+        track = self._track_specs()
         E = self.config.hot_sync_every
 
         def chunk_device(tables, local_state, batches, key):
             # Per-device key stream, decorrelated across workers.
             key = jax.random.fold_in(key, worker_index())
-            tables, hot = split_hot(tables)
+            tables, hot, maps, gids, sketches = split_tiering(tables)
             delta = self._init_hot_deltas(tables, tier)
+            # Sketch accumulators start at ZERO: each device folds only
+            # its own ids, and the end-of-call psum merges exactly the
+            # call's traffic into the (replicated) incoming window.
+            sk0 = {name: jnp.zeros_like(sketches[name])
+                   for name in sorted(track)}
             bufs = None
             if self.config.push_delay:
                 batch0 = jax.tree.map(
@@ -965,16 +1202,17 @@ class Trainer:
             hp_seen = {}
 
             def step_fn(carry, batch_t, snapshot=None):
-                tables, hot, delta, bufs, local_state, key, t = carry
+                tables, hot, delta, sk, bufs, local_state, key, t = carry
                 key, sub = jax.random.split(key)
-                pushes, local_state, out, hp, hcounts = self._compute_step(
+                (pushes, local_state, out, hp, hcounts,
+                 sk) = self._compute_step(
                     tables, snapshot, local_state, batch_t, sub,
-                    hot=hot, tier=tier,
+                    hot=hot, tier=tier, maps=maps, track=track, sk=sk,
                 )
                 hp_seen.update(hp)  # static, identical every traced step
                 if tier:
                     tables, delta = self._apply_hot_split(
-                        tables, delta, pushes, tier, hp)
+                        tables, delta, pushes, tier, hp, maps)
                 else:
                     tables, bufs = self._apply_or_buffer(
                         tables, bufs, t, pushes, hp)
@@ -983,10 +1221,10 @@ class Trainer:
                     lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
                 )
                 out = self._run_tap(out, tables, batch_t, local_state, t)
-                return (tables, hot, delta, bufs, local_state, key,
+                return (tables, hot, delta, sk, bufs, local_state, key,
                         t + 1), out
 
-            carry0 = (tables, hot, delta, bufs, local_state, key,
+            carry0 = (tables, hot, delta, sk0, bufs, local_state, key,
                       jnp.int32(0))
             if mode == "sync":
                 if not tier:
@@ -1004,8 +1242,9 @@ class Trainer:
                             batches) if R else None,
                         tail=jax.tree.map(lambda x: x[R * E:], batches)
                         if rem else None,
+                        gids=gids,
                     )
-                (tables, hot, delta, bufs, local_state, _, t) = carry
+                (tables, hot, delta, sk, bufs, local_state, _, t) = carry
             else:
                 # SSP: batches leaves are (R, s, B_local, ...).
                 def round_body(carry, batches_r):
@@ -1021,20 +1260,27 @@ class Trainer:
                     # Hot reconcile rides the round boundary: the next
                     # round's snapshot gather must see reconciled head
                     # rows (identity when untiered).
-                    return self._reconcile_carry(carry, tier), outs
+                    return self._reconcile_carry(carry, tier, gids), outs
 
-                (tables, hot, delta, bufs, local_state, _, t), outs = (
+                (tables, hot, delta, sk, bufs, local_state, _, t), outs = (
                     lax.scan(round_body, carry0, batches))
                 outs = jax.tree.map(
                     lambda x: x.reshape((-1,) + x.shape[2:]), outs
                 )
             tables = self._flush_push_bufs(tables, bufs, t, hp_seen)
             tables = {**tables,
-                      **{hot_key(n): v for n, v in sorted(hot.items())}}
+                      **{hot_key(n): v for n, v in sorted(hot.items())},
+                      **{map_key(n): v for n, v in sorted(maps.items())},
+                      **{ids_key(n): v for n, v in sorted(gids.items())},
+                      **self._merge_sketches(sketches, sk)}
             return tables, local_state, outs
 
         table_specs = {name: P(SHARD_AXIS, None) for name in self.store.specs}
         table_specs.update({hot_key(name): P() for name in tier})
+        table_specs.update({map_key(name): P() for name in sorted(mapped)})
+        table_specs.update({ids_key(name): P() for name in sorted(mapped)})
+        table_specs.update({sketch_key(name): P()
+                            for name in sorted(track)})
         ls_spec = P(WORKER_AXES)
 
         def specs_for_batches(batches):
@@ -1084,7 +1330,13 @@ class Trainer:
         key = (mode, ops.get_backend(), self.config.push_delay,
                self.config.step_tap, resilience.as_guard(self.config.guard),
                self._server_logic_key(), self.config.hot_sync_every,
-               tuple(sorted(self._hot_tier_map().items())))
+               tuple(sorted(self._hot_tier_map().items())),
+               # Adaptive tiering: the MAPPED set and the tracked sketch
+               # specs shape the traced program; the hot id membership
+               # itself is DATA, so re-ranks hit this same cache entry —
+               # the no-recompile contract tests/test_tiering.py pins.
+               tuple(sorted(self._mapped_tables().items())),
+               tuple(sorted(self._track_specs().items())))
         if key not in self._compiled:
             self._compiled[key] = self._wrap_audit(
                 self._build_chunk_fn(mode), f"chunk/{mode}")
@@ -1196,13 +1448,17 @@ class Trainer:
         T = self._indexed_call_steps(plan)
         s = self.config.sync_every
         tier = self._hot_tier_map()
+        mapped = self._mapped_tables()
+        track = self._track_specs()
         E = self.config.hot_sync_every
 
         def epoch_device(tables, local_state, iargs, start, key):
             widx = worker_index()
             key = jax.random.fold_in(key, widx)
-            tables, hot = split_hot(tables)
+            tables, hot, maps, gids, sketches = split_tiering(tables)
             delta = self._init_hot_deltas(tables, tier)
+            sk0 = {name: jnp.zeros_like(sketches[name])
+                   for name in sorted(track)}
             bufs = None
             if self.config.push_delay:
                 # Probe batch for push shapes (unused value, DCE'd by XLA).
@@ -1212,17 +1468,18 @@ class Trainer:
             hp_seen = {}
 
             def step_t(carry, t, snapshot=None):
-                tables, hot, delta, bufs, local_state, key = carry
+                tables, hot, delta, sk, bufs, local_state, key = carry
                 key, sub = jax.random.split(key)
                 batch = plan.local_batch_at(iargs, widx, t)
-                pushes, local_state, out, hp, hcounts = self._compute_step(
+                (pushes, local_state, out, hp, hcounts,
+                 sk) = self._compute_step(
                     tables, snapshot, local_state, batch, sub,
-                    hot=hot, tier=tier,
+                    hot=hot, tier=tier, maps=maps, track=track, sk=sk,
                 )
                 hp_seen.update(hp)  # static, identical every traced step
                 if tier:
                     tables, delta = self._apply_hot_split(
-                        tables, delta, pushes, tier, hp)
+                        tables, delta, pushes, tier, hp, maps)
                 else:
                     tables, bufs = self._apply_or_buffer(
                         tables, bufs, t, pushes, hp)
@@ -1231,17 +1488,20 @@ class Trainer:
                     lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
                 )
                 out = self._run_tap(out, tables, batch, local_state, t)
-                return (tables, hot, delta, bufs, local_state, key), out
+                return (tables, hot, delta, sk, bufs, local_state, key), out
 
             def finish(carry, outs):
-                tables, hot, delta, bufs, local_state, _ = carry
+                tables, hot, delta, sk, bufs, local_state, _ = carry
                 tables = self._flush_push_bufs(tables, bufs, start + T,
                                                hp_seen)
                 tables = {**tables,
-                          **{hot_key(n): v for n, v in sorted(hot.items())}}
+                          **{hot_key(n): v for n, v in sorted(hot.items())},
+                          **{map_key(n): v for n, v in sorted(maps.items())},
+                          **{ids_key(n): v for n, v in sorted(gids.items())},
+                          **self._merge_sketches(sketches, sk)}
                 return tables, local_state, outs
 
-            carry0 = (tables, hot, delta, bufs, local_state, key)
+            carry0 = (tables, hot, delta, sk0, bufs, local_state, key)
             if mode == "sync":
                 if not tier:
                     carry, outs = lax.scan(
@@ -1261,6 +1521,7 @@ class Trainer:
                     tail=(start + R * E
                           + jnp.arange(rem, dtype=jnp.int32))
                     if rem else None,
+                    gids=gids,
                 )
                 return finish(carry, outs)
 
@@ -1277,7 +1538,7 @@ class Trainer:
                 # Hot reconcile rides the round boundary (identity when
                 # untiered): the next snapshot gather sees reconciled
                 # head rows.
-                return self._reconcile_carry(carry, tier), outs
+                return self._reconcile_carry(carry, tier, gids), outs
 
             carry, outs = lax.scan(
                 round_body, carry0, jnp.arange(T // s, dtype=jnp.int32),
@@ -1287,6 +1548,10 @@ class Trainer:
 
         table_specs = {name: P(SHARD_AXIS, None) for name in self.store.specs}
         table_specs.update({hot_key(name): P() for name in tier})
+        table_specs.update({map_key(name): P() for name in sorted(mapped)})
+        table_specs.update({ids_key(name): P() for name in sorted(mapped)})
+        table_specs.update({sketch_key(name): P()
+                            for name in sorted(track)})
         ls_spec = P(WORKER_AXES)
 
         def run(tables, local_state, iargs, start, key):
@@ -1479,7 +1744,9 @@ class Trainer:
               self.config.push_delay, self.config.step_tap,
               resilience.as_guard(self.config.guard),
               self._server_logic_key(), self.config.hot_sync_every,
-              tuple(sorted(self._hot_tier_map().items())))
+              tuple(sorted(self._hot_tier_map().items())),
+              tuple(sorted(self._mapped_tables().items())),
+              tuple(sorted(self._track_specs().items())))
         if ck not in self._compiled:
             self._compiled[ck] = self._wrap_audit(
                 self._build_indexed_fn(plan, mode), f"indexed/{mode}")
@@ -1556,6 +1823,7 @@ class Trainer:
         n_calls = -(-T // T_call)
         all_metrics = []
         end_epoch = start_epoch + epochs
+        self._enter_tiering()
         # Two-tier re-split at run entry (restore/warm-start/config
         # changes); per-epoch calls keep the attached structure.
         tables = self._attach_hot(tables, timer)
@@ -1652,6 +1920,15 @@ class Trainer:
                     # epoch of buffered JSONL.
                     rec.event("epoch", phases=timer.chunk_summary(), **ev)
                     rec.flush()
+                if self.retierer is not None:
+                    # Adaptive-tiering boundary: fold the epoch's sketch
+                    # windows, maybe re-rank/re-plan (fps_tpu.tiering).
+                    # Quarantined epochs never reach here — their sketch
+                    # rolled back with the rest of the aux state.
+                    with _phase(timer, "retier"):
+                        tables = self.retierer.on_boundary(
+                            self, tables, e, recorder=rec)
+                    self.store.tables = dict(tables)
             self.store.tables = dict(tables)  # epochs == 0: loop never ran
             # End-of-run save whenever the last epoch's state isn't already on
             # disk — including when a quarantined final epoch skipped its
@@ -1890,9 +2167,26 @@ class Trainer:
         i = start_step - 1
         pending = None       # lag-by-one: one dispatched, unadjudicated chunk
         pending_save = None  # deferred (overlapped) boundary snapshot
+        self._enter_tiering()
         # Two-tier re-split at stream entry; run_chunk keeps the attached
         # structure live across the loop.
         tables = self._attach_hot(tables, timer)
+
+        def retier_boundary(j):
+            """Adaptive-tiering boundary for an adjudicated-clean chunk:
+            fold sketch windows, maybe re-rank/re-plan (fps_tpu.tiering).
+            Quarantined chunks skip it — their sketch window rolled back
+            with the rest of the aux state. Under health_lag=1 this runs
+            at chunk j's ADJUDICATION (one dispatch late, like every
+            other lag consumer), so re-rank decisions see one extra
+            chunk of traffic relative to lag 0."""
+            nonlocal tables
+            if self.retierer is None:
+                return
+            with _phase(timer, "retier"):
+                tables = self.retierer.on_boundary(
+                    self, tables, j, recorder=rec)
+            self.store.tables = dict(tables)
 
         def save_due(j):
             return (checkpointer is not None and checkpoint_every > 0
@@ -1955,6 +2249,16 @@ class Trainer:
                 if rec is not None:
                     rec.event("chunk", phases=timer.chunk_summary(), **ev)
                     rec.flush()
+                if (self.retierer is not None
+                        and entry.get("retier_state") is not None):
+                    # The tracker rolls back WITH the tables: under
+                    # health_lag=1 the restored aux entries predate the
+                    # previous boundary's fold/re-rank, and a tracker
+                    # that kept the newer hot_ids/tick would
+                    # desynchronize from the ::hotids the program
+                    # carries (the un-folded traffic still sits in the
+                    # restored ::sketch window, so nothing is lost).
+                    self.retierer.restore_snapshot(entry["retier_state"])
                 tables, local_state = restored
                 return True
             if on_chunk is not None:
@@ -2027,8 +2331,11 @@ class Trainer:
                 if quarantine is not None:
                     last_good = (resilience.tree_copy(tables),
                                  resilience.tree_copy(local_state))
+                    rt_snap = (self.retierer.snapshot()
+                               if self.retierer is not None else None)
                 else:
                     last_good = None
+                    rt_snap = None
                 ckey = jax.random.fold_in(key, i)
                 _beat(hb, i, "dispatch")
                 if lag:
@@ -2043,41 +2350,53 @@ class Trainer:
                         pmetrics = prestored = None
                         if prev is not None:
                             pmetrics, prestored = sync_entry(prev)
-                    if prev is not None and account_entry(
-                            prev, pmetrics, prestored):
-                        # prev was poisoned and the pre-prev snapshot is
-                        # restored — chunk i ran on poisoned state, so
-                        # recompute it deterministically (same chunk, same
-                        # key) from the restored state: exactly what the
-                        # lag-0 path would have dispatched.
-                        if quarantine is not None:
-                            last_good = (resilience.tree_copy(tables),
-                                         resilience.tree_copy(local_state))
-                        with _watch(watchdog, "chunk", i):
-                            tables, local_state, metrics = self.run_chunk(
-                                tables, local_state, chunk, ckey, timer=timer
-                            )
-                        save = boundary_copy(i) if save_due(i) else None
+                    if prev is not None:
+                        if account_entry(prev, pmetrics, prestored):
+                            # prev was poisoned and the pre-prev snapshot
+                            # is restored — chunk i ran on poisoned
+                            # state, so recompute it deterministically
+                            # (same chunk, same key) from the restored
+                            # state: exactly what the lag-0 path would
+                            # have dispatched.
+                            if quarantine is not None:
+                                last_good = (
+                                    resilience.tree_copy(tables),
+                                    resilience.tree_copy(local_state))
+                                rt_snap = (self.retierer.snapshot()
+                                           if self.retierer is not None
+                                           else None)
+                            with _watch(watchdog, "chunk", i):
+                                tables, local_state, metrics = (
+                                    self.run_chunk(tables, local_state,
+                                                   chunk, ckey,
+                                                   timer=timer))
+                            save = boundary_copy(i) if save_due(i) else None
+                        else:
+                            retier_boundary(prev["index"])
                     pending = {"index": i, "metrics": metrics,
-                               "last_good": last_good, "save": save}
+                               "last_good": last_good, "save": save,
+                               "retier_state": rt_snap}
                 else:
                     with _watch(watchdog, "chunk", i):
                         tables, local_state, metrics = self.run_chunk(
                             tables, local_state, chunk, ckey, timer=timer
                         )
                         entry = {"index": i, "metrics": metrics,
-                                 "last_good": last_good, "save": None}
+                                 "last_good": last_good, "save": None,
+                                 "retier_state": rt_snap}
                         metrics, restored = sync_entry(entry)
                     flush_save()  # previous boundary's deferred dump —
                     # overlapped: the device is already past that boundary
-                    account_entry(entry, metrics, restored)
+                    if not account_entry(entry, metrics, restored):
+                        retier_boundary(i)
             # Lag-by-one: the final chunk is still unadjudicated. Its
             # forced sync keeps watchdog coverage, like every other sync.
             if pending is not None:
                 prev, pending = pending, None
                 with _watch(watchdog, "chunk", prev["index"]):
                     pmetrics, prestored = sync_entry(prev)
-                account_entry(prev, pmetrics, prestored)
+                if not account_entry(prev, pmetrics, prestored):
+                    retier_boundary(prev["index"])
             flush_save()
             # End-of-stream save whenever the last chunk's state isn't already
             # on disk — including when a quarantined final chunk skipped its

@@ -115,6 +115,19 @@ def phys_to_id(phys: Array, num_shards: int, rps: int) -> Array:
 # mirror, under a reserved key — checkpoint/export iterate ``store.specs``
 # and therefore never serialize them (the sharded table is canonical).
 HOT_KEY_SUFFIX = "::hot"
+# Adaptive (mapped) tier aux entries (fps_tpu.tiering): the replica's
+# membership is an arbitrary hot id SET carried as replicated DATA
+# arrays — a slot map (global id -> replica slot, -1 = cold) and its
+# inverse (replica slot -> global id) — so a re-rank swaps arrays
+# without changing the traced program. ``::sketch`` is the device-side
+# frequency window (count-min) the online tracker accumulates inside
+# the compiled step. All ride the tables dict under reserved suffixes;
+# like ``::hot`` they are never serialized (specs stay canonical).
+MAP_KEY_SUFFIX = "::hotmap"
+IDS_KEY_SUFFIX = "::hotids"
+SKETCH_KEY_SUFFIX = "::sketch"
+AUX_KEY_SUFFIXES = (HOT_KEY_SUFFIX, MAP_KEY_SUFFIX, IDS_KEY_SUFFIX,
+                    SKETCH_KEY_SUFFIX)
 
 
 def hot_key(name: str) -> str:
@@ -122,8 +135,28 @@ def hot_key(name: str) -> str:
     return name + HOT_KEY_SUFFIX
 
 
+def map_key(name: str) -> str:
+    """Tables-dict key of ``name``'s replicated id->slot map."""
+    return name + MAP_KEY_SUFFIX
+
+
+def ids_key(name: str) -> str:
+    """Tables-dict key of ``name``'s replicated slot->global-id array."""
+    return name + IDS_KEY_SUFFIX
+
+
+def sketch_key(name: str) -> str:
+    """Tables-dict key of ``name``'s device-side frequency sketch."""
+    return name + SKETCH_KEY_SUFFIX
+
+
 def is_hot_key(key: str) -> bool:
     return key.endswith(HOT_KEY_SUFFIX)
+
+
+def is_aux_key(key: str) -> bool:
+    """True for ANY reserved tiering entry (replica, maps, sketch)."""
+    return any(key.endswith(s) for s in AUX_KEY_SUFFIXES)
 
 
 def hot_base(key: str) -> str:
@@ -131,11 +164,109 @@ def hot_base(key: str) -> str:
     return key[: -len(HOT_KEY_SUFFIX)]
 
 
-def split_hot(tables: Mapping[str, Any]) -> tuple[dict, dict]:
-    """Split a tables dict into ``(cold_by_name, hot_by_name)``."""
-    cold = {k: v for k, v in tables.items() if not is_hot_key(k)}
-    hot = {hot_base(k): v for k, v in tables.items() if is_hot_key(k)}
-    return cold, hot
+def split_tiering(
+    tables: Mapping[str, Any]
+) -> tuple[dict, dict, dict, dict, dict]:
+    """Split a tables dict into ``(canonical, hot, maps, gids, sketches)``
+    — each aux dict keyed by base table name. (The old two-way
+    ``split_hot`` was retired when this superseded it: a narrower split
+    would misclassify the adaptive tier's aux entries as canonical
+    tables.)"""
+    canonical, hot, maps, gids, sketches = {}, {}, {}, {}, {}
+    for k, v in tables.items():
+        if k.endswith(HOT_KEY_SUFFIX):
+            hot[k[: -len(HOT_KEY_SUFFIX)]] = v
+        elif k.endswith(MAP_KEY_SUFFIX):
+            maps[k[: -len(MAP_KEY_SUFFIX)]] = v
+        elif k.endswith(IDS_KEY_SUFFIX):
+            gids[k[: -len(IDS_KEY_SUFFIX)]] = v
+        elif k.endswith(SKETCH_KEY_SUFFIX):
+            sketches[k[: -len(SKETCH_KEY_SUFFIX)]] = v
+        else:
+            canonical[k] = v
+    return canonical, hot, maps, gids, sketches
+
+
+def hot_slot_map(num_ids: int, hot_gids: np.ndarray) -> np.ndarray:
+    """``(num_ids + 1,)`` int32 id->slot map for an arbitrary hot id set.
+
+    Entry ``i`` is the replica slot of global id ``i`` (``-1`` = cold);
+    the trailing sentinel row stays ``-1`` so device code can index with
+    ``where(ids >= 0, ids, num_ids)`` and padding ids resolve to cold
+    without a second mask."""
+    gids = np.asarray(hot_gids, np.int64)
+    if gids.size and (gids.min() < 0 or gids.max() >= num_ids):
+        raise ValueError(
+            f"hot ids outside [0, {num_ids}): "
+            f"[{gids.min()}, {gids.max()}]")
+    if len(np.unique(gids)) != len(gids):
+        raise ValueError("hot id set contains duplicates")
+    m = np.full(num_ids + 1, -1, np.int32)
+    m[gids] = np.arange(len(gids), dtype=np.int32)
+    return m
+
+
+def lookup_hot_slots(slot_map: Array, ids: Array) -> Array:
+    """Device-side ``(B,)`` replica slots for ``ids`` (-1 = cold or
+    padding). ``slot_map`` is :func:`hot_slot_map`'s array."""
+    sentinel = slot_map.shape[0] - 1
+    return jnp.take(slot_map, jnp.where(ids >= 0, ids, sentinel), axis=0)
+
+
+def split_hot_push_slots(
+    ids: Array, deltas: Array, slots: Array
+) -> tuple[tuple[Array, Array], tuple[Array, Array]]:
+    """Mapped-tier analog of :func:`split_hot_push`: partition one push
+    stream on ``slots >= 0`` (slot-map membership instead of ``id < H``).
+
+    Returns ``((cold_ids, cold_deltas), (hot_slots, hot_deltas))`` with
+    the other tier's entries masked to ``-1``/zero — the hot half is
+    already in SLOT space, ready for :func:`accumulate_hot`."""
+    hot = slots >= 0
+    cold = (
+        jnp.where(hot, jnp.asarray(-1, ids.dtype), ids),
+        jnp.where(hot[:, None], 0, deltas).astype(deltas.dtype),
+    )
+    hots = (
+        jnp.where(hot, slots, jnp.asarray(-1, slots.dtype)),
+        jnp.where(hot[:, None], deltas, 0).astype(deltas.dtype),
+    )
+    return cold, hots
+
+
+def reconcile_hot_mapped(
+    cold_shard: Array,
+    replica: Array,
+    delta_buf: Array,
+    hot_gids: Array,
+    *,
+    num_shards: int,
+    shard_axis: str = SHARD_AXIS,
+    data_axis: str | None = None,
+    mean: bool = False,
+) -> tuple[Array, Array, Array]:
+    """Window-end reconcile for an arbitrary hot id set (mapped tier).
+
+    Identical contract to :func:`reconcile_hot` except the replica's slot
+    ``j`` holds global id ``hot_gids[j]`` instead of id ``j``: the psum'd
+    combined delta is applied to the replica (bitwise-identical on every
+    device) AND scattered into this shard's OWNED rows of the canonical
+    table — under the owner-major cyclic layout id ``g`` lives on shard
+    ``g % S`` at local row ``g // S``. ``hot_gids`` is replicated DATA,
+    so a re-rank changes which rows reconcile without recompiling.
+
+    Returns ``(new_cold_shard, new_replica, zeroed_delta_buf)``.
+    """
+    combined, new_replica = _reconcile_combine(
+        replica, delta_buf, shard_axis=shard_axis, data_axis=data_axis,
+        mean=mean)
+    me = lax.axis_index(shard_axis)
+    owned = (hot_gids >= 0) & ((hot_gids % num_shards) == me)
+    lidx = jnp.where(owned, hot_gids // num_shards,
+                     jnp.asarray(-1, hot_gids.dtype))
+    new_cold = ops.scatter_add(cold_shard, lidx,
+                               combined.astype(cold_shard.dtype))
+    return new_cold, new_replica, jnp.zeros_like(delta_buf)
 
 
 def pull_hot(replica: Array, ids: Array, *, hot_ids: int) -> tuple[Array, Array]:
@@ -205,6 +336,34 @@ def accumulate_hot(
     return ops.scatter_add(delta_buf, hot_ids_arr, vals)
 
 
+def _reconcile_combine(
+    replica: Array,
+    delta_buf: Array,
+    *,
+    shard_axis: str,
+    data_axis: str | None,
+    mean: bool,
+) -> tuple[Array, Array]:
+    """Shared half of the window-end reconcile: psum the pending
+    buffers over the worker axes, normalize the ``mean`` combine's
+    count column, and apply to the replica. Returns
+    ``(combined_delta, new_replica)`` — the static and mapped reconciles
+    differ only in how the combined delta addresses the canonical
+    shard, so the summation/normalization semantics live in exactly one
+    place and cannot drift between them."""
+    _, dim = replica.shape
+    g = lax.psum(delta_buf, shard_axis)
+    if data_axis is not None:
+        g = lax.psum(g, data_axis)
+    if mean:
+        counts = g[:, dim]
+        combined = g[:, :dim] * (1.0 / jnp.maximum(counts, 1.0))[:, None]
+    else:
+        combined = g
+    combined = combined.astype(replica.dtype)
+    return combined, replica + combined
+
+
 def reconcile_hot(
     cold_shard: Array,
     replica: Array,
@@ -233,17 +392,10 @@ def reconcile_hot(
 
     Returns ``(new_cold_shard, new_replica, zeroed_delta_buf)``.
     """
-    H, dim = replica.shape
-    g = lax.psum(delta_buf, shard_axis)
-    if data_axis is not None:
-        g = lax.psum(g, data_axis)
-    if mean:
-        counts = g[:, dim]
-        combined = g[:, :dim] * (1.0 / jnp.maximum(counts, 1.0))[:, None]
-    else:
-        combined = g
-    combined = combined.astype(replica.dtype)
-    new_replica = replica + combined
+    H, _ = replica.shape
+    combined, new_replica = _reconcile_combine(
+        replica, delta_buf, shard_axis=shard_axis, data_axis=data_axis,
+        mean=mean)
     hl = -(-H // num_shards)  # local head rows on every shard
     me = lax.axis_index(shard_axis)
     # Global id of local head row j is j*S + me; rows past H (when S does
@@ -650,6 +802,7 @@ class ParamStore:
         self.sharding = NamedSharding(mesh, P(SHARD_AXIS, None))
         self.tables: dict[str, Array] = {}
         self._head_replica_fns: dict = {}  # (name, hot_rows) -> jitted gather
+        self._rows_replica_fns: dict = {}  # (name, nrows) -> jitted gather
 
     def init(self, key: Array) -> dict[str, Array]:
         """Materialize all tables directly in their sharded layout."""
@@ -700,6 +853,38 @@ class ParamStore:
             )
             self._head_replica_fns[(name, hot_rows)] = fn
         return fn(table)
+
+    def rows_replica(self, name: str, ids: np.ndarray,
+                     table: Array | None = None) -> Array:
+        """Replicated ``(len(ids), dim)`` array of arbitrary global ids of
+        ``name`` — the re-split half of the ADAPTIVE tier (the mapped
+        analog of :meth:`head_replica`, whose head is always ``[0, H)``).
+
+        The physical row indices ride as a jit ARGUMENT (not a baked
+        constant), so every re-rank at the same head size H reuses one
+        compiled gather — the no-recompile contract. Valid at any
+        compiled-call boundary (pending deltas are always reconciled
+        before a call returns). Multi-controller: collective, like
+        :meth:`head_replica`.
+        """
+        spec = self.specs[name]
+        ids = np.asarray(ids, np.int64)
+        if ids.size == 0 or ids.min() < 0 or ids.max() >= spec.num_ids:
+            raise ValueError(
+                f"table {name!r}: replica ids must be a non-empty subset "
+                f"of [0, {spec.num_ids})")
+        table = self.tables[name] if table is None else table
+        rps = rows_per_shard(spec.num_ids, self.num_shards)
+        phys = np.asarray(id_to_phys(ids, self.num_shards, rps),
+                          dtype=np.int32)
+        fn = self._rows_replica_fns.get((name, len(ids)))
+        if fn is None:
+            fn = jax.jit(
+                lambda t, p: t[p],
+                out_shardings=NamedSharding(self.mesh, P()),
+            )
+            self._rows_replica_fns[(name, len(ids))] = fn
+        return fn(table, phys)
 
     def table_specs_static(self) -> dict[str, tuple[int, int]]:
         """(num_shards, rows_per_shard) per table, for device-side code."""

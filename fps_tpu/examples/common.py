@@ -76,6 +76,19 @@ def base_parser(description: str) -> argparse.ArgumentParser:
                          "staleness bound applied to the parameter "
                          "plane. 1 (default) = exact mode, bit-identical "
                          "to the untiered path")
+    ap.add_argument("--auto-tier", action="store_true",
+                    help="adaptive tiering (fps_tpu.tiering, "
+                         "docs/performance.md): track pulled-id "
+                         "frequencies online (device-side count-min, "
+                         "psum-merged), derive per-table hot_tier / "
+                         "hot_sync_every / dense route from the "
+                         "sketched densities after a warmup (replacing "
+                         "the hand-tuned --hot-tier/--hot-sync-every "
+                         "knobs), and re-rank the hot set on drift — "
+                         "re-ranks swap replicated data, never "
+                         "recompile. Explicit --hot-tier/"
+                         "--hot-sync-every still apply until the "
+                         "planner's first decision")
     ap.add_argument("--guard", default=None, choices=["observe", "mask"],
                     help="on-device push-delta health guard "
                          "(fps_tpu.core.resilience): 'mask' drops "
@@ -200,11 +213,12 @@ def apply_hot_tier(args, trainer, store=None):
     """
     H = getattr(args, "hot_tier", 0)
     E = getattr(args, "hot_sync_every", 1)
+    auto = getattr(args, "auto_tier", False)
     if E < 1:
         raise SystemExit(f"--hot-sync-every must be >= 1, got {E}")
     if H < 0:
         raise SystemExit(f"--hot-tier must be >= 0, got {H}")
-    if not H and E == 1:
+    if not H and E == 1 and not auto:
         return trainer
     if trainer is None:
         emit({"event": "hot_tier_ignored",
@@ -218,11 +232,12 @@ def apply_hot_tier(args, trainer, store=None):
         for name, spec in store.specs.items():
             store.specs[name] = dataclasses.replace(
                 spec, hot_tier=min(H, spec.num_ids))
-    trainer.config = dataclasses.replace(trainer.config, hot_sync_every=E)
+    trainer.config = dataclasses.replace(trainer.config, hot_sync_every=E,
+                                         auto_tier=auto)
     tiered = sorted(trainer._hot_tier_map())  # also validates vs push_delay
     emit({"event": "hot_tier", "hot_tier": H, "hot_sync_every": E,
-          "tiered_tables": tiered,
-          "exact_mode": E == 1 or not tiered})
+          "auto_tier": auto, "tiered_tables": tiered,
+          "exact_mode": (E == 1 or not tiered) and not auto})
     return trainer
 
 

@@ -111,7 +111,7 @@ def default_registry() -> MetricsRegistry:
                    labels=("phase",),
                    help="host wall-clock per phase segment: ingest / place "
                         "/ dispatch / host_sync / checkpoint / callback / "
-                        "reconcile"),
+                        "reconcile / retier"),
         # Host pipeline (fps_tpu.core.prefetch).
         MetricSpec("prefetch.chunks", "counter", unit="chunks",
                    help="chunks assembled+placed by the background "
@@ -137,6 +137,22 @@ def default_registry() -> MetricsRegistry:
                         "the delta a reconcile actually applies is the "
                         "psum, whose norm can exceed this by up to "
                         "sqrt(num_devices) when device deltas align"),
+        # Adaptive tiering (fps_tpu.tiering; docs/performance.md
+        # "Adaptive tiering"): online hot-set re-ranking + auto-planner.
+        MetricSpec("tiering.re_ranks", "counter", unit="re_ranks",
+                   labels=("table",),
+                   help="hot-set re-ranks applied (replica + slot-map "
+                        "swap; never a recompile)"),
+        MetricSpec("tiering.churn", "gauge", unit="fraction",
+                   labels=("table",),
+                   help="last measured churn: |sketched top-H \\ current "
+                        "hot set| / H at the most recent check"),
+        MetricSpec("tiering.promoted_rows", "counter", unit="rows",
+                   labels=("table",),
+                   help="ids promoted into the hot set by re-ranks"),
+        MetricSpec("tiering.demoted_rows", "counter", unit="rows",
+                   labels=("table",),
+                   help="ids demoted out of the hot set by re-ranks"),
         # Health channel (thresholded by fps_tpu.obs.health.HealthMonitor).
         MetricSpec("health.nonfinite_rows", "counter", unit="rows",
                    labels=("table",),

@@ -119,6 +119,66 @@ def cm_query(spec: CountMinSpec, sketch: Array, ids: Array) -> Array:
 
 
 # ---------------------------------------------------------------------------
+# Decayed count-min (halve-on-schedule): drifting streams forget stale
+# hot sets. The decayed state is a running fold over fixed-size WINDOW
+# sketches (plain :func:`cm_update` accumulations): every
+# ``half_every``-th fold first halves the whole state, then adds the new
+# window — deterministic (the schedule is a tick counter, never
+# wall-clock), exact in float (halving is a power-of-two scale), and
+# LINEAR, so decay commutes with the psum/``+`` merge contract: folding
+# the merged windows of two substreams equals merging their separately
+# folded states (tested in tests/test_sketch.py). The effective weight
+# of a window folded ``k`` halvings ago is ``2^-k`` — an exponential
+# forget schedule with half-life ``half_every`` folds.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DecayedCountMinSpec:
+    depth: int = 4
+    width: int = 1024
+    seed: int = 0
+    # Halve the whole state every `half_every` folds (ticks). The decayed
+    # count of an id is therefore a window-weighted sum with weights
+    # 1, 1, ..., 1/2, 1/2, ... stepping down per half_every-fold block.
+    half_every: int = 8
+
+    def __post_init__(self):
+        if self.half_every < 1:
+            raise ValueError(
+                f"half_every must be >= 1, got {self.half_every}")
+
+    def cm(self) -> CountMinSpec:
+        """The plain count-min spec sharing this spec's hashing — window
+        sketches are built with it (``cm_init``/``cm_update``), so the
+        decayed state and its windows index identical buckets."""
+        return CountMinSpec(self.depth, self.width, self.seed)
+
+
+def dcm_init(spec: DecayedCountMinSpec):
+    """Fresh decayed state (works as numpy on host or jnp on device)."""
+    return np.zeros((spec.depth, spec.width), np.float32)
+
+
+def dcm_fold(spec: DecayedCountMinSpec, state, window, tick: int):
+    """Fold one window sketch into the decayed state at fold index
+    ``tick`` (0-based, monotone): halve first when the schedule says so,
+    then add — the newest window always enters at full weight. Pure
+    arithmetic: numpy in, numpy out (host tracker) or jnp in, jnp out.
+    """
+    if tick < 0:
+        raise ValueError(f"tick must be >= 0, got {tick}")
+    if tick > 0 and tick % spec.half_every == 0:
+        state = state * 0.5
+    return state + window
+
+
+def dcm_query(spec: DecayedCountMinSpec, state, ids) -> Array:
+    """(B,) decayed frequency estimates (min over depth rows); same
+    upward-bias guarantee as :func:`cm_query`, on the decayed counts."""
+    return cm_query(spec.cm(), jnp.asarray(state), ids)
+
+
+# ---------------------------------------------------------------------------
 # Tug-of-war / count-sketch (unbiased inner products & frequencies).
 # ---------------------------------------------------------------------------
 

@@ -51,6 +51,15 @@ SCENARIO_PREFETCH_KILL_AT = 4
 SCENARIO_HOT_TIER = 64
 SCENARIO_HOT_SYNC = 3
 SCENARIO_HOT_KILL_AT = 3
+# Retier-kill scenario: ADAPTIVE tier (mapped hot set + online
+# tracking, forced re-rank every check) killed between a re-rank and
+# the next checkpoint. check_every=2 puts re-rank checks at chunk
+# boundaries 1, 3, 5...; the kill at chunk 3 fires BEFORE boundary 3's
+# retier runs, so the restart must restore the last reconciled
+# snapshot AND the step-3 tracker sidecar, re-plan (re-derive the hot
+# set / replica / slot map), and replay chunk 3 bit-identically.
+SCENARIO_RETIER_EVERY = 2
+SCENARIO_RETIER_KILL_AT = 3
 
 
 def run_supervised_scenario(tmpdir: str, *, timeout: float = 600):
@@ -310,6 +319,109 @@ def run_hot_tier_kill_scenario(tmpdir: str, *, timeout: float = 600):
     return ok, detail
 
 
+def run_retier_kill_scenario(tmpdir: str, *, timeout: float = 600):
+    """SIGKILL between a hot-set re-rank and the next checkpoint, under
+    the supervisor, with the ADAPTIVE tier on (``--hot-tier`` +
+    ``--retier-every``: mapped hot set, device-side tracking, forced
+    re-rank every check, tracker sidecars beside the checkpoints). The
+    restart must restore the last reconciled snapshot (one canonical
+    table — re-ranks never touch canonical rows), restore the matching
+    tracker sidecar, re-derive the hot replica / slot map from both
+    (``Trainer._attach_hot``), and replay to final weights BIT-IDENTICAL
+    to a straight (unkilled) adaptive run — i.e. the resumed run's
+    re-rank decisions are the straight run's. A single crash must not
+    quarantine anything.
+
+    Returns ``(ok, detail)`` like :func:`run_supervised_scenario`.
+    """
+    import numpy as np
+
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=_ROOT)
+    demo = [sys.executable, "-m", "fps_tpu.testing.supervised_demo",
+            *SCENARIO_DEMO_ARGS,
+            "--hot-tier", str(SCENARIO_HOT_TIER),
+            "--hot-sync-every", str(SCENARIO_HOT_SYNC),
+            "--retier-every", str(SCENARIO_RETIER_EVERY)]
+    straight_dir = os.path.join(tmpdir, "straight")
+    sup_dir = os.path.join(tmpdir, "sup")
+    straight_out = os.path.join(tmpdir, "straight.npz")
+    sup_out = os.path.join(tmpdir, "sup.npz")
+
+    r = subprocess.run(
+        demo + ["--ckpt-dir", straight_dir, "--out", straight_out],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=timeout,
+    )
+    if r.returncode != 0:
+        return False, {"error": "straight adaptive run failed",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+    try:
+        with open(straight_out + ".meta.json", encoding="utf-8") as f:
+            straight_meta = json.load(f)
+    except OSError:
+        straight_meta = {}
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "supervise.py"),
+         "--state-dir", sup_dir, "--stall-timeout-s", "60",
+         "--startup-grace-s", "300", "--term-grace-s", "2",
+         "--backoff-base-s", "0.2", "--max-restarts", "2",
+         "--poll-s", "0.2", "--",
+         *demo, "--ckpt-dir", sup_dir, "--out", sup_out,
+         "--kill-at", str(SCENARIO_RETIER_KILL_AT)],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=timeout,
+    )
+    try:
+        digest = json.loads(r.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return False, {"error": "no supervisor digest",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+    try:
+        with open(sup_out + ".meta.json", encoding="utf-8") as f:
+            meta = json.load(f)
+    except OSError:
+        meta = {}
+    bit_identical = (
+        os.path.exists(sup_out)
+        and np.array_equal(np.load(straight_out)["weights"],
+                           np.load(sup_out)["weights"])
+    )
+    detail = {
+        "supervisor": {k: digest.get(k) for k in
+                       ("success", "attempts", "restarts",
+                        "deadline_aborts", "quarantined")},
+        "restored_step": meta.get("restored_step"),
+        "sidecar_restored": meta.get("tiering_restored"),
+        "re_ranks": [straight_meta.get("re_ranks"), meta.get("re_ranks")],
+        "bit_identical": bit_identical,
+        "corrupt_files": sorted(os.path.basename(p) for p in
+                                glob.glob(sup_dir + "/*.corrupt")),
+    }
+    ok = (r.returncode == 0 and digest.get("success")
+          and digest.get("restarts") == 1
+          # A SIGKILL crash is a death, not a stall: no deadline abort.
+          and digest.get("deadline_aborts") == 0
+          # One crash at one index is not quarantine evidence.
+          and digest.get("quarantined") == []
+          # The kill fires after chunk SCENARIO_RETIER_KILL_AT trains
+          # (async writer flushed first), before its checkpoint lands.
+          and meta.get("restored_step") == SCENARIO_RETIER_KILL_AT
+          # The restart really restored the step-3 tracker sidecar —
+          # without it the resumed re-rank decisions start cold and the
+          # bit-identity below would be vacuous luck.
+          and meta.get("tiering_restored") is True
+          # The adaptive machinery actually exercised: the straight run
+          # re-ranked at least once (forced-cadence mode re-ranks on the
+          # first check; the resumed attempt's count may legitimately be
+          # 0 on a stationary stream — its hot set is already ranked).
+          and (straight_meta.get("re_ranks") or 0) >= 1
+          and not detail["corrupt_files"]
+          and bit_identical)
+    return ok, detail
+
+
 SCENARIO_SERVE_KILL_AT = 3
 
 
@@ -497,6 +609,13 @@ def main(argv=None) -> int:
     ap.add_argument("--hot-sync-every", type=int, default=1,
                     help="hot-tier reconcile cadence in steps "
                          "(TrainerConfig.hot_sync_every)")
+    ap.add_argument("--retier-every", type=int, default=0,
+                    help="adaptive tiering (fps_tpu.tiering): attach a "
+                         "Retierer checking every N chunk boundaries "
+                         "with FORCED re-ranks (churn threshold -1) and "
+                         "tracker sidecars beside the checkpoints; "
+                         "combine with --hot-tier/--hot-sync-every for "
+                         "the mapped tier")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -551,13 +670,25 @@ def main(argv=None) -> int:
     from fps_tpu.examples.common import apply_hot_tier
 
     apply_hot_tier(args, trainer, store)
+    if args.retier_every:
+        from fps_tpu.tiering import Retierer
+
+        # Forced-cadence adaptive mode: re-rank on every check, tracker
+        # state persisted beside the checkpoints so a supervised restart
+        # replays the straight run's re-rank decisions bit-for-bit.
+        trainer.retierer = Retierer(check_every=args.retier_every,
+                                    churn_threshold=-1.0,
+                                    state_dir=args.ckpt_dir)
     tables, ls = trainer.init_state(jax.random.key(0))
 
     ckpt_cls = Checkpointer if args.sync_checkpointer else AsyncCheckpointer
     ckpt = ckpt_cls(args.ckpt_dir, keep=3)
     start = ckpt.latest_valid_step() or 0
+    tiering_restored = None
     if start:
         tables, ls, start = trainer.restore_checkpoint(ckpt, ls)
+        if trainer.retierer is not None:
+            tiering_restored = trainer.retierer.restore(start)
     if hb is not None:
         # Beat-before-work: name the chunk about to be attempted BEFORE
         # attempting it, so a crash inside the very first (resumed) chunk
@@ -640,7 +771,10 @@ def main(argv=None) -> int:
 
     np.savez(args.out, weights=weights(store))
     meta.update(finished=True,
-                skipped=sorted(rollback.skipped) if rollback else [])
+                skipped=sorted(rollback.skipped) if rollback else [],
+                tiering_restored=tiering_restored,
+                re_ranks=(trainer.retierer.re_ranks
+                          if trainer.retierer is not None else None))
     with open(args.out + ".meta.json", "w", encoding="utf-8") as f:
         json.dump(meta, f)
     print(json.dumps({"event": "demo_done", **meta}), flush=True)

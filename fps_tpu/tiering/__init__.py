@@ -1,0 +1,44 @@
+"""fps_tpu.tiering — adaptive tiering: online hot-set re-ranking and
+the auto-tiering planner.
+
+PR 5's hot tier is a *static* frequency-ranked head fixed at table-spec
+time; any workload whose hot set drifts decays back to cold-route
+collectives. This subsystem manages the tier *online*, in the NuPS
+(arxiv.org/pdf/2104.00501) mold, with the knobs *derived* from observed
+sparsity in the Parallax (arxiv.org/pdf/1808.02621) spirit:
+
+* **tracking** — a count-min window per table, updated device-side
+  inside the compiled step from the batch's pulled ids and psum-merged
+  across the mesh (:mod:`fps_tpu.sketch`); folded host-side into a
+  halve-on-schedule DECAYED count-min so drift forgets the stale head;
+* **re-rank + re-split** (:class:`Retierer`) — at chunk boundaries the
+  sketched top-H replaces the hot id set by swapping the replica and
+  its slot-map/gid arrays (replicated DATA, fixed shapes): re-ranks
+  never recompile, and the flush-reconcile invariant keeps checkpoints
+  canonical and byte-compatible across them;
+* **planning** (:func:`plan_tables`) — per-table ``hot_tier`` /
+  ``hot_sync_every`` / dense-route derived from sketched densities,
+  replacing three hand-tuned knobs (``TrainerConfig.auto_tier``,
+  ``tools/plan.py``).
+
+See docs/performance.md "Adaptive tiering" and docs/STALENESS.md (the
+re-rank cadence is a staleness knob on the tier-membership plane).
+"""
+
+from fps_tpu.tiering.planner import (
+    TableDensity,
+    TierPlan,
+    choose_sync_every,
+    global_sync_every,
+    head_coverage,
+    plan_tables,
+)
+from fps_tpu.tiering.probe import ProbeLogic, lowered_plan_text, probe_chunk
+from fps_tpu.tiering.retier import Retierer, sidecar_path
+
+__all__ = [
+    "TableDensity", "TierPlan", "plan_tables", "choose_sync_every",
+    "global_sync_every", "head_coverage",
+    "Retierer", "sidecar_path",
+    "ProbeLogic", "probe_chunk", "lowered_plan_text",
+]

@@ -1,0 +1,217 @@
+"""Auto-tiering planner: derive the per-table storage knobs from
+observed (sketched) id densities instead of hand-tuning them.
+
+Parallax (arxiv.org/pdf/1808.02621) shows the replicate-vs-shard
+decision and the sync cadence should come from *measured sparsity*;
+NuPS (arxiv.org/pdf/2104.00501) adds the hot/cold management policy.
+This module is the decision function: given per-table estimated id
+frequencies (from the online tracker's decayed count-min, or any other
+density estimate), :func:`plan_tables` chooses for every table
+
+* ``hot_tier`` — the replicated head size ``H``: the full table when it
+  fits the replica budget (the NuPS small-hot-table regime — statically
+  elides the collective pull/push routes), else the smallest head
+  covering ``coverage_target`` of estimated traffic (clamped to the
+  budget), else 0 when the distribution is too flat for a head to pay;
+* ``hot_sync_every`` — the reconcile cadence ``E``: smallest window
+  whose amortized reconcile traffic (``H*dim*itemsize/E`` bytes/step,
+  ``+1`` count column under a "mean" fold) stays below
+  ``reconcile_frac`` of the estimated per-step hot-row pull traffic it
+  replaces (clamped to ``[2, max_sync_every]`` — 1 is the exact mode,
+  i.e. "tier off");
+* ``dense`` — the replicate-on-read/dense-reduce collective route for
+  small tables (``TableSpec.dense_collectives``), decided against the
+  same byte threshold the trainer's "auto" resolution uses.
+
+numpy-only on purpose: the planner runs in jax-free tools
+(``tools/plan.py``) and on login nodes. The predicted collective-byte
+budget of a plan is NOT computed here — ``tools/plan.py`` lowers a
+probe program with the plan applied and measures it with
+``fps_tpu.analysis.collective_profile`` (a measured program, not a
+model).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# Default replica budget per table: how much memory each device may
+# spend on one table's hot replica. Deliberately generous relative to
+# the embedding-table scales the shipped workloads use — operators with
+# tight HBM override it per plan call.
+DEFAULT_REPLICA_BUDGET_BYTES = 64 << 20
+# A head must cover at least this fraction of estimated traffic to be
+# worth its reconcile + replica cost; flatter distributions stay
+# untiered (the gathered route is already payload-balanced for them).
+MIN_HEAD_COVERAGE = 0.5
+
+
+@dataclasses.dataclass(frozen=True)
+class TableDensity:
+    """What the planner needs to know about one table: geometry plus the
+    estimated per-id pull frequencies (any non-negative array of length
+    ``num_ids``; the online tracker supplies decayed count-min
+    estimates, tools/plan.py can synthesize Zipf profiles)."""
+
+    name: str
+    num_ids: int
+    dim: int
+    counts: np.ndarray
+    itemsize: int = 4
+
+    def __post_init__(self):
+        c = np.asarray(self.counts, np.float64)
+        if c.shape != (self.num_ids,):
+            raise ValueError(
+                f"table {self.name!r}: counts shape {c.shape} != "
+                f"({self.num_ids},)")
+        if c.size and c.min() < 0:
+            raise ValueError(f"table {self.name!r}: negative counts")
+        object.__setattr__(self, "counts", c)
+
+
+@dataclasses.dataclass(frozen=True)
+class TierPlan:
+    """One table's planned knobs (``reason`` is the human-readable
+    audit trail ``tools/plan.py`` prints per row)."""
+
+    hot_tier: int
+    hot_sync_every: int
+    dense: bool
+    coverage: float  # estimated traffic fraction the head serves
+    reason: str
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def head_coverage(counts: np.ndarray, H: int) -> float:
+    """Estimated traffic fraction served by the TOP-H ids (by count)."""
+    total = float(counts.sum())
+    if total <= 0 or H <= 0:
+        return 0.0
+    top = np.sort(counts)[::-1][:H]
+    return float(top.sum() / total)
+
+
+def choose_sync_every(
+    H: int,
+    dim: int,
+    itemsize: int,
+    coverage: float,
+    *,
+    batch_rows_per_step: int,
+    mean_combine: bool = False,
+    reconcile_frac: float = 0.25,
+    max_sync_every: int = 8,
+) -> int:
+    """Smallest reconcile window E whose amortized traffic stays under
+    ``reconcile_frac`` of the per-step hot traffic it replaces.
+
+    Cost model (docs/performance.md "Adaptive tiering"): a window moves
+    one ``(H, dim (+1 if mean))`` psum, i.e. ``H*(dim+mean)*itemsize/E``
+    bytes/step amortized; the hot rows it absorbs would otherwise ride
+    per-step collectives carrying about ``coverage * B * dim * itemsize``
+    bytes/step. E is the smallest integer making
+    ``reconcile/step <= reconcile_frac * absorbed/step``, clamped to
+    ``[2, max_sync_every]`` — the bound is the parameter-plane staleness
+    the operator accepts (docs/STALENESS.md).
+    """
+    reconcile_bytes = H * (dim + (1 if mean_combine else 0)) * itemsize
+    absorbed = coverage * batch_rows_per_step * dim * itemsize
+    if absorbed <= 0:
+        return max_sync_every
+    e = int(np.ceil(reconcile_bytes / (reconcile_frac * absorbed)))
+    return int(np.clip(e, 2, max_sync_every))
+
+
+def plan_tables(
+    densities: list[TableDensity] | dict[str, TableDensity],
+    *,
+    batch_rows_per_step: int,
+    replica_budget_bytes: int = DEFAULT_REPLICA_BUDGET_BYTES,
+    dense_table_bytes: int = 1 << 20,
+    coverage_target: float = 0.9,
+    min_head_coverage: float = MIN_HEAD_COVERAGE,
+    reconcile_frac: float = 0.25,
+    max_sync_every: int = 8,
+    mean_combine: bool = False,
+    num_shards: int = 8,
+) -> dict[str, TierPlan]:
+    """Choose ``(hot_tier, hot_sync_every, dense)`` per table from its
+    estimated density — the three knobs ``TableSpec``/``TrainerConfig``
+    otherwise make users hand-tune.
+
+    ``batch_rows_per_step``: pulled rows per step per table across all
+    workers (the traffic unit the cost model amortizes against).
+    ``num_shards`` informs only the reason strings (the single-shard
+    no-op case is resolved by the trainer, not here).
+
+    The driver's reconcile cadence is global (``TrainerConfig.
+    hot_sync_every``); per-table recommendations are returned anyway and
+    the applier takes the MIN over tiered tables — the tightest
+    staleness bound any table asked for (see ``apply_plan``).
+    """
+    if isinstance(densities, dict):
+        densities = list(densities.values())
+    plans: dict[str, TierPlan] = {}
+    for d in densities:
+        table_bytes = d.num_ids * d.dim * d.itemsize
+        dense = table_bytes <= dense_table_bytes
+        total = float(d.counts.sum())
+        if total <= 0:
+            plans[d.name] = TierPlan(
+                0, 1, dense, 0.0,
+                "no observed traffic: untiered until the tracker has "
+                "evidence")
+            continue
+        if table_bytes <= replica_budget_bytes:
+            cov = 1.0
+            H = d.num_ids
+            E = choose_sync_every(
+                H, d.dim, d.itemsize, cov,
+                batch_rows_per_step=batch_rows_per_step,
+                mean_combine=mean_combine,
+                reconcile_frac=reconcile_frac,
+                max_sync_every=max_sync_every)
+            plans[d.name] = TierPlan(
+                H, E, dense, cov,
+                f"full replication ({table_bytes}B <= "
+                f"{replica_budget_bytes}B budget): collective pull/push "
+                "statically elided")
+            continue
+        order = np.sort(d.counts)[::-1]
+        cum = np.cumsum(order) / total
+        H_cov = int(np.searchsorted(cum, coverage_target) + 1)
+        budget_rows = max(replica_budget_bytes // (d.dim * d.itemsize), 1)
+        H = int(min(H_cov, budget_rows, d.num_ids))
+        cov = float(cum[H - 1])
+        if cov < min_head_coverage:
+            plans[d.name] = TierPlan(
+                0, 1, dense, cov,
+                f"flat distribution: top-{H} covers only {cov:.2f} < "
+                f"{min_head_coverage} — a head would not pay its "
+                "reconcile")
+            continue
+        E = choose_sync_every(
+            H, d.dim, d.itemsize, cov,
+            batch_rows_per_step=batch_rows_per_step,
+            mean_combine=mean_combine,
+            reconcile_frac=reconcile_frac,
+            max_sync_every=max_sync_every)
+        plans[d.name] = TierPlan(
+            H, E, dense, cov,
+            f"partial head: top-{H} covers {cov:.2f} of estimated "
+            f"traffic (target {coverage_target}, budget "
+            f"{budget_rows} rows, {num_shards} shards)")
+    return plans
+
+
+def global_sync_every(plans: dict[str, TierPlan]) -> int:
+    """The driver's single reconcile cadence from per-table plans: the
+    MIN over tiered tables (tightest staleness bound requested); 1 (the
+    exact mode / tier off) when nothing tiers."""
+    es = [p.hot_sync_every for p in plans.values() if p.hot_tier > 0]
+    return min(es) if es else 1

@@ -1,0 +1,95 @@
+"""Generic pull/push probe workload: lower a plan's data-plane program
+without a real model.
+
+``tools/plan.py`` promises the plan's *measured* collective-byte budget
+(via ``fps_tpu.analysis.collective_profile``), which needs an actual
+lowered program per planned table set — but arbitrary user tables don't
+map onto any shipped model. :class:`ProbeLogic` is the minimal
+WorkerLogic whose data plane is exactly the store's: pull ``B`` ids per
+table, push same-shaped deltas back (a fixed scale of the pulled rows —
+enough to keep the push route live through DCE), emit one scalar. The
+lowered per-chunk program therefore carries precisely the collectives
+the plan's routing implies (gathered/dense pulls and pushes, the tier's
+reconcile psum, the tracker's sketch merge) and nothing else.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from fps_tpu.core.api import StepOutput, WorkerLogic
+
+
+class ProbeLogic(WorkerLogic):
+    """Pull/push probe over every table of a store.
+
+    The batch carries one ``{name}_ids`` column per table; ``step``
+    pushes ``-0.001 * pulled`` (a tiny decay — value is irrelevant, it
+    only has to depend on the pulled rows so no route folds away).
+    """
+
+    def __init__(self, table_names):
+        self.table_names = tuple(sorted(table_names))
+
+    def pull_ids(self, batch):
+        return {name: batch[f"{name}_ids"] for name in self.table_names}
+
+    def step(self, batch, pulled, local_state, key):
+        pushes = {
+            name: (batch[f"{name}_ids"], -0.001 * pulled[name])
+            for name in self.table_names
+        }
+        n = jnp.sum(
+            (batch[f"{self.table_names[0]}_ids"] >= 0).astype(jnp.float32))
+        return StepOutput(pushes=pushes, local_state=local_state,
+                          out={"n": n})
+
+
+def probe_chunk(table_specs, *, num_workers: int, local_batch: int = 32,
+                steps_per_chunk: int = 4, seed: int = 0) -> dict:
+    """One host chunk of uniform-random ids per table, shaped
+    ``(T, B_global)`` for the sync driver."""
+    rng = np.random.default_rng(seed)
+    B = num_workers * local_batch
+    return {
+        f"{name}_ids": rng.integers(
+            0, spec.num_ids, (steps_per_chunk, B)).astype(np.int32)
+        for name, spec in sorted(table_specs.items())
+    }
+
+
+def lowered_plan_text(mesh, specs, plans, *, hot_sync_every: int,
+                      retierer=None, local_batch: int = 32,
+                      steps_per_chunk: int = 4) -> str:
+    """Build a probe trainer with ``plans`` applied over ``specs`` and
+    return the StableHLO text of the exact per-chunk program it would
+    dispatch — what ``tools/plan.py`` feeds to ``collective_profile``.
+
+    ``retierer``: attach one to lower the ADAPTIVE (mapped + tracked)
+    variant of the program instead of the static tier.
+    """
+    import dataclasses
+
+    from fps_tpu.core.driver import Trainer, TrainerConfig, num_workers_of
+    from fps_tpu.core.store import ParamStore
+
+    planned = {}
+    for name, spec in sorted(specs.items()):
+        plan = plans.get(name)
+        if plan is not None:
+            spec = dataclasses.replace(
+                spec, hot_tier=plan.hot_tier,
+                dense_collectives=plan.dense)
+        planned[name] = spec
+    store = ParamStore(mesh, planned)
+    trainer = Trainer(
+        mesh, store, ProbeLogic(planned),
+        config=TrainerConfig(hot_sync_every=hot_sync_every),
+    )
+    trainer.retierer = retierer
+    chunk = probe_chunk(planned, num_workers=num_workers_of(mesh),
+                        local_batch=local_batch,
+                        steps_per_chunk=steps_per_chunk)
+    return trainer.lowered_chunk_text(chunk, "sync")

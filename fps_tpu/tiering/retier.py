@@ -1,0 +1,515 @@
+"""Online hot-set re-ranking: the host half of the adaptive tier.
+
+The driver tracks pulled-id frequencies device-side (a count-min window
+per tracked table, accumulated inside the compiled step and psum-merged
+across the mesh — ``fps_tpu.sketch``); this module owns everything that
+happens to those windows at chunk/epoch boundaries:
+
+* **fold** — every ``check_every`` boundaries the device windows are
+  read, folded into a host-side *decayed* count-min
+  (:class:`fps_tpu.sketch.DecayedCountMinSpec`, halve-on-schedule so a
+  drifting stream forgets its stale head), and reset;
+* **re-rank + re-split** — per mapped-tier table, the sketched top-H is
+  compared against the current hot id set; when churn exceeds the
+  threshold the hot set is replaced: the replica is re-derived from the
+  CANONICAL table (valid at any boundary — the flush reconcile already
+  ran), and the slot-map / gid arrays are swapped. All three are DATA
+  (same shapes), so a re-rank NEVER recompiles — the compile cache is
+  keyed on H only;
+* **auto-plan** (:func:`fps_tpu.tiering.planner.plan_tables`) — with
+  ``TrainerConfig.auto_tier`` the first ``warmup_checks`` folds run
+  untiered-but-tracked; the planner then derives per-table ``hot_tier``
+  / ``hot_sync_every`` / dense-route from the sketched densities and
+  the trainer re-resolves (one deliberate recompile — re-ranks after it
+  stay compile-free);
+* **sidecar persistence** (``state_dir``) — tracker state (decayed
+  sketches, pending windows, hot id sets, fold tick) is written beside
+  the checkpoints at every boundary via atomic rename, so a supervised
+  restart restores the EXACT tracker the straight run had at that step
+  and replays bit-identically (the ``retier_kill`` chaos scenario).
+  Checkpoints themselves stay canonical — one table per spec, byte-
+  compatible across re-ranks — because re-ranks never touch canonical
+  rows.
+
+Thread-safety note: a Retierer is driven only by the trainer's host
+loop (one call per boundary, same thread) — no locking needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Mapping
+
+import numpy as np
+
+from fps_tpu import sketch as sklib
+from fps_tpu.core.store import (
+    hot_key,
+    hot_slot_map,
+    ids_key,
+    is_aux_key,
+    map_key,
+    sketch_key,
+    _stable_hash,
+)
+from fps_tpu.tiering.planner import (
+    TableDensity,
+    global_sync_every,
+    plan_tables,
+)
+
+_log = logging.getLogger("fps_tpu.tiering")
+
+SIDECAR_FMT = "tiering-{:08d}.npz"
+
+
+def sidecar_path(state_dir: str, step: int) -> str:
+    return os.path.join(state_dir, SIDECAR_FMT.format(step))
+
+
+def top_ids(est: np.ndarray, H: int) -> np.ndarray:
+    """Deterministic top-H ids of an estimate vector: by count desc,
+    id asc on ties — IDENTICAL to a full ``lexsort`` ranking, but via
+    ``argpartition`` + a sort of only the candidates (``O(n + H log H)``
+    instead of ``O(n log n)``: a 20M-id table's per-check ranking must
+    not full-sort inside the training loop's retier phase). All ties at
+    the H-th value are included before the final cut so the tie-break
+    never depends on partition order."""
+    n = len(est)
+    if H >= n:
+        cand = np.arange(n)
+    else:
+        part = np.argpartition(-est, H - 1)[:H]
+        thresh = est[part].min()
+        cand = np.flatnonzero(est >= thresh)
+    order = cand[np.lexsort((cand, -est[cand]))]
+    return order[:H].astype(np.int64)
+
+
+class Retierer:
+    """Boundary-driven hot-set manager for one trainer.
+
+    Attach with ``trainer.retierer = Retierer(...)`` BEFORE the first
+    compiled call (tracking/mapped-tier resolution is part of the
+    compile key, like the guard), or set ``TrainerConfig.auto_tier``
+    and let the driver attach :meth:`auto_for` at run entry.
+
+    Args:
+      tables: table names to track/manage (default: every store spec).
+      spec: the decayed count-min config shared by every tracked table
+        (per-table hash seeds are derived from the table name).
+      check_every: fold/re-rank cadence in chunk/epoch boundaries —
+        the re-rank-cadence staleness knob (docs/STALENESS.md).
+      churn_threshold: re-rank when ``|top-H \\ current| / H`` exceeds
+        this; ``< 0`` re-ranks on every check (deterministic-cadence
+        mode, used by the chaos scenario).
+      auto_plan: run :func:`plan_tables` after ``warmup_checks`` folds
+        and apply it (spec/config mutation + one recompile).
+      warmup_checks: folds of evidence required before planning.
+      state_dir: write the per-boundary sidecar here (``keep`` newest
+        retained); None disables persistence.
+      batch_rows_hint: pulled rows per step fed to the planner's
+        reconcile cost model (the tracker cannot observe step counts).
+      plan_kwargs: extra :func:`plan_tables` keyword overrides
+        (replica_budget_bytes, coverage_target, ...).
+    """
+
+    def __init__(self, tables=None, *,
+                 spec: sklib.DecayedCountMinSpec | None = None,
+                 check_every: int = 4,
+                 churn_threshold: float = 0.25,
+                 auto_plan: bool = False,
+                 warmup_checks: int = 1,
+                 state_dir: str | None = None,
+                 keep: int = 3,
+                 batch_rows_hint: int = 1024,
+                 plan_kwargs: Mapping | None = None):
+        if check_every < 1:
+            raise ValueError(f"check_every must be >= 1, got {check_every}")
+        self.tables = None if tables is None else frozenset(tables)
+        self.spec = spec or sklib.DecayedCountMinSpec(depth=4, width=2048)
+        self.check_every = check_every
+        self.churn_threshold = churn_threshold
+        self.auto_plan = auto_plan
+        self.warmup_checks = warmup_checks
+        self.state_dir = state_dir
+        self.keep = keep
+        self.batch_rows_hint = batch_rows_hint
+        self.plan_kwargs = dict(plan_kwargs or {})
+        # -- mutable tracker state (sidecar-persisted) --
+        self.state: dict[str, np.ndarray] = {}   # decayed host sketches
+        self.hot_ids: dict[str, np.ndarray] = {}
+        self.tick = 0          # fold count (the decay schedule's clock)
+        self.planned = False
+        self.plans = None
+        # Pending device windows seeded into ``::sketch`` entries by
+        # _attach_hot after a restore (consumed lazily, kept until
+        # overwritten so idempotent attaches agree).
+        self._restored_windows: dict[str, np.ndarray] = {}
+        # -- run stats (not persisted; recorder carries the durable copy)
+        self.re_ranks = 0
+        self.checks = 0
+        self.last_churn: dict[str, float] = {}
+
+    @classmethod
+    def auto_for(cls, trainer) -> "Retierer":
+        """The ``TrainerConfig.auto_tier`` default: track every table,
+        plan after one warmup fold, re-rank at the default cadence."""
+        return cls(auto_plan=True)
+
+    # -- resolution interface (consumed by the driver) --------------------
+
+    def manages(self, name: str) -> bool:
+        return self.tables is None or name in self.tables
+
+    def _table_cm(self, name: str) -> sklib.CountMinSpec:
+        """The one hashing spec for ``name`` — used by BOTH the device-
+        side window updates and the host-side queries (a seed mismatch
+        between the two would silently estimate garbage; pinned by
+        tests/test_tiering.py). Seeds derive from the table name so
+        tables hash independently but every process (and every restart)
+        agrees."""
+        return sklib.CountMinSpec(
+            depth=self.spec.depth, width=self.spec.width,
+            seed=(self.spec.seed + _stable_hash(name)) % (2 ** 31))
+
+    def track_specs(self, specs: Mapping) -> dict[str, sklib.CountMinSpec]:
+        """{table: CountMinSpec} for every MANAGED table — the candidate
+        set; the driver's ``_track_specs`` intersects it with the
+        resolved tier (re-rankable partial heads only, except during an
+        auto-plan warmup where the planner needs every density), so a
+        fully-replicated, untiered, or resolution-disengaged table
+        carries no tracker ops in its steady-state program."""
+        out = {}
+        for name in sorted(specs):
+            if not self.manages(name):
+                continue
+            out[name] = self._table_cm(name)
+        return out
+
+    def hot_ids_for(self, name: str, H: int) -> np.ndarray:
+        """Current hot id set of ``name`` at head size ``H`` (rank
+        order, hottest first). Defaults to the static head ``[0, H)`` —
+        the frequency-ranked-ids convention — until a re-rank or plan
+        replaces it; a stored set of the wrong size (H changed by a
+        re-plan) resets to the default rather than guessing."""
+        cur = self.hot_ids.get(name)
+        if cur is None or len(cur) != H:
+            cur = np.arange(H, dtype=np.int64)
+            self.hot_ids[name] = cur
+        return cur
+
+    def device_window(self, name: str) -> np.ndarray | None:
+        """Restored pending window to seed ``name``'s ``::sketch`` entry
+        with (None = start from zeros)."""
+        return self._restored_windows.get(name)
+
+    def snapshot(self) -> dict:
+        """Copy of the mutable tracker state, paired with the driver's
+        pre-chunk ``last_good`` table copies: under ``health_lag=1`` a
+        chunk's quarantine restores tables captured BEFORE the previous
+        boundary's fold/re-rank ran, so the tracker must roll back with
+        them or hot_ids/tick desynchronize from the ``::hotids`` the
+        program actually carries (the fold is not lost — the restored
+        ``::sketch`` window still holds the unfolded traffic)."""
+        return {
+            "state": {k: v.copy() for k, v in self.state.items()},
+            "hot_ids": {k: v.copy() for k, v in self.hot_ids.items()},
+            "tick": self.tick,
+            "planned": self.planned,
+            "plans": self.plans,
+            "restored_windows": dict(self._restored_windows),
+            "re_ranks": self.re_ranks,
+            "checks": self.checks,
+        }
+
+    def restore_snapshot(self, snap: Mapping) -> None:
+        """Inverse of :meth:`snapshot` (quarantine rollback)."""
+        self.state = {k: v.copy() for k, v in snap["state"].items()}
+        self.hot_ids = {k: v.copy() for k, v in snap["hot_ids"].items()}
+        self.tick = snap["tick"]
+        self.planned = snap["planned"]
+        self.plans = snap["plans"]
+        self._restored_windows = dict(snap["restored_windows"])
+        self.re_ranks = snap["re_ranks"]
+        self.checks = snap["checks"]
+
+    def on_run_entry(self, trainer) -> None:
+        """Run-entry hook (called by the drivers before the tier
+        resolution is first consulted): re-apply a restored plan's
+        spec/config mutations so a supervised restart resolves the SAME
+        tiered program the interrupted run was dispatching. Idempotent;
+        a no-op until a plan exists."""
+        if self.planned and self.plans:
+            self._apply_plans_to(trainer)
+
+    # -- the boundary hook -------------------------------------------------
+
+    def on_boundary(self, trainer, tables: dict, index: int, *,
+                    recorder=None) -> dict:
+        """Fold/re-rank step after chunk/epoch ``index`` was adjudicated
+        clean. Mutates and returns the run's tables dict (aux entries
+        only — canonical tables are never touched here)."""
+        check = (index + 1) % self.check_every == 0
+        if not check and self.state_dir is None:
+            return tables
+        track = trainer._track_specs()
+        windows: dict[str, np.ndarray] = {}
+        for name in sorted(track):
+            k = sketch_key(name)
+            if k in tables:
+                windows[name] = np.asarray(tables[k])
+        if check:
+            self.checks += 1
+            for name in sorted(windows):
+                st = self.state.get(name)
+                if st is None:
+                    st = sklib.dcm_init(self.spec)
+                self.state[name] = sklib.dcm_fold(
+                    self.spec, st, windows[name], self.tick)
+                tables[sketch_key(name)] = self._put_replicated(
+                    trainer, np.zeros_like(windows[name]))
+                windows[name] = np.zeros_like(windows[name])
+                # The restored seed window (if any) is folded now: a
+                # later aux re-derivation must start from zeros, not
+                # re-seed (and double-count) the same traffic.
+                self._restored_windows.pop(name, None)
+            self.tick += 1
+            if (self.auto_plan and not self.planned
+                    and self.tick >= self.warmup_checks):
+                tables = self._apply_plan(trainer, tables, recorder)
+            tables = self._maybe_rerank(trainer, tables, recorder)
+        if self.state_dir is not None:
+            self._save_sidecar(index + 1, windows)
+        return tables
+
+    # -- re-rank + re-split ------------------------------------------------
+
+    def _estimated_counts(self, name: str, num_ids: int) -> np.ndarray | None:
+        st = self.state.get(name)
+        if st is None or float(st.sum()) <= 0:
+            return None
+        probe = np.arange(num_ids, dtype=np.int32)
+        # Query with the TABLE's hashing spec (the decayed spec only
+        # schedules the halvings) — the window sketches were built with
+        # it device-side.
+        return np.asarray(sklib.cm_query(self._table_cm(name),
+                                         np.asarray(st, np.float32),
+                                         probe))
+
+    def _maybe_rerank(self, trainer, tables: dict, recorder) -> dict:
+        mapped = trainer._mapped_tables()
+        for name in sorted(mapped):
+            H = mapped[name]
+            spec = trainer.store.specs[name]
+            est = self._estimated_counts(name, spec.num_ids)
+            if est is None:
+                continue
+            # Deterministic ranking: by estimated count desc, id asc.
+            cand = top_ids(est, H)
+            cur = set(self.hot_ids_for(name, H).tolist())
+            promoted = [g for g in cand.tolist() if g not in cur]
+            churn = len(promoted) / H
+            self.last_churn[name] = churn
+            if recorder is not None:
+                recorder.set("tiering.churn", churn, table=name)
+            if churn <= self.churn_threshold or not promoted:
+                continue
+            demoted = sorted(cur - set(cand.tolist()))
+            self.hot_ids[name] = cand
+            # Re-split: replica from the CANONICAL table (boundary
+            # invariant — the flush reconcile ran), maps as fresh
+            # replicated data. Same shapes as before: no recompile.
+            tables[hot_key(name)] = trainer.store.rows_replica(
+                name, cand, tables[name])
+            tables[ids_key(name)] = self._put_replicated(
+                trainer, cand.astype(np.int32))
+            tables[map_key(name)] = self._put_replicated(
+                trainer, hot_slot_map(spec.num_ids, cand))
+            self.re_ranks += 1
+            _log.info("tiering: re-ranked %r at check %d (churn %.3f, "
+                      "%d promoted / %d demoted of H=%d)", name,
+                      self.checks, churn, len(promoted), len(demoted), H)
+            if recorder is not None:
+                recorder.inc("tiering.re_ranks", table=name)
+                recorder.inc("tiering.promoted_rows", len(promoted),
+                             table=name)
+                recorder.inc("tiering.demoted_rows", len(demoted),
+                             table=name)
+                recorder.event("tiering_rerank", table=name,
+                               churn=round(churn, 4),
+                               promoted=len(promoted),
+                               demoted=len(demoted), head=H)
+        return tables
+
+    # -- auto-plan ----------------------------------------------------------
+
+    def _apply_plan(self, trainer, tables: dict, recorder) -> dict:
+        from fps_tpu import ops
+
+        store = trainer.store
+        densities = []
+        est_by_name = {}
+        for name in sorted(store.specs):
+            if not self.manages(name):
+                continue
+            spec = store.specs[name]
+            est = self._estimated_counts(name, spec.num_ids)
+            if est is not None:
+                est_by_name[name] = est
+            else:
+                est = np.zeros(spec.num_ids)
+            densities.append(TableDensity(
+                name, spec.num_ids, spec.dim, est,
+                itemsize=np.dtype(spec.dtype).itemsize))
+        kwargs = dict(
+            batch_rows_per_step=self.batch_rows_hint,
+            dense_table_bytes=ops.DENSE_TABLE_BYTES,
+            num_shards=trainer.num_shards,
+        )
+        kwargs.update(self.plan_kwargs)
+        plans = plan_tables(densities, **kwargs)
+        for name in sorted(plans):
+            plan = plans[name]
+            spec = store.specs[name]
+            est = est_by_name.get(name)
+            if 0 < plan.hot_tier < spec.num_ids and est is not None:
+                self.hot_ids[name] = top_ids(est, plan.hot_tier)
+        self.planned = True
+        self.plans = plans
+        E = self._apply_plans_to(trainer)
+        _log.info("tiering: plan applied at check %d — %s, "
+                  "hot_sync_every=%d", self.checks,
+                  {n: (p.hot_tier, p.hot_sync_every, p.dense)
+                   for n, p in sorted(plans.items())}, E)
+        if recorder is not None:
+            recorder.event(
+                "tiering_plan", hot_sync_every=E,
+                plan={n: p.to_json() for n, p in sorted(plans.items())})
+        # The resolution changed: strip every aux entry and re-derive
+        # against the new spec/config (ONE deliberate recompile; the
+        # re-ranks that follow swap data only).
+        tables = {k: v for k, v in tables.items() if not is_aux_key(k)}
+        return trainer._attach_hot(tables)
+
+    def _apply_plans_to(self, trainer) -> int:
+        """Mutate the trainer's specs/config to match ``self.plans``
+        (idempotent — replaying the same plan is a no-op on the compile
+        key). Returns the applied global hot_sync_every."""
+        store = trainer.store
+        for name in sorted(self.plans):
+            plan = self.plans[name]
+            spec = store.specs.get(name)
+            if spec is None:
+                continue
+            store.specs[name] = dataclasses.replace(
+                spec, hot_tier=plan.hot_tier,
+                dense_collectives=plan.dense)
+        E = global_sync_every(self.plans)
+        trainer.config = dataclasses.replace(
+            trainer.config, hot_sync_every=E)
+        return E
+
+    # -- sidecar persistence -------------------------------------------------
+
+    def _put_replicated(self, trainer, arr: np.ndarray):
+        import jax
+
+        return jax.device_put(np.asarray(arr), trainer._replicated)
+
+    def _save_sidecar(self, step: int, windows: dict) -> None:
+        os.makedirs(self.state_dir, exist_ok=True)
+        path = sidecar_path(self.state_dir, step)
+        arrays = {"meta": np.frombuffer(json.dumps({
+            "version": 1, "tick": self.tick, "step": step,
+            "planned": self.planned,
+            "plans": ({n: p.to_json() for n, p in sorted(
+                self.plans.items())} if self.plans else None),
+        }).encode(), dtype=np.uint8)}
+        for name in sorted(self.state):
+            arrays[f"state::{name}"] = self.state[name]
+        for name in sorted(self.hot_ids):
+            arrays[f"hot::{name}"] = self.hot_ids[name]
+        for name in sorted(windows):
+            arrays[f"window::{name}"] = windows[name]
+        tmp = path + ".tmp.npz"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._sweep_sidecars()
+
+    def _sweep_sidecars(self) -> None:
+        """Retention must track RESTORABILITY, not recency: a resume
+        restores the tracker sidecar matching ``latest_valid_step``,
+        which under ``checkpoint_every > 1`` is older than the last few
+        boundaries — so any sidecar whose step still has a published
+        snapshot beside it (``state_dir`` is the checkpoint dir in
+        supervised runs) survives the sweep, plus the newest ``keep``
+        regardless. Without co-located snapshots (a bare state_dir) the
+        newest-``keep`` fallback applies; co-locate with the
+        checkpoints when bit-identical resume matters."""
+        from fps_tpu.core import snapshot_format as fmt
+
+        ckpt_steps = set()
+        for f in os.listdir(self.state_dir):
+            m = fmt.SNAPSHOT_RE.fullmatch(f)
+            if m:
+                ckpt_steps.add(int(m.group(1)))
+        kept = sorted(
+            f for f in os.listdir(self.state_dir)
+            if f.startswith("tiering-") and f.endswith(".npz")
+            and not f.endswith(".tmp.npz"))
+        for f in kept[:-self.keep] if self.keep else kept:
+            try:
+                step = int(f[len("tiering-"):-len(".npz")])
+            except ValueError:
+                continue
+            if step in ckpt_steps:
+                continue
+            try:
+                os.remove(os.path.join(self.state_dir, f))
+            except OSError:
+                pass
+
+    def restore(self, step: int) -> bool:
+        """Load the sidecar written at boundary ``step`` (the checkpoint
+        step a supervised restart resumes from). Returns True on an
+        exact match; False (cold tracker, warns) when the sidecar is
+        missing — training stays correct either way, only the re-rank
+        decisions restart from scratch."""
+        if self.state_dir is None:
+            return False
+        path = sidecar_path(self.state_dir, step)
+        if not os.path.exists(path):
+            if step:
+                _log.warning(
+                    "tiering: no sidecar for step %d under %s — tracker "
+                    "restarts cold (re-rank decisions may differ from "
+                    "the uninterrupted run)", step, self.state_dir)
+            return False
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            self.tick = int(meta["tick"])
+            self.planned = bool(meta.get("planned", False))
+            from fps_tpu.tiering.planner import TierPlan
+
+            self.plans = ({n: TierPlan(**p) for n, p in
+                           meta["plans"].items()}
+                          if meta.get("plans") else None)
+            self.state = {}
+            self.hot_ids = {}
+            self._restored_windows = {}
+            for k in z.files:
+                if k.startswith("state::"):
+                    self.state[k[len("state::"):]] = z[k].copy()
+                elif k.startswith("hot::"):
+                    self.hot_ids[k[len("hot::"):]] = z[k].copy()
+                elif k.startswith("window::"):
+                    self._restored_windows[k[len("window::"):]] = (
+                        z[k].copy())
+        return True

@@ -42,6 +42,95 @@ def test_count_min_drops_negative_ids_and_merges():
     np.testing.assert_allclose(np.asarray(sk.merge(s1, s2)), np.asarray(s))
 
 
+def test_decayed_cm_halves_on_schedule_and_queries_like_cm():
+    """The fold schedule is exact: a constant window stream's state is a
+    closed-form geometric sum, and a single-window state queries exactly
+    like the plain count-min (same hashing via spec.cm())."""
+    spec = sk.DecayedCountMinSpec(depth=3, width=256, seed=2, half_every=2)
+    ids = np.array([5, 5, 7], np.int32)
+    win = np.asarray(sk.cm_update(spec.cm(), sk.cm_init(spec.cm()),
+                                  jnp.asarray(ids)))
+    st = sk.dcm_init(spec)
+    for t in range(4):  # folds at ticks 0..3, halvings before ticks 2
+        st = sk.dcm_fold(spec, st, win, t)
+    # weights per window (oldest->newest): 1/2, 1/2, 1, 1 -> total 3x
+    np.testing.assert_allclose(st, 3.0 * win)
+    est = np.asarray(sk.dcm_query(spec, win,
+                                  jnp.asarray(np.array([5, 7], np.int32))))
+    assert est[0] == 2.0 and est[1] == 1.0
+
+
+def test_decayed_cm_decay_merge_commute():
+    """Linearity contract: folding the elementwise-MERGED windows of two
+    substreams equals merging the separately folded states — the psum
+    merge and the halve-on-schedule decay commute."""
+    spec = sk.DecayedCountMinSpec(depth=4, width=512, seed=3, half_every=3)
+    rng = np.random.default_rng(0)
+    wins_a, wins_b = [], []
+    for _ in range(7):
+        for wins in (wins_a, wins_b):
+            ids = rng.integers(0, 300, 200).astype(np.int32)
+            wins.append(np.asarray(sk.cm_update(
+                spec.cm(), sk.cm_init(spec.cm()), jnp.asarray(ids))))
+    merged_then_fold = sk.dcm_init(spec)
+    fold_a = sk.dcm_init(spec)
+    fold_b = sk.dcm_init(spec)
+    for t, (wa, wb) in enumerate(zip(wins_a, wins_b)):
+        merged_then_fold = sk.dcm_fold(
+            spec, merged_then_fold, np.asarray(sk.merge(wa, wb)), t)
+        fold_a = sk.dcm_fold(spec, fold_a, wa, t)
+        fold_b = sk.dcm_fold(spec, fold_b, wb, t)
+    np.testing.assert_array_equal(merged_then_fold,
+                                  np.asarray(sk.merge(fold_a, fold_b)))
+
+
+def test_decayed_cm_forgets_stale_hot_set():
+    """Drift regression: after the hot set rotates, the decayed ranking
+    follows the NEW head within a few half-lives while the undecayed
+    count-min stays pinned to the stale one."""
+    spec = sk.DecayedCountMinSpec(depth=4, width=2048, seed=4, half_every=2)
+    vocab, probe = 400, np.arange(400, dtype=np.int32)
+    rng = np.random.default_rng(5)
+
+    def window(shift):
+        ids = ((rng.zipf(1.5, 4000) + shift) % vocab).astype(np.int32)
+        return np.asarray(sk.cm_update(spec.cm(), sk.cm_init(spec.cm()),
+                                       jnp.asarray(ids)))
+
+    decayed = sk.dcm_init(spec)
+    flat = sk.dcm_init(spec)
+    tick = 0
+    for _ in range(8):  # phase 1: head near id 0
+        w = window(0)
+        decayed = sk.dcm_fold(spec, decayed, w, tick)
+        flat = flat + w
+        tick += 1
+    for _ in range(8):  # phase 2: head rotates to id 200
+        w = window(200)
+        decayed = sk.dcm_fold(spec, decayed, w, tick)
+        flat = flat + w
+        tick += 1
+    top_decayed = np.argsort(-np.asarray(sk.dcm_query(
+        spec, decayed, jnp.asarray(probe))))[:10]
+    top_flat = np.argsort(-np.asarray(sk.dcm_query(
+        spec, flat, jnp.asarray(probe))))[:10]
+    new_head = set(range(200, 210))
+    assert len(new_head & set(top_decayed.tolist())) >= 7
+    # The undecayed fold still ranks the stale phase-1 head comparably —
+    # the failure mode the decay exists to fix.
+    assert len(new_head & set(top_flat.tolist())) < 7
+
+
+def test_decayed_cm_rejects_bad_schedule():
+    import pytest
+
+    with pytest.raises(ValueError, match="half_every"):
+        sk.DecayedCountMinSpec(half_every=0)
+    spec = sk.DecayedCountMinSpec()
+    with pytest.raises(ValueError, match="tick"):
+        sk.dcm_fold(spec, sk.dcm_init(spec), sk.dcm_init(spec), -1)
+
+
 def test_tug_of_war_inner_product_estimates_cooccurrence_similarity():
     """Two context-frequency vectors; the sketch inner product must track the
     true inner product — the co-occurrence similarity use case."""

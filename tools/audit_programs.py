@@ -163,6 +163,16 @@ BUDGETS: dict[str, dict] = {
     "mf_tiered": dict(max_collectives=3, max_collective_bytes=5120,
                       per_kind_max={"all_gather": 1, "all_to_all": 1,
                                     "all_reduce": 1}),
+    # ADAPTIVE tier over the same config (fps_tpu.tiering: mapped hot
+    # set + online tracking): the cold routes and the reconcile psum of
+    # mf_tiered (the mapped reconcile scatters by gid DATA — same
+    # collective), plus ONE more all_reduce: the tracker's end-of-call
+    # sketch merge (4x2048 f32 = 32768B). The slot-map/gid lookups are
+    # local gathers — re-ranks swap those arrays without touching this
+    # profile (rerank_byte_identity pins that claim exactly).
+    "mf_retier": dict(max_collectives=4, max_collective_bytes=37888,
+                      per_kind_max={"all_gather": 1, "all_to_all": 1,
+                                    "all_reduce": 2}),
     # Sparse logreg, gathered route + adagrad server fold.
     "logreg": dict(max_collectives=2, max_collective_bytes=3200,
                    per_kind_max={"all_gather": 1, "all_to_all": 1}),
@@ -221,6 +231,39 @@ def build_streaming_mf(mesh) -> str:
 def build_mf_tiered(mesh) -> str:
     trainer, chunks = _mf_pieces(mesh, hot_tier=32, hot_sync_every=2)
     return _lower_chunk_program(trainer, chunks)
+
+
+def _mf_retier_pieces(mesh):
+    """Adaptive (mapped + tracked) tier over the tiered-MF audit config:
+    partial head H=32 of NI=64 under a Retierer, so the program carries
+    the slot-map routes, the mapped reconcile, and the tracker's sketch
+    ops."""
+    from fps_tpu.tiering import Retierer
+
+    trainer, chunks = _mf_pieces(mesh, hot_tier=32, hot_sync_every=2)
+    trainer.retierer = Retierer(check_every=4)
+    return trainer, chunks
+
+
+def build_mf_retier(mesh) -> str:
+    return _lower_chunk_program(*_mf_retier_pieces(mesh))
+
+
+def rerank_byte_identity(mesh) -> bool:
+    """THE recompile-freedom claim as a pinned contract: two different
+    re-ranks of the same (H, table) must lower BYTE-IDENTICAL programs —
+    the hot id membership rides as replicated slot-map/gid DATA, never
+    as trace constants. A future change that bakes the ranking into the
+    program (a fresh compile per re-rank) fails this audit."""
+    trainer, chunks = _mf_retier_pieces(mesh)
+    chunk = next(iter(chunks))
+    t1 = trainer.lowered_chunk_text(chunk, "sync")
+    # Re-rank to a disjoint hot id set of the same size (num_ids=64,
+    # H=32: the complementary half) and lower again.
+    trainer.retierer.hot_ids["item_factors"] = np.arange(
+        32, 64, dtype=np.int64)
+    t2 = trainer.lowered_chunk_text(chunk, "sync")
+    return t1 == t2
 
 
 def build_logreg(mesh) -> str:
@@ -316,6 +359,7 @@ BUILDERS = {
     "mf": build_mf,
     "streaming_mf": build_streaming_mf,
     "mf_tiered": build_mf_tiered,
+    "mf_retier": build_mf_retier,
     "logreg": build_logreg,
     "w2v": build_w2v,
     "pa": build_pa,
@@ -325,7 +369,7 @@ BUILDERS = {
 
 def contract_for(name: str) -> ProgramContract:
     budget = BUDGETS[name]
-    tiered = name == "mf_tiered"
+    tiered = name in ("mf_tiered", "mf_retier")
     # H=32 head rows x RANK f32 (+1 mean-count column headroom is not
     # needed: MF folds are sum) — the smallest tiered head's byte size.
     hot_bytes = 32 * RANK * 4 if tiered else 0
@@ -384,9 +428,21 @@ def main(argv=None) -> int:
         for v in cert.violations:
             print(f"       [{v.pass_name}] {v.summary}", file=sys.stderr)
 
-    ok = all(c.ok for c in certs.values())
+    rerank_identical = None
+    if "mf_retier" in names:
+        # The adaptive tier's recompile-freedom contract: two different
+        # re-ranks of the same (H, table) lower byte-identical programs.
+        rerank_identical = rerank_byte_identity(mesh)
+        mark = "OK " if rerank_identical else "FAIL"
+        print(f"[{mark}] mf_retier: re-rank byte-identity "
+              f"({'identical' if rerank_identical else 'programs DIFFER'}"
+              " across disjoint hot id sets)", file=sys.stderr)
+
+    ok = (all(c.ok for c in certs.values())
+          and rerank_identical is not False)
     doc = {
         "audit_programs": {n: c.to_json() for n, c in certs.items()},
+        "rerank_byte_identical": rerank_identical,
         "ok": ok,
         "mesh": {"shard": 8, "data": 1},
         "scale": {"nu": NU, "ni": NI, "rank": RANK, "nf": NF,

@@ -44,6 +44,14 @@ fps_tpu.testing.workloads):
   boundary invariant), re-splits the hot replica, replays exactly one
   chunk, quarantines nothing, and reproduces a straight tiered run's
   final weights bit-for-bit.
+* ``retier_kill``              — SIGKILL between a hot-set re-rank and
+  the next checkpoint with the ADAPTIVE tier on (``fps_tpu.tiering``:
+  mapped hot set, device-side tracking, forced re-rank cadence,
+  tracker sidecars): survives iff the restart restores the last
+  reconciled snapshot AND the matching tracker sidecar, re-derives the
+  replica/slot-map from both, quarantines nothing, and replays to
+  final weights bit-identical to a straight adaptive run (i.e. the
+  resumed re-rank decisions are the straight run's).
 
 The digest also carries the clean run's program CERTIFICATE
 (``fps_tpu.analysis``, ``docs/analysis.md``): the compiled logreg step
@@ -227,6 +235,11 @@ def main():
 
         results["hot_tier_kill"], detail["hot_tier_kill"] = (
             run_hot_tier_kill_scenario(d))
+    with tempfile.TemporaryDirectory() as d:
+        from fps_tpu.testing.supervised_demo import run_retier_kill_scenario
+
+        results["retier_kill"], detail["retier_kill"] = (
+            run_retier_kill_scenario(d))
     with tempfile.TemporaryDirectory() as d:
         from fps_tpu.testing.supervised_demo import (
             run_serve_while_train_scenario,

@@ -21,6 +21,9 @@ join on ``run_id``) and prints a single JSON digest:
   replicated hot head over total pulled rows) and the last/max
   pending-delta gauge (parameter-plane staleness;
   `docs/performance.md` "Two-tier storage");
+* **tiering** — adaptive-tiering activity (`fps_tpu.tiering`):
+  re-ranks applied, promoted/demoted row totals, and the churn gauge
+  (`docs/performance.md` "Adaptive tiering");
 * **serve** — read-path tier (`fps_tpu.serve`): requests/rows served,
   exact p50/p99 request latency, the served step + step lag + the
   write→servable freshness SLO gauges, forward/backward swap counts, and
@@ -79,8 +82,8 @@ _INCIDENT_EVENTS = (
 REQUIRED_FIELDS = (
     "obs_dir", "run_ids", "processes", "chunks", "epochs", "steps",
     "examples", "phase_seconds", "health", "incidents", "checkpoint_saves",
-    "quarantined", "wall_span_s", "prefetch", "hot_tier", "source_stalls",
-    "analysis", "serve",
+    "quarantined", "wall_span_s", "prefetch", "hot_tier", "tiering",
+    "source_stalls", "analysis", "serve",
 )
 
 
@@ -262,6 +265,18 @@ def render_digest(obs_dir: str) -> dict:
                 "hot_tier.pending_delta", {}).get("last"),
             "pending_delta_max": gauges.get(
                 "hot_tier.pending_delta", {}).get("max"),
+        },
+        # Adaptive tiering (fps_tpu.tiering): online hot-set re-ranking
+        # + auto-planner activity — re-rank/promotion totals (labels
+        # fold across tables) and the churn gauge's last/max.
+        "tiering": {
+            "re_ranks": int(counters.get("tiering.re_ranks", 0)),
+            "promoted_rows": int(
+                counters.get("tiering.promoted_rows", 0)),
+            "demoted_rows": int(
+                counters.get("tiering.demoted_rows", 0)),
+            "churn_last": gauges.get("tiering.churn", {}).get("last"),
+            "churn_max": gauges.get("tiering.churn", {}).get("max"),
         },
         # Program contract auditor (fps_tpu.analysis): certification
         # totals; the per-violation events ride incidents verbatim.
