@@ -900,15 +900,70 @@ def _reexec_tiered_subprocess():
     return _reexec_workload_subprocess("tiered")
 
 
+def split_route_bytes(profile, *, hot_rows, dim, num_shards,
+                      counted=False, itemsize=4, sketch_bytes=0):
+    """Attribute a tiered program's collective bytes per ROUTE: the
+    window reconcile's reduce-scatter + all-gather pair (or the legacy /
+    extremum all_reduce) is identified by its analytically-known payload
+    (``ceil(H/S)*S`` padded head rows times the delta width — the count
+    column under a counted combine), everything else is the cold
+    pull/push routes. Separating the two makes the payload-proportional
+    cold-routing win and the sharded-reconcile cost independently
+    attributable in the A/B (one aggregate ratio conflates them)."""
+    total = sum(c.payload_bytes for c in profile)
+    tracking = 0
+    if sketch_bytes:
+        # The adaptive tier's end-of-call sketch-merge psum — its own
+        # bucket (it is tracking overhead, neither a data route).
+        for c in profile:
+            if c.kind == "all_reduce" and c.payload_bytes == sketch_bytes:
+                tracking += c.payload_bytes
+                break
+    if not hot_rows:
+        return {"cold": total - tracking, "hot_reconcile": 0,
+                "tracking": tracking}
+    Hp = -(-hot_rows // num_shards) * num_shards
+    dimp = dim + (1 if counted else 0)
+    rs_bytes = Hp * dimp * itemsize
+    ag_bytes = Hp * dim * itemsize
+    # The data-axis psum of the owned slice (meshes with a data axis),
+    # and the extremum pmax (full head + indicator column).
+    slice_bytes = (Hp // num_shards) * dimp * itemsize
+    ar_ok = (slice_bytes, Hp * (dim + 1) * itemsize)
+    want = {"reduce_scatter": (rs_bytes,), "all_gather": (ag_bytes,),
+            "all_reduce": ar_ok}
+    reconcile = 0
+    matched = {k: False for k in want}
+    for c in profile:
+        if (c.kind in want and not matched[c.kind]
+                and c.payload_bytes in want[c.kind]):
+            matched[c.kind] = True
+            reconcile += c.payload_bytes
+    return {"cold": total - reconcile - tracking,
+            "hot_reconcile": reconcile, "tracking": tracking}
+
+
 def run_tiered(args):
     """Zipf-skew two-tier A/B on the 8-device mesh: the same chunk
-    stream trained twice — hot tier OFF (sharded-only: per-step
-    collective pull/push) and ON (replicated hot head, per-device delta
-    buffers, one psum reconcile per ``hot_sync_every`` window). Reports
-    per-chunk cross-shard collective count (from the lowered program;
-    see :func:`count_collectives`) and examples/s for both arms. The
-    acceptance signal: strictly fewer collectives AND no throughput
-    regression with the tier on."""
+    stream trained four ways —
+
+    * **off**  — untiered (per-step collective pull/push);
+    * **on**   — full replication (the PR-5 headline: hot reads local,
+      one sharded reconcile per ``hot_sync_every`` window);
+    * **head** — PARTIAL hot head (H < num_ids) with the STATIC cold
+      routes: the ROADMAP scaling cliff — even at a >0.9 hit rate the
+      cold collectives still carry the full O(batch) payload;
+    * **head_compact** — the same partial head with
+      ``TableSpec.cold_budget``: cold ids compact into a bounded lane,
+      so cold-route collective bytes track actual cold traffic.
+
+    Reports per-chunk collective count and PER-ROUTE payload bytes (hot
+    reconcile vs cold pull/push — :func:`split_route_bytes`) plus
+    examples/s per arm. Acceptance signals: strictly fewer collectives
+    and no throughput regression for ``on`` vs ``off`` (PR 5), and a
+    >= 3x cold-route byte reduction for ``head_compact`` vs ``head`` at
+    a >= 0.9 hit rate (PR 10, pinned statically as the
+    ``mf_tiered_compact`` audit budget)."""
     import dataclasses
 
     import jax
@@ -927,6 +982,8 @@ def run_tiered(args):
 
     NU, NI, RANK = 4096, 4096, 16
     E_SYNC = 4          # hot_sync_every: the parameter-plane SSP bound
+    H_PART = 2048       # partial head: ~0.93 coverage at alpha 1.05
+    COLD_BUDGET = 256   # per-worker cold lane (~3.5x expected cold rows)
     LOCAL_BATCH, SPC, CHUNKS = 1024, 8, 12
     data = _zipf_ratings(NU, NI, W * LOCAL_BATCH * SPC * CHUNKS, seed=0)
 
@@ -935,9 +992,18 @@ def run_tiered(args):
                             steps_per_chunk=SPC, route_key="user", seed=5)
 
     out = {"hot_sync_every": E_SYNC, "hot_tier_rows": NI,
+           "partial_head": H_PART, "cold_budget": COLD_BUDGET,
            "zipf_alpha": 1.05, "mesh": dict(mesh.shape)}
     rates = {}
-    for label, H in (("off", 0), ("on", NI)):
+    # (label, H, cold_budget, force_gathered): the partial-head arms
+    # force the gathered cold route (dense_collectives=False) — the
+    # compaction story is about embedding-scale tables whose cold route
+    # cannot afford table-sized dense collectives; at this bench scale
+    # the item table would otherwise auto-resolve dense.
+    arms = (("off", 0, 0, False), ("on", NI, 0, False),
+            ("head", H_PART, 0, True),
+            ("head_compact", H_PART, COLD_BUDGET, True))
+    for label, H, C, gathered in arms:
         cfg = MFConfig(num_users=NU, num_items=NI, rank=RANK,
                        learning_rate=0.05)
         # Per-id mean combine: zipf-hot duplicate ids need the averaged
@@ -946,16 +1012,20 @@ def run_tiered(args):
         trainer, store = online_mf(mesh, cfg, combine="mean")
         if H:
             store.specs["item_factors"] = dataclasses.replace(
-                store.specs["item_factors"], hot_tier=H)
+                store.specs["item_factors"], hot_tier=H, cold_budget=C,
+                **({"dense_collectives": False} if gathered else {}))
             trainer.config = dataclasses.replace(
                 trainer.config, hot_sync_every=E_SYNC)
         from fps_tpu import obs
 
-        # Static collective count of the per-chunk program.
+        # Static collective profile of the per-chunk program, split per
+        # route (mean combine carries the count column -> counted=True).
         hlo = trainer.lowered_chunk_text(next(make_chunks()), "sync")
         profile = collective_profile(hlo)
         colls = len(profile)
         coll_bytes = sum(c.payload_bytes for c in profile)
+        routes = split_route_bytes(
+            profile, hot_rows=H, dim=RANK, num_shards=ns, counted=True)
 
         # Warm-up (compile), then timed run on fresh state with a fresh
         # recorder — the hit-rate counters must scope the timed pass
@@ -978,10 +1048,13 @@ def run_tiered(args):
         arm = {
             "collectives_per_chunk": colls,
             # Payload bytes those collectives move per chunk program —
-            # the structured profile's sum (fps_tpu.analysis): the
-            # partial-head scaling cliff (ROADMAP) is a BYTES story the
-            # bare count can't show.
+            # the structured profile's sum (fps_tpu.analysis), split by
+            # ROUTE so the reconcile-sharding and cold-compaction wins
+            # are separately attributable (the partial-head scaling
+            # cliff is a BYTES story the bare count can't show).
             "collective_bytes_per_chunk": coll_bytes,
+            "cold_bytes_per_chunk": routes["cold"],
+            "hot_reconcile_bytes_per_chunk": routes["hot_reconcile"],
             "examples_per_sec": round(n_ex / wall, 1),
             "wall_s": round(wall, 4),
             "train_rmse": round((se / max(n_ex, 1.0)) ** 0.5, 4),
@@ -992,23 +1065,55 @@ def run_tiered(args):
             pr = rec.counter_value("hot_tier.pulled_rows",
                                    table="item_factors")
             arm["hot_hit_rate"] = round(hr / pr, 4) if pr else None
+        if C:
+            arm["compact_chunks"] = int(
+                rec.counter_value("cold_route.compact_chunks"))
+            arm["overflow_chunks"] = int(rec.counter_value(
+                "cold_route.overflow_chunks", table="item_factors"))
+            arm["cold_dropped"] = int(rec.counter_value(
+                "hot_tier.cold_dropped", table="item_factors"))
         out[label] = arm
 
     off, on = out["off"], out["on"]
+    head, compact = out["head"], out["head_compact"]
     out["collectives_fewer"] = (on["collectives_per_chunk"]
                                 < off["collectives_per_chunk"])
-    out["collective_bytes_ratio"] = (
-        round(on["collective_bytes_per_chunk"]
-              / off["collective_bytes_per_chunk"], 4)
-        if off["collective_bytes_per_chunk"] else None)
+    # PER-ROUTE ratios (PR 10): the cold ratio isolates the compaction
+    # win at the same head; the reconcile share shows what the sharded
+    # window exchange costs against the cold traffic it absorbs.
+    out["collective_bytes_ratio"] = {
+        "cold_compact_vs_static": (
+            round(compact["cold_bytes_per_chunk"]
+                  / head["cold_bytes_per_chunk"], 4)
+            if head["cold_bytes_per_chunk"] else None),
+        "cold_head_vs_off": (
+            round(head["cold_bytes_per_chunk"]
+                  / off["cold_bytes_per_chunk"], 4)
+            if off["cold_bytes_per_chunk"] else None),
+        "total_on_vs_off": (
+            round(on["collective_bytes_per_chunk"]
+                  / off["collective_bytes_per_chunk"], 4)
+            if off["collective_bytes_per_chunk"] else None),
+    }
+    ratio = out["collective_bytes_ratio"]["cold_compact_vs_static"]
+    out["cold_bytes_reduction_x"] = (
+        round(1.0 / ratio, 2) if ratio else None)
     out["speedup"] = round(rates["on"] / rates["off"], 3)
+    out["speedup_compact_vs_head"] = round(
+        rates["head_compact"] / rates["head"], 3)
     print(
         f"tiered A/B: collectives/chunk {off['collectives_per_chunk']} -> "
         f"{on['collectives_per_chunk']} "
         f"({off['collective_bytes_per_chunk']} -> "
         f"{on['collective_bytes_per_chunk']} bytes), examples/s "
         f"{off['examples_per_sec']:.0f} -> {on['examples_per_sec']:.0f}, "
-        f"hot hit rate {on.get('hot_hit_rate')}", file=sys.stderr)
+        f"hot hit rate {on.get('hot_hit_rate')}; partial head "
+        f"hit rate {head.get('hot_hit_rate')}, cold bytes/chunk "
+        f"{head['cold_bytes_per_chunk']} -> "
+        f"{compact['cold_bytes_per_chunk']} "
+        f"({out['cold_bytes_reduction_x']}x, overflow "
+        f"{compact.get('overflow_chunks')}, dropped "
+        f"{compact.get('cold_dropped')})", file=sys.stderr)
     return {
         "metric": "zipf_mf_two_tier_examples_per_sec",
         "value": on["examples_per_sec"],
@@ -1150,10 +1255,25 @@ def run_tiered_drift(args):
         n_ex = float(sum(np.asarray(mm["n"]).sum() for mm in m))
         se = float(sum(np.asarray(mm["se"]).sum() for mm in m))
         rates[arm] = n_ex / wall
+        Hres = trainer._hot_tier_map().get("item_factors", 0)
+        sketch_b = 0
+        if trainer.retierer is not None:
+            cm = trainer.retierer.spec
+            sketch_b = cm.depth * cm.width * 4
+        routes = split_route_bytes(
+            profile, hot_rows=Hres, dim=RANK,
+            num_shards=mesh.shape["shard"], counted=True,
+            sketch_bytes=sketch_b)
         arm_out = {
             "collectives_per_chunk": len(profile),
             "collective_bytes_per_chunk": sum(
                 c.payload_bytes for c in profile),
+            # Per-route split (PR 10): cold pull/push vs the window
+            # reconcile vs tracking overhead — the three optimizations
+            # stay separately attributable.
+            "cold_bytes_per_chunk": routes["cold"],
+            "hot_reconcile_bytes_per_chunk": routes["hot_reconcile"],
+            "tracking_bytes_per_chunk": routes["tracking"],
             "examples_per_sec": round(n_ex / wall, 1),
             "wall_s": round(wall, 4),
             "train_rmse": round((se / max(n_ex, 1.0)) ** 0.5, 4),

@@ -220,37 +220,50 @@ class DtypeDriftDetector(AnalysisPass):
 
 
 class ReplicaConsistency(AnalysisPass):
-    """Tiered programs must reconcile through the shard-axis psum.
+    """Tiered programs must reconcile through the shard axis.
 
-    The two-tier storage's correctness story (PR 5) is that hot-tier
-    replica updates are *dominated by one psum*: per-device pending
-    deltas fold into replica + canonical head through an ``all_reduce``
-    over the shard axis, sized to the replicated head. A program that
-    claims tiering but lowers without that psum either silently dropped
-    the reconcile (divergent replicas) or re-routed hot traffic through
-    the gathered scatters (the budget the tier exists to avoid)."""
+    The two-tier storage's correctness story (PR 5, sharded in PR 10 per
+    arXiv:2004.13336) is that hot-tier replica updates are *dominated by
+    one window-end collective exchange*: per-device pending deltas fold
+    into replica + canonical head through a **reduce-scatter** over the
+    shard axis (each replica applies its disjoint 1/S slice, re-broadcast
+    by the paired all-gather), or — for the extremum combines, and in
+    pre-PR-10 programs — a full-head ``all_reduce``. A program that
+    claims tiering but lowers with neither either silently dropped the
+    reconcile (divergent replicas) or re-routed hot traffic through the
+    gathered scatters (the budget the tier exists to avoid).
+
+    Heuristic scope note: the op is identified by kind + shard group +
+    payload size (>= the replicated head's bytes), not by dataflow — a
+    cold-route reduce-scatter of at least that size also satisfies it.
+    The collective BUDGET pass pins the exact op census; this pass only
+    asserts the reconcile-shaped exchange exists."""
 
     name = "replica_consistency"
+
+    _KINDS = ("reduce_scatter", "all_reduce")
 
     def run(self, program, contract):
         if not contract.require_shard_psum:
             return []
         want = contract.hot_reconcile_bytes
-        for op in program.by_kind("all_reduce"):
-            if op.group_size is not None and op.group_size <= 1:
-                continue
-            if (contract.shard_group_size is not None
-                    and op.group_size is not None
-                    and op.group_size != contract.shard_group_size):
-                continue
-            if op.payload_bytes >= want:
-                return []
+        for kind in self._KINDS:
+            for op in program.by_kind(kind):
+                if op.group_size is not None and op.group_size <= 1:
+                    continue
+                if (contract.shard_group_size is not None
+                        and op.group_size is not None
+                        and op.group_size != contract.shard_group_size):
+                    continue
+                if op.payload_bytes >= want:
+                    return []
         side = (f" over groups of {contract.shard_group_size}"
                 if contract.shard_group_size else "")
         return [self._v(
-            f"no hot-tier reconcile psum found: expected an all_reduce"
-            f"{side} with payload >= {want}B — replica and canonical "
-            "table cannot stay consistent without it"
+            f"no hot-tier reconcile exchange found: expected a "
+            f"reduce_scatter (or extremum/legacy all_reduce){side} with "
+            f"payload >= {want}B — replica and canonical table cannot "
+            "stay consistent without it"
         )]
 
 

@@ -79,6 +79,30 @@ class WorkerLogic:
         """Map table name -> (B,) int32 ids to pull for this batch."""
         raise NotImplementedError
 
+    def pulled_ids_host(self, chunk: Pytree) -> Mapping[str, Any] | None:
+        """Optional HOST-side certification stream for the compacted cold
+        routes (``TableSpec.cold_budget``; docs/performance.md
+        "Payload-proportional routing").
+
+        Return ``{table: int id array}`` per compactable table, computed
+        from the RAW (un-``prepare``-d) host chunk: the LAST axis must be
+        the worker-major per-step id stream (the global batch dim for
+        one-id-per-example logics; multi-id columns shaped ``(T, B, k)``
+        reshape to ``(T, B*k)`` — worker-major blocks survive the
+        flatten) and the leading axes the chunk's step dims, and the
+        stream must cover every id the compiled step pulls OR pushes for
+        that table at each position. Padding positions may carry any id — the certifier
+        counts them conservatively (a padding id outside the hot set
+        consumes cold-lane budget, exactly as it would on device).
+
+        ``None`` (default): chunks from this logic are not host-
+        certifiable, so every chunk dispatches the static (full-payload)
+        cold routes even when a ``cold_budget`` is configured. Logics
+        whose ``prepare`` synthesizes ids on device (e.g. negative
+        sampling) must return ``None`` unless the synthesized ids are
+        provably hot."""
+        return None
+
     def head_prefix(self, batch: Pytree) -> Mapping[str, int]:
         """Optional STATIC guarantee: table name -> count of LEADING ids
         (in both :meth:`pull_ids` order and the step's push order) that
@@ -135,6 +159,69 @@ class WorkerLogic:
 
 
 @dataclasses.dataclass(frozen=True)
+class HotFold:
+    """Stateful hot-tier optimizer fold (Adagrad / Adam server state).
+
+    With the sharded reconcile (reduce-scatter → apply the owned 1/S
+    slice → all-gather, docs/performance.md "Sharded reconcile"), every
+    replica applies a DISJOINT slice of the hot head per window — so
+    per-row optimizer state can live sharded over the replica axis
+    instead of being replicated. A ``HotFold`` turns the window's
+    combined delta ``g`` (after the ``combine`` normalization) into an
+    adaptively-scaled step on the slice:
+
+    * ``"adagrad"`` — ``G += g²; step = lr · g / (sqrt(G) + eps)``;
+    * ``"adam"`` — lazy per-row Adam: rows untouched in a window keep
+      their moments and step count unchanged (sparse-table convention —
+      decaying untouched rows would make zero-traffic rows drift), rows
+      touched update ``m``/``v`` with bias correction by the row's own
+      window count ``t``.
+
+    The state is never replicated, never part of the canonical table
+    bytes, and flush-reconciled like the pending-delta buffers: the
+    canonical sharded table at any call boundary already holds the
+    folded steps, so checkpoints stay byte-canonical (an untiered
+    trainer restores them); the state itself rides the snapshot as
+    separate ``fold::`` arrays so a supervised resume is bit-identical.
+
+    Requires the hot tier to resolve ON for the table (multi-device,
+    ``hot_sync_every > 1``, full replication — partial heads would give
+    head rows an adaptive step and tail rows a raw one, a silent
+    semantic fork, so they are rejected at resolution).
+    """
+
+    kind: str  # "adagrad" | "adam"
+    lr: float = 1.0
+    eps: float = 1e-8
+    beta1: float = 0.9
+    beta2: float = 0.999
+
+    def __post_init__(self):
+        if self.kind not in ("adagrad", "adam"):
+            raise ValueError(
+                f"HotFold.kind {self.kind!r} — expected 'adagrad' or 'adam'"
+            )
+
+    def state_cols(self, dim: int) -> int:
+        """Columns of per-row optimizer state: Adagrad keeps ``G``;
+        Adam keeps ``(m, v, t)`` with the window count as a column."""
+        return dim if self.kind == "adagrad" else 2 * dim + 1
+
+
+def as_hot_fold(fold) -> HotFold | None:
+    """Normalize the ``ServerLogic.hot_fold`` shorthand: a string names
+    the fold kind with default hyperparameters; None passes through."""
+    if fold is None or isinstance(fold, HotFold):
+        return fold
+    if isinstance(fold, str):
+        return HotFold(kind=fold)
+    raise TypeError(
+        f"hot_fold must be a HotFold, a kind string, or None — got "
+        f"{type(fold).__name__}"
+    )
+
+
+@dataclasses.dataclass(frozen=True)
 class ServerLogic:
     """Per-table server fold — the reference's ``SimplePSLogic`` plus its
     pluggable combining senders.
@@ -151,10 +238,17 @@ class ServerLogic:
     (elementwise extremum), or a callable ``(summed, counts) -> combined``
     over each row's per-id delta sum and push count (see
     :func:`fps_tpu.core.store.push`).
+
+    ``hot_fold`` (a :class:`HotFold`, or its kind string) adds Adagrad /
+    Adam optimizer state to the table's HOT TIER, sharded over the
+    replica axis by the sharded reconcile — see :class:`HotFold` for the
+    exact semantics and the resolution requirements. Ignored (with the
+    tier's usual loud resolution errors) when the tier is off.
     """
 
     apply_fn: Callable[[Array, Array], Array] | None = None
     combine: str | Callable[[Array, Array], Array] = "sum"
+    hot_fold: "HotFold | str | None" = None
 
 
 ADDITIVE = ServerLogic(apply_fn=None)

@@ -352,6 +352,23 @@ class Checkpointer:
         synchronously at the training step it describes. Serialization
         (:meth:`_write`) can then run later/elsewhere."""
         arrays = _table_arrays(store)
+        # Hot-fold optimizer state (ServerLogic.hot_fold): separate
+        # ``fold::`` entries, never part of the canonical table bytes —
+        # an untiered (or older) reader skips the kind, a resuming
+        # tiered trainer restores it for bit-identical replay.
+        from fps_tpu.core.store import FOLD_KEY_SUFFIX
+
+        for key in sorted(store.tables):
+            if not key.endswith(FOLD_KEY_SUFFIX):
+                continue
+            name = key[: -len(FOLD_KEY_SUFFIX)]
+            arr = store.tables[key]
+            if (hasattr(arr, "sharding")
+                    and not arr.sharding.is_fully_addressable):
+                from fps_tpu.parallel.mesh import replicate_to_mesh
+
+                arr = replicate_to_mesh(arr, store.mesh)
+            arrays[snapshot_format.FOLD_PREFIX + name] = np.asarray(arr)
         leaves, treedef = jax.tree.flatten(local_state)
         for i, leaf in enumerate(leaves):
             # Multi-controller: a worker-sharded leaf spans processes, and
@@ -480,6 +497,13 @@ class Checkpointer:
             for k, v in entries.items()
             if k.startswith(f"table{_SEP}")
         }
+        # Hot-fold state rides the same values dict under its full
+        # ``fold::<name>`` key (table names never contain the separator,
+        # so the kinds cannot collide); load_tables re-installs it.
+        tables.update({
+            k: v for k, v in entries.items()
+            if k.startswith(snapshot_format.FOLD_PREFIX)
+        })
         return tables, _ls_leaves(entries), _ls_format(entries)
 
     def _quarantine(self, step: int, err: Exception) -> None:
@@ -582,10 +606,23 @@ class Checkpointer:
         # run-entry re-split (Trainer._attach_hot) derives fresh entries
         # from the restored canonical tables (and the restored tracker
         # state) instead of silently serving pre-restore values.
-        from fps_tpu.core.store import is_aux_key
+        from fps_tpu.core.store import FOLD_KEY_SUFFIX, is_aux_key
 
         for key in [k for k in store.tables if is_aux_key(k)]:
             del store.tables[key]
+        # Hot-fold optimizer state is the one aux kind that is NOT a
+        # projection of the canonical table — re-install the snapshot's
+        # ``fold::`` arrays (sharded like the tables; _attach_hot keeps
+        # them when the resolution still matches, drops them otherwise).
+        for key in sorted(values_by_name):
+            if not key.startswith(snapshot_format.FOLD_PREFIX):
+                continue
+            name = key[len(snapshot_format.FOLD_PREFIX):]
+            if name not in store.specs:
+                continue
+            arr = np.asarray(values_by_name[key], np.float32)
+            store.tables[name + FOLD_KEY_SUFFIX] = jax.device_put(
+                arr, store.sharding)
         return dict(store.tables)
 
     def restore_tables(

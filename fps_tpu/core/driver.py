@@ -38,18 +38,23 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fps_tpu import ops
 from fps_tpu.core import resilience
-from fps_tpu.core.api import ServerLogic, WorkerLogic
+from fps_tpu.core.api import ServerLogic, WorkerLogic, as_hot_fold
 from fps_tpu.core.prefetch import ChunkPrefetcher, PlacedChunk
 from fps_tpu.core.resilience import GuardConfig, RollbackPolicy
 from fps_tpu import sketch as _sketch
 from fps_tpu.core.store import (
+    FOLD_KEY_SUFFIX,
     IDS_KEY_SUFFIX,
     MAP_KEY_SUFFIX,
     SKETCH_KEY_SUFFIX,
     ParamStore,
     accumulate_hot,
+    compact_cold,
+    delta_counted,
+    fold_key,
     hot_base,
     hot_delta_init,
+    hot_fold_state_shape,
     hot_key,
     hot_slot_map,
     id_to_phys,
@@ -496,11 +501,13 @@ class Trainer:
           zero collectives);
         * ``hot_sync_every > 1`` — 1 is the exact mode, implemented as
           the untiered program itself (see TrainerConfig);
-        * additive ("sum") or "mean" server folds: windowed accumulation
-          needs delta sums (+ counts) to commute with the fold; apply_fn
-          and max/min/callable combines need per-push combine-then-apply
-          over the gathered union, so those tables keep the gathered
-          route untouched.
+        * "sum" / "mean" / "max" / "min" server folds: the windowed
+          pending buffer carries delta sums (+ counts) or elementwise
+          extrema, which commute with those combines; ``apply_fn`` and
+          callable combines need per-push combine-then-apply over the
+          gathered union, so those tables keep the gathered route
+          untouched (the one demotion left — PR 10 moved max/min onto
+          the tier via the extremum pending buffer).
         """
         H = spec.hot_tier
         if isinstance(H, str):
@@ -513,36 +520,79 @@ class Trainer:
                 f"table {spec.name!r}: hot_tier={H} must be >= 0"
             )
         if not H:
+            self._check_hot_fold(spec, 0)
             return 0
         if self.num_shards * self.mesh.shape[DATA_AXIS] == 1:
+            self._check_hot_fold(spec, 0)
             return 0
         if self.config.hot_sync_every <= 1:
+            self._check_hot_fold(spec, 0)
             return 0
         sl = self.server_logic[spec.name]
-        if sl.apply_fn is not None or sl.combine not in ("sum", "mean"):
+        if sl.apply_fn is not None or not (
+                isinstance(sl.combine, str)
+                and sl.combine in ("sum", "mean", "max", "min")):
             # The one SURPRISING disengagement: single-device meshes and
             # hot_sync_every=1 are documented expected states, but a
             # requested tier silently falling back because of the server
-            # fold hides a real semantic limit (windowed delta sums
-            # cannot commute with apply_fn/max/min/callable combines) —
-            # say so once, explicitly.
+            # fold hides a real semantic limit (windowed accumulation
+            # cannot reproduce per-push apply_fn / callable-combine
+            # semantics over the gathered union) — say so once,
+            # explicitly.
             if spec.name not in self._tier_warned:
                 self._tier_warned.add(spec.name)
                 fold = ("apply_fn" if sl.apply_fn is not None
-                        else f"combine={sl.combine!r}"
-                        if isinstance(sl.combine, str)
                         else "a callable combine")
                 msg = (
                     f"table {spec.name!r}: hot_tier={H} requested but the "
-                    f"non-additive server fold ({fold}) keeps the gathered "
-                    "route — windowed hot-delta accumulation only commutes "
-                    "with 'sum'/'mean' folds, so the tier is disabled for "
-                    "this table (the program lowers untiered)"
+                    f"per-push server fold ({fold}) keeps the gathered "
+                    "route — windowed hot-delta accumulation commutes "
+                    "with the 'sum'/'mean'/'max'/'min' combines only, so "
+                    "the tier is disabled for this table (the program "
+                    "lowers untiered)"
                 )
                 warnings.warn(msg, stacklevel=2)
                 _log.warning("%s", msg)
+            self._check_hot_fold(spec, 0)
             return 0
-        return min(int(H), spec.num_ids)
+        H = min(int(H), spec.num_ids)
+        self._check_hot_fold(spec, H)
+        return H
+
+    def _check_hot_fold(self, spec, resolved_H: int) -> None:
+        """Fail loudly when a stateful hot fold cannot engage: silently
+        downgrading Adagrad/Adam to plain addition would change the
+        optimizer, not just the data plane. Requires the tier to resolve
+        ON with FULL replication (a partial head would give head rows the
+        adaptive step and cold-tail rows the raw delta — a semantic
+        fork), and a sum/mean combine (the fold consumes window delta
+        sums)."""
+        fold = as_hot_fold(self.server_logic[spec.name].hot_fold)
+        if fold is None:
+            return
+        sl = self.server_logic[spec.name]
+        if not resolved_H:
+            raise ValueError(
+                f"table {spec.name!r}: hot_fold={fold.kind!r} requires the "
+                "hot tier to resolve ON (multi-device mesh, hot_tier > 0, "
+                "hot_sync_every > 1, no apply_fn) — a silently-ignored "
+                "server optimizer would change training semantics"
+            )
+        if resolved_H < spec.num_ids:
+            raise ValueError(
+                f"table {spec.name!r}: hot_fold={fold.kind!r} with a "
+                f"PARTIAL head (H={resolved_H} < {spec.num_ids}): head "
+                "rows would take the adaptive step while cold-tail pushes "
+                "fold additively — set hot_tier >= num_ids (the fold's "
+                "state shards over the replica axis, so full replication "
+                "does not replicate it)"
+            )
+        if sl.combine not in ("sum", "mean"):
+            raise ValueError(
+                f"table {spec.name!r}: hot_fold={fold.kind!r} needs a "
+                f"'sum'/'mean' combine (got {sl.combine!r}) — the fold "
+                "consumes the window's combined delta sum"
+            )
 
     def _hot_tier_map(self) -> dict[str, int]:
         """{table: replicated head rows} for every table the tier resolves
@@ -560,6 +610,72 @@ class Trainer:
                 f"{sorted(tier)})"
             )
         return tier
+
+    def _hot_fold_map(self) -> dict:
+        """{table: HotFold} for tables whose resolved tier carries a
+        stateful server fold (validated by :meth:`_check_hot_fold`).
+        Part of the compile-cache key via :meth:`_server_logic_key`."""
+        out = {}
+        for name in self._hot_tier_map():
+            fold = as_hot_fold(self.server_logic[name].hot_fold)
+            if fold is not None:
+                out[name] = fold
+        return out
+
+    def _cold_compact_map(self) -> dict[str, int]:
+        """{table: per-worker cold-lane width} for tables on the
+        COMPACTED cold routes (``TableSpec.cold_budget``; docs/
+        performance.md "Payload-proportional routing"): a partial hot
+        head (0 < H < num_ids) on a non-dense route with a positive
+        budget. The compacted program is a distinct compile-cache entry;
+        whether a given chunk may dispatch it is the host certifier's
+        per-chunk call (:meth:`_certify_cold`)."""
+        out = {}
+        for name, H in sorted(self._hot_tier_map().items()):
+            spec = self.store.specs[name]
+            C = int(getattr(spec, "cold_budget", 0) or 0)
+            if C <= 0 or H >= spec.num_ids:
+                continue
+            if self._resolve_dense(spec):
+                continue  # dense routes move table-sized payloads anyway
+            out[name] = C
+        return out
+
+    def _certify_cold(self, host_ids) -> tuple[bool, list[str]]:
+        """Host-side per-chunk certification for the compacted cold
+        routes: every (step, worker) slice's cold-id count must fit the
+        lane. ``host_ids`` is ``WorkerLogic.pulled_ids_host``'s dict (or
+        None = uncertifiable). Counts are conservative — padding
+        positions count like real ids, exactly as the device-side
+        compaction sees them. Returns ``(fits, overflowed_tables)``;
+        an uncertifiable chunk reports every compacted table."""
+        from fps_tpu.core.ingest import per_worker_cold_counts
+
+        compact = self._cold_compact_map()
+        overflowed = []
+        for name, C in compact.items():
+            arr = None if host_ids is None else host_ids.get(name)
+            if arr is None:
+                overflowed.append(name)
+                continue
+            H = self._hot_tier_map()[name]
+            member = None
+            if name in self._mapped_tables() and self.retierer is not None:
+                num_ids = self.store.specs[name].num_ids
+                member = np.zeros(num_ids + 1, bool)
+                member[self.retierer.hot_ids_for(name, H)] = True
+            counts = per_worker_cold_counts(
+                arr, self.num_workers, hot_head=H, hot_member=member)
+            if int(counts.max(initial=0)) > C:
+                overflowed.append(name)
+        return not overflowed, overflowed
+
+    def _host_cert_ids(self, chunk):
+        """The logic's host certification stream for a raw host chunk
+        (None when the logic cannot certify, or nothing is compacted)."""
+        if not self._cold_compact_map():
+            return None
+        return self.logic.pulled_ids_host(chunk)
 
     def _mapped_tables(self) -> dict[str, int]:
         """{table: H} for tables on the ADAPTIVE (mapped) tier: the
@@ -625,6 +741,7 @@ class Trainer:
         tier = self._hot_tier_map()
         mapped = self._mapped_tables()
         track = self._track_specs()
+        folds = self._hot_fold_map()
         if not (tier or track) and not any(is_aux_key(k) for k in tables):
             return tables
         out = {}
@@ -649,12 +766,23 @@ class Trainer:
                 cm = track.get(name)
                 if cm is not None and v.shape == (cm.depth, cm.width):
                     out[k] = v
+            elif k.endswith(FOLD_KEY_SUFFIX):
+                name = k[: -len(FOLD_KEY_SUFFIX)]
+                fold = folds.get(name)
+                if fold is not None and tuple(v.shape) == tuple(
+                        hot_fold_state_shape(
+                            fold, tier[name],
+                            self.store.specs[name].dim,
+                            self.num_shards)):
+                    out[k] = v  # live/restored state: keep (not derivable)
         missing_hot = [n for n in sorted(tier) if hot_key(n) not in out]
         missing_map = [n for n in sorted(mapped)
                        if map_key(n) not in out or ids_key(n) not in out]
         missing_sk = [n for n in sorted(track)
                       if sketch_key(n) not in out]
-        if not (missing_hot or missing_map or missing_sk):
+        missing_fold = [n for n in sorted(folds)
+                        if fold_key(n) not in out]
+        if not (missing_hot or missing_map or missing_sk or missing_fold):
             return out
         # Only an actual derivation pays (and records) the reconcile
         # phase — the steady-state per-chunk call is pure dict checks.
@@ -682,6 +810,16 @@ class Trainer:
                     win = np.zeros((cm.depth, cm.width), np.float32)
                 out[sketch_key(name)] = jax.device_put(
                     np.asarray(win, np.float32), self._replicated)
+            for name in missing_fold:
+                # Fresh (zero) optimizer state, SHARDED over the shard
+                # axis in reduce-scatter slice order; restored states
+                # arrive already in ``tables`` (checkpoint ``fold::``
+                # arrays) and were kept above.
+                shape = hot_fold_state_shape(
+                    folds[name], tier[name],
+                    self.store.specs[name].dim, self.num_shards)
+                out[fold_key(name)] = jax.device_put(
+                    np.zeros(shape, np.float32), self._table_sharding)
         return out
 
     def _enter_tiering(self) -> None:
@@ -760,7 +898,7 @@ class Trainer:
 
     def _compute_step(self, tables, snapshot, local_state, batch, key,
                       hot=None, tier=None, maps=None, track=None,
-                      sk=None):
+                      sk=None, compact=None):
         """Pull (from live tables, or the SSP ``snapshot`` when given), run
         the worker step, and return its pushes WITHOUT applying them,
         plus the (static) head-prefix guarantee for those pushes, the
@@ -786,6 +924,7 @@ class Trainer:
         tier = tier or {}
         maps = maps or {}
         track = track or {}
+        compact = compact or {}
         key, prep_key = jax.random.split(key)
         batch = self.logic.prepare(batch, prep_key)
         ids = self.logic.pull_ids(batch)
@@ -841,12 +980,30 @@ class Trainer:
                             "hot_rows": jnp.sum(hmask, dtype=jnp.int32),
                             "pulled_rows": live,
                         }
-                    vals = pull(
-                        tables[name], tids, num_shards=self.num_shards,
-                        dense=self._resolve_dense(spec),
-                        hot_rows=self._resolve_hot_rows(spec),
-                        head_prefix=hp.get(name, 0),
-                    )
+                    if H and name in compact:
+                        # Payload-proportional cold pull: pack the cold
+                        # residue into the certified lane, pull O(lane)
+                        # through the collective route, scatter the lane
+                        # rows back to their batch positions (masked /
+                        # dropped slots read zero rows — the -1
+                        # contract).
+                        lane_ids, _, pos, over = compact_cold(
+                            tids, None, budget=compact[name])
+                        lane_vals = pull(
+                            tables[name], lane_ids,
+                            num_shards=self.num_shards,
+                            dense=False,
+                            hot_rows=self._resolve_hot_rows(spec),
+                        )
+                        vals = ops.gather_rows(lane_vals, pos)
+                        hot_counts[name]["cold_dropped"] = over
+                    else:
+                        vals = pull(
+                            tables[name], tids, num_shards=self.num_shards,
+                            dense=self._resolve_dense(spec),
+                            hot_rows=self._resolve_hot_rows(spec),
+                            head_prefix=hp.get(name, 0),
+                        )
                     if H:
                         vals = jnp.where(hmask[:, None], hot_vals, vals)
                     pulled[name] = vals
@@ -1012,8 +1169,11 @@ class Trainer:
 
     # -- two-tier hot storage (device-side step/window plumbing) ----------
 
-    def _hot_mean(self, name: str) -> bool:
-        return self.server_logic[name].combine == "mean"
+    def _hot_combine(self, name: str) -> str:
+        return self.server_logic[name].combine
+
+    def _hot_fold(self, name: str):
+        return self._hot_fold_map().get(name)
 
     def _init_hot_deltas(self, tables, tier):
         """Fresh per-device pending-delta buffers ({} when untiered).
@@ -1022,22 +1182,28 @@ class Trainer:
         return {
             name: hot_delta_init(
                 H, tables[name].shape[1], tables[name].dtype,
-                mean=self._hot_mean(name),
+                combine=self._hot_combine(name),
+                fold=self._hot_fold(name),
             )
             for name, H in tier.items()
         }
 
     def _apply_hot_split(self, tables, delta, pushes, tier, hp,
-                         maps=None):
+                         maps=None, compact=None):
         """Partition each table's pushes on hot membership (``id < H``
         statically, or the adaptive tier's slot-map lookup), apply the
         cold part through the existing routes (statically elided when H
-        covers the table) and fold the hot part into the pending
-        buffers."""
+        covers the table, COMPACTED to the ``cold_budget`` lane when the
+        table rides the payload-proportional route) and fold the hot
+        part into the pending buffers. Returns the per-table count of
+        budget-overflow drops alongside (always zero for host-certified
+        chunks — the device-side observability net)."""
         if not tier:
-            return self._apply_pushes(tables, pushes, hp), delta
+            return self._apply_pushes(tables, pushes, hp), delta, {}
         maps = maps or {}
+        compact = compact or {}
         cold_pushes = {}
+        dropped = {}
         new_delta = dict(delta)
         with jax.named_scope("fps.hot_accumulate"):
             for name, (pids, pdeltas) in pushes.items():
@@ -1059,43 +1225,64 @@ class Trainer:
                     cold_pushes[name], hots = split_hot_push(
                         pids, pdeltas, hot_ids=H
                     )
+                if name in cold_pushes and name in compact:
+                    # Payload-proportional cold push: pack the residue
+                    # into the certified lane before the collective.
+                    cids, cdeltas = cold_pushes[name]
+                    lane_ids, lane_deltas, _, over = compact_cold(
+                        cids, cdeltas, budget=compact[name])
+                    cold_pushes[name] = (lane_ids, lane_deltas)
+                    dropped[name] = dropped.get(name, 0) + over
                 new_delta[name] = accumulate_hot(
-                    delta[name], *hots, mean=self._hot_mean(name)
+                    delta[name], *hots,
+                    combine=self._hot_combine(name),
+                    fold=self._hot_fold(name),
                 )
-        return self._apply_pushes(tables, cold_pushes, hp), new_delta
+        return self._apply_pushes(tables, cold_pushes, hp), new_delta, dropped
 
     def _reconcile_carry(self, carry, tier, gids=None):
         """Window-boundary reconcile over every tiered table (identity
-        when untiered): one psum per table folds the pending buffers into
-        replica + canonical table and zeroes the buffers. ``gids`` maps
+        when untiered): one reduce-scatter → owned-slice apply →
+        all-gather per table (pmax/pmin for the extremum combines) folds
+        the pending buffers into replica + canonical table, advances any
+        sharded fold state, and resets the buffers. ``gids`` maps
         adaptive-tier tables to their replicated slot->global-id arrays
         (DATA — the mapped reconcile scatters into whichever canonical
         rows the current ranking names, without recompiling)."""
         if not tier:
             return carry
         gids = gids or {}
-        tables, hot, delta = carry[0], carry[1], carry[2]
+        tables, hot, delta, folds = (carry[0], carry[1], carry[2],
+                                     carry[3])
         tables, hot, delta = dict(tables), dict(hot), dict(delta)
+        folds = dict(folds)
         data_axis = DATA_AXIS if self.mesh.shape[DATA_AXIS] > 1 else None
         with jax.named_scope("fps.reconcile"):
-            for name, H in tier.items():
+            for name, H in sorted(tier.items()):
+                fold = self._hot_fold(name)
+                fstate = folds.get(name)
                 if name in gids:
-                    tables[name], hot[name], delta[name] = (
-                        reconcile_hot_mapped(
-                            tables[name], hot[name], delta[name],
-                            gids[name],
-                            num_shards=self.num_shards,
-                            data_axis=data_axis,
-                            mean=self._hot_mean(name),
-                        ))
+                    (tables[name], hot[name], delta[name],
+                     fstate) = reconcile_hot_mapped(
+                        tables[name], hot[name], delta[name],
+                        gids[name],
+                        num_shards=self.num_shards,
+                        data_axis=data_axis,
+                        combine=self._hot_combine(name),
+                        fold=fold, fold_state=fstate,
+                    )
                 else:
-                    tables[name], hot[name], delta[name] = reconcile_hot(
+                    (tables[name], hot[name], delta[name],
+                     fstate) = reconcile_hot(
                         tables[name], hot[name], delta[name],
                         num_shards=self.num_shards,
                         data_axis=data_axis,
-                        mean=self._hot_mean(name),
+                        combine=self._hot_combine(name),
+                        fold=fold, fold_state=fstate,
                     )
-        return (tables, hot, delta) + tuple(carry[3:])
+                if fstate is not None:
+                    folds[name] = fstate
+        return (tables, hot, delta, folds) + tuple(carry[4:])
 
     def _windowed_scan(self, step, carry0, tier, *, head, tail,
                        gids=None):
@@ -1124,12 +1311,15 @@ class Trainer:
             lambda *xs: jnp.concatenate(xs, axis=0), *parts)
         return carry, outs
 
-    def _mount_hot_channel(self, out, hot_counts, delta, tier):
+    def _mount_hot_channel(self, out, hot_counts, delta, tier,
+                           dropped=None):
         """Attach the hot-tier telemetry to the worker out channel (the
         health channel's transport): per-table hit counts plus the
-        pending-buffer magnitude — the parameter-plane staleness gauge.
-        Traced only when the tier is on; same dict/collision contract as
-        the guard's health entry."""
+        pending-buffer magnitude — the parameter-plane staleness gauge —
+        and, on the compacted cold routes, the budget-overflow drop
+        count (zero for every host-certified chunk). Traced only when
+        the tier is on; same dict/collision contract as the guard's
+        health entry."""
         if not tier:
             return out
         if not isinstance(out, dict):
@@ -1143,16 +1333,28 @@ class Trainer:
                 "the worker's out channel already has a 'hot_tier' key — "
                 "it would collide with the tier's counters"
             )
+        dropped = dropped or {}
         chan = {}
-        for name, H in tier.items():
+        for name, H in sorted(tier.items()):
             counts = dict(hot_counts.get(name, {}))
+            if name in dropped:
+                counts["cold_dropped"] = (
+                    counts.get("cold_dropped", 0) + dropped[name])
             buf = delta[name]
-            dim = buf.shape[1] - (1 if self._hot_mean(name) else 0)
+            combine = self._hot_combine(name)
+            dim = buf.shape[1] - (
+                1 if (combine in ("max", "min")
+                      or delta_counted(combine, self._hot_fold(name)))
+                else 0)
+            vals = buf[:, :dim].astype(jnp.float32)
+            if combine in ("max", "min"):
+                # The extremum buffer is sentinel-filled; only touched
+                # rows (indicator column == 1) carry real magnitudes.
+                touched = jnp.abs(buf[:, dim]) <= 1.0
+                vals = jnp.where(touched[:, None], vals, 0.0)
             # Per-device sum of squared pending deltas (psum'd with the
             # rest of the out channel into the global magnitude).
-            counts["delta_sq"] = jnp.sum(
-                buf[:, :dim].astype(jnp.float32) ** 2
-            )
+            counts["delta_sq"] = jnp.sum(vals ** 2)
             chan[name] = counts
         return dict(out, **{resilience.HOT_TIER_KEY: chan})
 
@@ -1175,17 +1377,20 @@ class Trainer:
 
     # -- compiled chunk runners ------------------------------------------
 
-    def _build_chunk_fn(self, mode: str):
+    def _build_chunk_fn(self, mode: str, compact=None):
         nbatch_dims = 1 if mode == "sync" else 2
         tier = self._hot_tier_map()
         mapped = self._mapped_tables()
         track = self._track_specs()
+        folds_on = self._hot_fold_map()
+        compact = dict(compact or {})
         E = self.config.hot_sync_every
 
         def chunk_device(tables, local_state, batches, key):
             # Per-device key stream, decorrelated across workers.
             key = jax.random.fold_in(key, worker_index())
-            tables, hot, maps, gids, sketches = split_tiering(tables)
+            (tables, hot, maps, gids, sketches,
+             fstates) = split_tiering(tables)
             delta = self._init_hot_deltas(tables, tier)
             # Sketch accumulators start at ZERO: each device folds only
             # its own ids, and the end-of-call psum merges exactly the
@@ -1202,30 +1407,34 @@ class Trainer:
             hp_seen = {}
 
             def step_fn(carry, batch_t, snapshot=None):
-                tables, hot, delta, sk, bufs, local_state, key, t = carry
+                (tables, hot, delta, fstates, sk, bufs, local_state,
+                 key, t) = carry
                 key, sub = jax.random.split(key)
                 (pushes, local_state, out, hp, hcounts,
                  sk) = self._compute_step(
                     tables, snapshot, local_state, batch_t, sub,
                     hot=hot, tier=tier, maps=maps, track=track, sk=sk,
+                    compact=compact,
                 )
                 hp_seen.update(hp)  # static, identical every traced step
+                dropped = {}
                 if tier:
-                    tables, delta = self._apply_hot_split(
-                        tables, delta, pushes, tier, hp, maps)
+                    tables, delta, dropped = self._apply_hot_split(
+                        tables, delta, pushes, tier, hp, maps, compact)
                 else:
                     tables, bufs = self._apply_or_buffer(
                         tables, bufs, t, pushes, hp)
-                out = self._mount_hot_channel(out, hcounts, delta, tier)
+                out = self._mount_hot_channel(out, hcounts, delta, tier,
+                                              dropped)
                 out = jax.tree.map(
                     lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
                 )
                 out = self._run_tap(out, tables, batch_t, local_state, t)
-                return (tables, hot, delta, sk, bufs, local_state, key,
-                        t + 1), out
+                return (tables, hot, delta, fstates, sk, bufs,
+                        local_state, key, t + 1), out
 
-            carry0 = (tables, hot, delta, sk0, bufs, local_state, key,
-                      jnp.int32(0))
+            carry0 = (tables, hot, delta, fstates, sk0, bufs,
+                      local_state, key, jnp.int32(0))
             if mode == "sync":
                 if not tier:
                     carry, outs = lax.scan(step_fn, carry0, batches)
@@ -1244,7 +1453,8 @@ class Trainer:
                         if rem else None,
                         gids=gids,
                     )
-                (tables, hot, delta, sk, bufs, local_state, _, t) = carry
+                (tables, hot, delta, fstates, sk, bufs, local_state, _,
+                 t) = carry
             else:
                 # SSP: batches leaves are (R, s, B_local, ...).
                 def round_body(carry, batches_r):
@@ -1262,7 +1472,8 @@ class Trainer:
                     # rows (identity when untiered).
                     return self._reconcile_carry(carry, tier, gids), outs
 
-                (tables, hot, delta, sk, bufs, local_state, _, t), outs = (
+                (tables, hot, delta, fstates, sk, bufs, local_state, _,
+                 t), outs = (
                     lax.scan(round_body, carry0, batches))
                 outs = jax.tree.map(
                     lambda x: x.reshape((-1,) + x.shape[2:]), outs
@@ -1272,6 +1483,8 @@ class Trainer:
                       **{hot_key(n): v for n, v in sorted(hot.items())},
                       **{map_key(n): v for n, v in sorted(maps.items())},
                       **{ids_key(n): v for n, v in sorted(gids.items())},
+                      **{fold_key(n): v
+                         for n, v in sorted(fstates.items())},
                       **self._merge_sketches(sketches, sk)}
             return tables, local_state, outs
 
@@ -1281,6 +1494,10 @@ class Trainer:
         table_specs.update({ids_key(name): P() for name in sorted(mapped)})
         table_specs.update({sketch_key(name): P()
                             for name in sorted(track)})
+        # Fold state: SHARDED over the shard axis (reduce-scatter slice
+        # order), replicated over data — never a full copy per device.
+        table_specs.update({fold_key(name): P(SHARD_AXIS, None)
+                            for name in sorted(folds_on)})
         ls_spec = P(WORKER_AXES)
 
         def specs_for_batches(batches):
@@ -1319,14 +1536,19 @@ class Trainer:
         ``id()`` could be reused by a later callable after the original is
         garbage-collected, silently hitting a stale compiled program."""
         return tuple(
-            (name, sl.combine, sl.apply_fn)
+            (name, sl.combine, sl.apply_fn, as_hot_fold(sl.hot_fold))
             for name, sl in sorted(self.server_logic.items())
         )
 
-    def _get_compiled(self, mode: str):
+    def _get_compiled(self, mode: str, compact_ok: bool = True):
         # Keyed on the ops backend, push_delay, and server logic too:
         # set_backend() or a config/logic change after a compile must take
         # effect on the next chunk, not be shadowed by the jit cache.
+        # ``compact_ok``: the host certifier's per-chunk verdict — False
+        # selects the static (full-payload) cold-route program, so a
+        # budget-overflowing chunk dispatches exactly the cold_budget=0
+        # program (bit-identical fallback by construction).
+        compact = self._cold_compact_map() if compact_ok else {}
         key = (mode, ops.get_backend(), self.config.push_delay,
                self.config.step_tap, resilience.as_guard(self.config.guard),
                self._server_logic_key(), self.config.hot_sync_every,
@@ -1336,10 +1558,12 @@ class Trainer:
                # itself is DATA, so re-ranks hit this same cache entry —
                # the no-recompile contract tests/test_tiering.py pins.
                tuple(sorted(self._mapped_tables().items())),
-               tuple(sorted(self._track_specs().items())))
+               tuple(sorted(self._track_specs().items())),
+               tuple(sorted(compact.items())))
         if key not in self._compiled:
+            label = "chunk/" + mode + ("+compact" if compact else "")
             self._compiled[key] = self._wrap_audit(
-                self._build_chunk_fn(mode), f"chunk/{mode}")
+                self._build_chunk_fn(mode, compact), label)
         return self._compiled[key]
 
     # -- compile-time program certification (fps_tpu.analysis) ------------
@@ -1411,7 +1635,14 @@ class Trainer:
             tables = self._attach_hot(tables)
             placed = self._place_chunk(chunk, mode)
             key = key_to_replicated(jax.random.key(1), self.mesh)
-            return self._get_compiled(mode).lower(
+            # Same program selection as run_chunk: a compacted-route
+            # trainer lowers the program THIS chunk would dispatch
+            # (compacted when it certifies, static otherwise).
+            compact_ok = True
+            if self._cold_compact_map():
+                compact_ok, _ = self._certify_cold(
+                    self._host_cert_ids(chunk))
+            return self._get_compiled(mode, compact_ok).lower(
                 tables, ls, placed, key).as_text()
         finally:
             self.store.tables = saved
@@ -1450,12 +1681,14 @@ class Trainer:
         tier = self._hot_tier_map()
         mapped = self._mapped_tables()
         track = self._track_specs()
+        folds_on = self._hot_fold_map()
         E = self.config.hot_sync_every
 
         def epoch_device(tables, local_state, iargs, start, key):
             widx = worker_index()
             key = jax.random.fold_in(key, widx)
-            tables, hot, maps, gids, sketches = split_tiering(tables)
+            (tables, hot, maps, gids, sketches,
+             fstates) = split_tiering(tables)
             delta = self._init_hot_deltas(tables, tier)
             sk0 = {name: jnp.zeros_like(sketches[name])
                    for name in sorted(track)}
@@ -1468,7 +1701,8 @@ class Trainer:
             hp_seen = {}
 
             def step_t(carry, t, snapshot=None):
-                tables, hot, delta, sk, bufs, local_state, key = carry
+                (tables, hot, delta, fstates, sk, bufs, local_state,
+                 key) = carry
                 key, sub = jax.random.split(key)
                 batch = plan.local_batch_at(iargs, widx, t)
                 (pushes, local_state, out, hp, hcounts,
@@ -1477,31 +1711,38 @@ class Trainer:
                     hot=hot, tier=tier, maps=maps, track=track, sk=sk,
                 )
                 hp_seen.update(hp)  # static, identical every traced step
+                dropped = {}
                 if tier:
-                    tables, delta = self._apply_hot_split(
+                    tables, delta, dropped = self._apply_hot_split(
                         tables, delta, pushes, tier, hp, maps)
                 else:
                     tables, bufs = self._apply_or_buffer(
                         tables, bufs, t, pushes, hp)
-                out = self._mount_hot_channel(out, hcounts, delta, tier)
+                out = self._mount_hot_channel(out, hcounts, delta, tier,
+                                              dropped)
                 out = jax.tree.map(
                     lambda x: lax.psum(lax.psum(x, SHARD_AXIS), DATA_AXIS), out
                 )
                 out = self._run_tap(out, tables, batch, local_state, t)
-                return (tables, hot, delta, sk, bufs, local_state, key), out
+                return (tables, hot, delta, fstates, sk, bufs,
+                        local_state, key), out
 
             def finish(carry, outs):
-                tables, hot, delta, sk, bufs, local_state, _ = carry
+                (tables, hot, delta, fstates, sk, bufs, local_state,
+                 _) = carry
                 tables = self._flush_push_bufs(tables, bufs, start + T,
                                                hp_seen)
                 tables = {**tables,
                           **{hot_key(n): v for n, v in sorted(hot.items())},
                           **{map_key(n): v for n, v in sorted(maps.items())},
                           **{ids_key(n): v for n, v in sorted(gids.items())},
+                          **{fold_key(n): v
+                             for n, v in sorted(fstates.items())},
                           **self._merge_sketches(sketches, sk)}
                 return tables, local_state, outs
 
-            carry0 = (tables, hot, delta, sk0, bufs, local_state, key)
+            carry0 = (tables, hot, delta, fstates, sk0, bufs,
+                      local_state, key)
             if mode == "sync":
                 if not tier:
                     carry, outs = lax.scan(
@@ -1552,6 +1793,8 @@ class Trainer:
         table_specs.update({ids_key(name): P() for name in sorted(mapped)})
         table_specs.update({sketch_key(name): P()
                             for name in sorted(track)})
+        table_specs.update({fold_key(name): P(SHARD_AXIS, None)
+                            for name in sorted(folds_on)})
         ls_spec = P(WORKER_AXES)
 
         def run(tables, local_state, iargs, start, key):
@@ -1655,6 +1898,14 @@ class Trainer:
                         float(np.sum(np.asarray(
                             counters.get("pulled_rows", 0)))),
                         table=table)
+                if "cold_dropped" in counters:
+                    # Compacted-route overflow drops — ALWAYS zero for
+                    # host-certified chunks; nonzero means a certifier
+                    # bug, surfaced rather than silently losing updates.
+                    rec.inc("hot_tier.cold_dropped",
+                            float(np.sum(np.asarray(
+                                counters["cold_dropped"]))),
+                            table=table)
                 # Peak pending-delta magnitude across the call's steps —
                 # the parameter-plane staleness gauge (always 0 at the
                 # boundary itself: the flush reconcile drained it).
@@ -1961,7 +2212,8 @@ class Trainer:
 
     # -- host API ---------------------------------------------------------
 
-    def run_chunk(self, tables, local_state, batches, key, *, timer=None):
+    def run_chunk(self, tables, local_state, batches, key, *, timer=None,
+                  recorder=None):
         """Run one compiled chunk.
 
         Args:
@@ -1976,16 +2228,40 @@ class Trainer:
             host→device upload to ``place`` and the jitted call (enqueue +
             first-call compile) to ``dispatch``. ``fit_stream`` passes its
             own; standalone callers may too.
+          recorder: optional :class:`fps_tpu.obs.Recorder` for the
+            cold-route certification counters (default
+            ``self.recorder``).
 
         Returns:
           (tables, local_state, metrics) — metrics leaves have leading dim
           equal to the number of steps in the chunk (global sums per step).
         """
         mode = "sync" if self.config.sync_every is None else "ssp"
+        rec = recorder if recorder is not None else self.recorder
         # Two-tier re-split (no-op dict bookkeeping when already attached
         # or untiered): the compiled program's table structure must match
         # the current hot-tier resolution exactly.
         tables = self._attach_hot(tables, timer)
+        # Payload-proportional cold routing: certify this chunk against
+        # the cold_budget lanes at DISPATCH time (hot membership may have
+        # re-ranked since placement) and select the compacted or static
+        # program accordingly — the head_prefix pattern, per chunk.
+        compact_ok = True
+        if self._cold_compact_map():
+            if isinstance(batches, PlacedChunk):
+                host_ids = batches.host_ids
+            elif all(not isinstance(x, jax.Array)
+                     for x in jax.tree.leaves(batches)):
+                host_ids = self._host_cert_ids(batches)
+            else:
+                host_ids = None  # device-resident chunk: uncertifiable
+            compact_ok, overflowed = self._certify_cold(host_ids)
+            if rec is not None:
+                if compact_ok:
+                    rec.inc("cold_route.compact_chunks")
+                else:
+                    for t in overflowed:
+                        rec.inc("cold_route.overflow_chunks", table=t)
         with _phase(timer, "place"):
             if isinstance(batches, PlacedChunk):
                 # The prefetch pipeline already ran _place_chunk on its
@@ -1995,7 +2271,8 @@ class Trainer:
                 batches = self._place_chunk(batches, mode)
             key = key_to_replicated(key, self.mesh)
         with _phase(timer, "dispatch"):
-            tables, local_state, metrics = self._get_compiled(mode)(
+            tables, local_state, metrics = self._get_compiled(
+                mode, compact_ok)(
                 tables, local_state, batches, key
             )
         # The donated input buffers are dead now; keep the store's host-side
@@ -2154,8 +2431,17 @@ class Trainer:
         pf = None
         if cfg.prefetch:
             mode = "sync" if cfg.sync_every is None else "ssp"
+
+            def _place_for_pf(b, _m=mode):
+                # Placement on the worker thread, but retain the raw id
+                # columns the cold-route certifier needs: certification
+                # itself runs at dispatch (hot membership can re-rank
+                # between placement and dispatch).
+                return PlacedChunk(self._place_chunk(b, _m),
+                                   host_ids=self._host_cert_ids(b))
+
             pf = ChunkPrefetcher(
-                it, lambda b, _m=mode: self._place_chunk(b, _m),
+                it, _place_for_pf,
                 depth=cfg.prefetch, recorder=rec, timer=timer,
                 start_index=start_step,
                 # Preset-quarantined chunks are consumed but never
@@ -2342,7 +2628,8 @@ class Trainer:
                     prev, pending = pending, None
                     with _watch(watchdog, "chunk", i):
                         tables, local_state, metrics = self.run_chunk(
-                            tables, local_state, chunk, ckey, timer=timer
+                            tables, local_state, chunk, ckey, timer=timer,
+                            recorder=rec,
                         )
                         save = boundary_copy(i) if save_due(i) else None
                         # Adjudicate chunk i-1 NOW — its host sync waits
@@ -2369,7 +2656,8 @@ class Trainer:
                                 tables, local_state, metrics = (
                                     self.run_chunk(tables, local_state,
                                                    chunk, ckey,
-                                                   timer=timer))
+                                                   timer=timer,
+                                                   recorder=rec))
                             save = boundary_copy(i) if save_due(i) else None
                         else:
                             retier_boundary(prev["index"])
@@ -2379,7 +2667,8 @@ class Trainer:
                 else:
                     with _watch(watchdog, "chunk", i):
                         tables, local_state, metrics = self.run_chunk(
-                            tables, local_state, chunk, ckey, timer=timer
+                            tables, local_state, chunk, ckey, timer=timer,
+                            recorder=rec,
                         )
                         entry = {"index": i, "metrics": metrics,
                                  "last_good": last_good, "save": None,

@@ -27,6 +27,46 @@ from typing import Iterator, Mapping
 import numpy as np
 
 
+def per_worker_cold_counts(
+    ids: np.ndarray,
+    num_workers: int,
+    *,
+    hot_head: int = 0,
+    hot_member: np.ndarray | None = None,
+) -> np.ndarray:
+    """Per-(step, worker) cold-id counts of one chunk id column — the
+    host half of the compacted cold route's certification
+    (``TableSpec.cold_budget``; the ``head_prefix`` pattern applied to
+    payload-proportional routing).
+
+    ``ids`` is any array whose LAST axis is the global batch dim
+    (worker-major, ``W * local_batch`` — the chunk column layout);
+    leading axes are step dims. Hot membership is either the static
+    frequency-ranked head (``id < hot_head``) or an explicit boolean
+    ``hot_member`` array of length ``num_ids + 1`` (the adaptive tier's
+    current hot set; out-of-range ids clamp onto the trailing False
+    sentinel). Negative ids never count (the -1 padding contract);
+    everything else is counted conservatively, exactly as the device
+    compaction sees it.
+
+    Returns an ``(steps, num_workers)`` int array of cold counts — the
+    certifier compares its max against the lane budget.
+    """
+    a = np.asarray(ids)
+    B = a.shape[-1]
+    if B % num_workers:
+        raise ValueError(
+            f"batch dim {B} not divisible by num_workers={num_workers}")
+    per_worker = a.reshape(-1, num_workers, B // num_workers)
+    if hot_member is not None:
+        member = np.asarray(hot_member, bool)
+        cold = (per_worker >= 0) & ~member[
+            np.clip(per_worker, 0, len(member) - 1)]
+    else:
+        cold = per_worker >= hot_head
+    return cold.sum(axis=-1)
+
+
 def _to_ssp_shape(chunk: dict, sync_every: int) -> dict:
     """Reshape (T, B, ...) chunk leaves to (T//s, s, B, ...) for the SSP driver."""
     return {

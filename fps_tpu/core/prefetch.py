@@ -66,12 +66,21 @@ class PlacedChunk:
     wrapper exists so an already-uploaded chunk can never be mistaken
     for a host chunk and re-placed (or worse, a host chunk silently
     skip placement).
+
+    ``host_ids`` optionally carries the raw host id columns the
+    compacted-cold-route certifier needs
+    (``WorkerLogic.pulled_ids_host``): placement happens on the prefetch
+    worker thread, but hot-set membership can change between placement
+    and dispatch (re-ranks), so certification itself runs at dispatch
+    time against these retained host arrays — references to the source
+    chunk's columns, not copies.
     """
 
-    __slots__ = ("batches",)
+    __slots__ = ("batches", "host_ids")
 
-    def __init__(self, batches):
+    def __init__(self, batches, host_ids=None):
         self.batches = batches
+        self.host_ids = host_ids
 
 
 class ChunkPrefetcher:
@@ -144,7 +153,12 @@ class ChunkPrefetcher:
                 item = next(self._it, _END)
                 if (item is not _END and self._place is not None
                         and self._index not in self._skip_place):
-                    item = PlacedChunk(self._place(item))
+                    placed = self._place(item)
+                    # A place_fn may return a ready PlacedChunk itself
+                    # (the driver's certifying wrapper does, to attach
+                    # host_ids); only wrap bare batch pytrees.
+                    item = (placed if isinstance(placed, PlacedChunk)
+                            else PlacedChunk(placed))
                 self._index += 1
                 dt = time.perf_counter() - t0
                 if item is not _END:

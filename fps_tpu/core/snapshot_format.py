@@ -42,6 +42,7 @@ import numpy as np
 
 __all__ = [
     "SNAPSHOT_RE", "SNAPSHOT_FMT", "SEP", "TABLE_PREFIX", "LS_PREFIX",
+    "FOLD_PREFIX",
     "CRC_PREFIX", "IO_ERRORS", "array_crc32", "snapshot_path",
     "snapshot_steps", "verify_snapshot_file", "latest_valid_snapshot",
     "map_snapshot_arrays",
@@ -63,6 +64,12 @@ SNAPSHOT_FMT = "ckpt_{step:012d}.npz"
 SEP = "::"
 TABLE_PREFIX = f"table{SEP}"
 LS_PREFIX = f"ls{SEP}"
+# ``fold::<name>`` entries hold a table's hot-fold optimizer state
+# (Adagrad/Adam server state, ``ServerLogic.hot_fold``) in reduce-scatter
+# slice order — NEVER part of the canonical ``table::`` bytes, so a
+# snapshot stays restorable by untiered/older readers (which simply skip
+# the kind, as the default ``map_snapshot_arrays`` filter does).
+FOLD_PREFIX = f"fold{SEP}"
 CRC_PREFIX = f"meta{SEP}crc{SEP}"
 
 # Everything a torn/corrupted .npz throws on open or member read (zip

@@ -126,8 +126,14 @@ HOT_KEY_SUFFIX = "::hot"
 MAP_KEY_SUFFIX = "::hotmap"
 IDS_KEY_SUFFIX = "::hotids"
 SKETCH_KEY_SUFFIX = "::sketch"
+# Stateful hot-fold optimizer state (``ServerLogic.hot_fold``): per-row
+# Adagrad/Adam state for the hot head, SHARDED over the shard axis in
+# reduce-scatter slice order (never replicated). Persisted in snapshots
+# as separate ``fold::`` arrays — never part of the canonical table
+# bytes (``checkpoint._table_arrays`` iterates specs only).
+FOLD_KEY_SUFFIX = "::fold"
 AUX_KEY_SUFFIXES = (HOT_KEY_SUFFIX, MAP_KEY_SUFFIX, IDS_KEY_SUFFIX,
-                    SKETCH_KEY_SUFFIX)
+                    SKETCH_KEY_SUFFIX, FOLD_KEY_SUFFIX)
 
 
 def hot_key(name: str) -> str:
@@ -150,6 +156,11 @@ def sketch_key(name: str) -> str:
     return name + SKETCH_KEY_SUFFIX
 
 
+def fold_key(name: str) -> str:
+    """Tables-dict key of ``name``'s sharded hot-fold optimizer state."""
+    return name + FOLD_KEY_SUFFIX
+
+
 def is_hot_key(key: str) -> bool:
     return key.endswith(HOT_KEY_SUFFIX)
 
@@ -166,13 +177,13 @@ def hot_base(key: str) -> str:
 
 def split_tiering(
     tables: Mapping[str, Any]
-) -> tuple[dict, dict, dict, dict, dict]:
-    """Split a tables dict into ``(canonical, hot, maps, gids, sketches)``
-    — each aux dict keyed by base table name. (The old two-way
+) -> tuple[dict, dict, dict, dict, dict, dict]:
+    """Split a tables dict into ``(canonical, hot, maps, gids, sketches,
+    folds)`` — each aux dict keyed by base table name. (The old two-way
     ``split_hot`` was retired when this superseded it: a narrower split
     would misclassify the adaptive tier's aux entries as canonical
     tables.)"""
-    canonical, hot, maps, gids, sketches = {}, {}, {}, {}, {}
+    canonical, hot, maps, gids, sketches, folds = {}, {}, {}, {}, {}, {}
     for k, v in tables.items():
         if k.endswith(HOT_KEY_SUFFIX):
             hot[k[: -len(HOT_KEY_SUFFIX)]] = v
@@ -182,9 +193,11 @@ def split_tiering(
             gids[k[: -len(IDS_KEY_SUFFIX)]] = v
         elif k.endswith(SKETCH_KEY_SUFFIX):
             sketches[k[: -len(SKETCH_KEY_SUFFIX)]] = v
+        elif k.endswith(FOLD_KEY_SUFFIX):
+            folds[k[: -len(FOLD_KEY_SUFFIX)]] = v
         else:
             canonical[k] = v
-    return canonical, hot, maps, gids, sketches
+    return canonical, hot, maps, gids, sketches, folds
 
 
 def hot_slot_map(num_ids: int, hot_gids: np.ndarray) -> np.ndarray:
@@ -243,30 +256,35 @@ def reconcile_hot_mapped(
     num_shards: int,
     shard_axis: str = SHARD_AXIS,
     data_axis: str | None = None,
-    mean: bool = False,
-) -> tuple[Array, Array, Array]:
+    combine: str = "sum",
+    fold=None,
+    fold_state: Array | None = None,
+) -> tuple[Array, Array, Array, Array | None]:
     """Window-end reconcile for an arbitrary hot id set (mapped tier).
 
     Identical contract to :func:`reconcile_hot` except the replica's slot
-    ``j`` holds global id ``hot_gids[j]`` instead of id ``j``: the psum'd
-    combined delta is applied to the replica (bitwise-identical on every
-    device) AND scattered into this shard's OWNED rows of the canonical
-    table — under the owner-major cyclic layout id ``g`` lives on shard
-    ``g % S`` at local row ``g // S``. ``hot_gids`` is replicated DATA,
-    so a re-rank changes which rows reconcile without recompiling.
+    ``j`` holds global id ``hot_gids[j]`` instead of id ``j``: the
+    combined window delta is applied to the replica (bitwise-identical on
+    every device — it comes out of the reconcile's all-gather) AND
+    scattered into this shard's OWNED rows of the canonical table — under
+    the owner-major cyclic layout id ``g`` lives on shard ``g % S`` at
+    local row ``g // S``. ``hot_gids`` is replicated DATA, so a re-rank
+    changes which rows reconcile without recompiling.
 
-    Returns ``(new_cold_shard, new_replica, zeroed_delta_buf)``.
+    Returns ``(new_cold_shard, new_replica, reset_delta_buf,
+    new_fold_state)``.
     """
-    combined, new_replica = _reconcile_combine(
-        replica, delta_buf, shard_axis=shard_axis, data_axis=data_axis,
-        mean=mean)
+    combined, new_replica, new_state = _reconcile_combine(
+        replica, delta_buf, num_shards=num_shards, shard_axis=shard_axis,
+        data_axis=data_axis, combine=combine, fold=fold,
+        fold_state=fold_state)
     me = lax.axis_index(shard_axis)
     owned = (hot_gids >= 0) & ((hot_gids % num_shards) == me)
     lidx = jnp.where(owned, hot_gids // num_shards,
                      jnp.asarray(-1, hot_gids.dtype))
     new_cold = ops.scatter_add(cold_shard, lidx,
                                combined.astype(cold_shard.dtype))
-    return new_cold, new_replica, jnp.zeros_like(delta_buf)
+    return new_cold, new_replica, _reset_delta(delta_buf, combine), new_state
 
 
 def pull_hot(replica: Array, ids: Array, *, hot_ids: int) -> tuple[Array, Array]:
@@ -305,30 +323,109 @@ def split_hot_push(
     return cold, hots
 
 
-def hot_delta_init(hot_rows: int, dim: int, dtype, *, mean: bool) -> Array:
+def delta_counted(combine: str, fold) -> bool:
+    """Whether a table's pending-delta buffer carries the appended
+    push-count column: the ``"mean"`` combine needs it to normalize, and
+    every stateful fold needs it to apply lazily (touched rows only)."""
+    return combine == "mean" or fold is not None
+
+
+def compact_cold(
+    ids: Array, deltas: Array | None, *, budget: int
+) -> tuple[Array, Array | None, Array, Array]:
+    """Pack a masked cold-id stream into a fixed ``budget``-wide lane.
+
+    ``ids`` is a ``(B,)`` stream whose hot/padding slots are already
+    masked to ``-1`` (the :func:`split_hot_push` / :func:`pull_hot`
+    convention); the live entries are packed ORDER-PRESERVING (stable
+    cumsum positions) into a ``(budget,)`` lane with ``-1`` padding, so
+    the collective routes carry ``O(cold traffic)`` payload instead of
+    ``O(batch)``. Live entries beyond the budget are DROPPED (their lane
+    position is out of range, their pulls read zero rows) — callers must
+    only dispatch the compacted program for batches the host certifier
+    proved fit the budget (``Trainer._certify_cold``; the overflow count
+    is returned for the device-side observability net).
+
+    Returns ``(lane_ids, lane_deltas, pos, overflowed)``: ``pos`` maps
+    each original slot to its lane position (``-1`` = masked or dropped)
+    for scattering pulled lane rows back to batch positions;
+    ``overflowed`` is the scalar count of dropped live entries.
+    """
+    live = ids >= 0
+    pos = jnp.cumsum(live.astype(jnp.int32)) - 1
+    pos = jnp.where(live & (pos < budget), pos, -1)
+    # Negative .at[] indices WRAP (numpy semantics) — map masked slots to
+    # ``budget`` so mode="drop" actually drops them (the
+    # ops._xla_scatter_add pattern).
+    safe = jnp.where(pos >= 0, pos, budget)
+    lane_ids = jnp.full((budget,), -1, ids.dtype).at[safe].set(
+        ids, mode="drop")
+    lane_deltas = None
+    if deltas is not None:
+        lane_deltas = jnp.zeros(
+            (budget,) + deltas.shape[1:], deltas.dtype
+        ).at[safe].set(deltas, mode="drop")
+    overflowed = jnp.maximum(
+        jnp.sum(live.astype(jnp.int32)) - budget, 0)
+    return lane_ids, lane_deltas, pos, overflowed
+
+
+def hot_delta_init(hot_rows: int, dim: int, dtype, *, combine: str = "sum",
+                   fold=None) -> Array:
     """Fresh per-device pending-delta buffer for one tiered table.
 
     Accumulates in at least f32 (never below the table's own precision —
     same promotion rule as the non-"sum" combine folds in :func:`push`).
-    The ``mean`` combine carries a push-count column appended to the
-    payload so the reconcile can apply one count-normalized step per
-    touched row per window.
+    The ``mean`` combine (and any stateful fold) carries a push-count
+    column appended to the payload so the reconcile can normalize / fold
+    lazily per touched row per window. The ``max``/``min`` combines keep
+    an elementwise-extremum buffer instead: filled with the extremal
+    sentinel, plus a touched-indicator column (the same one-scatter trick
+    as :func:`push`'s extremum path).
     """
     acc_dt = jnp.promote_types(dtype, jnp.float32)
-    return jnp.zeros((hot_rows, dim + (1 if mean else 0)), acc_dt)
+    if combine in ("max", "min"):
+        lim = jnp.finfo(acc_dt).max
+        fill = -lim if combine == "max" else lim
+        return jnp.full((hot_rows, dim + 1), fill, acc_dt)
+    cols = dim + (1 if delta_counted(combine, fold) else 0)
+    return jnp.zeros((hot_rows, cols), acc_dt)
+
+
+def _reset_delta(delta_buf: Array, combine: str) -> Array:
+    """Window-end buffer reset: zeros for the additive combines, the
+    extremal sentinel fill for ``max``/``min``."""
+    if combine in ("max", "min"):
+        lim = jnp.finfo(delta_buf.dtype).max
+        fill = -lim if combine == "max" else lim
+        return jnp.full_like(delta_buf, fill)
+    return jnp.zeros_like(delta_buf)
 
 
 def accumulate_hot(
-    delta_buf: Array, hot_ids_arr: Array, hot_deltas: Array, *, mean: bool
+    delta_buf: Array, hot_ids_arr: Array, hot_deltas: Array, *,
+    combine: str = "sum", fold=None
 ) -> Array:
     """Fold one step's hot-tier pushes into the local pending buffer.
 
     ``hot_ids_arr``/``hot_deltas`` come from :func:`split_hot_push` (cold
     slots already ``-1``/zero, dropped by the scatter). Purely local —
     the collective happens once per window, in :func:`reconcile_hot`.
+    ``max``/``min`` combine via a native scatter-max/min with the
+    touched indicator riding as an appended ones column.
     """
     vals = hot_deltas.astype(delta_buf.dtype)
-    if mean:
+    if combine in ("max", "min"):
+        ones = jnp.ones(hot_ids_arr.shape, delta_buf.dtype)[:, None]
+        filled = jnp.concatenate([vals, ones], axis=1)
+        # Negative .at[] indices wrap — map the masked -1 slots out of
+        # range so mode="drop" drops them (ops._xla_scatter_add pattern).
+        safe = jnp.where(hot_ids_arr >= 0, hot_ids_arr,
+                         delta_buf.shape[0])
+        if combine == "max":
+            return delta_buf.at[safe].max(filled, mode="drop")
+        return delta_buf.at[safe].min(filled, mode="drop")
+    if delta_counted(combine, fold):
         # One scatter carries values AND counts (appended ones column) —
         # the same one-scatter trick as push()'s non-"sum" folds.
         cnt = (hot_ids_arr >= 0).astype(delta_buf.dtype)[:, None]
@@ -336,32 +433,119 @@ def accumulate_hot(
     return ops.scatter_add(delta_buf, hot_ids_arr, vals)
 
 
+def hot_fold_state_shape(fold, H: int, dim: int,
+                         num_shards: int) -> tuple[int, int]:
+    """GLOBAL shape of one table's hot-fold state: ``ceil(H/S)`` rows per
+    shard in reduce-scatter slice order (slice ``s`` holds head rows
+    ``[s*Hs, (s+1)*Hs)``), padded to a multiple of ``S``; columns per
+    :meth:`fps_tpu.core.api.HotFold.state_cols`."""
+    Hs = rows_per_shard(H, num_shards)
+    return (Hs * num_shards, fold.state_cols(dim))
+
+
+def apply_hot_fold(fold, state: Array, g: Array,
+                   counts: Array) -> tuple[Array, Array]:
+    """Apply a stateful fold to the owned reconcile slice.
+
+    ``g`` is the window's combined delta for this device's contiguous
+    head slice (post ``combine`` normalization), ``counts`` the per-row
+    push counts, ``state`` the device's slice of the sharded optimizer
+    state. LAZY semantics: rows with no pushes this window keep their
+    state (and receive a zero step) — the sparse-table convention, so a
+    zero-traffic row can never drift. Returns ``(step, new_state)``.
+    """
+    dt = g.dtype
+    touched = counts > 0
+    t1 = touched[:, None]
+    if fold.kind == "adagrad":
+        G = state + jnp.where(t1, g * g, 0.0).astype(state.dtype)
+        step = jnp.where(
+            t1, fold.lr * g / (jnp.sqrt(G).astype(dt) + fold.eps), 0.0)
+        return step, G
+    dim = g.shape[1]
+    m, v = state[:, :dim], state[:, dim:2 * dim]
+    t = state[:, 2 * dim]
+    t_new = t + touched.astype(state.dtype)
+    m_new = jnp.where(t1, fold.beta1 * m + (1.0 - fold.beta1) * g, m)
+    v_new = jnp.where(t1, fold.beta2 * v + (1.0 - fold.beta2) * g * g, v)
+    tc = jnp.maximum(t_new, 1.0)
+    mhat = m_new / (1.0 - fold.beta1 ** tc)[:, None]
+    vhat = v_new / (1.0 - fold.beta2 ** tc)[:, None]
+    step = jnp.where(
+        t1, fold.lr * mhat.astype(dt) / (jnp.sqrt(vhat).astype(dt)
+                                         + fold.eps), 0.0)
+    new_state = jnp.concatenate([m_new, v_new, t_new[:, None]], axis=1)
+    return step, new_state
+
+
 def _reconcile_combine(
     replica: Array,
     delta_buf: Array,
     *,
+    num_shards: int,
     shard_axis: str,
     data_axis: str | None,
-    mean: bool,
-) -> tuple[Array, Array]:
-    """Shared half of the window-end reconcile: psum the pending
-    buffers over the worker axes, normalize the ``mean`` combine's
-    count column, and apply to the replica. Returns
-    ``(combined_delta, new_replica)`` — the static and mapped reconciles
-    differ only in how the combined delta addresses the canonical
-    shard, so the summation/normalization semantics live in exactly one
-    place and cannot drift between them."""
-    _, dim = replica.shape
-    g = lax.psum(delta_buf, shard_axis)
+    combine: str,
+    fold=None,
+    fold_state: Array | None = None,
+) -> tuple[Array, Array, Array | None]:
+    """Shared half of the window-end reconcile, SHARDED over the replica
+    axis (arXiv:2004.13336's cross-replica weight-update sharding applied
+    to the hot tier): instead of one psum that hands every device the
+    full ``(H, dim')`` window delta, the pending buffers are
+
+    1. **reduce-scattered** over the shard axis — device ``s`` receives
+       the summed slice for head rows ``[s*Hs, (s+1)*Hs)`` only;
+    2. psum'd over the (replicated) data axis — now ``1/S`` the payload
+       the old full-head data psum moved;
+    3. normalized ("mean" count column) and, for a stateful
+       :class:`~fps_tpu.core.api.HotFold`, folded against the device's
+       DISJOINT slice of the sharded optimizer state — the property the
+       sharding buys: per-row Adagrad/Adam state exists exactly once
+       across the mesh, and each device does ``1/S`` of the fold work;
+    4. **all-gathered** back so every replica applies the identical
+       combined step.
+
+    The ``max``/``min`` combines keep a full-head pmax/pmin instead
+    (extremum does not reduce-scatter); they carry no fold state.
+
+    Returns ``(combined_step, new_replica, new_fold_state_slice)`` — the
+    static and mapped reconciles differ only in how the combined step
+    addresses the canonical shard, so the summation / normalization /
+    fold semantics live in exactly one place and cannot drift between
+    them."""
+    H, dim = replica.shape
+    if combine in ("max", "min"):
+        red = lax.pmax if combine == "max" else lax.pmin
+        g = red(delta_buf, shard_axis)
+        if data_axis is not None:
+            g = red(g, data_axis)
+        # Touched rows carry indicator 1.0; untouched still the sentinel.
+        touched = jnp.abs(g[:, dim]) <= 1.0
+        combined = jnp.where(touched[:, None], g[:, :dim],
+                             0.0).astype(replica.dtype)
+        return combined, replica + combined, fold_state
+    dimp = delta_buf.shape[1]
+    Hs = rows_per_shard(H, num_shards)
+    pad = Hs * num_shards - H
+    buf = delta_buf
+    if pad:
+        buf = jnp.concatenate(
+            [buf, jnp.zeros((pad, dimp), buf.dtype)], axis=0)
+    sl = lax.psum_scatter(buf, shard_axis, scatter_dimension=0, tiled=True)
     if data_axis is not None:
-        g = lax.psum(g, data_axis)
-    if mean:
-        counts = g[:, dim]
-        combined = g[:, :dim] * (1.0 / jnp.maximum(counts, 1.0))[:, None]
-    else:
-        combined = g
-    combined = combined.astype(replica.dtype)
-    return combined, replica + combined
+        sl = lax.psum(sl, data_axis)
+    counts = sl[:, dim] if dimp > dim else None
+    g = sl[:, :dim]
+    if combine == "mean":
+        g = g * (1.0 / jnp.maximum(counts, 1.0))[:, None]
+    new_state = fold_state
+    if fold is not None:
+        g, new_state = apply_hot_fold(fold, fold_state, g, counts)
+    g = g.astype(replica.dtype)
+    full = lax.all_gather(g, shard_axis, tiled=True)
+    combined = full[:H] if pad else full
+    return combined, replica + combined, new_state
 
 
 def reconcile_hot(
@@ -372,30 +556,42 @@ def reconcile_hot(
     num_shards: int,
     shard_axis: str = SHARD_AXIS,
     data_axis: str | None = None,
-    mean: bool = False,
-) -> tuple[Array, Array, Array]:
-    """Window-end reconcile: psum the pending buffers, apply everywhere.
+    combine: str = "sum",
+    fold=None,
+    fold_state: Array | None = None,
+) -> tuple[Array, Array, Array, Array | None]:
+    """Window-end reconcile: reduce-scatter the pending buffers, apply
+    the owned 1/S slice, all-gather the combined step everywhere.
 
-    One ``psum`` over the worker axes replaces ``hot_sync_every`` steps'
-    worth of per-step push collectives for the head rows. The combined
-    delta is applied to the replica (identically on every device — psum
+    One reduce-scatter + all-gather pair over the shard axis (see
+    :func:`_reconcile_combine` — the cross-replica sharded form of the
+    old full-head psum) replaces ``hot_sync_every`` steps' worth of
+    per-step push collectives for the head rows. The combined step is
+    applied to the replica (identically on every device — all-gather
     results are bitwise-identical across participants, so the replica
     stays replicated by construction) AND to this shard's OWNED head
     rows of the canonical table: under the owner-major cyclic layout,
     global id ``h`` lives on shard ``h % S`` at local row ``h // S``, so
     the shard's head ids occupy exactly local rows ``[0, ceil(H/S))``.
 
-    ``mean``: the buffer's appended count column turns the window's sum
-    into one count-normalized step per touched row (the windowed analog
-    of the "mean" combine's one-averaged-step-per-push; untouched rows
-    have count 0 and receive exactly zero).
+    ``combine="mean"``: the buffer's appended count column turns the
+    window's sum into one count-normalized step per touched row (the
+    windowed analog of the "mean" combine's one-averaged-step-per-push;
+    untouched rows have count 0 and receive exactly zero).
+    ``combine="max"/"min"``: one pmax/pmin of the extremum buffer — the
+    windowed analog of the extremum combine (one extremal step per
+    touched row per window). ``fold``: stateful Adagrad/Adam on the
+    owned slice (:func:`apply_hot_fold`), state sharded over the shard
+    axis in slice order.
 
-    Returns ``(new_cold_shard, new_replica, zeroed_delta_buf)``.
+    Returns ``(new_cold_shard, new_replica, reset_delta_buf,
+    new_fold_state)``.
     """
     H, _ = replica.shape
-    combined, new_replica = _reconcile_combine(
-        replica, delta_buf, shard_axis=shard_axis, data_axis=data_axis,
-        mean=mean)
+    combined, new_replica, new_state = _reconcile_combine(
+        replica, delta_buf, num_shards=num_shards, shard_axis=shard_axis,
+        data_axis=data_axis, combine=combine, fold=fold,
+        fold_state=fold_state)
     hl = -(-H // num_shards)  # local head rows on every shard
     me = lax.axis_index(shard_axis)
     # Global id of local head row j is j*S + me; rows past H (when S does
@@ -403,7 +599,7 @@ def reconcile_hot(
     gids = jnp.arange(hl, dtype=jnp.int32) * num_shards + me
     mine = ops.gather_rows(combined, jnp.where(gids < H, gids, -1))
     new_cold = cold_shard.at[:hl].add(mine.astype(cold_shard.dtype))
-    return new_cold, new_replica, jnp.zeros_like(delta_buf)
+    return new_cold, new_replica, _reset_delta(delta_buf, combine), new_state
 
 
 # ---------------------------------------------------------------------------
@@ -734,6 +930,22 @@ class TableSpec:
     # ``hot_sync_every = 1`` exact mode) the untiered program is lowered
     # unchanged. Default 0: off.
     hot_tier: int = 0
+    # Payload-proportional cold routing (docs/performance.md
+    # "Payload-proportional routing"): with a PARTIAL hot head
+    # (0 < H < num_ids), the cold routes otherwise keep the full-batch
+    # static collective payload even at a 0.99 hit rate. A positive
+    # ``cold_budget`` bounds the per-worker-per-step cold-id lane: each
+    # batch's cold ids/deltas are compacted on device into a
+    # ``(cold_budget,)`` stream before the collective pull/push, so the
+    # gathered routes carry O(cold traffic) bytes instead of O(batch).
+    # Ingest-certified like ``head_prefix``: the compacted program only
+    # dispatches for chunks the host proved fit the budget
+    # (``WorkerLogic.pulled_ids_host``); overflowing chunks fall back to
+    # the static route bit-identically, with a
+    # ``cold_route.overflow_chunks`` obs counter. Engages only when the
+    # tier resolves on with a partial head on a non-dense route; 0 (the
+    # default) keeps the static cold routes.
+    cold_budget: int = 0
 
     def zeros_init(self) -> "TableSpec":
         return dataclasses.replace(

@@ -76,6 +76,29 @@ def base_parser(description: str) -> argparse.ArgumentParser:
                          "staleness bound applied to the parameter "
                          "plane. 1 (default) = exact mode, bit-identical "
                          "to the untiered path")
+    ap.add_argument("--cold-budget", type=int, default=0, metavar="C",
+                    help="payload-proportional cold routing "
+                         "(TableSpec.cold_budget): with a PARTIAL hot "
+                         "head, compact each batch's cold ids into a "
+                         "C-wide per-worker lane so the cold collective "
+                         "routes carry O(cold traffic) payload instead "
+                         "of O(batch). Host-certified per chunk (like "
+                         "head_prefix); overflowing chunks fall back to "
+                         "the static routes bit-identically with a "
+                         "cold_route.overflow_chunks counter. Requires "
+                         "--hot-tier with H < num_ids on a non-dense "
+                         "route; 0 = static cold routes")
+    ap.add_argument("--hot-fold", default=None,
+                    choices=["adagrad", "adam"],
+                    help="stateful hot-tier server optimizer "
+                         "(ServerLogic.hot_fold): per-row Adagrad/Adam "
+                         "state sharded over the replica axis by the "
+                         "sharded reconcile (reduce-scatter -> apply "
+                         "the owned 1/S slice -> all-gather). Requires "
+                         "a FULLY-replicated hot tier (--hot-tier >= "
+                         "num_ids) with --hot-sync-every > 1; state "
+                         "rides checkpoints as fold:: arrays, canonical "
+                         "table bytes unchanged")
     ap.add_argument("--auto-tier", action="store_true",
                     help="adaptive tiering (fps_tpu.tiering, "
                          "docs/performance.md): track pulled-id "
@@ -214,10 +237,20 @@ def apply_hot_tier(args, trainer, store=None):
     H = getattr(args, "hot_tier", 0)
     E = getattr(args, "hot_sync_every", 1)
     auto = getattr(args, "auto_tier", False)
+    cold = getattr(args, "cold_budget", 0)
+    fold = getattr(args, "hot_fold", None)
     if E < 1:
         raise SystemExit(f"--hot-sync-every must be >= 1, got {E}")
     if H < 0:
         raise SystemExit(f"--hot-tier must be >= 0, got {H}")
+    if cold < 0:
+        raise SystemExit(f"--cold-budget must be >= 0, got {cold}")
+    if cold and not (H or auto):
+        raise SystemExit("--cold-budget needs a hot tier: pass "
+                         "--hot-tier H (partial head) or --auto-tier")
+    if fold and not H:
+        raise SystemExit("--hot-fold needs --hot-tier (fully-replicated: "
+                         "H >= num_ids) and --hot-sync-every > 1")
     if not H and E == 1 and not auto:
         return trainer
     if trainer is None:
@@ -231,12 +264,19 @@ def apply_hot_tier(args, trainer, store=None):
     if H:
         for name, spec in store.specs.items():
             store.specs[name] = dataclasses.replace(
-                spec, hot_tier=min(H, spec.num_ids))
+                spec, hot_tier=min(H, spec.num_ids),
+                cold_budget=cold)
+    if fold:
+        for name, sl in trainer.server_logic.items():
+            trainer.server_logic[name] = dataclasses.replace(
+                sl, hot_fold=fold)
     trainer.config = dataclasses.replace(trainer.config, hot_sync_every=E,
                                          auto_tier=auto)
     tiered = sorted(trainer._hot_tier_map())  # also validates vs push_delay
     emit({"event": "hot_tier", "hot_tier": H, "hot_sync_every": E,
           "auto_tier": auto, "tiered_tables": tiered,
+          "cold_budget": cold, "hot_fold": fold,
+          "compacted_tables": sorted(trainer._cold_compact_map()),
           "exact_mode": (E == 1 or not tiered) and not auto})
     return trainer
 

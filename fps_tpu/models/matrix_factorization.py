@@ -144,6 +144,16 @@ class MatrixFactorizationWorker(WorkerLogic):
             return {ITEM_TABLE: batch["all_items"].reshape(-1)}
         return {ITEM_TABLE: batch["item"].astype(jnp.int32)}
 
+    def pulled_ids_host(self, chunk):
+        """Cold-route certification stream (``TableSpec.cold_budget``):
+        the raw item column covers every id the step pulls AND pushes —
+        pushes mask padding to ``-1``, so certifying on the pull stream
+        is conservative. With negative sampling the ids are synthesized
+        on device in :meth:`prepare`, so chunks are not certifiable."""
+        if self.cfg.negative_samples:
+            return None
+        return {ITEM_TABLE: chunk["item"]}
+
     def touched_local_rows(self, batch):
         """Ids-aware local-guard refinement: :meth:`step` scatters only
         into the batch's own users' LOCAL rows (``u // num_workers`` —

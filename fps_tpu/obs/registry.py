@@ -137,6 +137,24 @@ def default_registry() -> MetricsRegistry:
                         "the delta a reconcile actually applies is the "
                         "psum, whose norm can exceed this by up to "
                         "sqrt(num_devices) when device deltas align"),
+        # Payload-proportional cold routing (TableSpec.cold_budget;
+        # docs/performance.md "Payload-proportional routing").
+        MetricSpec("cold_route.compact_chunks", "counter", unit="chunks",
+                   help="chunks host-certified to fit every cold_budget "
+                        "lane and dispatched through the COMPACTED "
+                        "cold-route program (O(cold traffic) collective "
+                        "payload)"),
+        MetricSpec("cold_route.overflow_chunks", "counter", unit="chunks",
+                   labels=("table",),
+                   help="chunks that overflowed (or could not certify) a "
+                        "table's cold_budget lane and fell back to the "
+                        "static full-payload cold routes — incremented "
+                        "once per overflowing table per chunk"),
+        MetricSpec("hot_tier.cold_dropped", "counter", unit="rows",
+                   labels=("table",),
+                   help="cold rows dropped by the device-side compaction "
+                        "lane (the observability net: zero for every "
+                        "host-certified chunk by construction)"),
         # Adaptive tiering (fps_tpu.tiering; docs/performance.md
         # "Adaptive tiering"): online hot-set re-ranking + auto-planner.
         MetricSpec("tiering.re_ranks", "counter", unit="re_ranks",
@@ -153,6 +171,12 @@ def default_registry() -> MetricsRegistry:
         MetricSpec("tiering.demoted_rows", "counter", unit="rows",
                    labels=("table",),
                    help="ids demoted out of the hot set by re-ranks"),
+        MetricSpec("tiering.replans", "counter", unit="replans",
+                   labels=("changed",),
+                   help="periodic re-planning checks (Retierer."
+                        "replan_every): changed=true re-applied a new "
+                        "plan (one deliberate recompile), changed=false "
+                        "was a strict no-op (zero recompiles)"),
         # Health channel (thresholded by fps_tpu.obs.health.HealthMonitor).
         MetricSpec("health.nonfinite_rows", "counter", unit="rows",
                    labels=("table",),

@@ -60,6 +60,17 @@ SCENARIO_HOT_KILL_AT = 3
 # set / replica / slot map), and replay chunk 3 bit-identically.
 SCENARIO_RETIER_EVERY = 2
 SCENARIO_RETIER_KILL_AT = 3
+# Sharded-reconcile kill scenario (PR 10): a FULLY-replicated hot tier
+# with a stateful Adagrad server fold — its per-row optimizer state is
+# sharded over the replica axis by the reduce-scatter reconcile and
+# persisted as fold:: checkpoint arrays. The SIGKILL lands between a
+# reduce-scatter window (the chunk's boundary flush-reconcile ran, its
+# Adagrad state advanced) and the next checkpoint; the restart must
+# restore canonical tables AND the matching fold state, or the resumed
+# Adagrad trajectory diverges from the straight run.
+SCENARIO_FOLD_TIER = 400  # >= NF: full replication (hot_fold requires it)
+SCENARIO_FOLD_SYNC = 3
+SCENARIO_FOLD_KILL_AT = 3
 
 
 def run_supervised_scenario(tmpdir: str, *, timeout: float = 600):
@@ -314,6 +325,103 @@ def run_hot_tier_kill_scenario(tmpdir: str, *, timeout: float = 600):
           # restored_step == SCENARIO_HOT_KILL_AT means exactly one chunk
           # was lost and replayed from a reconciled snapshot.
           and meta.get("restored_step") == SCENARIO_HOT_KILL_AT
+          and not detail["corrupt_files"]
+          and bit_identical)
+    return ok, detail
+
+
+def run_reconcile_shard_kill_scenario(tmpdir: str, *, timeout: float = 600):
+    """SIGKILL between a sharded (reduce-scatter) reconcile window and
+    the next checkpoint, with a stateful Adagrad hot-tier fold on
+    (``--hot-fold adagrad``: per-row optimizer state sharded over the
+    replica axis, persisted as ``fold::`` checkpoint arrays beside —
+    never inside — the canonical table bytes). The restart must restore
+    the last durable snapshot's canonical tables AND its fold state and
+    replay to final weights BIT-IDENTICAL to a straight (unkilled) run —
+    a fold state restarted from zeros would re-derive different Adagrad
+    step sizes and diverge. A single crash must not quarantine anything.
+
+    Returns ``(ok, detail)`` like :func:`run_supervised_scenario`.
+    """
+    import numpy as np
+
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=_ROOT)
+    demo = [sys.executable, "-m", "fps_tpu.testing.supervised_demo",
+            *SCENARIO_DEMO_ARGS,
+            "--hot-tier", str(SCENARIO_FOLD_TIER),
+            "--hot-sync-every", str(SCENARIO_FOLD_SYNC),
+            "--hot-fold", "adagrad"]
+    straight_dir = os.path.join(tmpdir, "straight")
+    sup_dir = os.path.join(tmpdir, "sup")
+    straight_out = os.path.join(tmpdir, "straight.npz")
+    sup_out = os.path.join(tmpdir, "sup.npz")
+
+    r = subprocess.run(
+        demo + ["--ckpt-dir", straight_dir, "--out", straight_out],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=timeout,
+    )
+    if r.returncode != 0:
+        return False, {"error": "straight hot-fold run failed",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "supervise.py"),
+         "--state-dir", sup_dir, "--stall-timeout-s", "60",
+         "--startup-grace-s", "300", "--term-grace-s", "2",
+         "--backoff-base-s", "0.2", "--max-restarts", "2",
+         "--poll-s", "0.2", "--",
+         *demo, "--ckpt-dir", sup_dir, "--out", sup_out,
+         "--kill-at", str(SCENARIO_FOLD_KILL_AT)],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=timeout,
+    )
+    try:
+        digest = json.loads(r.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return False, {"error": "no supervisor digest",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+    try:
+        with open(sup_out + ".meta.json", encoding="utf-8") as f:
+            meta = json.load(f)
+    except OSError:
+        meta = {}
+    bit_identical = (
+        os.path.exists(sup_out)
+        and np.array_equal(np.load(straight_out)["weights"],
+                           np.load(sup_out)["weights"])
+    )
+    # The snapshots really carry the sharded fold state as its own kind:
+    # canonical table bytes stay untouched (untiered readers skip
+    # fold::), and a resume without it could not be bit-identical.
+    fold_persisted = False
+    snaps = sorted(glob.glob(os.path.join(sup_dir, "ckpt_*.npz")))
+    if snaps:
+        with np.load(snaps[-1]) as z:
+            fold_persisted = any(k.startswith("fold::") for k in z.files)
+    detail = {
+        "supervisor": {k: digest.get(k) for k in
+                       ("success", "attempts", "restarts",
+                        "deadline_aborts", "quarantined")},
+        "restored_step": meta.get("restored_step"),
+        "fold_persisted": fold_persisted,
+        "bit_identical": bit_identical,
+        "corrupt_files": sorted(os.path.basename(p) for p in
+                                glob.glob(sup_dir + "/*.corrupt")),
+    }
+    ok = (r.returncode == 0 and digest.get("success")
+          and digest.get("restarts") == 1
+          # A SIGKILL crash is a death, not a stall: no deadline abort.
+          and digest.get("deadline_aborts") == 0
+          # One crash at one index is not quarantine evidence.
+          and digest.get("quarantined") == []
+          # The kill fires after chunk SCENARIO_FOLD_KILL_AT trains (the
+          # async writer flushed first) and before its checkpoint lands:
+          # exactly one chunk lost, replayed from a snapshot holding
+          # both the reconciled tables and the matching Adagrad state.
+          and meta.get("restored_step") == SCENARIO_FOLD_KILL_AT
+          and fold_persisted
           and not detail["corrupt_files"]
           and bit_identical)
     return ok, detail
@@ -616,6 +724,17 @@ def main(argv=None) -> int:
                          "tracker sidecars beside the checkpoints; "
                          "combine with --hot-tier/--hot-sync-every for "
                          "the mapped tier")
+    ap.add_argument("--cold-budget", type=int, default=0,
+                    help="payload-proportional cold routing "
+                         "(TableSpec.cold_budget; needs a partial "
+                         "--hot-tier)")
+    ap.add_argument("--hot-fold", default=None,
+                    choices=["adagrad", "adam"],
+                    help="stateful hot-tier server optimizer "
+                         "(ServerLogic.hot_fold; needs a fully-"
+                         "replicated --hot-tier and --hot-sync-every "
+                         "> 1) — its sharded state rides checkpoints "
+                         "as fold:: arrays")
     args = ap.parse_args(argv)
 
     import numpy as np

@@ -75,13 +75,26 @@ class TableDensity:
 @dataclasses.dataclass(frozen=True)
 class TierPlan:
     """One table's planned knobs (``reason`` is the human-readable
-    audit trail ``tools/plan.py`` prints per row)."""
+    audit trail ``tools/plan.py`` prints per row). ``cold_budget``
+    (PR 10) is the payload-proportional cold-route lane width — 0 keeps
+    the static cold routes (pre-PR-10 sidecars deserialize with the
+    default)."""
 
     hot_tier: int
     hot_sync_every: int
     dense: bool
     coverage: float  # estimated traffic fraction the head serves
     reason: str
+    cold_budget: int = 0
+
+    def knobs(self) -> tuple:
+        """The compile-affecting knob tuple — what periodic re-planning
+        compares to decide whether a fresh plan is a no-op (same knobs →
+        zero recompiles) or a real change (one deliberate recompile).
+        ``coverage``/``reason`` are evidence, not knobs: estimates drift
+        every fold and must not force spurious recompiles."""
+        return (self.hot_tier, self.hot_sync_every, self.dense,
+                self.cold_budget)
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -127,6 +140,30 @@ def choose_sync_every(
     return int(np.clip(e, 2, max_sync_every))
 
 
+def choose_cold_budget(
+    coverage: float,
+    batch_rows_per_step: int,
+    *,
+    num_workers: int = 1,
+    margin: float = 4.0,
+    min_budget: int = 8,
+) -> int:
+    """Per-worker cold-lane width for a partial head: the expected cold
+    rows per worker per step (``(1 - coverage) · B / W``) times a safety
+    ``margin`` (batch-to-batch variance — an undersized lane makes every
+    chunk overflow back to the static route), rounded up to a multiple
+    of 8 lanes. Returns 0 (static routes) when the margined lane would
+    not be meaningfully narrower than the batch itself — the compacted
+    route pays a pack/unpack per step, so it must buy a real payload
+    reduction."""
+    per_worker = max(batch_rows_per_step // max(num_workers, 1), 1)
+    expect = (1.0 - coverage) * per_worker
+    budget = int(np.ceil(max(expect * margin, min_budget) / 8.0) * 8)
+    if budget * 2 >= per_worker:
+        return 0
+    return budget
+
+
 def plan_tables(
     densities: list[TableDensity] | dict[str, TableDensity],
     *,
@@ -139,6 +176,8 @@ def plan_tables(
     max_sync_every: int = 8,
     mean_combine: bool = False,
     num_shards: int = 8,
+    num_workers: int = 1,
+    cold_budget_margin: float = 4.0,
 ) -> dict[str, TierPlan]:
     """Choose ``(hot_tier, hot_sync_every, dense)`` per table from its
     estimated density — the three knobs ``TableSpec``/``TrainerConfig``
@@ -201,11 +240,21 @@ def plan_tables(
             mean_combine=mean_combine,
             reconcile_frac=reconcile_frac,
             max_sync_every=max_sync_every)
+        # Partial head: the cold routes stay — make their payload track
+        # the measured cold traffic (docs/performance.md
+        # "Payload-proportional routing"). Dense tables skip it (their
+        # collectives are table-sized regardless of the lane).
+        C = 0 if dense else choose_cold_budget(
+            cov, batch_rows_per_step, num_workers=num_workers,
+            margin=cold_budget_margin)
         plans[d.name] = TierPlan(
             H, E, dense, cov,
             f"partial head: top-{H} covers {cov:.2f} of estimated "
             f"traffic (target {coverage_target}, budget "
-            f"{budget_rows} rows, {num_shards} shards)")
+            f"{budget_rows} rows, {num_shards} shards"
+            + (f"; compacted cold lane {C}/worker" if C else "")
+            + ")",
+            cold_budget=C)
     return plans
 
 

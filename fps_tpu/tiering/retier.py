@@ -47,6 +47,7 @@ import numpy as np
 
 from fps_tpu import sketch as sklib
 from fps_tpu.core.store import (
+    FOLD_KEY_SUFFIX,
     hot_key,
     hot_slot_map,
     ids_key,
@@ -109,6 +110,14 @@ class Retierer:
       auto_plan: run :func:`plan_tables` after ``warmup_checks`` folds
         and apply it (spec/config mutation + one recompile).
       warmup_checks: folds of evidence required before planning.
+      replan_every: periodic RE-planning cadence, in folds (checks):
+        after the initial plan, every ``replan_every``-th fold re-runs
+        :func:`plan_tables` against the current decayed densities. A
+        plan whose knobs (``TierPlan.knobs``: hot_tier / hot_sync_every
+        / dense / cold_budget) are unchanged is a strict no-op — zero
+        recompiles, specs/config untouched; a changed plan re-applies
+        with exactly one deliberate recompile (tested via build-count
+        asserts). 0 (default): the plan lands once per run, as before.
       state_dir: write the per-boundary sidecar here (``keep`` newest
         retained); None disables persistence.
       batch_rows_hint: pulled rows per step fed to the planner's
@@ -123,18 +132,23 @@ class Retierer:
                  churn_threshold: float = 0.25,
                  auto_plan: bool = False,
                  warmup_checks: int = 1,
+                 replan_every: int = 0,
                  state_dir: str | None = None,
                  keep: int = 3,
                  batch_rows_hint: int = 1024,
                  plan_kwargs: Mapping | None = None):
         if check_every < 1:
             raise ValueError(f"check_every must be >= 1, got {check_every}")
+        if replan_every < 0:
+            raise ValueError(
+                f"replan_every must be >= 0, got {replan_every}")
         self.tables = None if tables is None else frozenset(tables)
         self.spec = spec or sklib.DecayedCountMinSpec(depth=4, width=2048)
         self.check_every = check_every
         self.churn_threshold = churn_threshold
         self.auto_plan = auto_plan
         self.warmup_checks = warmup_checks
+        self.replan_every = replan_every
         self.state_dir = state_dir
         self.keep = keep
         self.batch_rows_hint = batch_rows_hint
@@ -281,6 +295,9 @@ class Retierer:
             if (self.auto_plan and not self.planned
                     and self.tick >= self.warmup_checks):
                 tables = self._apply_plan(trainer, tables, recorder)
+            elif (self.auto_plan and self.planned and self.replan_every
+                    and self.tick % self.replan_every == 0):
+                tables = self._replan(trainer, tables, recorder)
             tables = self._maybe_rerank(trainer, tables, recorder)
         if self.state_dir is not None:
             self._save_sidecar(index + 1, windows)
@@ -347,7 +364,10 @@ class Retierer:
 
     # -- auto-plan ----------------------------------------------------------
 
-    def _apply_plan(self, trainer, tables: dict, recorder) -> dict:
+    def _compute_plans(self, trainer):
+        """Run the planner against the current decayed densities.
+        Returns ``(plans, est_by_name)`` without mutating anything —
+        shared by the initial plan and periodic re-planning."""
         from fps_tpu import ops
 
         store = trainer.store
@@ -369,9 +389,17 @@ class Retierer:
             batch_rows_per_step=self.batch_rows_hint,
             dense_table_bytes=ops.DENSE_TABLE_BYTES,
             num_shards=trainer.num_shards,
+            num_workers=trainer.num_workers,
         )
         kwargs.update(self.plan_kwargs)
-        plans = plan_tables(densities, **kwargs)
+        return plan_tables(densities, **kwargs), est_by_name
+
+    def _install_plans(self, trainer, tables, plans, est_by_name,
+                       recorder, *, what: str) -> dict:
+        """Adopt ``plans``: seed partial-head rankings, mutate
+        specs/config, strip + re-derive the aux entries (the ONE
+        deliberate recompile; re-ranks after it swap data only)."""
+        store = trainer.store
         for name in sorted(plans):
             plan = plans[name]
             spec = store.specs[name]
@@ -381,19 +409,54 @@ class Retierer:
         self.planned = True
         self.plans = plans
         E = self._apply_plans_to(trainer)
-        _log.info("tiering: plan applied at check %d — %s, "
-                  "hot_sync_every=%d", self.checks,
-                  {n: (p.hot_tier, p.hot_sync_every, p.dense)
+        _log.info("tiering: %s applied at check %d — %s, "
+                  "hot_sync_every=%d", what, self.checks,
+                  {n: (p.hot_tier, p.hot_sync_every, p.dense,
+                       p.cold_budget)
                    for n, p in sorted(plans.items())}, E)
         if recorder is not None:
             recorder.event(
-                "tiering_plan", hot_sync_every=E,
+                "tiering_plan", hot_sync_every=E, what=what,
                 plan={n: p.to_json() for n, p in sorted(plans.items())})
-        # The resolution changed: strip every aux entry and re-derive
-        # against the new spec/config (ONE deliberate recompile; the
-        # re-ranks that follow swap data only).
-        tables = {k: v for k, v in tables.items() if not is_aux_key(k)}
+        # Strip the DERIVABLE aux entries (replicas, slot maps, sketches)
+        # so _attach_hot re-derives them under the new resolution — but
+        # KEEP ::fold optimizer state: it is the one aux kind that is not
+        # a projection of the canonical table (driver._attach_hot
+        # validates its shape against the new resolution and drops it
+        # only if genuinely stale; silently zeroing a live Adagrad/Adam
+        # accumulator on a re-plan would change step sizes mid-run).
+        tables = {k: v for k, v in tables.items()
+                  if not is_aux_key(k) or k.endswith(FOLD_KEY_SUFFIX)}
         return trainer._attach_hot(tables)
+
+    def _apply_plan(self, trainer, tables: dict, recorder) -> dict:
+        plans, est_by_name = self._compute_plans(trainer)
+        return self._install_plans(trainer, tables, plans, est_by_name,
+                                   recorder, what="plan")
+
+    def _replan(self, trainer, tables: dict, recorder) -> dict:
+        """Periodic RE-planning (``replan_every``): recompute the plan
+        from the current decayed densities; unchanged knobs are a strict
+        no-op (zero recompiles — specs, config, and aux entries all
+        untouched), changed knobs re-apply with one deliberate
+        recompile."""
+        plans, est_by_name = self._compute_plans(trainer)
+        old = self.plans or {}
+        unchanged = (set(plans) == set(old) and all(
+            plans[n].knobs() == old[n].knobs() for n in plans))
+        if recorder is not None:
+            recorder.inc("tiering.replans",
+                         changed="false" if unchanged else "true")
+        if unchanged:
+            # Refresh the evidence (coverage/reason) for the sidecar,
+            # but leave specs/config/aux alone — the compile key cannot
+            # move.
+            self.plans = plans
+            return tables
+        _log.info("tiering: re-plan at check %d changed the knobs — "
+                  "re-applying", self.checks)
+        return self._install_plans(trainer, tables, plans, est_by_name,
+                                   recorder, what="replan")
 
     def _apply_plans_to(self, trainer) -> int:
         """Mutate the trainer's specs/config to match ``self.plans``
@@ -407,7 +470,8 @@ class Retierer:
                 continue
             store.specs[name] = dataclasses.replace(
                 spec, hot_tier=plan.hot_tier,
-                dense_collectives=plan.dense)
+                dense_collectives=plan.dense,
+                cold_budget=getattr(plan, "cold_budget", 0))
         E = global_sync_every(self.plans)
         trainer.config = dataclasses.replace(
             trainer.config, hot_sync_every=E)

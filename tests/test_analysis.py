@@ -313,6 +313,80 @@ def test_mutation_missing_reconcile_psum_breaks_replica_consistency():
     assert not certify(TOY, tiered).ok
 
 
+RECONCILE_RS = f'''    %7 = "stablehlo.reduce_scatter"(%6) <{{channel_handle = #stablehlo.channel_handle<handle = 5, type = 1>, replica_groups = {GROUPS_1X8}, scatter_dimension = 0 : i64, use_global_device_ids}}> ({{
+    ^bb0(%arg6: tensor<f32>, %arg7: tensor<f32>):
+      %92 = stablehlo.add %arg6, %arg7 : tensor<f32>
+      stablehlo.return %92 : tensor<f32>
+    }}) : (tensor<64x8xf32>) -> tensor<8x8xf32>
+'''
+
+
+def test_sharded_reconcile_rs_satisfies_replica_consistency():
+    """PR 10: the window reconcile lowers a reduce-scatter (each replica
+    applies its 1/S slice) — ReplicaConsistency accepts it in place of
+    the legacy full-head psum, with the same group-size and payload
+    gates."""
+    import dataclasses
+
+    tiered = dataclasses.replace(
+        BASE, require_shard_psum=True, hot_reconcile_bytes=1024,
+        shard_group_size=8, max_collectives=3,
+        max_collective_bytes=8192,
+        per_kind_max={"all_gather": 1, "all_to_all": 1,
+                      "reduce_scatter": 1})
+    assert certify(_insert(RECONCILE_RS), tiered).ok
+    # An undersized reduce_scatter does not satisfy the reconcile bound.
+    small = dataclasses.replace(tiered, hot_reconcile_bytes=1 << 20)
+    cert = certify(_insert(RECONCILE_RS), small)
+    assert not cert.ok
+    assert "replica_consistency" in _pass_names(cert)
+
+
+def test_audit_diff_budgets_gate():
+    """tools/audit_programs.py --diff: growth vs the reference audit
+    fails iff it is NOT covered by the current pinned budget (an
+    unpinned regression); re-pinned growth and shrinkage pass."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "_audit_programs", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "audit_programs.py"))
+    ap = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ap)
+
+    old = {"audit_programs": {
+        "mf": {"collectives": {"count": 2, "bytes": 4096}},
+        "mf_tiered": {"collectives": {"count": 3, "bytes": 5120}},
+        "ghost": {"collectives": {"count": 1, "bytes": 64}},
+    }}
+    pinned_mf = ap.BUDGETS["mf"]
+    # Unchanged + shrunk: clean.
+    assert ap.diff_budgets(old, {
+        "mf": {"collective_count": 2, "collective_bytes": 4096},
+    }) == []
+    assert ap.diff_budgets(old, {
+        "mf": {"collective_count": 1, "collective_bytes": 2048},
+    }) == []
+    # Growth covered by the CURRENT pin (mf_tiered was deliberately
+    # re-pinned this PR to its sharded-reconcile census): passes.
+    cur = {"mf_tiered": {
+        "collective_count": ap.BUDGETS["mf_tiered"]["max_collectives"],
+        "collective_bytes":
+            ap.BUDGETS["mf_tiered"]["max_collective_bytes"]}}
+    assert ap.diff_budgets(old, cur) == []
+    # Unpinned growth: fails, naming the program.
+    bad = {"mf": {"collective_count": pinned_mf["max_collectives"] + 1,
+                  "collective_bytes": 999999}}
+    problems = ap.diff_budgets(old, bad)
+    assert len(problems) == 1 and problems[0].startswith("mf:")
+    # Programs absent from the old audit (new rows) never regress.
+    assert ap.diff_budgets(old, {
+        "brand_new": {"collective_count": 99,
+                      "collective_bytes": 1 << 30}}) == []
+
+
 def test_every_default_pass_has_a_mutation():
     """Meta-test: the suite above covers every registered pass."""
     from fps_tpu.analysis import DEFAULT_PASSES
@@ -680,4 +754,6 @@ def test_audit_programs_importable_without_reexec():
         text=True, timeout=300,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr
-    assert "IMPORT_OK 7" in proc.stdout
+    # 10 pinned rows since PR 10 (mf_tiered_gathered/mf_tiered_compact
+    # joined the census).
+    assert "IMPORT_OK 10" in proc.stdout

@@ -1,0 +1,304 @@
+"""Payload-proportional cold routing (``TableSpec.cold_budget``).
+
+The contracts under test, per docs/performance.md "Payload-proportional
+routing":
+
+* **compaction is exact** — for a chunk stream whose every batch fits
+  the lane, the compacted program produces the same tables and metrics
+  as the static cold routes (the lane carries the same cold ids/deltas,
+  zeros removed);
+* **overflow falls back bit-identically** — a chunk whose cold ids
+  exceed the budget dispatches the STATIC program (the exact
+  ``cold_budget=0`` program, same compile-cache entry), counts a
+  ``cold_route.overflow_chunks`` metric, and never drops an update;
+* **the compacted program is strictly smaller** — cold-route collective
+  payload scales with the lane, not the batch (pinned exactly in
+  ``tools/audit_programs.py`` as ``mf_tiered_compact`` vs
+  ``mf_tiered_gathered``);
+* the device-side ``hot_tier.cold_dropped`` net stays zero for every
+  host-certified chunk.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fps_tpu.core.driver import num_workers_of
+from fps_tpu.core.ingest import epoch_chunks, per_worker_cold_counts
+from fps_tpu.core.store import compact_cold
+from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+from fps_tpu.parallel.mesh import make_ps_mesh
+
+NU, NI, RANK = 48, 32, 4
+H = 16  # partial head
+
+
+def _make_trainer(mesh, *, cold_budget=0, combine="sum"):
+    trainer, store = online_mf(
+        mesh, MFConfig(num_users=NU, num_items=NI, rank=RANK),
+        combine=combine)
+    store.specs["item_factors"] = dataclasses.replace(
+        store.specs["item_factors"], hot_tier=H,
+        dense_collectives=False, cold_budget=cold_budget)
+    trainer.config = dataclasses.replace(trainer.config, hot_sync_every=2)
+    return trainer, store
+
+
+def _data(n, *, p_cold, seed=0):
+    """Ratings whose item stream is hot-heavy: cold fraction p_cold."""
+    rng = np.random.default_rng(seed)
+    item = np.where(rng.random(n) < p_cold,
+                    rng.integers(H, NI, n),
+                    rng.integers(0, H, n)).astype(np.int32)
+    return {"user": rng.integers(0, NU, n).astype(np.int32),
+            "item": item,
+            "rating": rng.normal(0, 1, n).astype(np.float32)}
+
+
+def _chunks(data, W, *, local_batch=8, spc=4, seed=5):
+    return list(epoch_chunks(data, num_workers=W, local_batch=local_batch,
+                             steps_per_chunk=spc, route_key="user",
+                             seed=seed))
+
+
+def _fit(trainer, chunks, rec=None):
+    trainer.recorder = rec
+    tables, ls = trainer.init_state(jax.random.key(0))
+    return trainer.fit_stream(tables, ls, iter(chunks), jax.random.key(1))
+
+
+# ---------------------------------------------------------------------------
+# Unit: the device-side lane packer and the host-side certifier.
+# ---------------------------------------------------------------------------
+
+def test_compact_cold_packs_order_preserving_and_drops_overflow():
+    ids = jnp.asarray([-1, 5, -1, 9, 3, -1, 7], jnp.int32)
+    deltas = jnp.arange(14, dtype=jnp.float32).reshape(7, 2)
+    lane_ids, lane_deltas, pos, over = compact_cold(ids, deltas, budget=4)
+    assert lane_ids.shape == (4,)
+    assert np.array_equal(np.asarray(lane_ids), [5, 9, 3, 7])
+    assert np.array_equal(np.asarray(lane_deltas),
+                          np.asarray(deltas)[[1, 3, 4, 6]])
+    # pos maps batch slots to lane positions; masked slots are -1.
+    assert np.array_equal(np.asarray(pos), [-1, 0, -1, 1, 2, -1, 3])
+    assert int(over) == 0
+
+    # Overflow: live entries beyond the lane are dropped and counted.
+    lane_ids, _, pos, over = compact_cold(ids, None, budget=2)
+    assert np.array_equal(np.asarray(lane_ids), [5, 9])
+    assert np.array_equal(np.asarray(pos), [-1, 0, -1, 1, -1, -1, -1])
+    assert int(over) == 2
+
+
+def test_per_worker_cold_counts_static_and_member():
+    # 2 steps x (2 workers * 3 local): worker-major batch layout.
+    ids = np.array([[0, 1, 9, 2, 8, 7],
+                    [9, 9, 9, -1, 0, 8]])
+    counts = per_worker_cold_counts(ids, 2, hot_head=8)
+    assert counts.shape == (2, 2)
+    # worker 0 step 0: {9}; worker 1 step 0: {8}; step 1: {9,9,9} / {8}
+    # (-1 is padding, never cold).
+    assert np.array_equal(counts, [[1, 1], [3, 1]])
+    # Membership form (adaptive tier): hot set {0, 9}.
+    member = np.zeros(11, bool)
+    member[[0, 9]] = True
+    counts = per_worker_cold_counts(ids, 2, hot_member=member)
+    assert np.array_equal(counts, [[1, 3], [0, 1]])
+    with pytest.raises(ValueError, match="divisible"):
+        per_worker_cold_counts(ids, 4)
+
+
+# ---------------------------------------------------------------------------
+# Resolution: where the compacted route engages.
+# ---------------------------------------------------------------------------
+
+def test_cold_compact_resolution_policy(devices8):
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    trainer, store = _make_trainer(mesh, cold_budget=4)
+    assert trainer._cold_compact_map() == {"item_factors": 4}
+    # Full replication: no cold route to compact.
+    store.specs["item_factors"] = dataclasses.replace(
+        store.specs["item_factors"], hot_tier=NI)
+    assert trainer._cold_compact_map() == {}
+    # Dense route: table-sized collectives regardless of the lane.
+    store.specs["item_factors"] = dataclasses.replace(
+        store.specs["item_factors"], hot_tier=H, dense_collectives=True)
+    assert trainer._cold_compact_map() == {}
+    # Tier off (exact mode): nothing engages.
+    trainer2, _ = _make_trainer(mesh, cold_budget=4)
+    trainer2.config = dataclasses.replace(trainer2.config,
+                                          hot_sync_every=1)
+    assert trainer2._cold_compact_map() == {}
+
+
+# ---------------------------------------------------------------------------
+# The exactness + fallback contracts.
+# ---------------------------------------------------------------------------
+
+def test_compacted_chunks_match_static_and_overflow_falls_back(devices8):
+    """One stream, three trainers: static (cold_budget=0), compacted
+    with a generous lane (every chunk certifies), compacted with a lane
+    of 0 < C < cold traffic (every chunk overflows). The generous arm
+    matches static numerically through the compacted program; the
+    overflow arm IS the static program — tables and metrics equal to
+    cold_budget=0 bit for bit, nothing dropped."""
+    from fps_tpu import obs
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    data = _data(W * 8 * 4 * 3, p_cold=0.2)
+    chunks = _chunks(data, W)
+    # Every batch's per-worker cold count, so the lane choices below are
+    # provably on the right side of the certifier.
+    counts = np.concatenate([
+        per_worker_cold_counts(c["item"], W, hot_head=H).reshape(-1)
+        for c in chunks])
+    assert counts.max() > 1  # the stream really has cold traffic
+
+    runs = {}
+    for label, C in (("static", 0), ("fits", int(counts.max())),
+                     ("overflows", 1)):
+        trainer, store = _make_trainer(mesh, cold_budget=C)
+        rec = obs.Recorder(sinks=[])
+        tables, _, m = _fit(trainer, chunks, rec)
+        runs[label] = (store.dump_model("item_factors")[1], m, rec,
+                       trainer)
+
+    static_vals, static_m, _, _ = runs["static"]
+
+    vals, m, rec, trainer = runs["fits"]
+    assert int(rec.counter_value("cold_route.compact_chunks")) == len(
+        chunks)
+    assert rec.counter_value("cold_route.overflow_chunks",
+                             table="item_factors") == 0
+    assert rec.counter_value("hot_tier.cold_dropped",
+                             table="item_factors") == 0
+    # The compacted program is a DIFFERENT cache entry...
+    assert len(trainer._compiled) == 1
+    # ...whose result matches the static route exactly: the lane carries
+    # the same cold ids/deltas in the same order, zeros removed.
+    assert np.array_equal(vals, static_vals)
+
+    vals, m, rec, trainer = runs["overflows"]
+    over = int(rec.counter_value("cold_route.overflow_chunks",
+                                 table="item_factors"))
+    fit = int(rec.counter_value("cold_route.compact_chunks"))
+    # Every chunk was adjudicated; the zero-weight-padded trailing chunk
+    # may legitimately fit a 1-wide lane, every full chunk overflows.
+    assert fit + over == len(chunks)
+    assert over >= len(chunks) - 1
+    # Fallback is the cold_budget=0 program (and the rare fitting chunk
+    # takes the exact compacted route): BIT-identical everything.
+    assert np.array_equal(vals, static_vals)
+    assert all(
+        np.array_equal(np.asarray(a["se"]), np.asarray(b["se"]))
+        and np.array_equal(np.asarray(a["n"]), np.asarray(b["n"]))
+        for a, b in zip(m, static_m))
+
+
+def test_mixed_stream_dispatches_both_programs(devices8):
+    """A stream with fitting AND overflowing chunks uses two compiled
+    programs (compact + static fallback) and still matches the all-
+    static run exactly."""
+    from fps_tpu import obs
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    hot = _data(W * 8 * 4, p_cold=0.0, seed=1)     # all-hot chunk
+    cold = _data(W * 8 * 4, p_cold=0.9, seed=2)    # cold-heavy chunk
+    data = {k: np.concatenate([hot[k], cold[k]]) for k in hot}
+    # seed=None: preserve stream order so the hot half lands (mostly) in
+    # the first chunk and the cold half later (a shuffle would mix them
+    # and make every chunk overflow).
+    chunks = _chunks(data, W, seed=None)
+    assert len(chunks) >= 2
+    # Lane sized to exactly fit the first chunk: skew routing leaks a
+    # few cold examples into it, so size from the measured counts and
+    # assert a later chunk really exceeds the lane.
+    per_chunk = [int(per_worker_cold_counts(
+        c["item"], W, hot_head=H).max()) for c in chunks]
+    lane = per_chunk[0]
+    assert max(per_chunk[1:]) > lane
+
+    trainer, store = _make_trainer(mesh, cold_budget=lane)
+    rec = obs.Recorder(sinks=[])
+    _fit(trainer, chunks, rec)
+    vals = store.dump_model("item_factors")[1]
+    assert int(rec.counter_value("cold_route.compact_chunks")) >= 1
+    assert int(rec.counter_value("cold_route.overflow_chunks",
+                                 table="item_factors")) >= 1
+    assert len(trainer._compiled) == 2  # compact + static fallback
+
+    static, sstore = _make_trainer(mesh, cold_budget=0)
+    _fit(static, chunks)
+    assert np.array_equal(vals, sstore.dump_model("item_factors")[1])
+
+
+def test_uncertifiable_logic_stays_static(devices8):
+    """A logic whose prepare() synthesizes ids (MF negative sampling)
+    reports pulled_ids_host=None — every chunk falls back to the static
+    program and nothing breaks."""
+    from fps_tpu import obs
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    trainer, store = online_mf(
+        mesh, MFConfig(num_users=NU, num_items=NI, rank=RANK,
+                       negative_samples=1))
+    store.specs["item_factors"] = dataclasses.replace(
+        store.specs["item_factors"], hot_tier=H,
+        dense_collectives=False, cold_budget=8)
+    trainer.config = dataclasses.replace(trainer.config, hot_sync_every=2)
+    assert trainer.logic.pulled_ids_host(
+        {"item": np.zeros(4, np.int32)}) is None
+    data = _data(W * 8 * 4, p_cold=0.1)
+    rec = obs.Recorder(sinks=[])
+    _fit(trainer, _chunks(data, W), rec)
+    assert int(rec.counter_value("cold_route.compact_chunks")) == 0
+    assert int(rec.counter_value("cold_route.overflow_chunks",
+                                 table="item_factors")) >= 1
+    assert np.isfinite(store.dump_model("item_factors")[1]).all()
+
+
+def test_compacted_program_smaller_and_prefetch_identical(devices8):
+    """The compacted program's cold-route collective payload is strictly
+    smaller than the static program's, and prefetch on/off dispatches
+    the same certified programs with identical results (certification
+    rides the PlacedChunk's retained host ids)."""
+    from fps_tpu.analysis import collective_profile
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    W = num_workers_of(mesh)
+    data = _data(W * 8 * 4 * 2, p_cold=0.1)
+    chunks = _chunks(data, W)
+    lane = int(max(per_worker_cold_counts(
+        c["item"], W, hot_head=H).max() for c in chunks))
+
+    trainer, store = _make_trainer(mesh, cold_budget=lane)
+    hlo_c = trainer.lowered_chunk_text(chunks[0], "sync")
+    static, _ = _make_trainer(mesh, cold_budget=0)
+    hlo_s = static.lowered_chunk_text(chunks[0], "sync")
+    # Test-scale payloads sit below the default 1KB data-plane
+    # threshold — lower it so the comparison sees the routes at all.
+    bytes_c = sum(c.payload_bytes for c in collective_profile(hlo_c, 64))
+    bytes_s = sum(c.payload_bytes for c in collective_profile(hlo_s, 64))
+    assert bytes_c < bytes_s
+
+    tables, _, _ = _fit(trainer, chunks)
+    want = store.dump_model("item_factors")[1]
+
+    pf_trainer, pf_store = _make_trainer(mesh, cold_budget=lane)
+    pf_trainer.config = dataclasses.replace(pf_trainer.config, prefetch=2)
+    from fps_tpu import obs
+
+    rec = obs.Recorder(sinks=[])
+    _fit(pf_trainer, chunks, rec)
+    assert int(rec.counter_value("cold_route.compact_chunks")) == len(
+        chunks)
+    assert np.array_equal(pf_store.dump_model("item_factors")[1], want)

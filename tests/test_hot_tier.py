@@ -219,9 +219,11 @@ def test_tiered_sync_invariant_and_determinism(devices8):
 
 def test_tiered_full_replication_elides_collective_routes(devices8):
     """H >= num_ids: the pull/push collective routes must be statically
-    GONE from the per-chunk program (only the reconcile psum and scalar
-    metric reductions remain) — the NuPS replicate-the-hot-table regime
-    and the source of the bench A/B's strictly-fewer-collectives win."""
+    GONE from the per-chunk program — the NuPS replicate-the-hot-table
+    regime and the source of the bench A/B's strictly-fewer-collectives
+    win. What remains is the SHARDED window reconcile (PR 10): one
+    reduce-scatter + one re-broadcast all-gather per window, plus scalar
+    metric reductions."""
     mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
     train, _ = logreg_data()
     chunks = logreg_chunks(train, num_workers_of(mesh), epochs=1)
@@ -235,12 +237,25 @@ def test_tiered_full_replication_elides_collective_routes(devices8):
         return trainer._get_compiled("sync").lower(
             tables, ls, batches, key).as_text()
 
-    pat = re.compile(r"stablehlo\.(all_gather|all_to_all|"
-                     r"collective_permute)")
-    n_off = len(pat.findall(lowered()))
-    n_on = len(pat.findall(lowered(hot_tier=NF, hot_sync_every=4)))
+    pat = re.compile(r"stablehlo\.(all_to_all|collective_permute)")
+    off_text = lowered()
+    on_text = lowered(hot_tier=NF, hot_sync_every=4)
+    n_off = len(pat.findall(off_text))
+    n_on = len(pat.findall(on_text))
     assert n_off > 0  # the untiered program really pays data collectives
-    assert n_on == 0, f"tiered program still carries {n_on} gather ops"
+    assert n_on == 0, f"tiered program still carries {n_on} route ops"
+    # The reconcile is the sharded RS+AG pair — present in the tiered
+    # program, absent untiered (the untiered push rides all_to_all).
+    assert "stablehlo.reduce_scatter" in on_text
+    assert "stablehlo.reduce_scatter" not in off_text
+    # The only all_gathers left are the reconcile re-broadcasts — the
+    # pull/push gather routes (which dominate the untiered count) are
+    # statically gone.
+    n_ag_on = len(re.findall(r"stablehlo\.all_gather", on_text))
+    n_rs_on = len(re.findall(r"stablehlo\.reduce_scatter", on_text))
+    assert n_ag_on == n_rs_on, (
+        f"{n_ag_on} all_gathers vs {n_rs_on} reconcile reduce_scatters "
+        "— a gather route survived full replication")
 
 
 def test_tiered_ssp_runs_and_reconciles_per_round(devices8):
@@ -420,9 +435,17 @@ def test_resolve_hot_tier_policy(devices8):
     mesh1 = make_ps_mesh(num_shards=1, num_data=1, devices=devices8[:1])
     tr1, store1 = _make_trainer(mesh1, hot_tier=64, hot_sync_every=4)
     assert tr1._resolve_hot_tier(store1.specs["weights"]) == 0
-    # Non-additive folds keep the gathered route.
+    # The max/min combines now ride the tier too (PR 10: windowed
+    # extremum pending buffer); only per-push folds (apply_fn / callable
+    # combine) keep the gathered route.
     from fps_tpu.core.api import ServerLogic
     trainer.server_logic["weights"] = ServerLogic(combine="max")
+    assert trainer._resolve_hot_tier(spec) == 64
+    trainer.server_logic["weights"] = ServerLogic(
+        apply_fn=lambda rows, delta: rows + delta)
+    assert trainer._resolve_hot_tier(spec) == 0
+    trainer.server_logic["weights"] = ServerLogic(
+        combine=lambda summed, counts: summed)
     assert trainer._resolve_hot_tier(spec) == 0
 
 
@@ -471,6 +494,187 @@ def test_owner_major_head_layout_invariant(devices8):
 
 
 # ---------------------------------------------------------------------------
+# Sharded reconcile + stateful hot folds (PR 10).
+# ---------------------------------------------------------------------------
+
+def test_sharded_reconcile_lowers_rs_ag_not_psum(devices8):
+    """The window reconcile is the reduce-scatter -> owned-slice apply ->
+    all-gather exchange (arXiv:2004.13336), not a full-head all_reduce:
+    the tiered program carries the RS, and its byte payload is the
+    padded head, not the batch."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=1)
+    trainer, _ = _make_trainer(mesh, hot_tier=64, hot_sync_every=4)
+    hlo = trainer.lowered_chunk_text(chunks[0], "sync")
+    from fps_tpu.analysis import collective_profile
+
+    prof = collective_profile(hlo, 64)
+    kinds = {c.kind for c in prof}
+    assert "reduce_scatter" in kinds
+    # H=64, dim=1 (logreg weights), f32 accumulator, padded to S=4.
+    assert any(c.kind == "reduce_scatter" and c.payload_bytes == 64 * 4
+               for c in prof)
+
+
+def _fold_trainer(mesh, *, fold="adagrad", H=NF, E=3, combine="sum"):
+    trainer, store = _make_trainer(mesh, hot_tier=H, hot_sync_every=E)
+    trainer.server_logic["weights"] = dataclasses.replace(
+        trainer.server_logic["weights"], combine=combine, hot_fold=fold)
+    return trainer, store
+
+
+def test_hot_fold_validation(devices8):
+    from fps_tpu.core.api import HotFold
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    # Partial head: the fold would fork semantics between head and tail.
+    trainer, store = _fold_trainer(mesh, H=64)
+    with pytest.raises(ValueError, match="PARTIAL head"):
+        trainer._hot_tier_map()
+    # Tier disengaged (exact mode): a silently-dropped optimizer is an
+    # error, not a fallback.
+    trainer, store = _fold_trainer(mesh, H=NF, E=1)
+    with pytest.raises(ValueError, match="resolve ON"):
+        trainer._hot_tier_map()
+    # Extremum combine cannot feed a delta-sum fold.
+    trainer, store = _fold_trainer(mesh, combine="max")
+    with pytest.raises(ValueError, match="'sum'/'mean'"):
+        trainer._hot_tier_map()
+    # Typo'd kind fails at construction, not first dispatch.
+    with pytest.raises(ValueError, match="adagrid"):
+        HotFold(kind="adagrid")
+    # The happy path resolves with the fold attached.
+    trainer, store = _fold_trainer(mesh)
+    assert trainer._hot_tier_map() == {"weights": NF}
+    assert trainer._hot_fold_map()["weights"].kind == "adagrad"
+
+
+@pytest.mark.parametrize("fold", ["adagrad", "adam"])
+def test_hot_fold_runs_deterministic_and_state_sharded(devices8, fold):
+    """A stateful hot-fold run is deterministic, keeps the projection
+    invariant, carries its state SHARDED (never replicated) under the
+    ::fold aux key, and actually changes the trajectory vs the plain
+    additive fold (the state is load-bearing)."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+    results = []
+    for _ in range(2):
+        trainer, store = _fold_trainer(mesh, fold=fold)
+        tables, _, m = _fit(trainer, chunks)
+        results.append((weights(store), m))
+        state = tables["weights::fold"]
+        from fps_tpu.core.api import HotFold
+        from fps_tpu.core.store import hot_fold_state_shape
+
+        assert tuple(state.shape) == hot_fold_state_shape(
+            HotFold(kind=fold), NF, 1, 4)
+        # Sharded over the shard axis — each device holds 1/S rows.
+        assert len(state.sharding.device_set) == 4
+        shard_rows = {(s.index[0].start, s.index[0].stop)
+                      for s in state.addressable_shards}
+        assert len(shard_rows) == 4, "fold state is replicated, not sharded"
+        assert np.isfinite(results[-1][0]).all()
+        rep = np.asarray(tables[hot_key("weights")])
+        assert np.array_equal(rep, store.lookup_host("weights",
+                                                     np.arange(NF)))
+    assert np.array_equal(results[0][0], results[1][0])
+    assert _tree_equal(results[0][1], results[1][1])
+    plain, pstore = _make_trainer(mesh, hot_tier=NF, hot_sync_every=3)
+    _fit(plain, chunks)
+    assert not np.array_equal(weights(pstore), results[0][0])
+
+
+def test_hot_fold_checkpoint_resume_bit_identical_and_canonical(
+        tmp_path, devices8):
+    """Fold state rides the snapshot as fold:: arrays: resume replays
+    bit-identically, while the canonical table bytes stay restorable by
+    an UNTIERED trainer (which drops the fold kind)."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+
+    trainer, store = _fold_trainer(mesh)
+    _fit(trainer, chunks)
+    want = weights(store)
+
+    d = str(tmp_path / "ck")
+    trainer, store = _fold_trainer(mesh)
+    tables, ls = trainer.init_state(jax.random.key(0))
+
+    class Stop(Exception):
+        pass
+
+    def stop_at(i, _m):
+        if i == 1:
+            raise Stop
+
+    with Checkpointer(d) as ckpt:
+        with pytest.raises(Stop):
+            trainer.fit_stream(
+                tables, ls, iter(chunks), jax.random.key(1),
+                checkpointer=ckpt, checkpoint_every=1, on_chunk=stop_at,
+            )
+        # The snapshot carries the state under its own kind.
+        import glob as _g
+        import os as _os
+        snaps = sorted(_g.glob(_os.path.join(d, "ckpt_*.npz")))
+        with np.load(snaps[-1]) as z:
+            assert any(k.startswith("fold::") for k in z.files)
+            assert "table::weights" in z.files
+        tables, ls, start = trainer.restore_checkpoint(ckpt, ls)
+        assert "weights::fold" in tables  # restored, not re-zeroed
+        trainer.fit_stream(
+            tables, ls, iter(chunks[start:]), jax.random.key(1),
+            start_step=start,
+        )
+        assert np.array_equal(weights(store), want)
+
+        # Untiered restore: fold arrays are skipped, canonical tables
+        # load clean.
+        untiered, ustore = _make_trainer(mesh)
+        utables, uls = untiered.init_state(jax.random.key(0))
+        utables, uls, _ = untiered.restore_checkpoint(ckpt, uls)
+        assert not any("::" in k for k in untiered._attach_hot(utables))
+        assert np.isfinite(weights(ustore)).all()
+
+
+def test_max_min_combine_rides_the_tier(devices8):
+    """max/min server combines now engage the tier (windowed extremum
+    pending buffer, pmax/pmin reconcile): deterministic runs, the
+    projection invariant holds, and the reconcile lowers an all_reduce
+    (extremum cannot reduce-scatter)."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=1)
+    for combine in ("max", "min"):
+        results = []
+        for _ in range(2):
+            trainer, store = _make_trainer(mesh, hot_tier=NF,
+                                           hot_sync_every=3)
+            trainer.server_logic["weights"] = dataclasses.replace(
+                trainer.server_logic["weights"], combine=combine)
+            assert trainer._hot_tier_map() == {"weights": NF}
+            tables, _, m = _fit(trainer, chunks)
+            w = weights(store)
+            assert np.isfinite(w).all()
+            rep = np.asarray(tables[hot_key("weights")])
+            assert np.array_equal(
+                rep, store.lookup_host("weights", np.arange(NF)))
+            results.append(w)
+        assert np.array_equal(results[0], results[1])
+    # The extremum reconcile is a pmax/pmin all_reduce sized to the
+    # head (+ indicator column), not a reduce-scatter.
+    hlo = trainer.lowered_chunk_text(chunks[0], "sync")
+    from fps_tpu.analysis import collective_profile
+
+    prof = collective_profile(hlo, 64)
+    assert any(c.kind == "all_reduce"
+               and c.payload_bytes == NF * 2 * 4 for c in prof)
+
+
+# ---------------------------------------------------------------------------
 # Chaos: SIGKILL between reconciles under the supervisor (slow tier).
 # ---------------------------------------------------------------------------
 
@@ -479,4 +683,18 @@ def test_sigkill_between_reconciles_resumes_bit_identical(tmp_path):
     from fps_tpu.testing.supervised_demo import run_hot_tier_kill_scenario
 
     ok, detail = run_hot_tier_kill_scenario(str(tmp_path))
+    assert ok, detail
+
+
+@pytest.mark.slow
+def test_reconcile_shard_kill_restores_fold_state_bit_identical(tmp_path):
+    """SIGKILL between a reduce-scatter window and the next checkpoint
+    with the Adagrad hot fold on: the restart restores canonical tables
+    AND the sharded fold state (fold:: snapshot arrays) and replays
+    bit-identically under the supervisor."""
+    from fps_tpu.testing.supervised_demo import (
+        run_reconcile_shard_kill_scenario,
+    )
+
+    ok, detail = run_reconcile_shard_kill_scenario(str(tmp_path))
     assert ok, detail

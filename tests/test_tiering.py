@@ -157,9 +157,9 @@ def test_rerank_zero_recompiles_and_boundary_invariant(devices8):
         builds = []
         orig = type(trainer)._build_chunk_fn
 
-        def counting(self, mode, _orig=orig, _b=builds):
+        def counting(self, mode, *args, _orig=orig, _b=builds, **kw):
             _b.append(mode)
-            return _orig(self, mode)
+            return _orig(self, mode, *args, **kw)
 
         trainer._build_chunk_fn = counting.__get__(trainer)
         tables, _, m = _fit(trainer, chunks)
@@ -309,9 +309,13 @@ def test_rows_replica_requires_valid_ids(devices8):
 # ---------------------------------------------------------------------------
 
 def test_fold_resolution_warns_not_silent(devices8):
+    # PR 10 moved max/min onto the tier (windowed extremum buffer), so
+    # the demotion — and its warning — is down to the per-push folds:
+    # a callable combine and apply_fn.
     mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
     trainer, store = _make_trainer(mesh, hot_tier=64, hot_sync_every=4)
-    trainer.server_logic["weights"] = ServerLogic(combine="max")
+    trainer.server_logic["weights"] = ServerLogic(
+        combine=lambda summed, counts: summed)
     with pytest.warns(UserWarning, match="gathered route"):
         assert trainer._resolve_hot_tier(store.specs["weights"]) == 0
     # Once per table per trainer — resolution runs per compile AND per
@@ -321,6 +325,13 @@ def test_fold_resolution_warns_not_silent(devices8):
     with _w.catch_warnings():
         _w.simplefilter("error")
         assert trainer._resolve_hot_tier(store.specs["weights"]) == 0
+
+    # max/min no longer demote: the tier engages.
+    trainer, store = _make_trainer(mesh, hot_tier=64, hot_sync_every=4)
+    trainer.server_logic["weights"] = ServerLogic(combine="max")
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        assert trainer._resolve_hot_tier(store.specs["weights"]) == 64
 
     # apply_fn trips the same report.
     trainer, store = _make_trainer(mesh, hot_tier=64, hot_sync_every=4)
@@ -377,6 +388,122 @@ def test_planner_no_evidence_stays_untiered_and_global_e():
     assert plans["b"].hot_tier == 64
     assert global_sync_every(plans) == plans["b"].hot_sync_every
     assert global_sync_every({"a": plans["a"]}) == 1
+
+
+def test_planner_cold_budget_for_partial_heads():
+    from fps_tpu.tiering.planner import choose_cold_budget
+
+    # Partial head on a non-dense table: the plan carries a compacted
+    # cold lane sized to the UNCOVERED traffic (margined, multiple of 8).
+    plans = plan_tables([_zipf_density("t", 1 << 20, 16, alpha=1.4)],
+                        batch_rows_per_step=4096,
+                        replica_budget_bytes=1 << 20,
+                        num_workers=8)
+    p = plans["t"]
+    assert 0 < p.hot_tier < (1 << 20)
+    assert p.cold_budget == choose_cold_budget(
+        p.coverage, 4096, num_workers=8)
+    assert p.cold_budget % 8 == 0
+    assert "compacted cold lane" in p.reason
+    # Full replication: no cold route, no lane.
+    plans = plan_tables([_zipf_density("t", 1024, 8)],
+                        batch_rows_per_step=256, num_workers=8)
+    assert plans["t"].cold_budget == 0
+    # Low coverage: a lane as wide as the batch buys nothing -> 0.
+    assert choose_cold_budget(0.1, 4096, num_workers=8) == 0
+    # knobs() compares the compile-affecting fields only.
+    a = plans["t"]
+    b = dataclasses.replace(a, coverage=0.123, reason="different")
+    assert a.knobs() == b.knobs()
+    assert a.knobs() != dataclasses.replace(a, cold_budget=8).knobs()
+
+
+def test_replan_unchanged_noop_changed_recompiles_once(devices8):
+    """Periodic RE-planning (Retierer.replan_every): an unchanged plan
+    is a strict no-op — zero recompiles, counted on the compile cache
+    AND the program-build calls; a changed plan (here: the replica
+    budget collapses, forcing full replication -> partial head)
+    recompiles exactly once."""
+    from fps_tpu import obs
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=4)
+    rt = Retierer(auto_plan=True, warmup_checks=1, check_every=1,
+                  replan_every=1)
+    trainer, store = _make_trainer(mesh, retierer=rt)
+    builds = []
+    orig = type(trainer)._build_chunk_fn
+
+    def counting(self, mode, *args, _orig=orig, _b=builds, **kw):
+        _b.append(mode)
+        return _orig(self, mode, *args, **kw)
+
+    trainer._build_chunk_fn = counting.__get__(trainer)
+    rec = obs.Recorder(sinks=[])
+    trainer.recorder = rec
+
+    # Phase 1: warmup program + the planned program = 2 builds; every
+    # boundary after the plan re-plans with UNCHANGED knobs (stationary
+    # stream) — zero further builds.
+    _fit(trainer, chunks)
+    assert rt.planned
+    n_initial = len(builds)
+    assert n_initial == 2, builds
+    assert rec.counter_value("tiering.replans", changed="false") >= 1
+    assert rec.counter_value("tiering.replans", changed="true") == 0
+    plan_before = {n: p.knobs() for n, p in rt.plans.items()}
+
+    # Phase 2: collapse the replica budget — the next re-plan must land
+    # a DIFFERENT plan (partial head) with exactly one recompile.
+    rt.plan_kwargs["replica_budget_bytes"] = 64 * 4  # 64 rows of dim 1
+    tables, ls = trainer.init_state(jax.random.key(0))
+    trainer.fit_stream(tables, ls, iter(chunks[:2]), jax.random.key(2))
+    assert rec.counter_value("tiering.replans", changed="true") == 1
+    assert {n: p.knobs() for n, p in rt.plans.items()} != plan_before
+    assert store.specs["weights"].hot_tier < NF
+    assert len(builds) == n_initial + 1, builds
+
+    # Phase 3: further boundaries with the (new) stationary plan are
+    # no-ops again.
+    n_after = len(builds)
+    trainer.fit_stream(trainer.store.tables, ls, iter(chunks[2:4]),
+                       jax.random.key(3), start_step=2)
+    assert len(builds) == n_after, builds
+    assert np.isfinite(weights(store)).all()
+
+
+def test_plan_application_preserves_fold_state(devices8):
+    """Applying (or re-applying) a plan strips the DERIVABLE aux entries
+    (replica, slot maps, sketches — re-split from the canonical table)
+    but must KEEP ::fold optimizer state: it is not a projection of the
+    canonical table, and zeroing a live Adagrad accumulator mid-run
+    would silently change step sizes."""
+    from fps_tpu.tiering.planner import TierPlan
+
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    trainer, store = _make_trainer(mesh, hot_tier=NF, hot_sync_every=3)
+    trainer.server_logic["weights"] = dataclasses.replace(
+        trainer.server_logic["weights"], hot_fold="adagrad")
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=1)
+    tables, _, _ = _fit(trainer, chunks)
+    state_before = np.asarray(tables["weights::fold"])
+    assert np.any(state_before != 0)  # the run really accumulated state
+
+    # Install a plan that keeps the table's knobs (full replication,
+    # same E): the strip must preserve the live fold state verbatim —
+    # a dropped entry would be re-derived as ZEROS by _attach_hot.
+    rt = Retierer()
+    trainer.retierer = rt
+    plans = {"weights": TierPlan(NF, 3, False, 1.0, "test")}
+    out = rt._install_plans(trainer, dict(tables), plans, {}, None,
+                            what="test")
+    assert "weights::fold" in out
+    assert np.array_equal(np.asarray(out["weights::fold"]), state_before)
+    # The derivable kinds were genuinely stripped + re-derived (the
+    # replica is a projection, so re-derivation is value-identical).
+    assert hot_key("weights") in out
 
 
 def test_planner_validates_density():

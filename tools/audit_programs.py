@@ -157,22 +157,46 @@ BUDGETS: dict[str, dict] = {
     # the per-step window at this audit scale (sub-threshold per step).
     "streaming_mf": dict(max_collectives=2, max_collective_bytes=4096,
                          per_kind_max={"all_gather": 1, "all_to_all": 1}),
-    # Tiered MF (hot head replicated, E=2): cold routes keep their two
-    # collectives; the reconcile psum is the third — the all_reduce
-    # ReplicaConsistency certifies, payload H*rank*4 = 1024B exactly.
-    "mf_tiered": dict(max_collectives=3, max_collective_bytes=5120,
-                      per_kind_max={"all_gather": 1, "all_to_all": 1,
-                                    "all_reduce": 1}),
-    # ADAPTIVE tier over the same config (fps_tpu.tiering: mapped hot
-    # set + online tracking): the cold routes and the reconcile psum of
-    # mf_tiered (the mapped reconcile scatters by gid DATA — same
-    # collective), plus ONE more all_reduce: the tracker's end-of-call
-    # sketch merge (4x2048 f32 = 32768B). The slot-map/gid lookups are
-    # local gathers — re-ranks swap those arrays without touching this
-    # profile (rerank_byte_identity pins that claim exactly).
-    "mf_retier": dict(max_collectives=4, max_collective_bytes=37888,
-                      per_kind_max={"all_gather": 1, "all_to_all": 1,
-                                    "all_reduce": 2}),
+    # Tiered MF (hot head replicated, E=2), SHARDED reconcile (PR 10,
+    # arXiv:2004.13336): cold routes keep their two collectives; the
+    # window reconcile is now a reduce-scatter (H*rank*4 = 1024B, each
+    # replica receives its disjoint 1/S slice) + the re-broadcast
+    # all_gather (1024B) in place of the old full-head psum —
+    # ReplicaConsistency certifies the reduce_scatter.
+    "mf_tiered": dict(max_collectives=4, max_collective_bytes=6144,
+                      per_kind_max={"all_gather": 2, "all_to_all": 1,
+                                    "reduce_scatter": 1}),
+    # Partial head (H=32 of 64) over the GATHERED cold routes with the
+    # STATIC full-batch payload — the ROADMAP scaling cliff this PR's
+    # compacted row is measured against: pull = ids all_gather (1024B) +
+    # vals reduce_scatter (8192B), push = ids+deltas all_gathers
+    # (1024B + 8192B), plus the sharded reconcile RS+AG (1024B each).
+    "mf_tiered_gathered": dict(max_collectives=6,
+                               max_collective_bytes=20480,
+                               per_kind_max={"all_gather": 4,
+                                             "reduce_scatter": 2}),
+    # The same partial head with cold_budget=8 (payload-proportional
+    # routing): cold ids compact into the certified 8-wide lane, so the
+    # gathered collectives shrink to O(lane) — vals RS 2048B + deltas AG
+    # 2048B (the 256B id lanes fall below the 1024B payload threshold).
+    # Cold-route bytes 18432 -> 4096: the statically-pinned 4.5x form of
+    # the bench A/B's >= 3x acceptance claim.
+    "mf_tiered_compact": dict(max_collectives=4,
+                              max_collective_bytes=6144,
+                              per_kind_max={"all_gather": 2,
+                                            "reduce_scatter": 2}),
+    # ADAPTIVE tier over the mf_tiered config (fps_tpu.tiering: mapped
+    # hot set + online tracking): the cold routes and the sharded
+    # reconcile RS+AG of mf_tiered (the mapped reconcile scatters by gid
+    # DATA — same collectives), plus ONE all_reduce: the tracker's
+    # end-of-call sketch merge (4x2048 f32 = 32768B). The slot-map/gid
+    # lookups are local gathers — re-ranks swap those arrays without
+    # touching this profile (rerank_byte_identity pins that claim
+    # exactly).
+    "mf_retier": dict(max_collectives=5, max_collective_bytes=38912,
+                      per_kind_max={"all_gather": 2, "all_to_all": 1,
+                                    "all_reduce": 1,
+                                    "reduce_scatter": 1}),
     # Sparse logreg, gathered route + adagrad server fold.
     "logreg": dict(max_collectives=2, max_collective_bytes=3200,
                    per_kind_max={"all_gather": 1, "all_to_all": 1}),
@@ -192,7 +216,8 @@ BUDGETS: dict[str, dict] = {
 }
 
 
-def _mf_pieces(mesh, *, sync_every=None, hot_tier=0, hot_sync_every=1):
+def _mf_pieces(mesh, *, sync_every=None, hot_tier=0, hot_sync_every=1,
+               cold_budget=0, gathered=False, skew=False):
     from fps_tpu.models.matrix_factorization import MFConfig, online_mf
     from fps_tpu.utils.datasets import synthetic_ratings
 
@@ -200,11 +225,33 @@ def _mf_pieces(mesh, *, sync_every=None, hot_tier=0, hot_sync_every=1):
     trainer, store = online_mf(mesh, cfg, sync_every=sync_every)
     if hot_tier:
         for name, spec in store.specs.items():
+            over = {}
+            if gathered:
+                # Force the gathered cold route: the compacted-lane rows
+                # audit the payload-proportional claim, which is about
+                # embedding-scale tables whose cold route cannot afford
+                # table-sized dense collectives (the audit-scale table
+                # would otherwise auto-resolve dense).
+                over["dense_collectives"] = False
             store.specs[name] = dataclasses.replace(
-                spec, hot_tier=min(hot_tier, spec.num_ids))
+                spec, hot_tier=min(hot_tier, spec.num_ids),
+                cold_budget=cold_budget, **over)
         trainer.config = dataclasses.replace(
             trainer.config, hot_sync_every=hot_sync_every)
     data = synthetic_ratings(NU, NI, 2000, rank=3, seed=3)
+    if skew:
+        # Hot-heavy item stream (~95% head hits) so the compacted row's
+        # host certifier accepts the audit chunk — the program SHAPES
+        # (the pinned payloads) are data-independent; the data only
+        # decides whether the compacted or the static program lowers.
+        rng = np.random.default_rng(7)
+        item = np.where(
+            rng.random(len(data["item"])) < 0.95,
+            rng.integers(0, min(hot_tier, NI) or NI,
+                         len(data["item"])),
+            rng.integers(min(hot_tier, NI), NI, len(data["item"])),
+        ).astype(np.int32)
+        data = dict(data, item=item)
     chunks = multi_epoch_chunks(
         data, 1, num_workers=num_workers_of(mesh), local_batch=LOCAL_BATCH,
         steps_per_chunk=STEPS, route_key="user", sync_every=sync_every,
@@ -230,6 +277,24 @@ def build_streaming_mf(mesh) -> str:
 
 def build_mf_tiered(mesh) -> str:
     trainer, chunks = _mf_pieces(mesh, hot_tier=32, hot_sync_every=2)
+    return _lower_chunk_program(trainer, chunks)
+
+
+def build_mf_tiered_gathered(mesh) -> str:
+    """Partial head over the GATHERED (non-dense) cold routes, STATIC
+    full-batch payload — the baseline the compacted row's >= 3x
+    cold-byte claim is measured against."""
+    trainer, chunks = _mf_pieces(mesh, hot_tier=32, hot_sync_every=2,
+                                 gathered=True, skew=True)
+    return _lower_chunk_program(trainer, chunks)
+
+
+def build_mf_tiered_compact(mesh) -> str:
+    """The same partial head with ``cold_budget=8``: cold ids compact
+    into the certified lane, so the gathered collectives carry O(lane)
+    payload — the payload-proportional routing row."""
+    trainer, chunks = _mf_pieces(mesh, hot_tier=32, hot_sync_every=2,
+                                 gathered=True, cold_budget=8, skew=True)
     return _lower_chunk_program(trainer, chunks)
 
 
@@ -359,6 +424,8 @@ BUILDERS = {
     "mf": build_mf,
     "streaming_mf": build_streaming_mf,
     "mf_tiered": build_mf_tiered,
+    "mf_tiered_gathered": build_mf_tiered_gathered,
+    "mf_tiered_compact": build_mf_tiered_compact,
     "mf_retier": build_mf_retier,
     "logreg": build_logreg,
     "w2v": build_w2v,
@@ -366,10 +433,51 @@ BUILDERS = {
     "ials": build_ials,
 }
 
+_TIERED_ROWS = ("mf_tiered", "mf_tiered_gathered", "mf_tiered_compact",
+                "mf_retier")
+
+
+def diff_budgets(old_doc: dict, measured: dict) -> list[str]:
+    """UNPINNED budget regressions of ``measured`` (``{program:
+    {"collective_count": n, "collective_bytes": b}}``) against a prior
+    audit JSON (``--out`` format). A program regresses when its measured
+    collective count or payload bytes GREW versus the old certificate
+    AND the growth is not covered by the current pinned ``BUDGETS`` row
+    — i.e. someone changed the data plane without re-pinning, which is
+    exactly the silent drift this gate exists to catch. Deliberate,
+    re-pinned growth is reported by the caller but passes. Programs
+    absent from either side are skipped (new rows cannot regress)."""
+    problems = []
+    old = old_doc.get("audit_programs", {})
+    for name in sorted(measured):
+        o = old.get(name)
+        if not o:
+            continue
+        # Certificate JSON (--out format) nests the census under
+        # "collectives": {"count": n, "bytes": b}.
+        oc = o.get("collectives", o)
+        old_n = oc.get("count", oc.get("collective_count", 0))
+        old_b = oc.get("bytes", oc.get("collective_bytes", 0))
+        cur_n = measured[name]["collective_count"]
+        cur_b = measured[name]["collective_bytes"]
+        if cur_n <= old_n and cur_b <= old_b:
+            continue
+        pinned = BUDGETS.get(name)
+        if (pinned is None
+                or cur_n > pinned["max_collectives"]
+                or cur_b > pinned["max_collective_bytes"]):
+            problems.append(
+                f"{name}: measured {cur_n} collectives / {cur_b}B vs "
+                f"{old_n} / {old_b}B in the reference audit, and the "
+                "growth is NOT covered by the pinned budget — re-pin "
+                "BUDGETS (and the docs table) if the change is "
+                "deliberate")
+    return problems
+
 
 def contract_for(name: str) -> ProgramContract:
     budget = BUDGETS[name]
-    tiered = name in ("mf_tiered", "mf_retier")
+    tiered = name in _TIERED_ROWS
     # H=32 head rows x RANK f32 (+1 mean-count column headroom is not
     # needed: MF folds are sum) — the smallest tiered head's byte size.
     hot_bytes = 32 * RANK * 4 if tiered else 0
@@ -404,6 +512,14 @@ def main(argv=None) -> int:
                     help="print measured profiles without enforcing "
                          "budgets (for re-pinning after a deliberate "
                          "program change)")
+    ap.add_argument("--diff", default=None, metavar="OLD.json",
+                    help="also diff the measured profiles against a "
+                         "prior audit JSON (--out format) and FAIL on "
+                         "any unpinned budget regression: a program "
+                         "whose collective count/bytes grew vs OLD "
+                         "without the BUDGETS row being re-pinned. "
+                         "Deliberate re-pinned growth is reported but "
+                         "passes — the diff is the review artifact")
     args = ap.parse_args(argv)
 
     names = (args.only.split(",") if args.only else list(BUILDERS))
@@ -438,8 +554,33 @@ def main(argv=None) -> int:
               f"({'identical' if rerank_identical else 'programs DIFFER'}"
               " across disjoint hot id sets)", file=sys.stderr)
 
+    diff_problems = []
+    if args.diff:
+        with open(args.diff, encoding="utf-8") as f:
+            old_doc = json.load(f)
+        measured = {
+            n: {"collective_count": c.collective_count,
+                "collective_bytes": c.collective_bytes}
+            for n, c in certs.items()
+        }
+        diff_problems = diff_budgets(old_doc, measured)
+        for n in sorted(measured):
+            o = old_doc.get("audit_programs", {}).get(n)
+            if not o:
+                continue
+            oc = o.get("collectives", o)
+            old_pair = (oc.get("count", 0), oc.get("bytes", 0))
+            cur_pair = (measured[n]["collective_count"],
+                        measured[n]["collective_bytes"])
+            if old_pair != cur_pair:
+                print(f"[DIFF] {n}: {old_pair[0]}/{old_pair[1]}B -> "
+                      f"{cur_pair[0]}/{cur_pair[1]}B", file=sys.stderr)
+        for p in diff_problems:
+            print(f"[FAIL] diff: {p}", file=sys.stderr)
+
     ok = (all(c.ok for c in certs.values())
-          and rerank_identical is not False)
+          and rerank_identical is not False
+          and not diff_problems)
     doc = {
         "audit_programs": {n: c.to_json() for n, c in certs.items()},
         "rerank_byte_identical": rerank_identical,

@@ -52,6 +52,15 @@ fps_tpu.testing.workloads):
   replica/slot-map from both, quarantines nothing, and replays to
   final weights bit-identical to a straight adaptive run (i.e. the
   resumed re-rank decisions are the straight run's).
+* ``reconcile_shard_kill``     — SIGKILL between a sharded
+  (reduce-scatter) reconcile window and the next checkpoint, with a
+  stateful Adagrad hot-tier fold on (``--hot-fold adagrad``: per-row
+  optimizer state sharded over the replica axis, persisted as
+  ``fold::`` checkpoint arrays): survives iff the restart restores the
+  canonical tables AND the matching fold state (fold arrays present in
+  the snapshot, canonical table bytes untouched), quarantines nothing,
+  and replays to final weights bit-identical to a straight run — a
+  zero-restarted Adagrad accumulator would diverge.
 
 The digest also carries the clean run's program CERTIFICATE
 (``fps_tpu.analysis``, ``docs/analysis.md``): the compiled logreg step
@@ -240,6 +249,13 @@ def main():
 
         results["retier_kill"], detail["retier_kill"] = (
             run_retier_kill_scenario(d))
+    with tempfile.TemporaryDirectory() as d:
+        from fps_tpu.testing.supervised_demo import (
+            run_reconcile_shard_kill_scenario,
+        )
+
+        results["reconcile_shard_kill"], detail["reconcile_shard_kill"] = (
+            run_reconcile_shard_kill_scenario(d))
     with tempfile.TemporaryDirectory() as d:
         from fps_tpu.testing.supervised_demo import (
             run_serve_while_train_scenario,

@@ -265,6 +265,15 @@ def render_digest(obs_dir: str) -> dict:
                 "hot_tier.pending_delta", {}).get("last"),
             "pending_delta_max": gauges.get(
                 "hot_tier.pending_delta", {}).get("max"),
+            # Payload-proportional cold routing (TableSpec.cold_budget):
+            # per-chunk program selection + the device-side drop net
+            # (nonzero cold_dropped = a certifier bug, not load).
+            "compact_chunks": int(
+                counters.get("cold_route.compact_chunks", 0)),
+            "overflow_chunks": int(
+                counters.get("cold_route.overflow_chunks", 0)),
+            "cold_dropped": int(
+                counters.get("hot_tier.cold_dropped", 0)),
         },
         # Adaptive tiering (fps_tpu.tiering): online hot-set re-ranking
         # + auto-planner activity — re-rank/promotion totals (labels

@@ -71,6 +71,9 @@ def _build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--max-sync-every", type=int, default=8,
                     help="reconcile-cadence ceiling (staleness bound)")
     ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--workers", type=int, default=8,
+                    help="total worker devices (sizes the per-worker "
+                         "compacted cold lane, planner.choose_cold_budget)")
     ap.add_argument("--no-lower", action="store_true",
                     help="plan only — skip lowering the probe program "
                          "(no jax devices needed)")
@@ -125,6 +128,7 @@ def main(argv=None) -> int:
         coverage_target=args.coverage,
         max_sync_every=args.max_sync_every,
         num_shards=args.shards,
+        num_workers=args.workers,
     )
 
     from fps_tpu.tiering.planner import global_sync_every
@@ -135,6 +139,7 @@ def main(argv=None) -> int:
         for name, p in sorted(plans.items()):
             print(f"{name}: hot_tier={p.hot_tier} "
                   f"hot_sync_every={p.hot_sync_every} dense={p.dense} "
+                  f"cold_budget={p.cold_budget} "
                   f"coverage={p.coverage:.3f}\n    [{p.reason}]",
                   file=sys.stderr)
 
