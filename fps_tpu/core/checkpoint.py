@@ -40,6 +40,7 @@ against the copy after the next chunk has been dispatched, so the dump's
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import tempfile
@@ -270,11 +271,20 @@ class Checkpointer:
     file is never deleted from under it.
     """
 
-    def __init__(self, directory: str, *, keep: int = 3):
+    def __init__(self, directory: str, *, keep: int = 3,
+                 fence_epoch: int | None = None):
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
         self.dir = directory
         self.keep = keep
+        # Pod fencing epoch (fps_tpu.supervise.pod): checked against the
+        # directory's ``pod_fence.json`` immediately before every
+        # publish. ``None`` = this writer predates/ignores the pod
+        # contract — it may publish into an UNfenced dir, but a fenced
+        # dir refuses it too (a stale pre-abort child must never leak a
+        # checkpoint into the pod's new attempt). Children read their
+        # epoch from the pod env contract: ``fence_epoch_from_env()``.
+        self.fence_epoch = fence_epoch
         os.makedirs(directory, exist_ok=True)
         self._sweep_tmp()
         self._sweep_corrupt()
@@ -382,19 +392,57 @@ class Checkpointer:
                 leaf = replicate_to_mesh(leaf, store.mesh)
             arrays[f"ls{_SEP}{i}"] = np.asarray(leaf)
         arrays[f"meta{_SEP}ls_format"] = np.array(local_state_format)
+        # Mesh-shape stamp: restore onto a DIFFERENT shape takes (and
+        # asserts) the explicit elastic re-split path — the invariant the
+        # pod's W±1 re-planning stands on.
+        arrays[snapshot_format.MESH_SHAPE_KEY] = np.array(json.dumps(
+            {k: int(v) for k, v in store.mesh.shape.items()},
+            sort_keys=True))
+        if self.fence_epoch is not None:
+            # Forensic epoch stamp: pod chaos scenarios scan these to
+            # prove no stale-epoch publish ever landed behind a fence.
+            arrays[snapshot_format.POD_EPOCH_KEY] = np.int64(
+                self.fence_epoch)
         del treedef  # structure is supplied by local_state_like at restore
         return arrays
+
+    def _check_fence(self, step: int) -> None:
+        """Refuse to publish behind a pod fence. Read FRESH on every
+        write (never cached): the fence appears asynchronously, dropped
+        by the pod leader into this directory when a newer attempt is
+        commanded — from that point this writer is a zombie of an aborted
+        attempt and must fail loudly, not land a stale snapshot."""
+        from fps_tpu.supervise import child as _pod
+
+        ok, min_epoch = _pod.fence_allows(self.dir, self.fence_epoch)
+        if ok:
+            return
+        _obs_event("checkpoint_fenced", step=int(step),
+                   epoch=self.fence_epoch, min_epoch=min_epoch,
+                   dir=self.dir)
+        _obs_metric("inc", "checkpoint.fenced_publishes", 1)
+        raise _pod.StaleEpochError(
+            f"checkpoint step {step} refused: writer epoch "
+            f"{self.fence_epoch} is behind the pod fence (min_epoch "
+            f"{min_epoch}) in {self.dir} — this process belongs to an "
+            "attempt the pod has aborted and restarted past"
+        )
 
     def _write(self, step: int, arrays: dict[str, np.ndarray]) -> str:
         """Serialize half of a save: CRC tags, atomic fsync'd write,
         telemetry, retention GC. Runs on the caller's thread here; the
         AsyncCheckpointer runs it on its writer thread."""
+        self._check_fence(step)
         arrays = dict(arrays)
         for k in list(arrays):
             arrays[_CRC_PREFIX + k] = np.uint32(array_crc32(arrays[k]))
         path = self._path(step)
         t0 = time.perf_counter()
-        _atomic_savez(path, arrays)
+        # The fence is re-checked as the PRE-COMMIT hook, after the slow
+        # serialize+fsync and immediately before the publishing rename —
+        # a fence that lands while a big table is serializing still wins.
+        _atomic_savez(path, arrays,
+                      precommit=lambda: self._check_fence(step))
         secs = time.perf_counter() - t0
         try:
             nbytes = os.path.getsize(path)
@@ -499,11 +547,16 @@ class Checkpointer:
         }
         # Hot-fold state rides the same values dict under its full
         # ``fold::<name>`` key (table names never contain the separator,
-        # so the kinds cannot collide); load_tables re-installs it.
+        # so the kinds cannot collide); load_tables re-installs it. The
+        # mesh-shape stamp rides along the same way so load_tables can
+        # detect (and assert) an elastic re-split restore.
         tables.update({
             k: v for k, v in entries.items()
             if k.startswith(snapshot_format.FOLD_PREFIX)
         })
+        if snapshot_format.MESH_SHAPE_KEY in entries:
+            tables[snapshot_format.MESH_SHAPE_KEY] = entries[
+                snapshot_format.MESH_SHAPE_KEY]
         return tables, _ls_leaves(entries), _ls_format(entries)
 
     def _quarantine(self, step: int, err: Exception) -> None:
@@ -586,7 +639,30 @@ class Checkpointer:
                     ) -> dict:
         """Validate and load pre-read table arrays (from
         :meth:`read_snapshot`) into ``store`` — public because
-        ``Trainer.restore_checkpoint`` builds on it."""
+        ``Trainer.restore_checkpoint`` builds on it.
+
+        Elastic re-split: when the snapshot's recorded mesh shape differs
+        from the store's current mesh, this restore IS the re-split path
+        the pod's W±1 re-planning depends on — tables are stored in
+        logical id order, so ``load_rows`` re-lays every row onto the new
+        owner-major layout. The path is taken explicitly (event + metric)
+        and ASSERTED: each re-split table must round-trip bit-identically
+        back to the snapshot's logical bytes."""
+        saved_shape = None
+        raw = values_by_name.get(snapshot_format.MESH_SHAPE_KEY)
+        if raw is not None:
+            try:
+                saved_shape = json.loads(str(raw))
+            except (TypeError, ValueError):
+                saved_shape = None
+        cur_shape = {k: int(v) for k, v in store.mesh.shape.items()}
+        resplit = bool(saved_shape) and saved_shape != cur_shape
+        if resplit:
+            _log.info("checkpoint step %d: mesh-shape re-split %s -> %s",
+                      step, saved_shape, cur_shape)
+            _obs_event("checkpoint_resplit", step=int(step),
+                       from_shape=saved_shape, to_shape=cur_shape)
+            _obs_metric("inc", "checkpoint.resplits", 1)
         for name, spec in store.specs.items():
             if name not in values_by_name:
                 raise ValueError(
@@ -623,6 +699,22 @@ class Checkpointer:
             arr = np.asarray(values_by_name[key], np.float32)
             store.tables[name + FOLD_KEY_SUFFIX] = jax.device_put(
                 arr, store.sharding)
+        if resplit:
+            # The explicit re-split assertion: every table, re-laid-out
+            # onto the new mesh, dumps back to EXACTLY the snapshot's
+            # logical bytes. Runs only on shape-changed restores (rare,
+            # boundary events), so the extra dump is off the common path.
+            for name in store.specs:
+                got = store.dump_model(name)[1]
+                want = np.asarray(values_by_name[name], got.dtype)
+                if not np.array_equal(got, want):
+                    raise AssertionError(
+                        f"elastic re-split restore of table {name!r} is "
+                        f"not bit-identical across mesh shapes "
+                        f"{saved_shape} -> {cur_shape} at step {step} — "
+                        "the flush-reconcile canonical-snapshot "
+                        "invariant is broken"
+                    )
         return dict(store.tables)
 
     def restore_tables(
@@ -736,8 +828,9 @@ class AsyncCheckpointer(Checkpointer):
       own retention GC runs on the writer thread and must not deadlock.
     """
 
-    def __init__(self, directory: str, *, keep: int = 3):
-        super().__init__(directory, keep=keep)
+    def __init__(self, directory: str, *, keep: int = 3,
+                 fence_epoch: int | None = None):
+        super().__init__(directory, keep=keep, fence_epoch=fence_epoch)
         self._cv = threading.Condition()
         self._queued: tuple[int, dict] | None = None
         self._writing = False
@@ -845,17 +938,29 @@ class AsyncCheckpointer(Checkpointer):
                     self._cv.notify_all()
 
 
+def fence_epoch_from_env() -> int | None:
+    """The pod fencing epoch of this process (``FPS_TPU_POD_EPOCH``), or
+    None when not running under a pod — pass as ``Checkpointer(...,
+    fence_epoch=...)`` so a pod child's publishes honor the fence."""
+    from fps_tpu.supervise import child as _pod
+
+    return _pod.pod_env()["epoch"]
+
+
 # ---------------------------------------------------------------------------
 # Atomic file helpers (a torn write must not corrupt the latest snapshot).
 # ---------------------------------------------------------------------------
 
-def _atomic_savez(path: str, arrays: Mapping[str, np.ndarray]) -> None:
+def _atomic_savez(path: str, arrays: Mapping[str, np.ndarray],
+                  precommit=None) -> None:
     """Serialize + fsync + atomic rename: after this returns, ``path``
     either holds the complete snapshot or (on a crash anywhere inside)
     its previous content — never a torn file. The fsync BEFORE the rename
     is what makes the rename a real durability point (a power loss after
     an unfsync'd rename can publish an empty file); the directory fsync
-    after makes the rename itself survive."""
+    after makes the rename itself survive. ``precommit`` (optional) runs
+    after the fsync and immediately before the publishing rename; if it
+    raises, nothing is published (the pod fence hook)."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
     try:
@@ -863,6 +968,8 @@ def _atomic_savez(path: str, arrays: Mapping[str, np.ndarray]) -> None:
             np.savez(f, **arrays)
             f.flush()
             os.fsync(f.fileno())
+        if precommit is not None:
+            precommit()
         os.replace(tmp, path)
         try:
             dfd = os.open(d, os.O_RDONLY)

@@ -391,6 +391,24 @@ class Trainer:
                 ),
                 local_state_format="exported",
             )
+        except Exception as e:
+            # A pod fence refusal (StaleEpochError, possibly re-raised
+            # from the async writer wrapped in RuntimeError) means this
+            # whole PROCESS belongs to an aborted pod attempt: name that
+            # plainly at the driver altitude before propagating — the
+            # training loop is over either way, and the pod scenarios
+            # grep for this line as the zombie's epitaph.
+            from fps_tpu.supervise.child import StaleEpochError
+
+            cause = e
+            while cause is not None:
+                if isinstance(cause, StaleEpochError):
+                    _log.error(
+                        "run fenced off by the pod at step %d: %s",
+                        step, cause)
+                    break
+                cause = cause.__cause__
+            raise
         finally:
             if prev is not None:
                 self.store.tables = prev

@@ -42,7 +42,7 @@ import numpy as np
 
 __all__ = [
     "SNAPSHOT_RE", "SNAPSHOT_FMT", "SEP", "TABLE_PREFIX", "LS_PREFIX",
-    "FOLD_PREFIX",
+    "FOLD_PREFIX", "MESH_SHAPE_KEY", "POD_EPOCH_KEY",
     "CRC_PREFIX", "IO_ERRORS", "array_crc32", "snapshot_path",
     "snapshot_steps", "verify_snapshot_file", "latest_valid_snapshot",
     "map_snapshot_arrays",
@@ -71,6 +71,15 @@ LS_PREFIX = f"ls{SEP}"
 # the kind, as the default ``map_snapshot_arrays`` filter does).
 FOLD_PREFIX = f"fold{SEP}"
 CRC_PREFIX = f"meta{SEP}crc{SEP}"
+# ``meta::mesh_shape`` records the (data, shard) mesh shape the snapshot
+# was taken on (a JSON object) — restore detects a mesh-shape change and
+# takes (and asserts) the explicit elastic re-split path. Pre-existing
+# snapshots simply lack the tag.
+MESH_SHAPE_KEY = f"meta{SEP}mesh_shape"
+# ``meta::pod_epoch`` stamps the pod fencing epoch of the writer (pod
+# runs only): forensic evidence that no epoch-stale publish ever landed
+# behind a fence.
+POD_EPOCH_KEY = f"meta{SEP}pod_epoch"
 
 # Everything a torn/corrupted .npz throws on open or member read (zip
 # magic, central directory, member CRC, npy header parsing, ...).

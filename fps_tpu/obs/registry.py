@@ -208,6 +208,15 @@ def default_registry() -> MetricsRegistry:
                    help="size of the last written snapshot"),
         MetricSpec("checkpoint.fallbacks", "counter", unit="snapshots",
                    help="corrupt snapshots quarantined by fallback restore"),
+        MetricSpec("checkpoint.fenced_publishes", "counter",
+                   unit="snapshots",
+                   help="publishes refused by a pod fence (the writer's "
+                        "epoch predates the pod's current attempt — "
+                        "fps_tpu.supervise.pod)"),
+        MetricSpec("checkpoint.resplits", "counter", unit="restores",
+                   help="restores that re-split tables onto a different "
+                        "mesh shape than the snapshot's (the elastic "
+                        "W±1 path; each is asserted bit-identical)"),
         # Watchdog.
         MetricSpec("watchdog.stalls", "counter", unit="stalls",
                    help="chunk/epoch dispatches that overran the deadline"),

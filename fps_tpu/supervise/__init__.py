@@ -19,28 +19,62 @@ See ``docs/resilience.md`` for the failure model this closes.
 
 from fps_tpu.supervise.child import (
     ATTEMPT_ENV,
+    FENCE_FILENAME,
     HEARTBEAT_ENV,
+    HEARTBEAT_VERSION,
+    POD_EPOCH_ENV,
+    POD_HOST_ENV,
+    POD_STEP_ENV,
+    POD_WORLD_ENV,
     STATE_ENV,
     Heartbeat,
     HeartbeatSink,
+    StaleEpochError,
     attempt_from_env,
+    fence_allows,
     from_env,
+    pod_env,
     quarantined_from_env,
+    read_fence,
+    write_fence,
 )
 from fps_tpu.supervise.supervisor import (
     RunSupervisor,
     SupervisorConfig,
 )
 
+# pod.py resolves its siblings through sys.modules (it must also load by
+# bare file path with zero fps_tpu imports), so child and supervisor are
+# imported above it here — the package then shares ONE class identity.
+from fps_tpu.supervise.pod import (
+    Lease,
+    PodConfig,
+    PodMember,
+)
+
 __all__ = [
     "RunSupervisor",
     "SupervisorConfig",
+    "PodConfig",
+    "PodMember",
+    "Lease",
     "Heartbeat",
     "HeartbeatSink",
+    "StaleEpochError",
     "from_env",
     "attempt_from_env",
     "quarantined_from_env",
+    "pod_env",
+    "read_fence",
+    "write_fence",
+    "fence_allows",
     "HEARTBEAT_ENV",
+    "HEARTBEAT_VERSION",
     "STATE_ENV",
     "ATTEMPT_ENV",
+    "POD_HOST_ENV",
+    "POD_EPOCH_ENV",
+    "POD_WORLD_ENV",
+    "POD_STEP_ENV",
+    "FENCE_FILENAME",
 ]

@@ -30,6 +30,102 @@ HEARTBEAT_ENV = "FPS_TPU_HEARTBEAT"
 STATE_ENV = "FPS_TPU_SUPERVISOR_STATE"
 ATTEMPT_ENV = "FPS_TPU_ATTEMPT"
 
+# Pod membership contract (fps_tpu/supervise/pod.py sets these when the
+# child runs under a pod coordinator; all absent in plain supervised runs):
+# the member host name this child belongs to, the fencing epoch of the pod
+# attempt that spawned it, the pod world size (live member count), and the
+# pod-commanded common restart step.
+POD_HOST_ENV = "FPS_TPU_POD_HOST"
+POD_EPOCH_ENV = "FPS_TPU_POD_EPOCH"
+POD_WORLD_ENV = "FPS_TPU_POD_WORLD"
+POD_STEP_ENV = "FPS_TPU_POD_STEP"
+
+# Heartbeat schema version, written into every beat. The supervisor
+# rejects beats wearing an unknown version (or a foreign ``host``) loudly
+# instead of silently misparsing them — the cross-host beat-file
+# collision a shared pod directory makes possible.
+HEARTBEAT_VERSION = 2
+
+# Fence file a pod leader drops into a member's CHECKPOINT dir before
+# commanding a new attempt: a writer whose own epoch is below
+# ``min_epoch`` must refuse to publish (fps_tpu.core.checkpoint checks it
+# before every atomic rename). Lives here — not in the checkpoint layer —
+# because both sides of the pod contract (the stdlib-only coordinator and
+# the jax-laden child) must share one definition, and this module is the
+# one both can load.
+FENCE_FILENAME = "pod_fence.json"
+
+
+class StaleEpochError(RuntimeError):
+    """A checkpoint publish was refused because the writer's fencing
+    epoch predates the pod fence — the writer belongs to an attempt the
+    pod has already aborted and restarted past."""
+
+
+def read_fence(directory: str) -> dict | None:
+    """The pod fence in ``directory`` (``{"min_epoch": E, "step": S}``),
+    or None when the dir is unfenced / the fence is torn (an unreadable
+    fence must not brick an unsupervised run)."""
+    try:
+        with open(os.path.join(directory, FENCE_FILENAME),
+                  encoding="utf-8") as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def write_fence(directory: str, min_epoch: int, step: int) -> None:
+    """Atomically publish the fence (tmp + rename, same dir)."""
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".fence.tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump({"min_epoch": int(min_epoch), "step": int(step)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(directory, FENCE_FILENAME))
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def fence_allows(directory: str, epoch: int | None) -> tuple[bool, int]:
+    """Whether a writer with fencing epoch ``epoch`` may publish into
+    ``directory``. Returns ``(allowed, min_epoch)``. An unfenced dir
+    allows everyone; a fenced dir refuses writers with no epoch at all
+    (``epoch=None``) — a writer that predates the pod contract must not
+    publish into a pod-managed dir."""
+    fence = read_fence(directory)
+    if fence is None:
+        return True, 0
+    try:
+        min_epoch = int(fence.get("min_epoch", 0))
+    except (TypeError, ValueError):
+        return True, 0
+    if epoch is None:
+        return False, min_epoch
+    return int(epoch) >= min_epoch, min_epoch
+
+
+def pod_env() -> dict:
+    """The pod contract from the environment: ``{"host", "epoch",
+    "world", "step"}`` with Nones when unsupervised/un-podded."""
+
+    def _int(name):
+        v = os.environ.get(name)
+        try:
+            return int(v) if v not in (None, "") else None
+        except ValueError:
+            return None
+
+    return {
+        "host": os.environ.get(POD_HOST_ENV) or None,
+        "epoch": _int(POD_EPOCH_ENV),
+        "world": _int(POD_WORLD_ENV),
+        "step": _int(POD_STEP_ENV),
+    }
+
 
 class Heartbeat:
     """Progress beacon: one small JSON object, atomically replaced.
@@ -53,7 +149,17 @@ class Heartbeat:
         self._dir = d
 
     def beat(self, index: int | None = None, **fields) -> None:
-        rec = {"t": time.time(), "pid": os.getpid(), "index": index}
+        rec = {
+            "version": HEARTBEAT_VERSION,
+            "t": time.time(),
+            "pid": os.getpid(),
+            # The pod member this beat belongs to (None outside pods):
+            # in a shared pod dir a misrouted heartbeat path would
+            # otherwise let host A's beats keep host B's supervisor
+            # believing its child is alive.
+            "host": os.environ.get(POD_HOST_ENV) or None,
+            "index": index,
+        }
         rec.update(fields)
         fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".hb.tmp")
         try:

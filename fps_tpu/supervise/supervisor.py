@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import glob as _glob
+import hashlib
 import json
 import os
 import signal
@@ -50,6 +51,23 @@ JOURNAL_FILENAME = "journal-supervisor.jsonl"
 HEARTBEAT_ENV = "FPS_TPU_HEARTBEAT"
 STATE_ENV = "FPS_TPU_SUPERVISOR_STATE"
 ATTEMPT_ENV = "FPS_TPU_ATTEMPT"
+
+# Heartbeat schema this supervisor understands — mirrored from child.py
+# (same loadable-by-path reason as the env contract above). Beats wearing
+# any other version are rejected loudly, never misparsed.
+HEARTBEAT_VERSION = 2
+
+# supervisor_state.json schema. Version-less files are the v1 layout
+# (every field this loader defaults); a FUTURE version means a newer
+# supervisor owns this state dir and silently reinterpreting its file
+# could un-quarantine poison — refuse loudly instead.
+STATE_SCHEMA_VERSION = 2
+
+# The quarantine list is append-only evidence; a long pod run hitting a
+# drifting poison source could otherwise grow it without bound (and the
+# state file with it, rewritten every attempt). Oldest entries evict
+# first — they describe chunks the run has long replayed past.
+QUARANTINE_CAP = 256
 
 
 @dataclasses.dataclass(frozen=True)
@@ -75,6 +93,14 @@ class SupervisorConfig:
     backoff_base_s: float = 1.0
     backoff_factor: float = 2.0
     backoff_max_s: float = 60.0
+    # Bounded jitter fraction applied by RunSupervisor.backoff_s on top
+    # of the exponential schedule: each backoff lands in
+    # [base, base * (1 + jitter)], deterministically derived from the
+    # supervisor's state_dir — N hosts of a pod restarting after a
+    # coordinated abort then hit the shared filesystem desynchronized
+    # instead of in lockstep, while any ONE host's schedule stays exactly
+    # reproducible across reruns.
+    backoff_jitter: float = 0.25
     term_grace_s: float = 5.0
     poll_interval_s: float = 0.25
     quarantine_after: int = 2
@@ -89,10 +115,14 @@ class SupervisorConfig:
         if self.quarantine_after < 1:
             raise ValueError(
                 f"quarantine_after must be >= 1, got {self.quarantine_after}")
+        if not 0 <= self.backoff_jitter <= 1:
+            raise ValueError(
+                f"backoff_jitter must be in [0, 1], got {self.backoff_jitter}")
 
     def backoff_s(self, restart: int) -> float:
         """Deterministic exponential backoff before relaunch ``restart``
-        (0-based): base * factor**restart, capped."""
+        (0-based): base * factor**restart, capped. Jitter-free — the
+        per-host jittered schedule is :meth:`RunSupervisor.backoff_s`."""
         return min(self.backoff_max_s,
                    self.backoff_base_s * self.backoff_factor ** restart)
 
@@ -136,7 +166,8 @@ class RunSupervisor:
     def __init__(self, cmd: list[str], *, state_dir: str,
                  config: SupervisorConfig | None = None,
                  watch: tuple[str, ...] = (),
-                 env: dict | None = None, cwd: str | None = None):
+                 env: dict | None = None, cwd: str | None = None,
+                 host: str | None = None):
         self.cmd = list(cmd)
         self.config = config or SupervisorConfig()
         self.state_dir = state_dir
@@ -147,7 +178,30 @@ class RunSupervisor:
         self.watch = tuple(watch)
         self.env = dict(env or {})
         self.cwd = cwd
+        # Pod member identity: when set, only beats carrying this host
+        # (or none) count — a beat from another pod member's child that
+        # lands in this file by misconfiguration is rejected loudly.
+        self.host = host
+        # (mtime) of beats already reported bad — one loud event per
+        # distinct rejected beat, not one per poll.
+        self._rejected_beats: set = set()
         self.state = self._load_state()
+
+    def backoff_s(self, restart: int) -> float:
+        """The per-host jittered backoff schedule: the config's
+        exponential base stretched by a bounded factor in
+        ``[1, 1 + backoff_jitter]`` derived deterministically from
+        ``(state_dir, restart)``. Same state_dir ⇒ the exact same
+        schedule on every rerun (replayable chaos tests); different
+        state_dirs (= different pod members) ⇒ desynchronized restarts
+        after a pod-wide abort."""
+        base = self.config.backoff_s(restart)
+        if not self.config.backoff_jitter:
+            return base
+        seed = f"{os.path.abspath(self.state_dir)}:{restart}".encode()
+        u = int.from_bytes(hashlib.sha256(seed).digest()[:8], "big")
+        u /= float(1 << 64)  # [0, 1)
+        return base * (1.0 + self.config.backoff_jitter * u)
 
     # -- persisted state ---------------------------------------------------
 
@@ -157,13 +211,38 @@ class RunSupervisor:
                 state = json.load(f)
         except (OSError, json.JSONDecodeError):
             state = {}
+        # Migration guard: version-less files are the v1 layout, loadable
+        # by defaulting every newer field (below). A FUTURE schema means a
+        # newer supervisor owns this dir — reinterpreting its fields here
+        # could silently drop quarantine evidence, so refuse loudly.
+        found = int(state.get("schema", 1))
+        if found > STATE_SCHEMA_VERSION:
+            raise ValueError(
+                f"{self.state_path} has schema v{found}, this supervisor "
+                f"understands <= v{STATE_SCHEMA_VERSION} — refusing to "
+                "reinterpret a newer supervisor's state"
+            )
+        state["schema"] = STATE_SCHEMA_VERSION
         state.setdefault("restarts", 0)
         state.setdefault("quarantined", [])
         state.setdefault("attempts", [])
+        state.setdefault("heartbeat_rejected", 0)
         return state
 
     def _save_state(self) -> None:
         _atomic_write_json(self.state_path, self.state)
+
+    def _cap_quarantine(self) -> None:
+        """Bound the quarantine list at :data:`QUARANTINE_CAP`, evicting
+        OLDEST-first (append order): ancient entries describe chunks the
+        run replayed past long ago, while the newest entries are the ones
+        protecting the current resume window."""
+        q = self.state["quarantined"]
+        if len(q) <= QUARANTINE_CAP:
+            return
+        evicted, self.state["quarantined"] = q[:-QUARANTINE_CAP], q[-QUARANTINE_CAP:]
+        self._event("quarantine_evicted", evicted=evicted,
+                    cap=QUARANTINE_CAP)
 
     def _event(self, etype: str, **fields) -> None:
         rec = {"kind": "event", "t": time.time(), "event": etype, **fields}
@@ -180,14 +259,42 @@ class RunSupervisor:
         ``phase`` is the optional sub-chunk boundary the child last
         crossed (the drivers beat ``prefetch``/``ingest``/``dispatch``
         between chunk boundaries) — it sharpens where an attempt died
-        without changing the index-keyed quarantine logic."""
+        without changing the index-keyed quarantine logic.
+
+        Schema hardening: a beat wearing an unknown ``version``, or a
+        ``host`` other than this supervisor's (a cross-host collision in
+        a shared pod dir), is REJECTED — one loud ``heartbeat_rejected``
+        journal event + persisted counter per distinct beat — and never
+        counts as liveness or progress."""
         try:
             mtime = os.path.getmtime(self.heartbeat_path)
             with open(self.heartbeat_path, encoding="utf-8") as f:
                 rec = json.load(f)
-            return mtime, rec.get("index"), rec.get("phase")
         except (OSError, json.JSONDecodeError):
             return None, None, None
+        reason = None
+        version = rec.get("version") if isinstance(rec, dict) else None
+        if not isinstance(rec, dict) or version != HEARTBEAT_VERSION:
+            reason = f"unknown heartbeat version {version!r}"
+        elif self.host is not None and rec.get("host") not in (None,
+                                                               self.host):
+            reason = (f"beat from host {rec.get('host')!r}, "
+                      f"this supervisor is {self.host!r}")
+        if reason is not None:
+            if mtime not in self._rejected_beats:
+                if len(self._rejected_beats) > 512:
+                    self._rejected_beats.clear()  # bound the dedupe memory
+                self._rejected_beats.add(mtime)
+                self.state["heartbeat_rejected"] = (
+                    int(self.state.get("heartbeat_rejected", 0)) + 1)
+                self._save_state()
+                self._event("heartbeat_rejected", reason=reason,
+                            path=self.heartbeat_path,
+                            beat={k: rec.get(k) for k in
+                                  ("version", "host", "index", "pid")}
+                            if isinstance(rec, dict) else None)
+            return None, None, None
+        return mtime, rec.get("index"), rec.get("phase")
 
     def _watch_fingerprint(self):
         """Size+mtime fingerprint over the watched globs — any change in
@@ -204,18 +311,29 @@ class RunSupervisor:
 
     # -- child control -----------------------------------------------------
 
-    def _spawn(self, attempt: int, log_path: str) -> subprocess.Popen:
+    def _child_env(self, attempt: int) -> dict:
+        """Environment for one attempt — subclass hook (the pod member
+        adds the pod membership contract on top)."""
         env = dict(os.environ)
         env.update(self.env)
         env[HEARTBEAT_ENV] = self.heartbeat_path
         env[STATE_ENV] = self.state_path
         env[ATTEMPT_ENV] = str(attempt)
+        return env
+
+    def _child_cmd(self) -> list[str]:
+        """argv for one attempt — subclass hook (the pod member
+        substitutes its host name into path templates)."""
+        return list(self.cmd)
+
+    def _spawn(self, attempt: int, log_path: str) -> subprocess.Popen:
         logf = open(log_path, "ab")
         try:
             # Own session => own process group: the TERM/KILL escalation
             # reaches every thread/grandchild, not just the leader.
             return subprocess.Popen(
-                self.cmd, env=env, cwd=self.cwd, stdout=logf,
+                self._child_cmd(), env=self._child_env(attempt),
+                cwd=self.cwd, stdout=logf,
                 stderr=subprocess.STDOUT, start_new_session=True,
             )
         finally:
@@ -389,7 +507,7 @@ class RunSupervisor:
                 self._event("supervisor_give_up", attempts=attempt + 1,
                             restarts=restarts_this_run, reason=reason)
                 break
-            backoff = cfg.backoff_s(restarts_this_run)
+            backoff = self.backoff_s(restarts_this_run)
             if run_deadline is not None and (
                     time.monotonic() + backoff >= run_deadline):
                 reason = "wall_deadline"
@@ -416,6 +534,8 @@ class RunSupervisor:
             "wall_deadline_hit": any(
                 a.get("aborted") == "wall_deadline" for a in attempts),
             "quarantined": list(self.state["quarantined"]),
+            "heartbeat_rejected": int(
+                self.state.get("heartbeat_rejected", 0)),
             "last_index": attempts[-1].get("last_index") if attempts else None,
             "wall_s": round(time.monotonic() - t0, 3),
             "state_path": self.state_path,
@@ -455,6 +575,7 @@ class RunSupervisor:
                 and all(a.get("last_index") == idx for a in tail)
                 and idx not in self.state["quarantined"]):
             self.state["quarantined"].append(int(idx))
+            self._cap_quarantine()
             self._save_state()
             self._event("chunk_quarantined", index=int(idx),
                         after_attempts=len(tail),

@@ -679,6 +679,507 @@ def run_serve_while_train_scenario(tmpdir: str, *, timeout: float = 600):
     return ok, detail
 
 
+# ---------------------------------------------------------------------------
+# Pod-level scenarios (fps_tpu.supervise.pod): N member agents over one
+# shared pod dir, each supervising its own replica of the demo child —
+# the single-machine stand-in for N symmetric hosts of one SPMD job.
+# ---------------------------------------------------------------------------
+
+SCENARIO_POD_HOSTS = ("h0", "h1", "h2")
+SCENARIO_POD_KILL_AT = 3
+SCENARIO_POD_CRASH_AT = 5
+# The partition scenario needs the children still RUNNING when the
+# post-seizure fence lands (a few seconds after the leader freezes), so
+# the stale leader's orphan demonstrably hits the fence: pace every
+# chunk boundary with a deterministic sleep.
+SCENARIO_PARTITION_ARGS = ("--examples", "8000", "--epochs", "6",
+                           "--chunk-sleep-s", "0.3")
+SCENARIO_ELASTIC_ARGS = ("--examples", "20000", "--epochs", "4")
+
+
+def _pod_child_env():
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=_ROOT)
+    return env
+
+
+def _launch_pod(pod_dir: str, child_args, *, hosts=SCENARIO_POD_HOSTS,
+                pod_flags=(), member_flags=()):
+    """Start one pod-member process per host (each supervising its own
+    demo child); returns {host: Popen}."""
+    os.makedirs(pod_dir, exist_ok=True)
+    env = _pod_child_env()
+    procs = {}
+    for h in hosts:
+        cmd = [
+            sys.executable, os.path.join(_ROOT, "tools", "supervise.py"),
+            "--pod-dir", pod_dir, "--pod-host", h,
+            "--pod-size", str(len(hosts)), *pod_flags,
+            "--stall-timeout-s", "20", "--startup-grace-s", "300",
+            "--term-grace-s", "2", "--backoff-base-s", "0.2",
+            "--backoff-max-s", "2", "--max-restarts", "6",
+            "--poll-s", "0.15", "--lease-ttl-s", "1.5",
+            "--member-timeout-s", "4", *member_flags, "--",
+            sys.executable, "-m", "fps_tpu.testing.supervised_demo",
+            *child_args, "--keep", "20",
+            "--ckpt-dir", os.path.join(pod_dir, "{host}"),
+            "--out", os.path.join(pod_dir, "{host}", "out.npz"),
+        ]
+        procs[h] = subprocess.Popen(
+            cmd, env=env, cwd=_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+    return procs
+
+
+def _collect_pod(procs: dict, timeout: float) -> dict:
+    """Wait for every member; returns {host: {"rc", "digest", "tail"}}."""
+    import time as _time
+
+    out = {}
+    deadline = _time.monotonic() + timeout
+    for h, p in procs.items():
+        try:
+            stdout, _ = p.communicate(
+                timeout=max(5.0, deadline - _time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            stdout, _ = p.communicate()
+        digest = None
+        try:
+            digest = json.loads(stdout.strip().splitlines()[-1])
+        except (json.JSONDecodeError, IndexError):
+            pass
+        out[h] = {"rc": p.returncode, "digest": digest,
+                  "tail": stdout[-1200:]}
+    return out
+
+
+def _run_straight(tmpdir: str, child_args, *, timeout: float,
+                  preset_quarantine=None):
+    """One unsupervised demo run → (ok, weights_path, tail). With
+    ``preset_quarantine``, the run carries that quarantine set through
+    the supervised-child env contract (the straight twin of a pod run
+    that quarantined those chunks)."""
+    env = _pod_child_env()
+    if preset_quarantine:
+        state = os.path.join(tmpdir, "straight_state.json")
+        with open(state, "w", encoding="utf-8") as f:
+            json.dump({"quarantined": sorted(preset_quarantine)}, f)
+        env["FPS_TPU_SUPERVISOR_STATE"] = state
+    straight_dir = os.path.join(tmpdir, "straight")
+    straight_out = os.path.join(tmpdir, "straight.npz")
+    r = subprocess.run(
+        [sys.executable, "-m", "fps_tpu.testing.supervised_demo",
+         *child_args, "--ckpt-dir", straight_dir, "--out", straight_out],
+        env=env, cwd=_ROOT, capture_output=True, text=True,
+        timeout=timeout)
+    return r.returncode == 0, straight_out, (r.stdout + r.stderr)[-1000:]
+
+
+def _pod_dirs_clean(pod_dir: str, hosts) -> list[str]:
+    """Corrupt-quarantined files and TORN PUBLISHED snapshots across all
+    member dirs — must be empty. (``*.tmp.npz`` leftovers of a child
+    SIGKILLed mid-write are NOT debris here: they were never published,
+    and the checkpointer's construction sweep collects them — the
+    acceptance bar is zero torn checkpoints *published*.)"""
+    import zipfile
+
+    bad = [p for h in hosts
+           for p in glob.glob(os.path.join(pod_dir, h, "*.corrupt"))]
+    for h in hosts:
+        for p in glob.glob(os.path.join(pod_dir, h, "ckpt_*.npz")):
+            try:
+                with zipfile.ZipFile(p) as z:
+                    if z.testzip() is None:
+                        continue
+            except (OSError, zipfile.BadZipFile):
+                pass
+            bad.append(p + ":torn")
+    return sorted(os.path.relpath(p, pod_dir) for p in bad)
+
+
+def _stale_publishes(pod_dir: str, hosts) -> list[str]:
+    """Snapshots written AFTER their dir's fence yet stamped with an
+    epoch below it — the publishes the fence exists to prevent. Must be
+    empty in every pod scenario."""
+    import numpy as np
+
+    bad = []
+    for h in hosts:
+        d = os.path.join(pod_dir, h)
+        fence_path = os.path.join(d, "pod_fence.json")
+        try:
+            with open(fence_path, encoding="utf-8") as f:
+                fence = json.load(f)
+            fence_mtime = os.stat(fence_path).st_mtime_ns
+            min_epoch = int(fence["min_epoch"])
+        except (OSError, KeyError, ValueError, json.JSONDecodeError):
+            continue
+        for p in sorted(glob.glob(os.path.join(d, "ckpt_*.npz"))):
+            try:
+                if os.stat(p).st_mtime_ns <= fence_mtime:
+                    continue
+                with np.load(p) as z:
+                    epoch = (int(z["meta::pod_epoch"])
+                             if "meta::pod_epoch" in z.files else None)
+            except (OSError, ValueError):
+                continue
+            if epoch is not None and epoch < min_epoch:
+                bad.append(f"{h}/{os.path.basename(p)}:epoch{epoch}"
+                           f"<fence{min_epoch}")
+    return bad
+
+
+def _pod_bit_identity(pod_dir: str, hosts, straight_out: str):
+    """(all_identical, per-host detail) of member outputs vs straight."""
+    import numpy as np
+
+    want = np.load(straight_out)["weights"]
+    detail = {}
+    for h in hosts:
+        p = os.path.join(pod_dir, h, "out.npz")
+        detail[h] = bool(os.path.exists(p)
+                         and np.array_equal(np.load(p)["weights"], want))
+    return all(detail.values()), detail
+
+
+def run_pod_kill_one_host_scenario(tmpdir: str, *, timeout: float = 600):
+    """ONE member's child is SIGKILLed mid-run: the leader must make ONE
+    pod-wide decision — coordinated abort of every member's child,
+    restart of all three from the COMMON ``latest_valid_step`` — after
+    which every member finishes bit-identical to an uninterrupted run.
+    One crash quarantines nothing and evicts nobody.
+    """
+    ok, straight_out, tail = _run_straight(
+        tmpdir, SCENARIO_DEMO_ARGS, timeout=timeout)
+    if not ok:
+        return False, {"error": "straight run failed", "tail": tail}
+    pod_dir = os.path.join(tmpdir, "pod")
+    procs = _launch_pod(
+        pod_dir,
+        (*SCENARIO_DEMO_ARGS, "--kill-at", str(SCENARIO_POD_KILL_AT),
+         "--misbehave-host", "h1"))
+    res = _collect_pod(procs, timeout)
+    digests = {h: r["digest"] for h, r in res.items()}
+    if any(r["digest"] is None for r in res.values()):
+        return False, {"error": "missing member digest",
+                       "tails": {h: r["tail"] for h, r in res.items()}}
+    bit_identical, bit_detail = _pod_bit_identity(
+        pod_dir, SCENARIO_POD_HOSTS, straight_out)
+    detail = {
+        "digests": {h: {k: d[k] for k in
+                        ("success", "attempts", "epoch", "pod")}
+                    for h, d in digests.items()},
+        "bit_identical": bit_detail,
+        "debris": _pod_dirs_clean(pod_dir, SCENARIO_POD_HOSTS),
+        "stale_publishes": _stale_publishes(pod_dir, SCENARIO_POD_HOSTS),
+        "kill_fired": os.path.exists(
+            os.path.join(pod_dir, "h1", "kill_at.done")),
+    }
+    ok = (all(r["rc"] == 0 and r["digest"]["success"]
+              for r in res.values())
+          # ONE pod-wide decision, not per-host timers: exactly one
+          # coordinated restart, shared by every member's digest.
+          and all(d["pod"]["restarts"] == 1 for d in digests.values())
+          and all(d["pod"]["quarantined"] == [] for d in digests.values())
+          and all(d["pod"]["evicted"] == [] for d in digests.values())
+          and detail["kill_fired"]
+          and not detail["debris"] and not detail["stale_publishes"]
+          and bit_identical)
+    return ok, detail
+
+
+def run_pod_partition_coordinator_scenario(tmpdir: str, *,
+                                           timeout: float = 600):
+    """The LEASE HOLDER's member agent is SIGSTOPped mid-run (a
+    partitioned coordinator host: its child keeps training, orphaned). A
+    follower must seize the expired lease (fencing epoch bump), treat the
+    unreachable member as failed, fence every member dir, and command a
+    coordinated restart — and the stale leader's orphan child must be
+    REFUSED by the fence when it next publishes. On SIGCONT the deposed
+    leader rejoins as a follower and the pod completes bit-identical to
+    an uninterrupted run.
+    """
+    import time as _time
+
+    ok, straight_out, tail = _run_straight(
+        tmpdir, SCENARIO_PARTITION_ARGS, timeout=timeout)
+    if not ok:
+        return False, {"error": "straight run failed", "tail": tail}
+    pod_dir = os.path.join(tmpdir, "pod")
+    # Tighter unreachable-member detection: the fence must land while
+    # the frozen leader's orphan is still mid-run (argparse keeps the
+    # LAST occurrence, so this overrides the launch default).
+    procs = _launch_pod(pod_dir, SCENARIO_PARTITION_ARGS,
+                        member_flags=("--member-timeout-s", "3"))
+
+    lease_path = os.path.join(pod_dir, "pod_lease.json")
+
+    def _read_json(path):
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    deadline = _time.monotonic() + timeout
+    leader = seized_by = None
+    import signal as _signal
+
+    stopped_pid = None
+    try:
+        # Wait for a leader AND its first published snapshot (the run is
+        # really underway), then freeze the leader's member agent.
+        while _time.monotonic() < deadline:
+            lease = _read_json(lease_path)
+            if lease and lease.get("host"):
+                mem = _read_json(os.path.join(
+                    pod_dir, "members", lease["host"] + ".json"))
+                if mem and (mem.get("latest_step") or 0) >= 1:
+                    leader = lease["host"]
+                    stopped_pid = procs[leader].pid
+                    os.kill(stopped_pid, _signal.SIGSTOP)
+                    break
+            _time.sleep(0.1)
+        if leader is None:
+            return False, {"error": "no leader emerged"}
+        # Wait for the seizure (lease holder changes, epoch grows).
+        while _time.monotonic() < deadline:
+            lease = _read_json(lease_path)
+            if lease and lease.get("host") not in (None, leader):
+                seized_by = lease["host"]
+                break
+            _time.sleep(0.1)
+        # Give the new leader time to fence + restart and the orphan
+        # time to run into the fence, then release the old leader.
+        _time.sleep(6.0)
+    finally:
+        if stopped_pid is not None:
+            try:
+                os.kill(stopped_pid, _signal.SIGCONT)
+            except ProcessLookupError:
+                pass
+
+    res = _collect_pod(procs, max(10.0, deadline - _time.monotonic()))
+    digests = {h: r["digest"] for h, r in res.items()}
+    if any(r["digest"] is None for r in res.values()):
+        return False, {"error": "missing member digest", "leader": leader,
+                       "tails": {h: r["tail"] for h, r in res.items()}}
+    # The orphan's epitaph: its attempt log must show the fence refusal
+    # (StaleEpochError) — the "stale leader cannot publish" half of the
+    # acceptance criterion; the mtime/epoch scan is the on-disk half.
+    fenced_logs = []
+    for p in glob.glob(os.path.join(pod_dir, leader, "attempt-*.log")):
+        try:
+            with open(p, encoding="utf-8", errors="replace") as f:
+                if "StaleEpochError" in f.read():
+                    fenced_logs.append(os.path.basename(p))
+        except OSError:
+            pass
+    bit_identical, bit_detail = _pod_bit_identity(
+        pod_dir, SCENARIO_POD_HOSTS, straight_out)
+    detail = {
+        "stopped_leader": leader,
+        "seized_by": seized_by,
+        "fenced_logs": sorted(fenced_logs),
+        "digests": {h: {k: d[k] for k in
+                        ("success", "leader_terms", "epoch", "pod")}
+                    for h, d in digests.items()},
+        "bit_identical": bit_detail,
+        "debris": _pod_dirs_clean(pod_dir, SCENARIO_POD_HOSTS),
+        "stale_publishes": _stale_publishes(pod_dir, SCENARIO_POD_HOSTS),
+    }
+    ok = (all(r["rc"] == 0 and r["digest"]["success"]
+              for r in res.values())
+          and seized_by is not None and seized_by != leader
+          and digests[seized_by]["leader_terms"] >= 1
+          and bool(fenced_logs)
+          and all(d["pod"]["quarantined"] == [] for d in digests.values())
+          and not detail["debris"] and not detail["stale_publishes"]
+          and bit_identical)
+    return ok, detail
+
+
+def run_pod_flapping_member_scenario(tmpdir: str, *, timeout: float = 600):
+    """One member's child crashes deterministically at the same chunk on
+    every attempt (a flapping member). The pod must converge in two
+    coordinated restarts: crash, crash → the leader quarantines that
+    chunk POD-WIDE, and the third attempt completes with EVERY member
+    skipping it — no host re-dispatches a chunk another host proved
+    poisonous. Bit-identity target: a straight run carrying the same
+    quarantine preset.
+    """
+    ok, straight_out, tail = _run_straight(
+        tmpdir, SCENARIO_DEMO_ARGS, timeout=timeout,
+        preset_quarantine={SCENARIO_POD_CRASH_AT})
+    if not ok:
+        return False, {"error": "straight run failed", "tail": tail}
+    pod_dir = os.path.join(tmpdir, "pod")
+    procs = _launch_pod(
+        pod_dir,
+        (*SCENARIO_DEMO_ARGS, "--crash-at", str(SCENARIO_POD_CRASH_AT),
+         "--misbehave-host", "h1"))
+    res = _collect_pod(procs, timeout)
+    digests = {h: r["digest"] for h, r in res.items()}
+    if any(r["digest"] is None for r in res.values()):
+        return False, {"error": "missing member digest",
+                       "tails": {h: r["tail"] for h, r in res.items()}}
+    # The broadcast, observed at the CHILDREN: every member's final meta
+    # shows the quarantined chunk skipped — including the members whose
+    # own children never crashed.
+    skipped = {}
+    for h in SCENARIO_POD_HOSTS:
+        try:
+            with open(os.path.join(pod_dir, h, "out.npz.meta.json"),
+                      encoding="utf-8") as f:
+                skipped[h] = json.load(f).get("skipped")
+        except OSError:
+            skipped[h] = None
+    bit_identical, bit_detail = _pod_bit_identity(
+        pod_dir, SCENARIO_POD_HOSTS, straight_out)
+    detail = {
+        "digests": {h: {k: d[k] for k in ("success", "attempts", "pod")}
+                    for h, d in digests.items()},
+        "skipped": skipped,
+        "bit_identical": bit_detail,
+        "debris": _pod_dirs_clean(pod_dir, SCENARIO_POD_HOSTS),
+        "stale_publishes": _stale_publishes(pod_dir, SCENARIO_POD_HOSTS),
+    }
+    ok = (all(r["rc"] == 0 and r["digest"]["success"]
+              for r in res.values())
+          and all(d["pod"]["quarantined"] == [SCENARIO_POD_CRASH_AT]
+                  for d in digests.values())
+          and all(d["pod"]["restarts"] == 2 for d in digests.values())
+          and all(d["pod"]["evicted"] == [] for d in digests.values())
+          and all(skipped[h] == [SCENARIO_POD_CRASH_AT]
+                  for h in SCENARIO_POD_HOSTS)
+          and not detail["debris"] and not detail["stale_publishes"]
+          and bit_identical)
+    return ok, detail
+
+
+def run_pod_elastic_resize_scenario(tmpdir: str, *, timeout: float = 600):
+    """The elastic W→W−1→W path: a whole HOST dies (member agent AND its
+    child SIGKILLed) after the pod has made progress. The leader, past
+    that member's budget (``--evict-after 1``: one disappearance of a
+    dead host), re-plans the run at W−1 and the survivors continue. The
+    host then RETURNS (its member agent relaunched); the leader syncs it
+    the newest canonical snapshot (the elastic re-split source) and
+    restarts the pod at W. Every member — the returned one included —
+    must finish byte-identical to a straight W-host run at the same step
+    count, with zero torn or epoch-stale checkpoints published.
+    """
+    import signal as _signal
+    import time as _time
+
+    ok, straight_out, tail = _run_straight(
+        tmpdir, SCENARIO_ELASTIC_ARGS, timeout=timeout)
+    if not ok:
+        return False, {"error": "straight run failed", "tail": tail}
+    pod_dir = os.path.join(tmpdir, "pod")
+    pod_flags = ("--elastic", "--evict-after", "1",
+                 "--rejoin-delay-s", "0.3")
+    procs = _launch_pod(pod_dir, SCENARIO_ELASTIC_ARGS,
+                        pod_flags=pod_flags)
+
+    def _read_json(path):
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    deadline = _time.monotonic() + timeout
+    victim = None
+    # Kill a NON-leader host once the pod has real progress (the victim
+    # has published snapshots the post-return catch-up can be measured
+    # against).
+    while _time.monotonic() < deadline:
+        lease = _read_json(os.path.join(pod_dir, "pod_lease.json"))
+        leader = (lease or {}).get("host")
+        if leader in SCENARIO_POD_HOSTS:
+            for h in SCENARIO_POD_HOSTS:
+                if h == leader:
+                    continue
+                mem = _read_json(os.path.join(pod_dir, "members",
+                                              h + ".json"))
+                if mem and (mem.get("latest_step") or 0) >= 2:
+                    victim = h
+                    for pid in (mem.get("child_pid"), procs[h].pid):
+                        if pid:
+                            try:
+                                os.killpg(pid, _signal.SIGKILL)
+                            except (OSError, ProcessLookupError):
+                                try:
+                                    os.kill(pid, _signal.SIGKILL)
+                                except (OSError, ProcessLookupError):
+                                    pass
+                    break
+            if victim is not None:
+                break
+        _time.sleep(0.1)
+    if victim is None:
+        for p in procs.values():
+            p.kill()
+        return False, {"error": "no victim reached step 2 in time"}
+
+    # Wait for the eviction (control world drops to 2), then RETURN the
+    # host: relaunch its member agent with the identical command.
+    saw_evicted_world = None
+    while _time.monotonic() < deadline:
+        ctl = _read_json(os.path.join(pod_dir, "pod_control.json"))
+        if ctl and ctl.get("action") == "run" and ctl.get("world") == 2:
+            saw_evicted_world = 2
+            break
+        if ctl and ctl.get("action") in ("shutdown", "give_up"):
+            break
+        _time.sleep(0.1)
+    if saw_evicted_world == 2:
+        procs[victim].wait()  # reap the killed agent
+        relaunched = _launch_pod(pod_dir, SCENARIO_ELASTIC_ARGS,
+                                 hosts=(victim,), pod_flags=pod_flags)
+        procs[victim] = relaunched[victim]
+
+    res = _collect_pod(procs, max(10.0, deadline - _time.monotonic()))
+    digests = {h: r["digest"] for h, r in res.items()}
+    if any(r["digest"] is None for h, r in res.items() if h != victim) \
+            or res[victim]["digest"] is None:
+        return False, {"error": "missing member digest",
+                       "victim": victim,
+                       "tails": {h: r["tail"] for h, r in res.items()}}
+    victim_meta = _read_json(os.path.join(
+        pod_dir, victim, "out.npz.meta.json")) or {}
+    bit_identical, bit_detail = _pod_bit_identity(
+        pod_dir, SCENARIO_POD_HOSTS, straight_out)
+    detail = {
+        "victim": victim,
+        "evicted_world_observed": saw_evicted_world,
+        "digests": {h: {k: d[k] for k in ("success", "attempts", "pod")}
+                    for h, d in digests.items()},
+        "victim_restored_step": victim_meta.get("restored_step"),
+        "bit_identical": bit_detail,
+        "debris": _pod_dirs_clean(pod_dir, SCENARIO_POD_HOSTS),
+        "stale_publishes": _stale_publishes(pod_dir, SCENARIO_POD_HOSTS),
+    }
+    ok = (all(r["rc"] == 0 and r["digest"]["success"]
+              for r in res.values())
+          and saw_evicted_world == 2
+          # The full elastic cycle: one eviction (W→W−1), one
+          # readmission (W−1→W), ending with all three back in the plan.
+          and all(d["pod"]["readmissions"] == 1 for d in digests.values())
+          and all(d["pod"]["world"] == 3 for d in digests.values())
+          and all(d["pod"]["evicted"] == [] for d in digests.values())
+          and all(d["pod"]["quarantined"] == [] for d in digests.values())
+          # The returned host resumed from the SYNCED canonical
+          # snapshot — caught up, not cold-started.
+          and (victim_meta.get("restored_step") or 0) >= 2
+          and not detail["debris"] and not detail["stale_publishes"]
+          and bit_identical)
+    return ok, detail
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="supervised tiny-logreg child (fps_tpu.supervise demo)")
@@ -735,6 +1236,26 @@ def main(argv=None) -> int:
                          "replicated --hot-tier and --hot-sync-every "
                          "> 1) — its sharded state rides checkpoints "
                          "as fold:: arrays")
+    ap.add_argument("--chunk-sleep-s", type=float, default=0.0,
+                    help="sleep this long at every chunk boundary — "
+                         "paces the run so pod chaos scenarios can land "
+                         "their faults while the children are "
+                         "demonstrably mid-run (pure wall-clock, no "
+                         "effect on the math)")
+    ap.add_argument("--keep", type=int, default=3,
+                    help="snapshot retention (Checkpointer keep). Pod "
+                         "scenarios raise it: a coordinated restart "
+                         "rolls every member back to the POD-COMMON "
+                         "step, which a fast member's default retention "
+                         "may already have collected")
+    ap.add_argument("--misbehave-host", default=None,
+                    help="apply the wedge/crash/kill flags only when "
+                         "running as this pod member (FPS_TPU_POD_HOST) "
+                         "— one pod command template, one poisoned host")
+    ap.add_argument("--crash-until-file", default=None,
+                    help="exit(3) at startup (before any beat) until "
+                         "this file exists — the flapping member an "
+                         "elastic pod must evict and later re-admit")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -761,6 +1282,23 @@ def main(argv=None) -> int:
     hb = child.from_env()
     preset = child.quarantined_from_env()
     attempt = child.attempt_from_env()
+    pod = child.pod_env()
+
+    # Pod-member misbehavior gating: one shared command template, and
+    # only the named member actually misbehaves.
+    misbehave = (args.misbehave_host is None
+                 or pod["host"] == args.misbehave_host)
+    if not misbehave:
+        args.wedge_at = args.crash_at = args.kill_at = None
+        args.kill_prefetch_at = args.crash_until_file = None
+    if (args.crash_until_file is not None
+            and not os.path.exists(args.crash_until_file)):
+        # Dies before any beat or jax import: the leader sees an
+        # index-less crash (never quarantinable) and, past the member's
+        # eviction budget, re-plans the pod without this host.
+        print(json.dumps({"event": "demo_crash_until_file",
+                          "file": args.crash_until_file}), flush=True)
+        return 3
 
     # A heartbeat-only recorder makes the DRIVER's sub-phase beats
     # (prefetch/ingest/dispatch, with a phase field) flow: without it the
@@ -801,13 +1339,36 @@ def main(argv=None) -> int:
     tables, ls = trainer.init_state(jax.random.key(0))
 
     ckpt_cls = Checkpointer if args.sync_checkpointer else AsyncCheckpointer
-    ckpt = ckpt_cls(args.ckpt_dir, keep=3)
-    start = ckpt.latest_valid_step() or 0
+    # Under a pod, publishes carry (and are fenced by) this child's
+    # attempt epoch — a zombie of an aborted pod attempt dies loudly on
+    # its next save instead of leaking state into the new attempt.
+    ckpt = ckpt_cls(args.ckpt_dir, keep=args.keep,
+                    fence_epoch=pod["epoch"])
+    if pod["step"] is not None:
+        # Pod-commanded COMMON restart step: prefer it exactly, fall back
+        # to the newest verified snapshot at-or-below it (retention may
+        # have advanced past a very old command), then to whatever this
+        # member has — replica determinism makes any of these converge.
+        commanded = pod["step"]
+        if commanded and ckpt.verify_snapshot(commanded):
+            start = commanded
+        else:
+            below = [s for s in ckpt.steps()
+                     if s <= commanded and ckpt.verify_snapshot(s)]
+            start = below[-1] if below else (ckpt.latest_valid_step() or 0)
+        if start:
+            tables, ls, start = trainer.restore_checkpoint(
+                ckpt, ls, step=start)
+    else:
+        start = ckpt.latest_valid_step() or 0
+        if start:
+            # Auto-resolve (step=None): a corrupt newest snapshot is
+            # quarantined and the restore falls back — the supervised
+            # scenarios' torn-candidate contract.
+            tables, ls, start = trainer.restore_checkpoint(ckpt, ls)
     tiering_restored = None
-    if start:
-        tables, ls, start = trainer.restore_checkpoint(ckpt, ls)
-        if trainer.retierer is not None:
-            tiering_restored = trainer.retierer.restore(start)
+    if start and trainer.retierer is not None:
+        tiering_restored = trainer.retierer.restore(start)
     if hb is not None:
         # Beat-before-work: name the chunk about to be attempted BEFORE
         # attempting it, so a crash inside the very first (resumed) chunk
@@ -817,7 +1378,8 @@ def main(argv=None) -> int:
         # budget instead).
         hb.beat(index=start, attempt=attempt)
     meta = {"attempt": attempt, "restored_step": start,
-            "quarantined": sorted(preset), "total_chunks": len(chunks)}
+            "quarantined": sorted(preset), "total_chunks": len(chunks),
+            "pod": pod}
     print(json.dumps({"event": "demo_start", **meta}), flush=True)
 
     marker = os.path.join(args.ckpt_dir, "misbehave.done")
@@ -839,6 +1401,10 @@ def main(argv=None) -> int:
         )
 
     def on_chunk(i, metrics):
+        if args.chunk_sleep_s:
+            import time as _time
+
+            _time.sleep(args.chunk_sleep_s)
         # The last beat before this point named chunk i (beat-before-work:
         # the post-restore beat, or the previous boundary's i-1 -> i).
         if (args.crash_at is not None and i == args.crash_at

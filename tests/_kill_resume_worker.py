@@ -119,13 +119,13 @@ def main() -> int:
         ackpt = ck_mod.AsyncCheckpointer(ckdir, keep=2)
         real_savez = ck_mod._atomic_savez
 
-        def dying_savez(path, arrays):
+        def dying_savez(path, arrays, precommit=None):
             if path.endswith(ck_mod.SNAPSHOT_FMT.format(step=3)):
                 # Step 3's BACKGROUND write: partial tmp hits the disk,
                 # then SIGKILL — from the writer thread itself, i.e. the
                 # kill lands mid-serialize with the rename never reached.
                 chaos.partial_write_then_kill(ckdir)
-            return real_savez(path, arrays)
+            return real_savez(path, arrays, precommit)
 
         ck_mod._atomic_savez = dying_savez
         trainer.run_indexed(tables, ls, plan, key, epochs=4,

@@ -13,6 +13,21 @@ quarantine set, and can misbehave on demand:
 * ``--crash-at K`` — exit(3) at chunk K on EVERY attempt whose quarantine
   set does not contain K: the deterministic-poison crash loop the
   supervisor must break by quarantining K.
+* ``--crash-until-file F`` — exit(3) at startup (before any beat) until
+  ``F`` exists: the flapping member the elastic pod must EVICT and, once
+  the operator clears the fault (touches F), re-admit.
+* ``--misbehave-host H`` — only misbehave when this process runs as pod
+  member ``H`` (``FPS_TPU_POD_HOST``): one shared pod command template
+  can then poison exactly one member.
+
+Pod contract (``fps_tpu/supervise/pod.py``): besides ``progress.json``
+the stub publishes tiny zip "snapshots" named like real checkpoints
+(``ckpt_%012d.npz`` — zipfile members carry CRCs, so the stdlib-only pod
+coordinator verifies them exactly like real npz snapshots), resumes from
+the pod-commanded common step (``FPS_TPU_POD_STEP``), and refuses to
+publish behind a pod fence (``pod_fence.json`` vs ``FPS_TPU_POD_EPOCH``)
+— exiting 9 with a ``stale epoch`` marker, the stub-speed analog of
+``fps_tpu.core.checkpoint``'s ``StaleEpochError``.
 
 Usage: python _supervised_stub.py --dir D --chunks N [flags]
 Writes ``result.json`` ({"done": N, "ran": [...]}) into --dir on success.
@@ -28,6 +43,7 @@ import os
 import signal
 import sys
 import time
+import zipfile
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -39,6 +55,32 @@ def _load_child_module():
     sys.modules[spec.name] = mod  # 3.10 needs the registration pre-exec
     spec.loader.exec_module(mod)
     return mod
+
+
+def _publish_snapshot(child, directory: str, step: int, epoch,
+                      keep: int = 3) -> None:
+    """Checkpoint-shaped publish: fence check, tmp write, atomic rename,
+    keep-N retention — the control-plane surface of a real save."""
+    ok, min_epoch = child.fence_allows(directory, epoch)
+    if not ok:
+        print(f"stub: stale epoch {epoch} < fence {min_epoch}, "
+              "refusing to publish", flush=True)
+        sys.exit(9)
+    name = f"ckpt_{step:012d}.npz"
+    tmp = os.path.join(directory, name + ".stub.tmp")
+    with zipfile.ZipFile(tmp, "w") as z:
+        z.writestr("progress.json",
+                   json.dumps({"step": step, "epoch": epoch}))
+    os.replace(tmp, os.path.join(directory, name))
+    steps = sorted(
+        int(f[5:17]) for f in os.listdir(directory)
+        if f.startswith("ckpt_") and f.endswith(".npz") and len(f) == 21
+    )
+    for s in steps[:-keep]:
+        try:
+            os.remove(os.path.join(directory, f"ckpt_{s:012d}.npz"))
+        except OSError:
+            pass
 
 
 def main() -> int:
@@ -57,6 +99,11 @@ def main() -> int:
                          "graceful-shutdown child): an ABORTED attempt "
                          "ending rc=0 must still not count as success")
     ap.add_argument("--crash-at", type=int, default=None)
+    ap.add_argument("--crash-until-file", default=None,
+                    help="exit(3) at startup until this file exists")
+    ap.add_argument("--misbehave-host", default=None,
+                    help="apply wedge/crash flags only when running as "
+                         "this pod member")
     args = ap.parse_args()
 
     if args.trap_term:
@@ -65,16 +112,30 @@ def main() -> int:
     child = _load_child_module()
     hb = child.from_env()
     quarantined = child.quarantined_from_env()
+    pod = child.pod_env()
     os.makedirs(args.dir, exist_ok=True)
     progress_path = os.path.join(args.dir, "progress.json")
     marker = os.path.join(args.dir, "wedge.done")
 
+    misbehave = (args.misbehave_host is None
+                 or pod["host"] == args.misbehave_host)
+    if (misbehave and args.crash_until_file is not None
+            and not os.path.exists(args.crash_until_file)):
+        print("stub: crash-until-file fault active, dying at startup",
+              flush=True)
+        return 3
+
     start = 0
-    try:
-        with open(progress_path, encoding="utf-8") as f:
-            start = int(json.load(f)["next"])
-    except (OSError, ValueError, KeyError, json.JSONDecodeError):
-        pass
+    if pod["step"] is not None:
+        # Pod-commanded common restart step: every member resumes HERE,
+        # not from its own (possibly different) local progress.
+        start = pod["step"]
+    else:
+        try:
+            with open(progress_path, encoding="utf-8") as f:
+                start = int(json.load(f)["next"])
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            pass
 
     ran = []
     for i in range(start, args.chunks):
@@ -82,10 +143,10 @@ def main() -> int:
             hb.beat(index=i, attempt=child.attempt_from_env())
         if i in quarantined:
             continue  # carried quarantine: consume the index, skip the work
-        if args.crash_at is not None and i == args.crash_at:
+        if misbehave and args.crash_at is not None and i == args.crash_at:
             print(f"stub: deterministic crash at chunk {i}", flush=True)
             return 3
-        if args.wedge_at is not None and i == args.wedge_at \
+        if misbehave and args.wedge_at is not None and i == args.wedge_at \
                 and (args.wedge_always or not os.path.exists(marker)):
             open(marker, "w").close()  # wedge once; the restart proceeds
             print(f"stub: wedging ({args.wedge_mode}) at chunk {i}",
@@ -98,11 +159,13 @@ def main() -> int:
         ran.append(i)
         with open(progress_path, "w", encoding="utf-8") as f:
             json.dump({"next": i + 1}, f)  # the stub's "checkpoint"
+        _publish_snapshot(child, args.dir, i + 1, pod["epoch"])
 
     with open(os.path.join(args.dir, "result.json"), "w",
               encoding="utf-8") as f:
         json.dump({"done": args.chunks, "ran": ran,
-                   "attempt": child.attempt_from_env()}, f)
+                   "attempt": child.attempt_from_env(),
+                   "pod": pod}, f)
     return 0
 
 

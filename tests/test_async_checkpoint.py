@@ -65,11 +65,11 @@ def _slow_savez(jaxmods, monkeypatch, delay_s, started=None):
     ck = jaxmods["ck"]
     real = ck._atomic_savez
 
-    def slow(path, arrays):
+    def slow(path, arrays, precommit=None):
         if started is not None:
             started.set()
         time.sleep(delay_s)
-        return real(path, arrays)
+        return real(path, arrays, precommit)
 
     monkeypatch.setattr(ck, "_atomic_savez", slow)
     return real

@@ -567,3 +567,144 @@ def test_sigkill_and_fresh_process_resume(tmp_path):
     a, b = np.load(straight), np.load(resumed)
     np.testing.assert_array_equal(a["item_factors"], b["item_factors"])
     np.testing.assert_array_equal(a["user_factors"], b["user_factors"])
+
+
+# ---------------------------------------------------------------------------
+# Pod fencing (fps_tpu.supervise.pod contract at the checkpoint layer).
+# ---------------------------------------------------------------------------
+
+def test_fenced_publish_refused(tmp_path, jaxmods, devices8):
+    """A writer whose fencing epoch predates the dir's pod fence must
+    REFUSE to publish (StaleEpochError), leaving the snapshot trail
+    untouched; a writer at-or-above the fence publishes normally, and an
+    epoch-less writer is refused by any fenced dir (a pre-pod zombie
+    must not leak state into a pod attempt)."""
+    import pytest as _pytest
+
+    from fps_tpu.supervise.child import StaleEpochError, write_fence
+
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    _, _, _, store = _mf(jaxmods, num_shards=2)
+    store.init(jax.random.key(0))
+    d = str(tmp_path / "c")
+
+    fenced = ck.Checkpointer(d, fence_epoch=2)
+    fenced.save(1, store, None)
+    write_fence(d, 3, 1)
+    with _pytest.raises(StaleEpochError):
+        fenced.save(2, store, None)
+    assert fenced.steps() == [1]  # nothing published behind the fence
+
+    ok = ck.Checkpointer(d, fence_epoch=3)
+    ok.save(2, store, None)
+    assert ok.steps() == [1, 2]
+
+    epochless = ck.Checkpointer(d)
+    with _pytest.raises(StaleEpochError):
+        epochless.save(3, store, None)
+    assert ok.steps() == [1, 2]
+
+
+def test_fenced_async_writer_surfaces_on_caller(tmp_path, jaxmods, devices8):
+    """The async writer hits the fence on its background thread; the
+    refusal must re-raise on the caller (flush/close), chained from the
+    StaleEpochError, and never publish a torn or stale snapshot."""
+    import pytest as _pytest
+
+    from fps_tpu.supervise.child import StaleEpochError, write_fence
+
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    _, _, _, store = _mf(jaxmods, num_shards=2)
+    store.init(jax.random.key(0))
+    d = str(tmp_path / "a")
+
+    ac = ck.AsyncCheckpointer(d, fence_epoch=1)
+    ac.save(1, store, None)
+    ac.flush()
+    write_fence(d, 5, 1)
+    ac.save(2, store, None)  # accepted; the WRITER will be refused
+    with _pytest.raises(RuntimeError) as ei:
+        ac.flush()
+    cause = ei.value.__cause__
+    assert isinstance(cause, StaleEpochError), cause
+    assert ck.Checkpointer(d, fence_epoch=5).steps() == [1]
+    ac.close()  # error re-raises ONCE (already consumed): clean close
+
+
+def test_fence_epoch_from_env(monkeypatch):
+    from fps_tpu.core.checkpoint import fence_epoch_from_env
+    from fps_tpu.supervise.child import POD_EPOCH_ENV
+
+    monkeypatch.delenv(POD_EPOCH_ENV, raising=False)
+    assert fence_epoch_from_env() is None
+    monkeypatch.setenv(POD_EPOCH_ENV, "7")
+    assert fence_epoch_from_env() == 7
+
+
+# ---------------------------------------------------------------------------
+# Mesh-shape-independent restore: the explicit elastic re-split path.
+# ---------------------------------------------------------------------------
+
+def test_resplit_restore_bit_identical_at_w_minus_and_plus_one(
+        tmp_path, jaxmods, devices8):
+    """A checkpoint written at W=3 shards restores BIT-IDENTICALLY at
+    W-1=2 and W+1=4 shards through the explicit re-split path: the
+    restore detects the recorded mesh-shape change, emits the
+    checkpoint_resplit event + counter, and asserts the re-laid-out
+    tables round-trip to the snapshot's exact logical bytes — the
+    invariant the pod's elastic W->W-1->W re-planning stands on."""
+    import jax
+
+    from fps_tpu import obs
+    from fps_tpu.obs import events as obs_events
+    from fps_tpu.obs.sinks import MemorySink
+
+    ck = jaxmods["ck"]
+    _, cfg, trainerA, storeA = _mf(jaxmods, num_shards=3)
+    tabA, lsA = trainerA.init_state(jax.random.key(1))
+    data = jaxmods["synthetic_ratings"](32, 24, 3 * 8 * 4, seed=3)
+    chunks = _chunks(jaxmods, data, 3)[:2]
+    tabA, lsA, _ = trainerA.fit_stream(
+        tabA, lsA, chunks, jax.random.key(5),
+        checkpointer=ck.Checkpointer(str(tmp_path / "w3")),
+        checkpoint_every=2)
+    want = {n: storeA.dump_model(n)[1] for n in storeA.specs}
+
+    for shards in (2, 4):  # W-1 and W+1
+        sink = MemorySink()
+        rec = obs.Recorder(sinks=[sink])
+        _, _, trainerB, storeB = _mf(jaxmods, num_shards=shards)
+        tabB, lsB = trainerB.init_state(jax.random.key(99))
+        storeB.tables = tabB
+        with obs_events.default_recorder(rec):
+            tabB, lsB, step = trainerB.restore_checkpoint(
+                ck.Checkpointer(str(tmp_path / "w3")), lsB)
+        assert step == 2
+        for n, v in want.items():
+            np.testing.assert_array_equal(storeB.dump_model(n)[1], v)
+        events = [r for r in sink.records
+                  if r.get("event") == "checkpoint_resplit"]
+        assert len(events) == 1, events
+        assert events[0]["from_shape"] == {"data": 1, "shard": 3}
+        assert events[0]["to_shape"] == {"data": 1, "shard": shards}
+
+
+def test_same_shape_restore_emits_no_resplit(tmp_path, jaxmods, devices8):
+    """The re-split path (and its extra per-table round-trip dump) stays
+    OFF the common same-mesh restore."""
+    import jax
+
+    from fps_tpu import obs
+    from fps_tpu.obs import events as obs_events
+    from fps_tpu.obs.sinks import MemorySink
+
+    ck = jaxmods["ck"]
+    _, _, _, store = _mf(jaxmods, num_shards=2)
+    store.init(jax.random.key(0))
+    ckpt = ck.Checkpointer(str(tmp_path / "s"))
+    ckpt.save(1, store, None)
+    sink = MemorySink()
+    with obs_events.default_recorder(obs.Recorder(sinks=[sink])):
+        ckpt.restore_tables(store)
+    assert not [r for r in sink.records
+                if r.get("event") == "checkpoint_resplit"]

@@ -1,0 +1,612 @@
+"""Pod-level coordination (fps_tpu/supervise/pod.py + tools/supervise.py).
+
+Tier-1 keeps the pod protocol honest at stub speed: N member agents
+(the REAL CLI, one subprocess each) over one shared pod dir, each
+supervising a jax-free stub child (``tests/_supervised_stub.py``) that
+beats, publishes zip "snapshots" shaped like real checkpoints, honors
+the pod-commanded common restart step, and refuses to publish behind a
+pod fence. The real-jax versions of these scenarios live in
+``fps_tpu.testing.supervised_demo`` (run by ``tools/chaos_sweep.py`` and
+the slow tests below).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import zipfile
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_STUB = os.path.join(_ROOT, "tests", "_supervised_stub.py")
+_CLI = os.path.join(_ROOT, "tools", "supervise.py")
+
+HOSTS = ("h0", "h1", "h2")
+
+
+def _member_cmd(pod_dir, host, pod_size, *flags, child=()):
+    return [
+        sys.executable, _CLI, "--pod-dir", str(pod_dir), "--pod-host",
+        host, "--pod-size", str(pod_size),
+        "--stall-timeout-s", "1.2", "--startup-grace-s", "15",
+        "--term-grace-s", "0.4", "--backoff-base-s", "0.1",
+        "--backoff-max-s", "0.5", "--max-restarts", "6",
+        "--poll-s", "0.1", "--lease-ttl-s", "1.0",
+        "--member-timeout-s", "3.0", *flags,
+        "--", sys.executable, _STUB,
+        "--dir", os.path.join(str(pod_dir), "{host}"), *child,
+    ]
+
+
+def _launch(pod_dir, *flags, hosts=HOSTS, child=()):
+    return {
+        h: subprocess.Popen(
+            _member_cmd(pod_dir, h, len(hosts), *flags, child=child),
+            cwd=_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for h in hosts
+    }
+
+
+def _collect(procs, timeout=120):
+    out = {}
+    deadline = time.monotonic() + timeout
+    for h, p in procs.items():
+        try:
+            stdout, _ = p.communicate(
+                timeout=max(5, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            stdout, _ = p.communicate()
+        digest = None
+        try:
+            digest = json.loads(stdout.strip().splitlines()[-1])
+        except (json.JSONDecodeError, IndexError):
+            pass
+        out[h] = (p.returncode, digest, stdout[-1500:])
+    return out
+
+
+def _result(pod_dir, host):
+    with open(os.path.join(str(pod_dir), host, "result.json"),
+              encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _read_json(path):
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# End-to-end member protocol (subprocess, stub children).
+# ---------------------------------------------------------------------------
+
+def test_pod_clean_run_elects_one_leader(tmp_path):
+    """A fault-free pod: every member succeeds, exactly one leader term
+    is ever held, zero restarts, and every stub finishes all chunks."""
+    res = _collect(_launch(tmp_path / "pod",
+                           child=("--chunks", "6", "--chunk-s", "0.05")))
+    assert all(rc == 0 and d["success"] for rc, d, _ in res.values()), res
+    assert sum(d["leader_terms"] for _, d, _ in res.values()) == 1
+    assert all(d["pod"]["restarts"] == 0 for _, d, _ in res.values())
+    for h in HOSTS:
+        assert _result(tmp_path / "pod", h)["done"] == 6
+
+
+def test_pod_wedged_member_one_coordinated_abort(tmp_path):
+    """One member's child SIGSTOPs mid-run: the stall becomes ONE
+    pod-wide decision — every member digest shows the same single
+    coordinated restart, nothing is quarantined, and the pod journal
+    narrates the abort (member_failed -> fence_written -> pod_restart)."""
+    res = _collect(_launch(
+        tmp_path / "pod",
+        child=("--chunks", "6", "--chunk-s", "0.05", "--wedge-at", "3",
+               "--wedge-mode", "sigstop", "--misbehave-host", "h1")))
+    assert all(rc == 0 and d["success"] for rc, d, _ in res.values()), res
+    assert all(d["pod"]["restarts"] == 1 for _, d, _ in res.values())
+    assert all(d["pod"]["quarantined"] == [] for _, d, _ in res.values())
+    events = [json.loads(line)["event"] for line in
+              open(tmp_path / "pod" / "journal-pod.jsonl")]
+    for expected in ("pod_start", "member_failed", "fence_written",
+                     "pod_restart", "pod_shutdown"):
+        assert expected in events, events
+
+
+def test_pod_quarantine_broadcast(tmp_path):
+    """A chunk that crashes ONE member on every attempt is quarantined
+    POD-WIDE after two coordinated restarts: every member's stub — the
+    never-crashing ones included — skips it, so no host re-dispatches a
+    chunk another host proved poisonous."""
+    res = _collect(_launch(
+        tmp_path / "pod",
+        child=("--chunks", "8", "--chunk-s", "0.05", "--crash-at", "5",
+               "--misbehave-host", "h1")))
+    assert all(rc == 0 and d["success"] for rc, d, _ in res.values()), res
+    assert all(d["pod"]["quarantined"] == [5]
+               for _, d, _ in res.values())
+    assert all(d["pod"]["restarts"] == 2 for _, d, _ in res.values())
+    for h in HOSTS:
+        assert 5 not in _result(tmp_path / "pod", h)["ran"], h
+    # The broadcast rides the pod state file through the child env
+    # contract (STATE_ENV -> pod_state.json).
+    state = _read_json(tmp_path / "pod" / "pod_state.json")
+    assert state["quarantined"] == [5]
+
+
+def test_pod_elastic_eviction_and_readmission(tmp_path):
+    """Elastic membership at stub speed: one member's child dies at
+    startup (index-less — never quarantinable) until evicted at W-1;
+    the fault then clears, the member reports ready, and the leader
+    re-admits it (snapshot sync + restart at W). Every member finishes."""
+    fixed = tmp_path / "fixed"
+    procs = _launch(
+        tmp_path / "pod", "--elastic", "--evict-after", "2",
+        "--rejoin-delay-s", "0.5",
+        child=("--chunks", "10", "--chunk-s", "0.15",
+               "--crash-until-file", str(fixed),
+               "--misbehave-host", "h2"))
+    # Clear the fault the moment the eviction lands (world drops to 2).
+    deadline = time.monotonic() + 60
+    saw_world2 = False
+    while time.monotonic() < deadline:
+        ctl = _read_json(tmp_path / "pod" / "pod_control.json")
+        if ctl and ctl.get("action") == "run" and ctl.get("world") == 2:
+            saw_world2 = True
+            open(fixed, "w").close()
+            break
+        time.sleep(0.05)
+    res = _collect(procs)
+    assert saw_world2, [r[2] for r in res.values()]
+    assert all(rc == 0 and d["success"] for rc, d, _ in res.values()), res
+    assert all(d["pod"]["readmissions"] == 1 for _, d, _ in res.values())
+    assert all(d["pod"]["world"] == 3 for _, d, _ in res.values())
+    assert all(d["pod"]["evicted"] == [] for _, d, _ in res.values())
+    for h in HOSTS:
+        assert _result(tmp_path / "pod", h)["done"] == 10
+    events = [json.loads(line)["event"] for line in
+              open(tmp_path / "pod" / "journal-pod.jsonl")]
+    for expected in ("member_evicted", "member_readmitted"):
+        assert expected in events, events
+
+
+def test_pod_partition_seizure_and_fencing(tmp_path):
+    """The lease holder's member agent is SIGSTOPped: a follower seizes
+    the lease (epoch bump), fences every member dir, and restarts the
+    pod — and the stale leader's ORPHANED stub child is refused by the
+    fence on its next publish (exit 9, 'stale epoch' in its log). On
+    SIGCONT the deposed leader rejoins and the pod completes."""
+    procs = _launch(tmp_path / "pod", "--lease-ttl-s", "0.6",
+                    "--member-timeout-s", "1.2",
+                    child=("--chunks", "40", "--chunk-s", "0.25"))
+    lease_path = tmp_path / "pod" / "pod_lease.json"
+    deadline = time.monotonic() + 60
+    leader = None
+    try:
+        while time.monotonic() < deadline:
+            lease = _read_json(lease_path)
+            holder = (lease or {}).get("host")
+            mem = (_read_json(tmp_path / "pod" / "members"
+                              / f"{holder}.json") if holder else None)
+            # Freeze only once the leader's CHILD exists and has
+            # published — otherwise there is no orphan to fence.
+            if mem and mem.get("child_pid") \
+                    and (mem.get("latest_step") or 0) >= 1:
+                leader = holder
+                os.kill(procs[leader].pid, signal.SIGSTOP)
+                break
+            time.sleep(0.05)
+        assert leader is not None, "no leader emerged"
+        seized_by = None
+        while time.monotonic() < deadline:
+            lease = _read_json(lease_path)
+            if lease and lease.get("host") != leader:
+                seized_by = lease["host"]
+                break
+            time.sleep(0.05)
+        assert seized_by is not None, "lease never seized"
+        # Fence lands with the post-partition restart; the orphan (still
+        # publishing every 0.25s) must hit it. Give it a moment.
+        time.sleep(3.0)
+    finally:
+        if leader is not None:
+            try:
+                os.kill(procs[leader].pid, signal.SIGCONT)
+            except ProcessLookupError:
+                pass
+    res = _collect(procs)
+    assert all(rc == 0 and d["success"] for rc, d, _ in res.values()), res
+    assert res[seized_by][1]["leader_terms"] >= 1
+    # The orphan's refusal: its attempt log carries the stub's stale-
+    # epoch marker (the checkpoint layer's StaleEpochError analog).
+    logs = ""
+    ldir = tmp_path / "pod" / leader
+    for f in os.listdir(ldir):
+        if f.startswith("attempt-") and f.endswith(".log"):
+            logs += open(ldir / f, encoding="utf-8",
+                         errors="replace").read()
+    assert "stale epoch" in logs, logs[-800:]
+    # Epoch monotonicity across the seizure: the final epoch exceeds 2
+    # (initial acquire + launch) because the seizure bumped it.
+    assert all(d["epoch"] >= 4 for _, d, _ in res.values())
+
+
+def test_pod_give_up_exhausts_budget(tmp_path):
+    """An unrecoverable member (wedges every attempt, quarantine can't
+    help) burns the pod restart budget: the leader gives up, every
+    member exits nonzero with action=give_up."""
+    res = _collect(_launch(
+        tmp_path / "pod", "--max-restarts", "1",
+        child=("--chunks", "6", "--chunk-s", "0.05", "--wedge-at", "2",
+               "--wedge-always", "--misbehave-host", "h1")))
+    assert all(rc == 1 and not d["success"]
+               for rc, d, _ in res.values()), res
+    assert all(d["action"] == "give_up" for _, d, _ in res.values())
+
+
+# ---------------------------------------------------------------------------
+# Library pieces (no subprocess).
+# ---------------------------------------------------------------------------
+
+def test_snapshot_re_mirrors_format():
+    """pod.py mirrors the snapshot filename contract (it must stay
+    stdlib-only and cannot import the numpy-laden snapshot_format) —
+    this is the tripwire for the mirror drifting."""
+    from fps_tpu.core import snapshot_format
+    from fps_tpu.supervise import pod
+
+    assert pod.SNAPSHOT_RE.pattern == snapshot_format.SNAPSHOT_RE.pattern
+
+
+def test_pod_module_loads_without_fps_tpu():
+    """The jax-free contract: loading pod.py by file path in a bare
+    interpreter must import neither fps_tpu nor jax nor numpy."""
+    code = (
+        "import importlib.util, sys\n"
+        f"path = {os.path.join(_ROOT, 'fps_tpu', 'supervise', 'pod.py')!r}\n"
+        "spec = importlib.util.spec_from_file_location('_pod', path)\n"
+        "mod = importlib.util.module_from_spec(spec)\n"
+        "sys.modules[spec.name] = mod\n"
+        "spec.loader.exec_module(mod)\n"
+        "mod.PodConfig(pod_size=2)\n"
+        "bad = [m for m in sys.modules if m == 'jax' or m == 'numpy'"
+        " or m.startswith(('jax.', 'numpy.', 'fps_tpu'))]\n"
+        "assert not bad, bad\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=60)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_lease_acquire_renew_seize(tmp_path):
+    """Lease mechanics with a controlled clock: two-tick acquisition,
+    renewal keeps the holder, an expired lease is seized with an epoch
+    bump, and the deposed holder observes the loss."""
+    from fps_tpu.supervise.pod import Lease
+
+    now = [100.0]
+    a = Lease(str(tmp_path / "lease.json"), "a", 2.0, clock=lambda: now[0])
+    b = Lease(str(tmp_path / "lease.json"), "b", 2.0, clock=lambda: now[0])
+
+    held, _, _ = a.tick()  # claim
+    assert not held
+    held, rec, seized = a.tick()  # confirm
+    assert held and rec["epoch"] == 1 and not b.tick()[0]
+
+    now[0] += 1.0  # fresh enough: b cannot seize, a renews
+    assert not b.tick()[0]
+    assert a.tick()[0]
+
+    now[0] += 10.0  # expired: b claims...
+    held, _, _ = b.tick()
+    assert not held
+    held, rec, seized = b.tick()  # ...and confirms with a bumped epoch
+    assert held and seized and rec["epoch"] == 2
+    assert not a.tick()[0]  # the deposed holder steps down
+
+
+def test_lease_claim_race_single_winner(tmp_path):
+    """Two simultaneous claims settle on the single rename winner: the
+    later writer holds, the earlier claimant loses its claim."""
+    from fps_tpu.supervise.pod import Lease
+
+    now = [10.0]
+    a = Lease(str(tmp_path / "l.json"), "a", 2.0, clock=lambda: now[0])
+    b = Lease(str(tmp_path / "l.json"), "b", 2.0, clock=lambda: now[0])
+    a.tick()  # a claims
+    b._write(1)  # b's racing claim rename lands after a's
+    b._claimed = True
+    assert not a.tick()[0]  # a reads b's record: claim lost
+    held, rec, seized = b.tick()
+    assert held and rec["host"] == "b"
+
+
+def test_fence_helpers(tmp_path):
+    from fps_tpu.supervise.child import (
+        fence_allows,
+        read_fence,
+        write_fence,
+    )
+
+    d = str(tmp_path)
+    assert read_fence(d) is None
+    assert fence_allows(d, None) == (True, 0)  # unfenced: everyone may
+    write_fence(d, 4, 17)
+    assert read_fence(d) == {"min_epoch": 4, "step": 17}
+    assert fence_allows(d, 5) == (True, 4)
+    assert fence_allows(d, 4) == (True, 4)
+    assert fence_allows(d, 3) == (False, 4)
+    assert fence_allows(d, None) == (False, 4)  # epoch-less writer
+
+
+def test_latest_valid_snapshot_step_stdlib_verify(tmp_path):
+    """The coordinator's stdlib-only snapshot verification: zip CRCs
+    catch truncation, non-snapshot names are ignored, and the newest
+    INTACT step wins."""
+    from fps_tpu.supervise.pod import latest_valid_snapshot_step
+
+    d = str(tmp_path)
+    assert latest_valid_snapshot_step(d) is None
+    for step in (3, 5):
+        with zipfile.ZipFile(
+                os.path.join(d, f"ckpt_{step:012d}.npz"), "w") as z:
+            z.writestr("x", b"payload" * 64)
+    open(os.path.join(d, "not_a_ckpt.npz"), "wb").write(b"junk")
+    assert latest_valid_snapshot_step(d) == 5
+    # Truncate the newest: the scan falls back to the survivor.
+    p5 = os.path.join(d, "ckpt_%012d.npz" % 5)
+    with open(p5, "r+b") as f:
+        f.truncate(os.path.getsize(p5) // 2)
+    cache = {}
+    assert latest_valid_snapshot_step(d, cache) == 3
+    assert latest_valid_snapshot_step(d, cache) == 3  # cached verdicts
+
+
+def test_pod_config_validation():
+    from fps_tpu.supervise import PodConfig
+
+    with pytest.raises(ValueError):
+        PodConfig(pod_size=0)
+    with pytest.raises(ValueError):
+        PodConfig(lease_ttl_s=0)
+    with pytest.raises(ValueError):
+        PodConfig(evict_after=0)
+
+
+def test_pod_member_rejects_bad_host(tmp_path):
+    from fps_tpu.supervise import PodMember
+
+    with pytest.raises(ValueError):
+        PodMember(["true"], pod_dir=str(tmp_path), host="a/b")
+    with pytest.raises(ValueError):
+        PodMember(["true"], pod_dir=str(tmp_path), host="")
+
+
+def test_pod_state_future_schema_refused(tmp_path):
+    from fps_tpu.supervise import PodMember
+
+    m = PodMember(["true"], pod_dir=str(tmp_path), host="h0")
+    with open(m.pod_state_path, "w", encoding="utf-8") as f:
+        json.dump({"schema": 99}, f)
+    with pytest.raises(ValueError):
+        m._load_pod_state()
+
+
+def test_child_cmd_host_template(tmp_path):
+    from fps_tpu.supervise import PodMember
+
+    m = PodMember(["run", "--dir", "{host}-work", "--plain"],
+                  pod_dir=str(tmp_path), host="h7")
+    assert m._child_cmd() == ["run", "--dir", "h7-work", "--plain"]
+
+
+def test_child_env_carries_pod_contract(tmp_path):
+    from fps_tpu.supervise import PodMember, child
+
+    m = PodMember(["true"], pod_dir=str(tmp_path), host="h1")
+    m._pod_ctx = {"epoch": 4, "world": 3, "step": 7}
+    env = m._child_env(2)
+    assert env[child.POD_HOST_ENV] == "h1"
+    assert env[child.POD_EPOCH_ENV] == "4"
+    assert env[child.POD_WORLD_ENV] == "3"
+    assert env[child.POD_STEP_ENV] == "7"
+    # Quarantine broadcast: the child's carried set comes from the POD
+    # state file, not the member's own.
+    assert env[child.STATE_ENV] == m.pod_state_path
+    assert env[child.ATTEMPT_ENV] == "2"
+
+
+def test_pod_env_parsing(monkeypatch):
+    from fps_tpu.supervise import child
+
+    for var in (child.POD_HOST_ENV, child.POD_EPOCH_ENV,
+                child.POD_WORLD_ENV, child.POD_STEP_ENV):
+        monkeypatch.delenv(var, raising=False)
+    assert child.pod_env() == {"host": None, "epoch": None, "world": None,
+                               "step": None}
+    monkeypatch.setenv(child.POD_HOST_ENV, "h2")
+    monkeypatch.setenv(child.POD_EPOCH_ENV, "5")
+    monkeypatch.setenv(child.POD_WORLD_ENV, "3")
+    monkeypatch.setenv(child.POD_STEP_ENV, "9")
+    assert child.pod_env() == {"host": "h2", "epoch": 5, "world": 3,
+                               "step": 9}
+
+
+def test_cli_pod_flag_validation(tmp_path):
+    """--pod-dir and --pod-host must travel together; --state-dir stays
+    required outside pod mode."""
+    for flags in (["--pod-dir", str(tmp_path)],
+                  ["--pod-host", "h0"],
+                  []):
+        r = subprocess.run(
+            [sys.executable, _CLI, *flags, "--", "true"],
+            capture_output=True, text=True, timeout=60, cwd=_ROOT)
+        assert r.returncode == 2, (flags, r.stderr)
+
+
+# ---------------------------------------------------------------------------
+# Full stack (slow): real jax children under the pod coordinator.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_pod_kill_one_host_bit_identical(tmp_path):
+    from fps_tpu.testing.supervised_demo import (
+        run_pod_kill_one_host_scenario,
+    )
+
+    ok, detail = run_pod_kill_one_host_scenario(str(tmp_path))
+    assert ok, detail
+
+
+@pytest.mark.slow
+def test_pod_partition_coordinator_fenced(tmp_path):
+    from fps_tpu.testing.supervised_demo import (
+        run_pod_partition_coordinator_scenario,
+    )
+
+    ok, detail = run_pod_partition_coordinator_scenario(str(tmp_path))
+    assert ok, detail
+
+
+@pytest.mark.slow
+def test_pod_flapping_member_quarantine_broadcast(tmp_path):
+    from fps_tpu.testing.supervised_demo import (
+        run_pod_flapping_member_scenario,
+    )
+
+    ok, detail = run_pod_flapping_member_scenario(str(tmp_path))
+    assert ok, detail
+
+
+@pytest.mark.slow
+def test_pod_elastic_resize_bit_identical(tmp_path):
+    from fps_tpu.testing.supervised_demo import (
+        run_pod_elastic_resize_scenario,
+    )
+
+    ok, detail = run_pod_elastic_resize_scenario(str(tmp_path))
+    assert ok, detail
+
+
+# ---------------------------------------------------------------------------
+# Review-hardening regressions.
+# ---------------------------------------------------------------------------
+
+def test_dead_host_keeps_accruing_failures_until_evicted(tmp_path):
+    """A PERMANENTLY unreachable host re-fires its staleness incident
+    every member_timeout (it must reach the elastic eviction budget —
+    one frozen incident would stick its failure count at 1 forever),
+    while the pacing stops a single partition from burning the restart
+    budget within one poll tick."""
+    from fps_tpu.supervise import PodConfig, PodMember, SupervisorConfig
+    from fps_tpu.supervise.pod import _atomic_write_json
+
+    cfg = PodConfig(pod_size=2, elastic=True, evict_after=2,
+                    member_timeout_s=0.4,
+                    member=SupervisorConfig(backoff_base_s=0.05))
+    m = PodMember(["true"], pod_dir=str(tmp_path), host="h0", config=cfg)
+    assert not m.lease.tick()[0] and m.lease.tick()[0]  # claim + confirm
+    m.is_leader = True
+    m.pod_state = m._load_pod_state()
+    m.pod_state["epoch"] = 1
+    m.pod_state["roster"] = m.pod_state["plan"] = ["h0", "h1"]
+
+    def fresh_self(status="running"):
+        _atomic_write_json(os.path.join(m.members_dir, "h0.json"),
+                           {"host": "h0", "t": time.time(),
+                            "epoch": int(m.pod_state["epoch"]),
+                            "status": status})
+
+    # h1 never writes a beacon: unreachable from the start.
+    fresh_self()
+    now = time.time()
+    m._leader_tick(now)
+    assert m.pod_state["failures"].get("h1") == 1
+    # Same tick window: the incident is deduped, no double-count.
+    m._leader_tick(now + 0.1)
+    assert m.pod_state["failures"].get("h1") == 1
+    # Past the pacing window: still unreachable -> counts again -> evicted.
+    fresh_self()
+    m._leader_tick(now + 1.0)
+    assert m.pod_state["failures"].get("h1") == 2
+    assert m.pod_state["evicted"] == ["h1"]
+    assert m.pod_state["plan"] == ["h0"]
+
+
+def test_lease_epoch_regression_reseized(tmp_path):
+    """A deposed leader frozen mid-renewal can rename a STALE (lower-
+    epoch) record over the successor's lease; observers treat the
+    regression as expiry and re-seize strictly ABOVE every epoch ever
+    seen, keeping the fencing epoch monotone."""
+    from fps_tpu.supervise.pod import Lease
+
+    now = [100.0]
+    a = Lease(str(tmp_path / "l.json"), "a", 2.0, clock=lambda: now[0])
+    b = Lease(str(tmp_path / "l.json"), "b", 2.0, clock=lambda: now[0])
+    a.tick(), a.tick()  # a holds at epoch 1
+    now[0] += 10.0
+    b.tick(), b.tick()  # expired: b seizes at epoch 2
+    assert b.tick()[0]
+    # a's frozen renewal resumes: last-writer-wins reinstalls epoch 1.
+    a._write(1)
+    held, rec, _ = b.tick()
+    assert not held  # b saw the regression and re-claimed...
+    held, rec, seized = b.tick()
+    assert held and seized and rec["epoch"] == 3  # ...strictly above max
+
+
+def test_readmit_deferred_when_sync_fails(tmp_path):
+    """A failed catch-up sync DEFERS readmission: admitting an unsynced
+    member would roll the whole pod back to its stale frontier via the
+    common-step min."""
+    from fps_tpu.supervise import PodConfig, PodMember
+
+    cfg = PodConfig(pod_size=2, elastic=True)
+    m = PodMember(["true"], pod_dir=str(tmp_path), host="h0", config=cfg)
+    assert not m.lease.tick()[0] and m.lease.tick()[0]
+    m.pod_state = m._load_pod_state()
+    m.pod_state["epoch"] = 3
+    m.pod_state["roster"] = ["h0", "h1"]
+    m.pod_state["plan"] = ["h0"]
+    m.pod_state["evicted"] = ["h1"]
+    # The pod HAS canonical progress (a valid snapshot at step 4)...
+    with zipfile.ZipFile(
+            os.path.join(str(tmp_path), "h0", "ckpt_%012d.npz" % 4),
+            "w") as z:
+        z.writestr("x", b"y" * 64)
+    # ...but the copy into h1 fails.
+    m._sync_member = lambda host: None
+    m._readmit(time.time(), "h1")
+    assert m.pod_state["evicted"] == ["h1"]  # still out
+    assert m.pod_state["plan"] == ["h0"]
+    assert m.pod_state["readmissions"] == 0
+    events = [json.loads(line)["event"] for line in
+              open(tmp_path / "journal-pod.jsonl")]
+    assert "readmit_deferred" in events
+
+
+def test_oversize_snapshot_structural_verify_only(tmp_path, monkeypatch):
+    """Past FULL_VERIFY_MAX_BYTES the scan checks zip STRUCTURE only
+    (bounded stall in the lease-renewing poll loop); under it, member
+    CRCs still catch bit rot."""
+    from fps_tpu.supervise import pod
+
+    d = str(tmp_path)
+    p = os.path.join(d, "ckpt_%012d.npz" % 7)
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("x", b"payload" * 64)
+    # Flip a payload byte: CRC now fails, structure still parses.
+    with open(p, "r+b") as f:
+        f.seek(os.path.getsize(p) // 2)
+        byte = f.read(1)[0]
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([byte ^ 0xFF]))
+    assert pod.latest_valid_snapshot_step(d) is None  # full CRC: caught
+    monkeypatch.setattr(pod, "FULL_VERIFY_MAX_BYTES", 8)
+    assert pod.latest_valid_snapshot_step(d) == 7  # structural only

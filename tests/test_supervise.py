@@ -163,6 +163,113 @@ def test_env_contract_mirrored():
     assert supervisor.HEARTBEAT_ENV == child.HEARTBEAT_ENV
     assert supervisor.STATE_ENV == child.STATE_ENV
     assert supervisor.ATTEMPT_ENV == child.ATTEMPT_ENV
+    assert supervisor.HEARTBEAT_VERSION == child.HEARTBEAT_VERSION
+
+
+def test_backoff_jitter_deterministic(tmp_path):
+    """RunSupervisor.backoff_s: bounded jitter in [base, base*(1+j)],
+    seeded from the state_dir — the SAME dir replays the exact schedule,
+    DIFFERENT dirs (pod members) desynchronize, and jitter=0 restores
+    the config's pure exponential."""
+    from fps_tpu.supervise import RunSupervisor, SupervisorConfig
+
+    cfg = SupervisorConfig(backoff_base_s=1.0, backoff_factor=2.0,
+                           backoff_max_s=8.0, backoff_jitter=0.25)
+    a = RunSupervisor(["true"], state_dir=str(tmp_path / "a"), config=cfg)
+    a2 = RunSupervisor(["true"], state_dir=str(tmp_path / "a"), config=cfg)
+    b = RunSupervisor(["true"], state_dir=str(tmp_path / "b"), config=cfg)
+    sched_a = [a.backoff_s(i) for i in range(4)]
+    assert sched_a == [a2.backoff_s(i) for i in range(4)]  # replayable
+    assert sched_a != [b.backoff_s(i) for i in range(4)]  # desynced
+    for i, s in enumerate(sched_a):
+        base = cfg.backoff_s(i)
+        assert base <= s <= base * 1.25, (i, s, base)
+    plain = RunSupervisor(
+        ["true"], state_dir=str(tmp_path / "a"),
+        config=SupervisorConfig(backoff_jitter=0.0))
+    assert plain.backoff_s(1) == plain.config.backoff_s(1)
+    with pytest.raises(ValueError):
+        SupervisorConfig(backoff_jitter=1.5)
+
+
+def test_heartbeat_rejected_unknown_version_and_wrong_host(tmp_path):
+    """Schema hardening: a beat wearing an unknown version, or a foreign
+    host in a host-pinned supervisor, is rejected LOUDLY (journal event
+    + persisted counter) and never counts as liveness or progress."""
+    from fps_tpu.supervise import RunSupervisor
+
+    sup = RunSupervisor(["true"], state_dir=str(tmp_path), host="h0")
+
+    def write_beat(rec):
+        with open(sup.heartbeat_path, "w", encoding="utf-8") as f:
+            json.dump(rec, f)
+        os.utime(sup.heartbeat_path)
+
+    write_beat({"version": 99, "index": 3})
+    assert sup._read_heartbeat() == (None, None, None)
+    write_beat({"version": 2, "host": "h1", "index": 4})  # foreign host
+    assert sup._read_heartbeat() == (None, None, None)
+    state = json.load(open(tmp_path / "supervisor_state.json"))
+    assert state["heartbeat_rejected"] == 2
+    events = [json.loads(line) for line in
+              open(tmp_path / "journal-supervisor.jsonl")]
+    rejected = [e for e in events if e["event"] == "heartbeat_rejected"]
+    assert len(rejected) == 2
+    assert "version" in rejected[0]["reason"]
+    assert "host" in rejected[1]["reason"]
+    # A valid beat (own host, or no host at all) passes.
+    write_beat({"version": 2, "host": "h0", "index": 5})
+    assert sup._read_heartbeat()[1] == 5
+    write_beat({"version": 2, "index": 6})
+    assert sup._read_heartbeat()[1] == 6
+    # Un-pinned supervisors accept any host (single-host runs).
+    anyhost = RunSupervisor(["true"], state_dir=str(tmp_path / "s2"))
+    with open(anyhost.heartbeat_path, "w", encoding="utf-8") as f:
+        json.dump({"version": 2, "host": "whoever", "index": 7}, f)
+    assert anyhost._read_heartbeat()[1] == 7
+
+
+def test_state_schema_version_and_migration(tmp_path):
+    """Version-less (v1) state files migrate by defaulting; a FUTURE
+    schema refuses loudly instead of silently reinterpreting a newer
+    supervisor's quarantine evidence."""
+    from fps_tpu.supervise import RunSupervisor
+    from fps_tpu.supervise.supervisor import STATE_SCHEMA_VERSION
+
+    state_path = tmp_path / "supervisor_state.json"
+    state_path.write_text(json.dumps(
+        {"restarts": 3, "quarantined": [7], "attempts": []}))  # v1: no schema
+    sup = RunSupervisor(["true"], state_dir=str(tmp_path))
+    assert sup.state["schema"] == STATE_SCHEMA_VERSION
+    assert sup.state["quarantined"] == [7]  # evidence carried over
+    assert sup.state["restarts"] == 3
+
+    state_path.write_text(json.dumps({"schema": STATE_SCHEMA_VERSION + 1}))
+    with pytest.raises(ValueError):
+        RunSupervisor(["true"], state_dir=str(tmp_path))
+
+
+def test_quarantine_cap_oldest_first(tmp_path):
+    """The quarantine list is bounded: past QUARANTINE_CAP entries the
+    OLDEST evict first (they protect chunks long replayed past), with a
+    journal event recording what was dropped."""
+    from fps_tpu.supervise import RunSupervisor
+    from fps_tpu.supervise.supervisor import QUARANTINE_CAP
+
+    sup = RunSupervisor(["true"], state_dir=str(tmp_path))
+    sup.state["quarantined"] = list(range(QUARANTINE_CAP + 10))
+    sup._cap_quarantine()
+    assert sup.state["quarantined"] == list(range(10, QUARANTINE_CAP + 10))
+    events = [json.loads(line) for line in
+              open(tmp_path / "journal-supervisor.jsonl")]
+    evicted = [e for e in events if e["event"] == "quarantine_evicted"]
+    assert evicted and evicted[0]["evicted"] == list(range(10))
+    # Under the cap: a no-op, no event spam.
+    sup._cap_quarantine()
+    events2 = [json.loads(line) for line in
+               open(tmp_path / "journal-supervisor.jsonl")]
+    assert len([e for e in events2
+                if e["event"] == "quarantine_evicted"]) == 1
 
 
 def test_supervisor_module_loads_without_fps_tpu(tmp_path):
@@ -303,7 +410,8 @@ def test_source_stall_classified_and_surfaced(tmp_path):
     child_code = (
         "import json, os, time\n"
         "p = os.environ['FPS_TPU_HEARTBEAT']\n"
-        "json.dump({'index': 2, 'phase': 'prefetch'}, open(p, 'w'))\n"
+        "json.dump({'version': 2, 'index': 2, 'phase': 'prefetch'},"
+        " open(p, 'w'))\n"
         "time.sleep(120)\n"
     )
     rc, digest = _run_supervised(
@@ -342,7 +450,8 @@ def test_driver_stall_not_classified_as_source(tmp_path):
     child_code = (
         "import json, os, time\n"
         "p = os.environ['FPS_TPU_HEARTBEAT']\n"
-        "json.dump({'index': 1, 'phase': 'dispatch'}, open(p, 'w'))\n"
+        "json.dump({'version': 2, 'index': 1, 'phase': 'dispatch'},"
+        " open(p, 'w'))\n"
         "time.sleep(120)\n"
     )
     rc, digest = _run_supervised(

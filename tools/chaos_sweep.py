@@ -62,11 +62,39 @@ fps_tpu.testing.workloads):
   and replays to final weights bit-identical to a straight run — a
   zero-restarted Adagrad accumulator would diverge.
 
+* ``pod_kill_one_host``        — pod of 3 member agents
+  (``fps_tpu.supervise.pod``) over one shared pod dir; ONE member's
+  child is SIGKILLed: survives iff the leader makes one pod-wide
+  decision (coordinated abort + restart of ALL members from the common
+  ``latest_valid_step``), nothing is quarantined or evicted, and every
+  member finishes bit-identical to an uninterrupted run.
+* ``pod_partition_coordinator`` — the lease HOLDER's member agent is
+  SIGSTOPped: survives iff a follower seizes the expired lease (fencing
+  epoch bump), fences every member dir, restarts the pod, the stale
+  leader's orphan child is REFUSED by the fence when it next publishes
+  (StaleEpochError in its log; no epoch-stale snapshot postdates the
+  fence), and the released leader rejoins to a bit-identical finish.
+* ``pod_flapping_member``      — one member's child crashes at the same
+  chunk on every attempt: survives iff two coordinated restarts converge
+  on a POD-WIDE quarantine of that chunk, EVERY member skips it (no host
+  re-dispatches a chunk another host proved poisonous), and all members
+  match a straight run carrying the same quarantine preset.
+* ``pod_elastic_resize``       — a whole host dies (member agent + child
+  SIGKILLed) and later returns: survives iff the leader evicts it (the
+  pod re-plans at W-1), the survivors continue, the returning member is
+  re-admitted at the next boundary from a SYNCED canonical snapshot, and
+  every member finishes byte-identical to a straight W-host run — with
+  zero torn or epoch-stale checkpoints published.
+
 The digest also carries the clean run's program CERTIFICATE
 (``fps_tpu.analysis``, ``docs/analysis.md``): the compiled logreg step
 is audited against its derived contract, so a regression in collective
 structure / donation / host-transfer freedom fails the sweep even when
 every scenario still survives.
+
+``--only SCENARIO[,SCENARIO...]`` (repeatable) runs a subset so CI can
+shard the sweep; a red run exits nonzero and names the failing
+scenarios on stderr (and in the digest's ``failed`` list).
 
 Run (CPU mesh, like the test suite):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -208,66 +236,132 @@ def supervised_scenario(tmpdir):
     return run_supervised_scenario(tmpdir)
 
 
-def main():
+def _subprocess_scenario(fn_name):
+    """A scenario that lives in fps_tpu.testing.supervised_demo and runs
+    whole child processes — imported lazily, executed in a fresh
+    tempdir."""
     import tempfile
 
-    mesh = make_ps_mesh()
-    train, test = logreg_data()
-    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
-    trainer_clean, store_clean, _ = run_logreg(mesh, chunks)
-    acc_clean = accuracy(store_clean, test)
-    certificate = program_certificate(trainer_clean, chunks)
+    def run(_harness):
+        import fps_tpu.testing.supervised_demo as demo
+
+        with tempfile.TemporaryDirectory() as d:
+            return getattr(demo, fn_name)(d)
+
+    return run
+
+
+def _harness_scenarios():
+    """Scenario registry: name -> callable(harness) -> (ok, detail|None).
+    The in-process scenarios share one lazily-built logreg harness; the
+    subprocess ones (supervised / pod) need none of it."""
+    import tempfile
+
+    def ckpt(mode):
+        def run(h):
+            with tempfile.TemporaryDirectory() as d:
+                return ckpt_scenario(d, h["mesh"], h["chunks"], mode), None
+
+        return run
+
+    return {
+        "nan_mask": lambda h: poison_scenario(
+            h["mesh"], h["chunks"], h["test"], h["acc_clean"], "nan"),
+        "inf_mask": lambda h: poison_scenario(
+            h["mesh"], h["chunks"], h["test"], h["acc_clean"], "inf"),
+        "huge_norm_mask": lambda h: poison_scenario(
+            h["mesh"], h["chunks"], h["test"], h["acc_clean"], "huge"),
+        "observe_rollback": lambda h: rollback_scenario(
+            h["mesh"], h["chunks"]),
+        "ckpt_truncate": ckpt("truncate"),
+        "ckpt_bitflip": ckpt("bitflip"),
+        "tmp_sweep": ckpt("tmp_sweep"),
+        "supervised": lambda h: supervised_scenario_tmp(),
+        "prefetch_kill": _subprocess_scenario("run_prefetch_kill_scenario"),
+        "hot_tier_kill": _subprocess_scenario("run_hot_tier_kill_scenario"),
+        "retier_kill": _subprocess_scenario("run_retier_kill_scenario"),
+        "reconcile_shard_kill": _subprocess_scenario(
+            "run_reconcile_shard_kill_scenario"),
+        "serve_while_train": _subprocess_scenario(
+            "run_serve_while_train_scenario"),
+        # Pod-level scenarios (fps_tpu.supervise.pod): N member agents
+        # over one shared pod dir — one failure domain.
+        "pod_kill_one_host": _subprocess_scenario(
+            "run_pod_kill_one_host_scenario"),
+        "pod_partition_coordinator": _subprocess_scenario(
+            "run_pod_partition_coordinator_scenario"),
+        "pod_flapping_member": _subprocess_scenario(
+            "run_pod_flapping_member_scenario"),
+        "pod_elastic_resize": _subprocess_scenario(
+            "run_pod_elastic_resize_scenario"),
+    }
+
+
+def supervised_scenario_tmp():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        return supervised_scenario(d)
+
+
+# Scenarios that need the shared in-process logreg harness (mesh, chunk
+# stream, clean-run accuracy); everything else runs pure subprocesses.
+_NEEDS_HARNESS = ("nan_mask", "inf_mask", "huge_norm_mask",
+                  "observe_rollback", "ckpt_truncate", "ckpt_bitflip",
+                  "tmp_sweep")
+
+
+def main(argv=None):
+    import argparse
+
+    scenarios = _harness_scenarios()
+    ap = argparse.ArgumentParser(
+        description="fps_tpu chaos sweep: run the fault-injector matrix "
+                    "and print a one-line survival digest")
+    ap.add_argument("--only", action="append", default=[],
+                    metavar="SCENARIO[,SCENARIO...]",
+                    help="run only these scenarios (repeatable / "
+                         "comma-separated) — lets CI shard the sweep; "
+                         f"known: {', '.join(scenarios)}")
+    args = ap.parse_args(argv)
+    selected = [s for arg in args.only for s in arg.split(",") if s]
+    unknown = sorted(set(selected) - set(scenarios))
+    if unknown:
+        ap.error(f"unknown scenario(s) {unknown}; "
+                 f"known: {sorted(scenarios)}")
+    names = [n for n in scenarios if not selected or n in selected]
+
+    harness = None
+    certificate = None
+    if any(n in _NEEDS_HARNESS for n in names) or not selected:
+        mesh = make_ps_mesh()
+        train, test = logreg_data()
+        chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+        trainer_clean, store_clean, _ = run_logreg(mesh, chunks)
+        harness = {"mesh": mesh, "test": test, "chunks": chunks,
+                   "acc_clean": accuracy(store_clean, test)}
+        # The certificate rides the full sweep (and any shard that
+        # builds the harness anyway): a collective-structure regression
+        # fails the sweep even when every scenario survives.
+        certificate = program_certificate(trainer_clean, chunks)
 
     results = {}
     detail = {}
-    results["nan_mask"], detail["nan_mask"] = poison_scenario(
-        mesh, chunks, test, acc_clean, "nan")
-    results["inf_mask"], detail["inf_mask"] = poison_scenario(
-        mesh, chunks, test, acc_clean, "inf")
-    results["huge_norm_mask"], detail["huge_norm_mask"] = poison_scenario(
-        mesh, chunks, test, acc_clean, "huge")
-    results["observe_rollback"], detail["observe_rollback"] = (
-        rollback_scenario(mesh, chunks))
-    for mode in ("truncate", "bitflip", "tmp_sweep"):
-        with tempfile.TemporaryDirectory() as d:
-            results[f"ckpt_{mode}" if mode != "tmp_sweep" else mode] = (
-                ckpt_scenario(d, mesh, chunks, mode))
-    with tempfile.TemporaryDirectory() as d:
-        results["supervised"], detail["supervised"] = supervised_scenario(d)
-    with tempfile.TemporaryDirectory() as d:
-        from fps_tpu.testing.supervised_demo import run_prefetch_kill_scenario
+    for name in names:
+        out = scenarios[name](harness)
+        ok, d = out if isinstance(out, tuple) else (out, None)
+        results[name] = bool(ok)
+        if d is not None:
+            detail[name] = d
 
-        results["prefetch_kill"], detail["prefetch_kill"] = (
-            run_prefetch_kill_scenario(d))
-    with tempfile.TemporaryDirectory() as d:
-        from fps_tpu.testing.supervised_demo import run_hot_tier_kill_scenario
-
-        results["hot_tier_kill"], detail["hot_tier_kill"] = (
-            run_hot_tier_kill_scenario(d))
-    with tempfile.TemporaryDirectory() as d:
-        from fps_tpu.testing.supervised_demo import run_retier_kill_scenario
-
-        results["retier_kill"], detail["retier_kill"] = (
-            run_retier_kill_scenario(d))
-    with tempfile.TemporaryDirectory() as d:
-        from fps_tpu.testing.supervised_demo import (
-            run_reconcile_shard_kill_scenario,
-        )
-
-        results["reconcile_shard_kill"], detail["reconcile_shard_kill"] = (
-            run_reconcile_shard_kill_scenario(d))
-    with tempfile.TemporaryDirectory() as d:
-        from fps_tpu.testing.supervised_demo import (
-            run_serve_while_train_scenario,
-        )
-
-        results["serve_while_train"], detail["serve_while_train"] = (
-            run_serve_while_train_scenario(d))
-
+    failed = sorted(n for n, ok in results.items() if not ok)
+    cert_ok = certificate is None or certificate["ok"]
     digest = {
         "chaos_sweep": results,
         "survived": sum(results.values()),
         "total": len(results),
+        # The names CI wants on a red run — also printed to stderr.
+        "failed": failed,
         # Per-scenario evidence: per-table health-counter totals and the
         # rollback/quarantine record (survival booleans alone said WHETHER
         # we lived, not WHAT the defenses saw).
@@ -275,12 +369,18 @@ def main():
         # The compiled program's contract certificate (fps_tpu.analysis):
         # collective structure regressions surface next to survival.
         "program_certificate": certificate,
-        "mesh": dict(mesh.shape),
-        "clean_test_acc": round(acc_clean, 4),
+        "clean_test_acc": (round(harness["acc_clean"], 4)
+                           if harness else None),
     }
+    if harness:
+        digest["mesh"] = dict(harness["mesh"].shape)
     print(json.dumps(digest), flush=True)
-    return 0 if (digest["survived"] == digest["total"]
-                 and certificate["ok"]) else 1
+    if failed or not cert_ok:
+        blame = list(failed) + ([] if cert_ok else ["program_certificate"])
+        print(f"chaos_sweep: FAILED scenarios: {', '.join(blame)}",
+              file=sys.stderr, flush=True)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

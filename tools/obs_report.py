@@ -69,12 +69,26 @@ _INCIDENT_EVENTS = (
     "health_abort",
     "poisoned_stream_abort",
     "checkpoint_fallback",
+    "checkpoint_fenced",
+    "checkpoint_resplit",
     "deadline_abort",
     "supervisor_restart",
     "chunk_quarantined",
+    "heartbeat_rejected",
     "supervisor_give_up",
     "supervised_run_end",
     "analysis.contract_violation",
+    # Pod coordination (journal-pod.jsonl, written into the pod dir by
+    # the lease-holding member — point this tool at the pod dir and the
+    # digest narrates the whole pod run).
+    "lease_seized",
+    "member_failed",
+    "member_evicted",
+    "member_readmitted",
+    "pod_restart",
+    "pod_quarantine",
+    "pod_give_up",
+    "pod_shutdown",
 )
 
 # Digest keys that must always be present (the smoke test asserts these —
@@ -83,7 +97,7 @@ REQUIRED_FIELDS = (
     "obs_dir", "run_ids", "processes", "chunks", "epochs", "steps",
     "examples", "phase_seconds", "health", "incidents", "checkpoint_saves",
     "quarantined", "wall_span_s", "prefetch", "hot_tier", "tiering",
-    "source_stalls", "analysis", "serve",
+    "source_stalls", "analysis", "serve", "pod",
 )
 
 
@@ -317,6 +331,29 @@ def render_digest(obs_dir: str) -> dict:
             "swaps": dict(sorted(swap_directions.items())),
             "rejected_snapshots": int(
                 counters.get("serve.rejected_snapshots", 0)),
+        },
+        # Pod coordination (fps_tpu.supervise.pod): the control-plane
+        # narrative folded from journal-pod.jsonl — lease churn, the
+        # pod-wide decisions, membership changes, and the child-side
+        # fence refusals / elastic re-splits from the run journals.
+        "pod": {
+            "lease_seizures": len(incidents.get("lease_seized", ())),
+            "member_failures": len(incidents.get("member_failed", ())),
+            "restarts": len(incidents.get("pod_restart", ())),
+            "evictions": len(incidents.get("member_evicted", ())),
+            "readmissions": len(incidents.get("member_readmitted", ())),
+            "quarantines": len(incidents.get("pod_quarantine", ())),
+            # The counter and the event fire together from _check_fence;
+            # max() so a dir holding both sources doesn't double-count.
+            "fenced_publishes": max(
+                int(counters.get("checkpoint.fenced_publishes", 0)),
+                len(incidents.get("checkpoint_fenced", ()))),
+            "resplit_restores": int(
+                counters.get("checkpoint.resplits", 0)),
+            "heartbeat_rejected": len(
+                incidents.get("heartbeat_rejected", ())),
+            "completed": bool(incidents.get("pod_shutdown")),
+            "gave_up": bool(incidents.get("pod_give_up")),
         },
         # Supervisor deadline aborts whose last heartbeat was a stalled
         # 'prefetch'-phase beat: the SOURCE wedged, not the driver.

@@ -20,6 +20,20 @@ The child signals progress by either
 Usage:
   python tools/supervise.py --state-dir CKPT_DIR [policy flags] -- CMD...
 
+Pod mode (fps_tpu/supervise/pod.py — one failure domain for a
+multi-host run): run one such process per host with a SHARED --pod-dir:
+
+  python tools/supervise.py --pod-dir POD --pod-host h0 --pod-size 3 \
+      [--elastic] [policy flags] -- CMD...
+
+Members elect a leader over an atomic-rename lease; every
+abort/restart/quarantine becomes one pod-wide, epoch-fenced decision
+(coordinated restart from the COMMON latest_valid_step; the quarantine
+set is merged and broadcast). '{host}' in CMD expands to the member's
+host name; the member's state dir (and, by convention, its child's
+checkpoint dir) is POD_DIR/HOST. See docs/resilience.md "Pod-level
+coordination".
+
 Prints the one-line JSON digest (attempts, restarts, deadline aborts,
 quarantined indices, success) and exits 0 only on child success.
 
@@ -39,12 +53,12 @@ import sys
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _load_supervisor_module():
-    """Load fps_tpu/supervise/supervisor.py WITHOUT importing the fps_tpu
+def _load_supervise_module(name: str):
+    """Load fps_tpu/supervise/<name>.py WITHOUT importing the fps_tpu
     package (whose __init__ pulls jax — the supervisor must never drag a
     TPU runtime into this process; same pattern as tests/conftest.py)."""
-    path = os.path.join(_ROOT, "fps_tpu", "supervise", "supervisor.py")
-    spec = importlib.util.spec_from_file_location("_fps_supervisor", path)
+    path = os.path.join(_ROOT, "fps_tpu", "supervise", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_fps_{name}", path)
     mod = importlib.util.module_from_spec(spec)
     # Registered BEFORE exec: dataclass creation resolves its module via
     # sys.modules on 3.10.
@@ -53,17 +67,23 @@ def _load_supervisor_module():
     return mod
 
 
+def _load_supervisor_module():
+    return _load_supervise_module("supervisor")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Run a training command under the fps_tpu deadline-abort "
                     "supervisor",
         usage="%(prog)s [flags] -- CMD [ARG...]",
     )
-    ap.add_argument("--state-dir", required=True,
+    ap.add_argument("--state-dir", default=None,
                     help="directory for supervisor_state.json, heartbeat, "
                          "supervisor journal, and per-attempt child logs "
                          "(conventionally the checkpoint dir: quarantine "
-                         "state lives next to the snapshots it protects)")
+                         "state lives next to the snapshots it protects). "
+                         "Required unless running in pod mode, where the "
+                         "member's state dir is POD_DIR/HOST")
     ap.add_argument("--stall-timeout-s", type=float, default=120.0,
                     help="liveness deadline between progress signals")
     ap.add_argument("--startup-grace-s", type=float, default=None,
@@ -87,6 +107,42 @@ def main(argv=None) -> int:
                     metavar="GLOB",
                     help="file glob whose growth also counts as liveness "
                          "(repeatable; e.g. 'OBSDIR/journal-p*.jsonl')")
+    pod = ap.add_argument_group(
+        "pod coordination (fps_tpu.supervise.pod)",
+        "run this process as ONE member of a pod: all members share "
+        "--pod-dir (a shared filesystem), elect a leader over an "
+        "atomic-rename lease, and every abort/restart/quarantine becomes "
+        "one pod-wide decision. '{host}' in the child command expands to "
+        "--pod-host; the member's state dir (and, by convention, its "
+        "child's checkpoint dir) is POD_DIR/HOST.")
+    pod.add_argument("--pod-dir", default=None,
+                     help="shared pod directory (lease, control, pod "
+                          "state, per-member subdirs); enables pod mode "
+                          "together with --pod-host")
+    pod.add_argument("--pod-host", default=None,
+                     help="this member's unique host name within the pod")
+    pod.add_argument("--pod-size", type=int, default=1,
+                     help="number of members forming the pod (the leader "
+                          "waits for all of them before the first launch)")
+    pod.add_argument("--elastic", action="store_true",
+                     help="elastic membership: evict a member whose "
+                          "failures exhaust --evict-after (the pod "
+                          "re-plans at W-1) and re-admit it when it "
+                          "returns")
+    pod.add_argument("--lease-ttl-s", type=float, default=5.0,
+                     help="leader lease expiry; any member may seize an "
+                          "expired lease (fencing epoch bump)")
+    pod.add_argument("--member-timeout-s", type=float, default=10.0,
+                     help="member-beacon staleness before the leader "
+                          "treats that host as unreachable")
+    pod.add_argument("--evict-after", type=int, default=2,
+                     help="consecutive member failures before eviction "
+                          "(elastic pods)")
+    pod.add_argument("--readmit-budget", type=int, default=2,
+                     help="re-admissions allowed per evicted host")
+    pod.add_argument("--rejoin-delay-s", type=float, default=0.5,
+                     help="cooldown before an evicted member reports "
+                          "ready again")
     ap.add_argument("--pretty", action="store_true",
                     help="indent the digest JSON")
     # Split at the first literal "--" BEFORE parsing: parse_known_args
@@ -101,6 +157,10 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if not cmd:
         ap.error("no child command given (append it after --)")
+    if bool(args.pod_dir) != bool(args.pod_host):
+        ap.error("--pod-dir and --pod-host must be given together")
+    if not args.pod_dir and not args.state_dir:
+        ap.error("--state-dir is required outside pod mode")
 
     sup_mod = _load_supervisor_module()
     config = sup_mod.SupervisorConfig(
@@ -115,11 +175,30 @@ def main(argv=None) -> int:
         poll_interval_s=args.poll_s,
         quarantine_after=args.quarantine_after,
     )
-    supervisor = sup_mod.RunSupervisor(
-        cmd, state_dir=args.state_dir, config=config,
-        watch=tuple(args.watch),
-    )
-    digest = supervisor.run()
+    if args.pod_dir:
+        pod_mod = _load_supervise_module("pod")
+        pod_config = pod_mod.PodConfig(
+            pod_size=args.pod_size,
+            elastic=args.elastic,
+            lease_ttl_s=args.lease_ttl_s,
+            member_timeout_s=args.member_timeout_s,
+            max_restarts=args.max_restarts,
+            evict_after=args.evict_after,
+            readmit_budget=args.readmit_budget,
+            rejoin_delay_s=args.rejoin_delay_s,
+            member=config,
+        )
+        member = pod_mod.PodMember(
+            cmd, pod_dir=args.pod_dir, host=args.pod_host,
+            config=pod_config, watch=tuple(args.watch),
+        )
+        digest = member.run()
+    else:
+        supervisor = sup_mod.RunSupervisor(
+            cmd, state_dir=args.state_dir, config=config,
+            watch=tuple(args.watch),
+        )
+        digest = supervisor.run()
     print(json.dumps(digest, indent=2 if args.pretty else None), flush=True)
     return 0 if digest["success"] else 1
 
