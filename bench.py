@@ -959,7 +959,11 @@ def run_tiered(args):
 
     Reports per-chunk collective count and PER-ROUTE payload bytes (hot
     reconcile vs cold pull/push — :func:`split_route_bytes`) plus
-    examples/s per arm. Acceptance signals: strictly fewer collectives
+    examples/s per arm, and an ``ssp`` sub-run: the ``head_compact``
+    configuration under bounded staleness (``sync_every > 1``),
+    measuring the compact/overflow certification rates there (the
+    carried-over ROADMAP question; surfaced fleet-wide as
+    ``cold_route_cert_rate`` in ``fps_tpu.obs.fleet`` rollups). Acceptance signals: strictly fewer collectives
     and no throughput regression for ``on`` vs ``off`` (PR 5), and a
     >= 3x cold-route byte reduction for ``head_compact`` vs ``head`` at
     a >= 0.9 hit rate (PR 10, pinned statically as the
@@ -987,29 +991,41 @@ def run_tiered(args):
     LOCAL_BATCH, SPC, CHUNKS = 1024, 8, 12
     data = _zipf_ratings(NU, NI, W * LOCAL_BATCH * SPC * CHUNKS, seed=0)
 
-    def make_chunks():
+    def make_chunks(s=None):
+        # s > 1 re-chunks the same stream for SSP mode (per-round batch
+        # layout: extra leading rounds axis).
         return epoch_chunks(data, num_workers=W, local_batch=LOCAL_BATCH,
-                            steps_per_chunk=SPC, route_key="user", seed=5)
+                            steps_per_chunk=SPC, route_key="user",
+                            sync_every=s, seed=5)
 
+    SSP_S = 2  # the ssp arm's bounded-staleness window (sync_every)
     out = {"hot_sync_every": E_SYNC, "hot_tier_rows": NI,
            "partial_head": H_PART, "cold_budget": COLD_BUDGET,
            "zipf_alpha": 1.05, "mesh": dict(mesh.shape)}
     rates = {}
-    # (label, H, cold_budget, force_gathered): the partial-head arms
-    # force the gathered cold route (dense_collectives=False) — the
-    # compaction story is about embedding-scale tables whose cold route
-    # cannot afford table-sized dense collectives; at this bench scale
-    # the item table would otherwise auto-resolve dense.
-    arms = (("off", 0, 0, False), ("on", NI, 0, False),
-            ("head", H_PART, 0, True),
-            ("head_compact", H_PART, COLD_BUDGET, True))
-    for label, H, C, gathered in arms:
+    # (label, H, cold_budget, force_gathered, sync_every): the
+    # partial-head arms force the gathered cold route
+    # (dense_collectives=False) — the compaction story is about
+    # embedding-scale tables whose cold route cannot afford table-sized
+    # dense collectives; at this bench scale the item table would
+    # otherwise auto-resolve dense. The "ssp" arm is the head_compact
+    # configuration under BOUNDED STALENESS (the carried-over ROADMAP
+    # question): the per-chunk host certification is mode-independent
+    # (raw id streams, not staleness, decide the lane), and this arm
+    # pins that with measured compact/overflow rates — surfaced
+    # fleet-wide as cold_route_cert_rate in fps_tpu.obs.fleet rollups.
+    arms = (("off", 0, 0, False, None), ("on", NI, 0, False, None),
+            ("head", H_PART, 0, True, None),
+            ("head_compact", H_PART, COLD_BUDGET, True, None),
+            ("ssp", H_PART, COLD_BUDGET, True, SSP_S))
+    for label, H, C, gathered, s in arms:
         cfg = MFConfig(num_users=NU, num_items=NI, rank=RANK,
                        learning_rate=0.05)
         # Per-id mean combine: zipf-hot duplicate ids need the averaged
         # step (run_mf's reasoning) — and it exercises the tier's
         # windowed count-normalized reconcile.
-        trainer, store = online_mf(mesh, cfg, combine="mean")
+        trainer, store = online_mf(mesh, cfg, combine="mean",
+                                   sync_every=s)
         if H:
             store.specs["item_factors"] = dataclasses.replace(
                 store.specs["item_factors"], hot_tier=H, cold_budget=C,
@@ -1020,7 +1036,8 @@ def run_tiered(args):
 
         # Static collective profile of the per-chunk program, split per
         # route (mean combine carries the count column -> counted=True).
-        hlo = trainer.lowered_chunk_text(next(make_chunks()), "sync")
+        mode = "sync" if s is None else "ssp"
+        hlo = trainer.lowered_chunk_text(next(make_chunks(s)), mode)
         profile = collective_profile(hlo)
         colls = len(profile)
         coll_bytes = sum(c.payload_bytes for c in profile)
@@ -1033,14 +1050,14 @@ def run_tiered(args):
         from itertools import islice
 
         tables, ls = trainer.init_state(jax.random.key(0))
-        trainer.fit_stream(tables, ls, islice(make_chunks(), 2),
+        trainer.fit_stream(tables, ls, islice(make_chunks(s), 2),
                            jax.random.key(9))
         rec = obs.Recorder(sinks=[])
         trainer.recorder = rec
         tables, ls = trainer.init_state(jax.random.key(0))
         t0 = time.perf_counter()
         tables, ls, m = trainer.fit_stream(
-            tables, ls, make_chunks(), jax.random.key(1))
+            tables, ls, make_chunks(s), jax.random.key(1))
         wall = time.perf_counter() - t0
         n_ex = float(sum(np.asarray(mm["n"]).sum() for mm in m))
         se = float(sum(np.asarray(mm["se"]).sum() for mm in m))
@@ -1059,11 +1076,16 @@ def run_tiered(args):
             "wall_s": round(wall, 4),
             "train_rmse": round((se / max(n_ex, 1.0)) ** 0.5, 4),
         }
+        if s is not None:
+            arm["sync_every"] = s
         if H:
             hr = rec.counter_value("hot_tier.hot_rows",
                                    table="item_factors")
             pr = rec.counter_value("hot_tier.pulled_rows",
                                    table="item_factors")
+            # None under SSP by design: reads come from the round
+            # snapshot, not the replica, so no pull counters flow
+            # (driver fold docs).
             arm["hot_hit_rate"] = round(hr / pr, 4) if pr else None
         if C:
             arm["compact_chunks"] = int(
@@ -1072,6 +1094,10 @@ def run_tiered(args):
                 "cold_route.overflow_chunks", table="item_factors"))
             arm["cold_dropped"] = int(rec.counter_value(
                 "hot_tier.cold_dropped", table="item_factors"))
+            total = arm["compact_chunks"] + arm["overflow_chunks"]
+            arm["certification_rate"] = (
+                round(arm["compact_chunks"] / total, 4) if total
+                else None)
         out[label] = arm
 
     off, on = out["off"], out["on"]
@@ -1113,7 +1139,9 @@ def run_tiered(args):
         f"{compact['cold_bytes_per_chunk']} "
         f"({out['cold_bytes_reduction_x']}x, overflow "
         f"{compact.get('overflow_chunks')}, dropped "
-        f"{compact.get('cold_dropped')})", file=sys.stderr)
+        f"{compact.get('cold_dropped')}); SSP s={SSP_S} cert rate "
+        f"{out['ssp']['certification_rate']} (overflow "
+        f"{out['ssp']['overflow_chunks']})", file=sys.stderr)
     return {
         "metric": "zipf_mf_two_tier_examples_per_sec",
         "value": on["examples_per_sec"],

@@ -37,6 +37,13 @@ some cases shipped and fixed) before:
   Every snapshot read must go through the CRC-verified paths — a raw
   ``np.load`` of a ``ckpt_*.npz`` silently accepts a torn or bit-rotted
   file the integrity layer exists to reject.
+* **FPS007 host-clock-in-builder** — ``time.time()`` /
+  ``time.perf_counter()`` (and friends) inside a compiled-fn builder
+  subtree (the FPS003 scope). A host clock read while TRACING runs once
+  at trace time and bakes a constant into the program — it measures
+  nothing, and two traces of the "same" program differ. Host timing
+  belongs in ``PhaseTimer`` (``fps_tpu.obs.timing``), outside the
+  builders; device timing belongs to the profiler.
 
 Suppression: append ``# noqa: FPSNNN`` to the flagged line — but the
 tier-1 test runs this linter over ``fps_tpu/`` expecting zero findings,
@@ -84,11 +91,25 @@ RULES = {
     "FPS006": "raw open()/np.load of a checkpoint/snapshot path outside "
               "the CRC-verified readers (core/checkpoint.py, "
               "core/snapshot_format.py, serve/)",
+    "FPS007": "host clock call (time.time/perf_counter/...) inside a "
+              "compiled-fn builder — it bakes a trace-time constant "
+              "into the program; host timing stays in PhaseTimer",
 }
 
 # Calls whose presence makes a function (and everything lexically inside
-# it) a compiled-fn builder for FPS003.
+# it) a compiled-fn builder for FPS003/FPS007.
 _TRACE_TRIGGERS = {"scan", "fori_loop", "while_loop", "shard_map"}
+
+# FPS007: host wall-clock reads that are trace-time constants inside a
+# compiled-fn builder. Bare names cover `from time import perf_counter`
+# — including bare `time` itself (`from time import time; time()`),
+# which can false-positive on a user callable named `time` inside a
+# builder; rename it or `# noqa: FPS007`.
+_HOST_CLOCKS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.thread_time",
+    "time", "perf_counter", "monotonic", "process_time", "thread_time",
+}
 
 # jnp predicates that return arrays — poison in a bool context.
 _TRACER_PREDICATES = {
@@ -233,6 +254,15 @@ class _Linter(ast.NodeVisitor):
         return False
 
     def visit_Call(self, node):
+        # FPS007: a host clock read under tracing is a constant, not a
+        # measurement (the _trace_depth scope is FPS003's).
+        if self._trace_depth and _call_name(node) in _HOST_CLOCKS:
+            self._add(
+                "FPS007", node,
+                f"{_call_name(node)}() inside a compiled-fn builder — "
+                "a host clock read at trace time bakes a constant into "
+                "the program; host timing stays in PhaseTimer "
+                "(fps_tpu.obs.timing), outside the builders")
         if not self.is_ckpt_reader:
             name = _call_name(node)
             if (name in ("open", "np.load", "numpy.load")

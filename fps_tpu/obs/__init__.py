@@ -14,6 +14,16 @@ One subsystem, four altitudes (see ``docs/observability.md``):
   :class:`StepWatchdog` deadline-flags stalled chunks/stragglers.
 * **journal** — :class:`RunJournal` writes the per-process run narrative
   that ``tools/obs_report.py`` renders into a digest.
+* **tracing** — :mod:`fps_tpu.obs.trace` mints trace/span ids propagated
+  through the supervised-child env contract, so supervisor decisions,
+  pod restarts, attempts, and chunk phases link into ONE causal tree
+  (``tools/trace_export.py`` renders Chrome/Perfetto JSON).
+* **fleet** — :mod:`fps_tpu.obs.fleet` tails N per-host obs dirs into
+  windowed rollups with declarative SLO burn-rate evaluation
+  (``tools/obs_report.py --fleet``).
+* **drift** — :mod:`fps_tpu.obs.drift` checks the live data plane's
+  measured collective traffic against the budgets pinned in
+  ``AUDIT_r*.json`` (``analysis.budget_drift`` + incidents).
 
 Everything is host-side: attaching a recorder never changes the compiled
 program (tested), and ``recorder=None`` costs nothing.
@@ -24,6 +34,13 @@ from __future__ import annotations
 import os
 
 from fps_tpu.obs import events
+from fps_tpu.obs.drift import BudgetDriftDetector, load_pinned_budgets
+from fps_tpu.obs.fleet import (
+    DEFAULT_SLOS,
+    SLO,
+    evaluate_slos,
+    fleet_digest,
+)
 from fps_tpu.obs.health import (
     HEALTH_ABORT,
     HEALTH_ESCALATE,
@@ -45,6 +62,14 @@ from fps_tpu.obs.registry import (
 )
 from fps_tpu.obs.sinks import JsonlSink, MemorySink, PrometheusSink, Sink
 from fps_tpu.obs.timing import DRIVER_PHASES, PhaseTimer, Throughput, trace
+from fps_tpu.obs.trace import (
+    PARENT_SPAN_ENV,
+    TRACE_ID_ENV,
+    TraceContext,
+    Tracer,
+    new_span_id,
+    new_trace_id,
+)
 
 __all__ = [
     "MetricSpec", "MetricsRegistry", "Recorder", "default_registry",
@@ -53,6 +78,10 @@ __all__ = [
     "HealthMonitor", "StepWatchdog",
     "HEALTH_OK", "HEALTH_ESCALATE", "HEALTH_ABORT",
     "RunJournal", "new_run_id", "config_digest", "process_index",
+    "TraceContext", "Tracer", "new_trace_id", "new_span_id",
+    "TRACE_ID_ENV", "PARENT_SPAN_ENV",
+    "BudgetDriftDetector", "load_pinned_budgets",
+    "SLO", "DEFAULT_SLOS", "evaluate_slos", "fleet_digest",
     "events", "open_run",
 ]
 
@@ -78,7 +107,17 @@ def open_run(obs_dir: str, *, config=None, run_id: str | None = None,
     run_id = run_id or new_run_id()
     proc = process_index()
     os.makedirs(obs_dir, exist_ok=True)
-    run_meta = {"process": proc, "config_digest": config_digest(config)}
+    # Causal tracing (fps_tpu.obs.trace): inherit the trace/parent-span
+    # from the supervisor env contract (or mint a standalone trace) and
+    # mint this run's own span — the journal's run_start is the causal
+    # anchor everything in this obs dir hangs under when
+    # tools/trace_export.py renders the tree. Host-side only: these are
+    # env vars and journal fields, never traced into a program.
+    ctx = TraceContext.from_env()
+    run_span = new_span_id()
+    run_meta = {"process": proc, "config_digest": config_digest(config),
+                "trace_id": ctx.trace_id or new_trace_id(),
+                "span_id": run_span, "parent_id": ctx.parent_id}
     if meta:
         run_meta.update(meta)
     journal = RunJournal(
@@ -95,6 +134,10 @@ def open_run(obs_dir: str, *, config=None, run_id: str | None = None,
         run_id=run_id,
         base_labels={"process": str(proc)},
     )
+    # The run's tracer: explicit spans emitted through it parent under
+    # this run's span by default (rec.trace.span("my_phase"): ...).
+    rec.trace = Tracer(rec, trace_id=run_meta["trace_id"],
+                       parent_id=run_span)
     if install:
         events.set_default_recorder(rec)
         _prev_close = rec.close

@@ -261,6 +261,16 @@ def default_registry() -> MetricsRegistry:
                         "collective_budget / host_transfer / donation / "
                         "dtype_drift / replica_consistency) — each also "
                         "emits an analysis.contract_violation event"),
+        # Runtime budget-drift detection (fps_tpu.obs.drift): the live
+        # data plane's measured collective traffic vs the budgets pinned
+        # in AUDIT_r*.json.
+        MetricSpec("analysis.budget_drift", "gauge", unit="ratio",
+                   labels=("program",),
+                   help="measured/pinned collective payload-byte ratio "
+                        "for one observed program (1.0 = on certified "
+                        "budget; NaN = unpinned/unbounded); departures "
+                        "beyond tolerance also emit a budget_drift "
+                        "incident event"),
     ])
 
 

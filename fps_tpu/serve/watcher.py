@@ -57,6 +57,17 @@ def _emit_metric(recorder, kind: str, name: str, value, **labels) -> None:
     events.record_metric(kind, name, value, **labels)
 
 
+def _emit_event(recorder, etype: str, **fields) -> None:
+    """Journal event through an explicit recorder, else the process
+    default — same contract as :func:`_emit_metric`."""
+    if recorder is not None:
+        recorder.event(etype, **fields)
+        return
+    from fps_tpu.obs import events
+
+    events.emit(etype, **fields)
+
+
 class _JournalTail:
     """Incremental reader of one JSONL journal that survives the file
     being truncated, replaced (rotation / supervisor restart), or not
@@ -330,6 +341,13 @@ class SnapshotWatcher:
         self.write_to_servable_s = max(0.0, now - write_wall)
         _emit_metric(self.recorder, "set", "serve.write_to_servable_s",
                      self.write_to_servable_s)
+        # Journal event beside the counters: the swap becomes a span in
+        # the exported causal trace (tools/trace_export.py) — serve-side
+        # hot-swaps link into the same tree as the publish that fed them.
+        _emit_event(self.recorder, "serve_swap", step=int(snap.step),
+                    direction=direction,
+                    write_to_servable_s=round(self.write_to_servable_s,
+                                              4))
         if self.on_swap is not None:
             self.on_swap(snap, direction)
 

@@ -40,6 +40,24 @@ POD_EPOCH_ENV = "FPS_TPU_POD_EPOCH"
 POD_WORLD_ENV = "FPS_TPU_POD_WORLD"
 POD_STEP_ENV = "FPS_TPU_POD_STEP"
 
+# Causal-tracing contract (fps_tpu/obs/trace.py is the canonical doc;
+# mirrored here because this module must stay loadable without the
+# package): the trace id of the run/pod this child belongs to, and the
+# span id of the supervisor ATTEMPT that spawned it — the child's run
+# journal (obs.open_run) links its own spans under that parent, so one
+# exported trace connects leader decision -> member attempt -> chunk.
+TRACE_ID_ENV = "FPS_TPU_TRACE_ID"
+PARENT_SPAN_ENV = "FPS_TPU_PARENT_SPAN"
+
+
+def trace_from_env() -> dict:
+    """The tracing contract from the environment: ``{"trace_id",
+    "parent_id"}`` with Nones when untraced."""
+    return {
+        "trace_id": os.environ.get(TRACE_ID_ENV) or None,
+        "parent_id": os.environ.get(PARENT_SPAN_ENV) or None,
+    }
+
 # Heartbeat schema version, written into every beat. The supervisor
 # rejects beats wearing an unknown version (or a foreign ``host``) loudly
 # instead of silently misparsing them — the cross-host beat-file

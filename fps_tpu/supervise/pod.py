@@ -359,9 +359,12 @@ class PodMember(_sup.RunSupervisor):
 
     def _pod_event(self, etype: str, **fields) -> None:
         """Pod-journal append (O_APPEND single line: safe under the brief
-        dual-writer window a lease handover allows)."""
+        dual-writer window a lease handover allows). Every record carries
+        the pod trace id (when known) so ``tools/trace_export.py`` can
+        hang the whole pod narrative under one tree."""
+        trace = (self.pod_state or {}).get("trace_id") or self.trace_id
         rec = {"kind": "event", "t": time.time(), "event": etype,
-               "host": self.host, **fields}
+               "host": self.host, "trace_id": trace, **fields}
         with open(self.pod_journal_path, "a", encoding="utf-8") as f:
             f.write(json.dumps(rec) + "\n")
             f.flush()
@@ -394,6 +397,12 @@ class PodMember(_sup.RunSupervisor):
         st.setdefault("crash_streaks", {})
         st.setdefault("handled", {})
         st.setdefault("last_control", None)
+        # Causal tracing (fps_tpu/obs/trace.py): one trace per pod run,
+        # minted by the first leader; the root span is the pod_start and
+        # every decision span hangs under it. Persisted so a seizing
+        # leader continues the SAME trace instead of forking a new one.
+        st.setdefault("trace_id", None)
+        st.setdefault("root_span", None)
         return st
 
     def _save_pod_state(self) -> None:
@@ -468,6 +477,7 @@ class PodMember(_sup.RunSupervisor):
             pass
         log_path = os.path.join(self.state_dir,
                                 f"attempt-{self._attempt}.log")
+        self._attempt_span = _sup._mint_id()
         self._child = self._spawn(self._attempt, log_path)
         self._status, self._status_kind, self._rc = "running", None, None
         self._last_index = None
@@ -482,6 +492,9 @@ class PodMember(_sup.RunSupervisor):
         self._event("attempt_start", attempt=self._attempt,
                     pid=self._child.pid, cmd=self._child_cmd(),
                     pod_epoch=(self._pod_ctx or {}).get("epoch"),
+                    trace_id=self.trace_id, span_id=self._attempt_span,
+                    parent_id=(self._pod_ctx or {}).get("span")
+                    or self.run_span,
                     quarantined=(self._pod_ctx or {}).get("quarantined",
                                                           []))
 
@@ -497,7 +510,11 @@ class PodMember(_sup.RunSupervisor):
         }
         self.state["attempts"].append(record)
         self._save_state()
-        self._event("attempt_end", **record)
+        self._event("attempt_end", trace_id=self.trace_id,
+                    span_id=self._attempt_span,
+                    parent_id=(self._pod_ctx or {}).get("span")
+                    or self.run_span,
+                    **record)
 
     def _babysit(self, now: float) -> None:
         """Non-blocking slice of RunSupervisor._run_attempt: liveness off
@@ -558,11 +575,16 @@ class PodMember(_sup.RunSupervisor):
         if action in ("shutdown", "give_up"):
             return action
         members = list(ctl.get("members", ()))
+        # Join the pod's trace: attempts spawned for this control parent
+        # under the leader's decision span.
+        if ctl.get("trace_id"):
+            self.trace_id = ctl["trace_id"]
         self._pod_ctx = {
             "epoch": self._executed_epoch,
             "world": int(ctl.get("world", len(members))),
             "step": int(ctl.get("step", 0)),
             "quarantined": list(ctl.get("quarantined", ())),
+            "span": ctl.get("span_id"),
         }
         if self.host in members:
             if self._respawns == 0:
@@ -613,9 +635,14 @@ class PodMember(_sup.RunSupervisor):
                 roster = sorted(reports)[: cfg.pod_size]
                 st["roster"] = list(roster)
                 st["plan"] = list(roster)
+                if not st.get("trace_id"):
+                    st["trace_id"] = _sup._mint_id(128)
+                    st["root_span"] = _sup._mint_id()
+                self.trace_id = st["trace_id"]
                 self._pod_event("pod_start", roster=roster,
                                 pod_size=cfg.pod_size,
-                                elastic=cfg.elastic)
+                                elastic=cfg.elastic,
+                                span_id=st["root_span"])
                 self._decide_restart(now, reason="start", failed=[],
                                      spend_budget=False)
             return
@@ -761,7 +788,8 @@ class PodMember(_sup.RunSupervisor):
             steps.append(0 if s is None else int(s))
         return min(steps) if steps else 0
 
-    def _fence_all(self, epoch: int, step: int) -> None:
+    def _fence_all(self, epoch: int, step: int,
+                   parent_id: str | None = None) -> None:
         """Drop the fencing epoch into EVERY roster member's checkpoint
         dir (evicted and unreachable hosts included — their orphaned
         children are exactly the writers the fence must stop). A fence
@@ -776,6 +804,7 @@ class PodMember(_sup.RunSupervisor):
                 floor = 0
             _child.write_fence(d, max(int(epoch), floor), step)
         self._pod_event("fence_written", min_epoch=epoch, step=step,
+                        span_id=_sup._mint_id(), parent_id=parent_id,
                         hosts=list(self.pod_state["roster"]))
 
     def _still_leader(self) -> bool:
@@ -800,10 +829,14 @@ class PodMember(_sup.RunSupervisor):
         st["epoch"] = new_epoch
         self.lease.advance_epoch(new_epoch)
         step = self._common_step()
+        # The decision's span id: rides the control record to every
+        # member, whose attempt spans parent under it — ONE coordinated
+        # restart = one span tree across all hosts in the exported trace.
+        decision_span = _sup._mint_id()
         # Fences BEFORE the control record: by the time any member (or
         # straggler child) can see the new attempt, stale publishes are
         # already refused.
-        self._fence_all(new_epoch, step)
+        self._fence_all(new_epoch, step, parent_id=decision_span)
         control = {
             "schema": 1,
             "action": "run",
@@ -813,6 +846,8 @@ class PodMember(_sup.RunSupervisor):
             "world": len(st["plan"]),
             "quarantined": list(st["quarantined"]),
             "reason": reason,
+            "trace_id": st.get("trace_id"),
+            "span_id": decision_span,
             "t": time.time(),
         }
         st["attempts"].append({
@@ -827,6 +862,8 @@ class PodMember(_sup.RunSupervisor):
                         world=len(st["plan"]), members=list(st["plan"]),
                         failed=failed, reason=reason,
                         restarts=int(st["restarts"]),
+                        span_id=decision_span,
+                        parent_id=st.get("root_span"),
                         quarantined=list(st["quarantined"]))
 
     def _readmit(self, now: float, host: str) -> None:
@@ -911,6 +948,8 @@ class PodMember(_sup.RunSupervisor):
         _atomic_write_json(self.control_path, control)
         self._pod_event(f"pod_{action}", epoch=new_epoch, reason=reason,
                         restarts=int(st["restarts"]),
+                        span_id=_sup._mint_id(),
+                        parent_id=st.get("root_span"),
                         quarantined=list(st["quarantined"]),
                         evicted=list(st["evicted"]))
 
@@ -925,8 +964,14 @@ class PodMember(_sup.RunSupervisor):
         wall = self.config.wall_deadline_s
         deadline = t0 + wall if wall is not None else None
         startup_deadline = t0 + cfg.startup_deadline_s
+        # Same span contract as RunSupervisor's supervisor_start: the
+        # member's own run span must EXIST in the journal, or attempts
+        # that fall back to it (no control span yet) dangle in the
+        # exported tree.
         self._event("pod_member_start", pod_dir=self.pod_dir,
-                    pod_size=cfg.pod_size, elastic=cfg.elastic)
+                    pod_size=cfg.pod_size, elastic=cfg.elastic,
+                    trace_id=self.trace_id, span_id=self.run_span,
+                    parent_id=self.trace_parent)
         self._write_member()
         terminal = None
         try:
@@ -942,6 +987,9 @@ class PodMember(_sup.RunSupervisor):
                     self.pod_state["epoch"] = max(
                         int(self.pod_state["epoch"]), lease_epoch)
                     self._save_pod_state()
+                    # A seizing leader continues the pod's ONE trace.
+                    if self.pod_state.get("trace_id"):
+                        self.trace_id = self.pod_state["trace_id"]
                     # epoch 1 is the pod's very first acquisition; any
                     # higher claimed epoch means a previous holder was
                     # deposed — that is a seizure.
@@ -949,6 +997,8 @@ class PodMember(_sup.RunSupervisor):
                         "lease_seized" if seized and lease_epoch > 1
                         else "lease_acquired",
                         epoch=int(self.pod_state["epoch"]),
+                        span_id=_sup._mint_id(),
+                        parent_id=self.pod_state.get("root_span"),
                         term=self.leader_terms)
                 elif not held and self.is_leader:
                     self._pod_event("lease_lost",
@@ -1014,7 +1064,8 @@ class PodMember(_sup.RunSupervisor):
             "state_path": self.state_path,
             "pod_state_path": self.pod_state_path,
         }
-        self._event("pod_member_end", **{
-            k: v for k, v in digest.items()
-            if k not in ("state_path", "pod_state_path")})
+        self._event("pod_member_end", trace_id=self.trace_id,
+                    span_id=self.run_span, **{
+                        k: v for k, v in digest.items()
+                        if k not in ("state_path", "pod_state_path")})
         return digest

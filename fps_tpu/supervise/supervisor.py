@@ -52,6 +52,23 @@ HEARTBEAT_ENV = "FPS_TPU_HEARTBEAT"
 STATE_ENV = "FPS_TPU_SUPERVISOR_STATE"
 ATTEMPT_ENV = "FPS_TPU_ATTEMPT"
 
+# Causal-tracing contract — mirrored from fps_tpu/obs/trace.py /
+# fps_tpu/supervise/child.py (same loadable-by-path reason as above;
+# tests/test_trace.py asserts the mirrors match). The supervisor stamps
+# trace_id/span_id/parent_id on its attempt_start/attempt_end journal
+# events and hands each child its attempt's span id, so the child's run
+# journal links under the attempt and tools/trace_export.py can render
+# the whole supervised run as one span tree.
+TRACE_ID_ENV = "FPS_TPU_TRACE_ID"
+PARENT_SPAN_ENV = "FPS_TPU_PARENT_SPAN"
+
+
+def _mint_id(bits: int = 64) -> str:
+    """A fresh random hex id (uuid4-backed, stdlib-only)."""
+    import uuid
+
+    return uuid.uuid4().hex[: bits // 4]
+
 # Heartbeat schema this supervisor understands — mirrored from child.py
 # (same loadable-by-path reason as the env contract above). Beats wearing
 # any other version are rejected loudly, never misparsed.
@@ -185,6 +202,14 @@ class RunSupervisor:
         # (mtime) of beats already reported bad — one loud event per
         # distinct rejected beat, not one per poll.
         self._rejected_beats: set = set()
+        # Causal tracing: inherit the trace from the environment (a pod
+        # member re-inherits the pod's trace via the control record) or
+        # mint a fresh one; the supervisor's own run is a span under the
+        # inherited parent, and every attempt is a span under that.
+        self.trace_id = os.environ.get(TRACE_ID_ENV) or _mint_id(128)
+        self.trace_parent = os.environ.get(PARENT_SPAN_ENV) or None
+        self.run_span = _mint_id()
+        self._attempt_span = None  # minted per attempt, pre-spawn
         self.state = self._load_state()
 
     def backoff_s(self, restart: int) -> float:
@@ -319,6 +344,9 @@ class RunSupervisor:
         env[HEARTBEAT_ENV] = self.heartbeat_path
         env[STATE_ENV] = self.state_path
         env[ATTEMPT_ENV] = str(attempt)
+        # The child's spans parent under THIS attempt's span.
+        env[TRACE_ID_ENV] = self.trace_id
+        env[PARENT_SPAN_ENV] = self._attempt_span or self.run_span
         return env
 
     def _child_cmd(self) -> list[str]:
@@ -400,9 +428,12 @@ class RunSupervisor:
         except OSError:
             pass
         t0 = time.monotonic()
+        self._attempt_span = _mint_id()
         proc = self._spawn(attempt, log_path)
         self._event("attempt_start", attempt=attempt, pid=proc.pid,
                     cmd=self.cmd,
+                    trace_id=self.trace_id, span_id=self._attempt_span,
+                    parent_id=self.run_span,
                     quarantined=list(self.state["quarantined"]))
         last_signal = t0
         deadline_s = (cfg.startup_grace_s if cfg.startup_grace_s is not None
@@ -468,7 +499,9 @@ class RunSupervisor:
             "runtime_s": round(time.monotonic() - t0, 3),
             "log": log_path,
         }
-        self._event("attempt_end", **record)
+        self._event("attempt_end", trace_id=self.trace_id,
+                    span_id=self._attempt_span, parent_id=self.run_span,
+                    **record)
         return record
 
     # -- the supervision loop ----------------------------------------------
@@ -483,6 +516,8 @@ class RunSupervisor:
                         if cfg.wall_deadline_s is not None else None)
         self._event("supervisor_start", cmd=self.cmd,
                     state_path=self.state_path,
+                    trace_id=self.trace_id, span_id=self.run_span,
+                    parent_id=self.trace_parent,
                     config=dataclasses.asdict(cfg))
         attempt = len(self.state["attempts"])
         restarts_this_run = 0
@@ -541,8 +576,10 @@ class RunSupervisor:
             "state_path": self.state_path,
             "journal_path": self.journal_path,
         }
-        self._event("supervised_run_end", **{
-            k: v for k, v in digest.items() if k != "journal_path"})
+        self._event("supervised_run_end", trace_id=self.trace_id,
+                    span_id=self.run_span, **{
+                        k: v for k, v in digest.items()
+                        if k != "journal_path"})
         return digest
 
     def _maybe_quarantine(self, record: dict) -> None:

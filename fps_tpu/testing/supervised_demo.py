@@ -705,6 +705,76 @@ def _pod_child_env():
     return env
 
 
+def _load_trace_export():
+    """tools/trace_export.py by file path (tools/ is not a package)."""
+    import importlib.util
+
+    path = os.path.join(_ROOT, "tools", "trace_export.py")
+    spec = importlib.util.spec_from_file_location("_fps_trace_export",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _export_pod_trace(pod_dir: str, hosts):
+    """The pod chaos scenarios' trace evidence: export the pod dir's
+    merged Chrome/Perfetto trace (written next to the journals) and
+    summarize the coordinated-restart span trees — one entry per
+    ``pod_restart`` decision, with the per-host attempt children and the
+    fencing epoch each child carries."""
+    te = _load_trace_export()
+    spans = te.collect_spans([pod_dir])
+    doc = te.export_chrome(spans)
+    out_path = os.path.join(pod_dir, "pod_trace.json")
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, default=str)
+    trees = te.coordinated_restart_trees(spans)
+    summary = []
+    for tree in trees:
+        children = tree["children"]
+        attempts = [c for c in children if c["cat"] == "attempt"]
+        epoch = tree["epoch"]
+        summary.append({
+            "epoch": epoch,
+            "children": len(children),
+            "attempt_hosts": sorted({c.get("host") for c in attempts}),
+            # The fencing epoch must ride EVERY child span: attempts
+            # carry it as pod_epoch, the fence write as min_epoch.
+            "children_carry_epoch": all(
+                (c["attrs"].get("pod_epoch")
+                 if c["cat"] == "attempt"
+                 else c["attrs"].get("min_epoch")) == epoch
+                for c in children) if children else False,
+        })
+    return {
+        "trace_path": out_path,
+        "trace_events": len(doc["traceEvents"]),
+        "spans": len(spans),
+        "restart_trees": summary,
+    }
+
+
+def _pod_fleet_digest(pod_dir: str, hosts):
+    """Fleet rollup + SLO burn over the member dirs (each holds the
+    child's --obs-dir telemetry beside its snapshots) — attached to the
+    chaos digest so the sweep carries the fleet-level evidence."""
+    from fps_tpu.obs import fleet
+
+    # The pod dir itself rides along: journal-pod.jsonl holds the
+    # pod_restart events the rollup's restart counter folds.
+    digest = fleet.fleet_digest(
+        [pod_dir] + [os.path.join(pod_dir, h) for h in hosts])
+    roll = digest["rollup"]
+    return {
+        "hosts": roll["hosts"],
+        "window_s": roll["window_s"],
+        "windows": len(roll["windows"]),
+        "totals": roll["totals"],
+        "slo": digest["slo"],
+    }
+
+
 def _launch_pod(pod_dir: str, child_args, *, hosts=SCENARIO_POD_HOSTS,
                 pod_flags=(), member_flags=()):
     """Start one pod-member process per host (each supervising its own
@@ -725,6 +795,7 @@ def _launch_pod(pod_dir: str, child_args, *, hosts=SCENARIO_POD_HOSTS,
             sys.executable, "-m", "fps_tpu.testing.supervised_demo",
             *child_args, "--keep", "20",
             "--ckpt-dir", os.path.join(pod_dir, "{host}"),
+            "--obs-dir", os.path.join(pod_dir, "{host}"),
             "--out", os.path.join(pod_dir, "{host}", "out.npz"),
         ]
         procs[h] = subprocess.Popen(
@@ -868,6 +939,17 @@ def run_pod_kill_one_host_scenario(tmpdir: str, *, timeout: float = 600):
                        "tails": {h: r["tail"] for h, r in res.items()}}
     bit_identical, bit_detail = _pod_bit_identity(
         pod_dir, SCENARIO_POD_HOSTS, straight_out)
+    trace = _export_pod_trace(pod_dir, SCENARIO_POD_HOSTS)
+    trees = trace["restart_trees"]
+    # THE tracing acceptance: the coordinated restart exports as ONE
+    # span tree — a single pod_restart parent whose per-host attempt
+    # children all carry the fencing epoch — not N disconnected
+    # per-host journal fragments.
+    trace_ok = (len(trees) == 1
+                and trees[0]["attempt_hosts"]
+                == sorted(SCENARIO_POD_HOSTS)
+                and trees[0]["children_carry_epoch"]
+                and trace["trace_events"] > 0)
     detail = {
         "digests": {h: {k: d[k] for k in
                         ("success", "attempts", "epoch", "pod")}
@@ -877,6 +959,8 @@ def run_pod_kill_one_host_scenario(tmpdir: str, *, timeout: float = 600):
         "stale_publishes": _stale_publishes(pod_dir, SCENARIO_POD_HOSTS),
         "kill_fired": os.path.exists(
             os.path.join(pod_dir, "h1", "kill_at.done")),
+        "trace": trace,
+        "fleet": _pod_fleet_digest(pod_dir, SCENARIO_POD_HOSTS),
     }
     ok = (all(r["rc"] == 0 and r["digest"]["success"]
               for r in res.values())
@@ -886,6 +970,7 @@ def run_pod_kill_one_host_scenario(tmpdir: str, *, timeout: float = 600):
           and all(d["pod"]["quarantined"] == [] for d in digests.values())
           and all(d["pod"]["evicted"] == [] for d in digests.values())
           and detail["kill_fired"]
+          and trace_ok
           and not detail["debris"] and not detail["stale_publishes"]
           and bit_identical)
     return ok, detail
@@ -980,6 +1065,24 @@ def run_pod_partition_coordinator_scenario(tmpdir: str, *,
             pass
     bit_identical, bit_detail = _pod_bit_identity(
         pod_dir, SCENARIO_POD_HOSTS, straight_out)
+    trace = _export_pod_trace(pod_dir, SCENARIO_POD_HOSTS)
+    trees = trace["restart_trees"]
+    # Tracing acceptance under partition: the pod's FINAL coordinated
+    # restart (the new leader's post-seizure decision) exports as
+    # exactly ONE span tree — one parent span at the final run epoch
+    # with attempt children from every host, each carrying the fencing
+    # epoch. (A paced unreachable-member incident may legitimately spend
+    # a second restart while the old leader is frozen; each is its own
+    # well-formed tree, and the final one must have gathered the whole
+    # pod.)
+    final = [t for t in trees if trees and t["epoch"]
+             == max(x["epoch"] for x in trees)]
+    trace_ok = (len(trees) >= 1 and len(final) == 1
+                and final[0]["attempt_hosts"]
+                == sorted(SCENARIO_POD_HOSTS)
+                and all(t["children_carry_epoch"] for t in trees
+                        if t["children"])
+                and trace["trace_events"] > 0)
     detail = {
         "stopped_leader": leader,
         "seized_by": seized_by,
@@ -990,12 +1093,14 @@ def run_pod_partition_coordinator_scenario(tmpdir: str, *,
         "bit_identical": bit_detail,
         "debris": _pod_dirs_clean(pod_dir, SCENARIO_POD_HOSTS),
         "stale_publishes": _stale_publishes(pod_dir, SCENARIO_POD_HOSTS),
+        "trace": trace,
     }
     ok = (all(r["rc"] == 0 and r["digest"]["success"]
               for r in res.values())
           and seized_by is not None and seized_by != leader
           and digests[seized_by]["leader_terms"] >= 1
           and bool(fenced_logs)
+          and trace_ok
           and all(d["pod"]["quarantined"] == [] for d in digests.values())
           and not detail["debris"] and not detail["stale_publishes"]
           and bit_identical)
@@ -1256,6 +1361,13 @@ def main(argv=None) -> int:
                     help="exit(3) at startup (before any beat) until "
                          "this file exists — the flapping member an "
                          "elastic pod must evict and later re-admit")
+    ap.add_argument("--obs-dir", default=None,
+                    help="open full on-disk telemetry here "
+                         "(fps_tpu.obs.open_run): run journal + event "
+                         "log, with the causal-trace context inherited "
+                         "from the supervisor env contract — the pod "
+                         "chaos scenarios point tools/trace_export.py "
+                         "and the fleet rollups at these")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -1303,9 +1415,24 @@ def main(argv=None) -> int:
     # A heartbeat-only recorder makes the DRIVER's sub-phase beats
     # (prefetch/ingest/dispatch, with a phase field) flow: without it the
     # only beats are this file's chunk-boundary ones and the supervisor
-    # would record last_phase=null for every mid-chunk death.
+    # would record last_phase=null for every mid-chunk death. With
+    # --obs-dir the full on-disk recorder opens instead (run journal +
+    # event log, trace context from the supervisor env) and the
+    # heartbeat sink rides it.
     rec = None
-    if hb is not None:
+    if args.obs_dir:
+        import fps_tpu.obs as obs
+
+        rec = obs.open_run(
+            args.obs_dir,
+            config={"examples": args.examples, "epochs": args.epochs},
+            meta={k: v for k, v in
+                  (("host", pod["host"]),
+                   ("workload", "supervised_demo"),
+                   ("attempt", attempt)) if v is not None})
+        if hb is not None:
+            rec.sinks.append(child.HeartbeatSink(hb))
+    elif hb is not None:
         from fps_tpu.obs import Recorder
 
         rec = Recorder(sinks=[child.HeartbeatSink(hb)])
@@ -1453,6 +1580,8 @@ def main(argv=None) -> int:
         on_chunk=on_chunk, rollback=rollback, recorder=rec,
     )
     ckpt.close()
+    if args.obs_dir and rec is not None:
+        rec.close()  # run_end + final flush (journal = the trace spine)
 
     np.savez(args.out, weights=weights(store))
     meta.update(finished=True,

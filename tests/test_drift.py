@@ -1,0 +1,219 @@
+"""Budget-drift detection (fps_tpu.obs.drift).
+
+ISSUE 12 acceptance: the detector folds the LIVE data plane (the lowered
+program a tiered MF run actually dispatches, weighted by its dispatch
+counters) against the budgets pinned in ``AUDIT_r10.json`` — a clean run
+stays quiet (gauge 1.0, zero incidents) while a seeded budget mutation
+(pinned bytes halved) fires an ``analysis.budget_drift`` incident that
+``tools/obs_report.py`` surfaces.
+"""
+
+import copy
+import importlib.util
+import json
+import math
+import os
+import sys
+
+import pytest
+
+from fps_tpu import obs
+from fps_tpu.obs.drift import (
+    BudgetDriftDetector,
+    load_pinned_budgets,
+    profile_budget,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_AUDIT = os.path.join(_ROOT, "AUDIT_r10.json")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- pinned-budget loading ----------------------------------------------
+
+
+def test_load_pinned_budgets_from_audit_r10():
+    pinned = load_pinned_budgets(_AUDIT)
+    # The r10 census: every pinned program row loads with its exact
+    # totals and per-kind split.
+    assert {"mf", "mf_tiered", "mf_tiered_compact", "logreg",
+            "w2v"} <= set(pinned)
+    mt = pinned["mf_tiered"]
+    assert mt["count"] == 4 and mt["bytes"] == 6144
+    assert mt["per_kind"]["reduce_scatter"] == {"count": 1,
+                                                "bytes": 1024}
+    lr = pinned["logreg"]
+    assert lr["count"] == 2 and lr["bytes"] == 3200
+
+
+# -- detector unit semantics --------------------------------------------
+
+
+def _pinned_one(bytes_=1000, count=2, per_kind=None):
+    return {"p": {"count": count, "bytes": bytes_,
+                  "per_kind": per_kind or {"all_gather":
+                                           {"count": 2,
+                                            "bytes": bytes_}}}}
+
+
+def test_profile_budget_normalizes_shapes():
+    class C:
+        kind, payload_bytes = "all_gather", 512
+
+    for profile in ([C(), C()],
+                    [("all_gather", 512), ("all_gather", 512)],
+                    [{"kind": "all_gather", "payload_bytes": 512}] * 2):
+        b = profile_budget(profile)
+        assert b == {"count": 2, "bytes": 1024,
+                     "per_kind": {"all_gather": {"count": 2,
+                                                 "bytes": 1024}}}
+
+
+def test_detector_quiet_within_tolerance():
+    det = BudgetDriftDetector(_pinned_one(), byte_rel_tol=0.05)
+    det.observe("p", [("all_gather", 500), ("all_gather", 510)])
+    [r] = det.evaluate(emit=False)
+    assert r.ok and r.byte_ratio == pytest.approx(1.01)
+
+
+def test_detector_flags_bytes_count_and_new_kind():
+    det = BudgetDriftDetector(_pinned_one(), byte_rel_tol=0.05)
+    det.observe("p", [("all_gather", 2000), ("all_gather", 2000),
+                      ("psum", 64)])
+    [r] = det.evaluate(emit=False)
+    assert not r.ok
+    blob = " ".join(r.reasons)
+    assert "bytes" in blob and "count 3 vs pinned 2" in blob
+    assert "unpinned collective kind 'psum'" in blob
+    assert r.byte_ratio == pytest.approx(4064 / 1000)
+
+
+def test_detector_unpinned_program_policy():
+    det = BudgetDriftDetector({}, allow_unpinned=True)
+    det.observe("new", [("all_gather", 64)])
+    [r] = det.evaluate(emit=False)
+    assert r.ok and r.byte_ratio is None
+    strict = BudgetDriftDetector({}, allow_unpinned=False)
+    strict.observe("new", [("all_gather", 64)])
+    [r] = strict.evaluate(emit=False)
+    assert not r.ok and "no pinned budget" in r.reasons[0]
+
+
+def test_detector_zero_chunk_observation_never_fires():
+    """chunks=0 moved no traffic: the report documents the (drifted)
+    ratio but evaluate() must not turn it into an incident."""
+    mem = obs.MemorySink()
+    rec = obs.Recorder(sinks=[mem])
+    det = BudgetDriftDetector(_pinned_one(), recorder=rec)
+    det.observe("p", [("all_gather", 2000)], chunks=0)
+    [r] = det.evaluate()
+    assert r.ok and r.byte_ratio == pytest.approx(2.0)
+    assert mem.events("budget_drift") == []
+
+
+def test_detector_validates_args():
+    with pytest.raises(ValueError):
+        BudgetDriftDetector({}, byte_rel_tol=-1)
+    det = BudgetDriftDetector({})
+    with pytest.raises(ValueError):
+        det.observe("p")  # neither profile nor budget
+    with pytest.raises(ValueError):
+        det.observe("p", [("a", 1)], budget={"count": 1, "bytes": 1,
+                                             "per_kind": {}})
+
+
+def test_emissions_ride_registry_and_obs_report(tmp_path):
+    """The gauge/incident telemetry validates against the default
+    registry, lands in an obs dir, and surfaces in the digest's analysis
+    section + incidents."""
+    d = str(tmp_path / "obs")
+    rec = obs.open_run(d, config=None, install=False)
+    det = BudgetDriftDetector(_pinned_one(), recorder=rec)
+    det.observe("p", [("all_gather", 500), ("all_gather", 500)],
+                chunks=3)
+    det.observe("p", [("all_gather", 2000)], chunks=1)
+    reports = det.evaluate()
+    assert [r.ok for r in reports] == [True, False]
+    rec.close()
+
+    snap_gauges = rec.snapshot()["gauges"]
+    assert snap_gauges["analysis.budget_drift{program=p}"] == 2.0
+
+    report = _load_tool("obs_report")
+    digest = report.render_digest(d)
+    assert digest["analysis"]["budget_drift_incidents"] == 1
+    assert digest["analysis"]["budget_drift_ratio_max"] == 2.0
+    [incident] = digest["incidents"]["budget_drift"]
+    assert incident["program"] == "p" and incident["chunks"] == 1
+    assert "collective bytes 2000 vs pinned 1000" in incident["reasons"][0]
+    # Strict JSON all the way out (the --json contract).
+    json.loads(json.dumps(report.digest_json(d), allow_nan=False))
+
+
+# -- acceptance: live tiered MF vs AUDIT_r10.json ------------------------
+
+
+@pytest.fixture(scope="module")
+def mf_tiered_live(devices8):
+    """The audit harness's exact mf_tiered configuration, RUN live for
+    two chunks with a recorder: (collective profile of the dispatched
+    program, chunks dispatched, recorder)."""
+    import jax
+
+    from fps_tpu.analysis import collective_profile
+    from fps_tpu.parallel.mesh import make_ps_mesh
+
+    audit = _load_tool("audit_programs")
+    mesh = make_ps_mesh(num_shards=8, num_data=1, devices=devices8[:8])
+    trainer, chunks = audit._mf_pieces(mesh, hot_tier=32,
+                                       hot_sync_every=2)
+    chunks = list(chunks)
+    hlo = trainer.lowered_chunk_text(chunks[0], "sync")
+    rec = obs.Recorder(sinks=[obs.MemorySink()])
+    trainer.recorder = rec
+    tables, ls = trainer.init_state(jax.random.key(0))
+    trainer.fit_stream(tables, ls, iter(chunks[:2]), jax.random.key(1))
+    return collective_profile(hlo), rec
+
+
+def test_clean_tiered_mf_run_stays_quiet(mf_tiered_live):
+    profile, rec = mf_tiered_live
+    chunks = int(rec.counter_value("driver.chunks"))
+    assert chunks == 2  # the live dispatch weight, from the data plane
+    det = BudgetDriftDetector(load_pinned_budgets(_AUDIT), recorder=rec)
+    det.observe("mf_tiered", profile, chunks=chunks)
+    [r] = det.evaluate()
+    assert r.ok and r.byte_ratio == pytest.approx(1.0)
+    assert r.measured_bytes == 6144 and r.measured_count == 4
+    # Quiet means QUIET: the gauge reads 1.0 and no incident event fired.
+    assert rec.snapshot()["gauges"][
+        "analysis.budget_drift{program=mf_tiered}"] == 1.0
+    assert rec.sinks[0].events("budget_drift") == []
+
+
+def test_seeded_budget_mutation_flags_incident(mf_tiered_live):
+    """Halve the pinned bytes (the ISSUE's seeded mutation): the same
+    live program now measures 2x the certified budget — the detector
+    must flag it as an analysis.budget_drift incident."""
+    profile, _ = mf_tiered_live
+    pinned = copy.deepcopy(load_pinned_budgets(_AUDIT))
+    pinned["mf_tiered"]["bytes"] //= 2
+    mem = obs.MemorySink()
+    rec = obs.Recorder(sinks=[mem])
+    det = BudgetDriftDetector(pinned, recorder=rec)
+    det.observe("mf_tiered", profile, chunks=2)
+    [r] = det.evaluate()
+    assert not r.ok
+    assert r.byte_ratio == pytest.approx(2.0)
+    [event] = mem.events("budget_drift")
+    assert event["program"] == "mf_tiered"
+    assert math.isclose(event["byte_ratio"], 2.0)
+    assert any("bytes" in reason for reason in event["reasons"])

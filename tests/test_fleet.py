@@ -1,0 +1,196 @@
+"""Fleet rollups + SLO burn (fps_tpu.obs.fleet, obs_report --fleet).
+
+Synthetic per-host obs dirs (the aggregator is a pure JSONL consumer)
+pin the windowing math, the fleet signals (throughput, tiering hit rate,
+cold-route certification rate, freshness, restart/fence counts), the SLO
+burn-rate semantics, and the ``tools/obs_report.py --fleet`` CLI.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from fps_tpu.obs.fleet import (
+    DEFAULT_SLOS,
+    SLO,
+    evaluate_slos,
+    fleet_digest,
+    host_series,
+    rollup,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_report():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(_ROOT, "tools", "obs_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _metric(t, name, value, mtype="counter", **labels):
+    rec = {"kind": "metric", "t": t, "name": name, "mtype": mtype,
+           "value": value}
+    if labels:
+        rec["labels"] = labels
+    return rec
+
+
+def _event(t, etype, **fields):
+    return {"kind": "event", "t": t, "event": etype, **fields}
+
+
+def _write(path, records):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def _host_dir(tmp_path, name, *, t0, chunks=4, restart_at=None):
+    """One host's synthetic trail: per-chunk counter increments 10s
+    apart, a freshness gauge, and optionally a supervisor restart."""
+    d = str(tmp_path / name)
+    events = []
+    journal = [_event(t0, "run_start", run_id=name + "-run")]
+    for i in range(chunks):
+        t = t0 + 10.0 * i
+        events += [
+            _metric(t, "driver.chunks", 1),
+            _metric(t, "driver.examples", 1000),
+            _metric(t, "hot_tier.hot_rows", 90, table="item"),
+            _metric(t, "hot_tier.pulled_rows", 100, table="item"),
+            _metric(t, "cold_route.compact_chunks", 1),
+            _metric(t, "serve.write_to_servable_s", 2.0 + i,
+                    mtype="gauge"),
+        ]
+        if i == chunks - 1:
+            events.append(_metric(t, "cold_route.overflow_chunks", 1,
+                                  table="item"))
+    if restart_at is not None:
+        journal.append(_event(t0 + restart_at, "supervisor_restart",
+                              attempt=1))
+    _write(os.path.join(d, "events-p0.jsonl"), events)
+    _write(os.path.join(d, "journal-supervisor.jsonl"), journal)
+    return d
+
+
+def test_host_series_and_totals(tmp_path):
+    d = _host_dir(tmp_path, "h0", t0=1000.0)
+    s = host_series(d)
+    assert sum(v for _, v in s["counters"]["driver.examples"]) == 4000
+    assert len(s["samples"]["serve.write_to_servable_s"]) == 4
+
+    roll = rollup([d], num_windows=1)
+    tot = roll["totals"]
+    assert tot["examples"] == 4000
+    assert tot["chunks"] == 4
+    assert tot["hot_hit_rate"] == pytest.approx(0.9)
+    # 4 compact + 1 overflow chunk-samples -> 0.8 certification.
+    assert tot["cold_route_cert_rate"] == pytest.approx(0.8)
+    assert tot["freshness_s_max"] == pytest.approx(5.0)
+    assert tot["restarts"] == 0
+
+
+def test_rollup_windows_split_and_fold_hosts(tmp_path):
+    d0 = _host_dir(tmp_path, "h0", t0=1000.0)
+    d1 = _host_dir(tmp_path, "h1", t0=1000.0, restart_at=15.0)
+    roll = rollup([d0, d1], window_s=20.0)
+    assert roll["hosts"] == ["h0", "h1"]
+    assert roll["window_s"] == 20.0
+    # Span 0..30s -> two 20s windows.
+    assert len(roll["windows"]) == 2
+    w0, w1 = roll["windows"]
+    # Window 0 holds chunk samples at t=0s,10s from BOTH hosts.
+    assert w0["examples"] == 4000 and w1["examples"] == 4000
+    assert w0["restarts"] == 1 and w1["restarts"] == 0
+    assert w0["examples_per_sec"] == pytest.approx(200.0)
+    # The totals row folds both hosts across the whole span.
+    assert roll["totals"]["examples"] == 8000
+    assert roll["totals"]["restarts"] == 1
+
+
+def test_rollup_empty_dirs(tmp_path):
+    d = str(tmp_path / "empty")
+    os.makedirs(d)
+    roll = rollup([d])
+    assert roll["windows"] == [] and roll["totals"] is None
+    digest = fleet_digest([d])
+    assert digest["slo"] == {s.name: pytest.approx(
+        digest["slo"][s.name]) for s in DEFAULT_SLOS}  # shape only
+    for v in digest["slo"].values():
+        assert v["windows_evaluated"] == 0 and v["ok"]
+
+
+def test_slo_semantics_and_burn_rate():
+    slo = SLO("fresh", "freshness_s_max", "<=", 10.0, objective=0.9)
+    assert slo.good(5.0) and not slo.good(11.0) and slo.good(None) is None
+    with pytest.raises(ValueError):
+        SLO("bad", "x", "==", 1.0)
+    with pytest.raises(ValueError):
+        SLO("bad", "x", ">=", 1.0, objective=1.5)
+
+    windows = [{"freshness_s_max": v} for v in (5.0, 12.0, None, 5.0,
+                                                 5.0)]
+    out = evaluate_slos({"windows": windows}, [slo])["fresh"]
+    # 4 evaluated, 1 bad -> bad_fraction 0.25; error budget 0.1 ->
+    # burn 2.5: the objective is being missed 2.5x faster than allowed.
+    assert out["windows_evaluated"] == 4
+    assert out["bad_windows"] == 1
+    assert out["bad_fraction"] == pytest.approx(0.25)
+    assert out["burn_rate"] == pytest.approx(2.5)
+    assert out["ok"] is False
+
+    clean = evaluate_slos(
+        {"windows": [{"freshness_s_max": 1.0}] * 10}, [slo])["fresh"]
+    assert clean["ok"] and clean["burn_rate"] == 0.0
+
+
+def test_fleet_digest_slo_burn_on_synthetic_fleet(tmp_path):
+    d0 = _host_dir(tmp_path, "h0", t0=1000.0)
+    d1 = _host_dir(tmp_path, "h1", t0=1000.0, restart_at=5.0)
+    digest = fleet_digest([d0, d1], window_s=8.0)
+    assert digest["schema"] == 1
+    slo = digest["slo"]
+    assert set(slo) == {s.name for s in DEFAULT_SLOS}
+    # Certification dips below 0.9 only in the overflow window.
+    cert = slo["cold_route_certification"]
+    assert cert["windows_evaluated"] >= 3 and cert["bad_windows"] == 1
+    # One restart window out of 4 at objective 0.75 -> burn 1.0 (ok:
+    # the budget is exactly spent, not overspent).
+    rst = slo["restart_quiet"]
+    assert rst["windows_evaluated"] == 4
+    assert rst["bad_windows"] == 1 and rst["ok"]
+    assert slo["budget_drift_quiet"]["bad_windows"] == 0
+
+
+def test_obs_report_fleet_cli(tmp_path, capsys):
+    report = _load_report()
+    d0 = _host_dir(tmp_path, "h0", t0=1000.0)
+    d1 = _host_dir(tmp_path, "h1", t0=1000.0)
+    assert report.main(["--fleet", d0, d1, "--window-s", "20",
+                        "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["schema"] == 1
+    assert out["rollup"]["hosts"] == ["h0", "h1"]
+    assert out["rollup"]["totals"]["examples"] == 8000
+    assert set(out["slo"]) == {s.name for s in DEFAULT_SLOS}
+    # Host digests ride along (the member dirs hold supervisor journals
+    # only -> the standard digest still renders, with zero chunks... or
+    # None when a dir has no digestible files at all).
+    assert set(out["host_digests"]) == {"h0", "h1"}
+    assert out["host_digests"]["h0"]["schema"] == 1
+
+    # Multiple dirs without --fleet is an error, as is --json --pretty.
+    with pytest.raises(SystemExit):
+        report.main([d0, d1])
+    with pytest.raises(SystemExit):
+        report.main([d0, "--json", "--pretty"])
+    # Empty fleet: loud exit 2.
+    empty = str(tmp_path / "none")
+    os.makedirs(empty)
+    assert report.main(["--fleet", empty]) == 2

@@ -245,6 +245,78 @@ def test_fps006_sanctioned_readers_are_exempt():
 
 
 # ---------------------------------------------------------------------------
+# FPS007 — host clock calls inside compiled-fn builder subtrees.
+# ---------------------------------------------------------------------------
+
+
+def test_fps007_flags_host_clock_in_builder():
+    src = """
+    import time
+    from jax import lax
+
+    def build():
+        def step(c, x):
+            t = time.perf_counter()
+            return c, t
+        return lax.scan(step, 0, None)
+    """
+    assert rules_of(src) == ["FPS007"]
+    # Every clock spelling flags, bare imports included — `from time
+    # import time; time()` too.
+    for call in ("time.time()", "time.monotonic()", "perf_counter()",
+                 "time()"):
+        one = f"""
+        from time import perf_counter
+        import time
+        from jax import lax
+
+        def build():
+            def step(c, x):
+                return c, {call}
+            return lax.scan(step, 0, None)
+        """
+        assert rules_of(one) == ["FPS007"], call
+
+
+def test_fps007_outside_builder_is_clean():
+    # No trace trigger anywhere: the timing module's own PhaseTimer
+    # pattern stays legal.
+    src = """
+    import time
+
+    def phase():
+        t0 = time.perf_counter()
+        return time.perf_counter() - t0
+    """
+    assert rules_of(src) == []
+    # Non-clock time.* calls inside a builder stay legal too.
+    src2 = """
+    import time
+    from jax import lax
+
+    def build():
+        def step(c, x):
+            return c, x
+        time.sleep(0)
+        return lax.scan(step, 0, None)
+    """
+    assert rules_of(src2) == []
+
+
+def test_fps007_noqa_and_explain():
+    src = """
+    import time
+    from jax import lax
+
+    def build():
+        t = time.time()  # noqa: FPS007
+        return lax.scan(lambda c, x: (c, x), 0, None)
+    """
+    assert rules_of(src) == []
+    assert "FPS007" in RULES and "PhaseTimer" in RULES["FPS007"]
+
+
+# ---------------------------------------------------------------------------
 # Machinery: noqa, syntax errors, file walking, the CI gate.
 # ---------------------------------------------------------------------------
 
@@ -283,7 +355,7 @@ def test_lint_paths_walks_and_selects(tmp_path):
 
 def test_rule_table_is_complete():
     assert set(RULES) == {"FPS001", "FPS002", "FPS003", "FPS004", "FPS005",
-                          "FPS006"}
+                          "FPS006", "FPS007"}
 
 
 def test_package_lints_clean():

@@ -515,3 +515,92 @@ def test_recorder_off_and_on_compile_identically(devices8):
 
     assert lowered_text(None) == lowered_text(
         obs.Recorder(sinks=[obs.MemorySink()]))
+
+
+# ---------------------------------------------------------------------------
+# Registry completeness (ISSUE 12 satellite): every metric name the
+# package emits has a spec — the silently-unregistered-metric class.
+# ---------------------------------------------------------------------------
+
+_METRIC_NAME_RE = None  # compiled lazily below
+
+
+def _emitted_metric_names():
+    """AST scan of fps_tpu/ for metric emissions: ``<recv>.inc/set/
+    observe("name", ...)`` calls, the ``events.record_metric(kind,
+    "name", ...)`` indirection, and wrapper helpers (``_emit_metric`` /
+    ``_inc``-style) — the first string argument shaped like a dotted
+    metric name is the emission."""
+    import ast
+    import re
+
+    name_re = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+    emitters = {"inc", "set", "observe", "record_metric",
+                "_emit_metric", "_obs_metric", "_inc", "_set",
+                "_observe"}
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "fps_tpu")
+    found = {}  # name -> first "path:line" site
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                leaf = (func.attr if isinstance(func, ast.Attribute)
+                        else func.id if isinstance(func, ast.Name)
+                        else None)
+                if leaf not in emitters:
+                    continue
+                for arg in node.args:
+                    if (isinstance(arg, ast.Constant)
+                            and isinstance(arg.value, str)
+                            and name_re.match(arg.value)):
+                        found.setdefault(
+                            arg.value,
+                            f"{os.path.relpath(path, root)}:"
+                            f"{node.lineno}")
+                        break
+    return found
+
+
+def test_every_emitted_metric_name_is_registered():
+    """The silently-unregistered-metric class: an emission through the
+    process-default path (events.record_metric) degrades to a logged
+    DROP when its name has no spec — this scan fails the build instead,
+    for every emission site anywhere in fps_tpu/."""
+    emitted = _emitted_metric_names()
+    # Non-vacuity: the scan must see the known emission styles — direct
+    # recorder calls (driver), the process-default indirection
+    # (checkpoint), and the serve-side _emit_metric wrapper.
+    for expected in ("driver.chunks", "checkpoint.saves",
+                     "serve.rejected_snapshots",
+                     "analysis.budget_drift",
+                     "analysis.certified_programs"):
+        assert expected in emitted, f"scan lost {expected}"
+    registry = obs.default_registry()
+    unregistered = {name: site for name, site in sorted(emitted.items())
+                    if name not in registry}
+    assert not unregistered, (
+        "metric(s) emitted without a MetricSpec in "
+        f"obs/registry.py: {unregistered}")
+
+
+def test_registry_scan_catches_a_seeded_unregistered_emission(tmp_path):
+    """The scanner itself is not vacuous: a seeded emission of an
+    unknown name would be caught by the same name-shape matcher."""
+    import ast
+    import re
+
+    name_re = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+    src = 'rec.inc("totally.unregistered_metric", 2, table="x")\n'
+    call = ast.parse(src).body[0].value
+    [arg] = [a for a in call.args if isinstance(a, ast.Constant)
+             and isinstance(a.value, str) and name_re.match(a.value)]
+    assert arg.value not in obs.default_registry()

@@ -64,6 +64,19 @@ def test_obs_report_digest_from_logreg_run(devices8, capsys, tmp_path):
     line = capsys.readouterr().out.strip()
     assert json.loads(line)["chunks"] == 2
 
+    # --json: the pinned machine contract — identical payload, compact,
+    # versioned schema field, strict JSON (digest_json is the importable
+    # form fps_tpu/obs/fleet.py consumers use).
+    assert report.main([obs_dir, "--json"]) == 0
+    machine = json.loads(capsys.readouterr().out.strip())
+    assert machine["schema"] == report.DIGEST_SCHEMA_VERSION
+    assert machine == report.digest_json(obs_dir)
+    # The causal-trace anchor rides the journal: the run_start carries
+    # trace/span ids (fps_tpu.obs.trace) without perturbing the digest.
+    journal = os.path.join(obs_dir, "journal-p0.jsonl")
+    start = json.loads(open(journal).readline())
+    assert start["event"] == "run_start" and start["span_id"]
+
 
 def test_obs_report_surfaces_incidents(tmp_path):
     """Rollback / stall / escalation / checkpoint-fallback events written

@@ -92,6 +92,14 @@ is audited against its derived contract, so a regression in collective
 structure / donation / host-transfer freedom fails the sweep even when
 every scenario still survives.
 
+The pod scenarios additionally export their CAUSAL TRACE
+(``tools/trace_export.py``: one merged Chrome/Perfetto span tree per pod
+dir — ``pod_kill_one_host`` and ``pod_partition_coordinator`` fail
+unless the coordinated restart is a single parent span whose per-host
+attempt children all carry the fencing epoch) and a FLEET rollup + SLO
+burn section (``fps_tpu.obs.fleet`` over the member obs dirs), lifted
+into the digest's top-level ``fleet`` field.
+
 ``--only SCENARIO[,SCENARIO...]`` (repeatable) runs a subset so CI can
 shard the sweep; a red run exits nonzero and names the failing
 scenarios on stderr (and in the digest's ``failed`` list).
@@ -369,6 +377,12 @@ def main(argv=None):
         # The compiled program's contract certificate (fps_tpu.analysis):
         # collective structure regressions surface next to survival.
         "program_certificate": certificate,
+        # Fleet rollup + SLO burn over the pod scenario's member obs
+        # dirs (fps_tpu.obs.fleet, computed inside the scenario before
+        # its tempdir is collected): the sweep's fleet-level telemetry
+        # evidence — throughput, cold-route certification rate, restart
+        # counts, and burn-rate verdicts ride the digest.
+        "fleet": (detail.get("pod_kill_one_host") or {}).get("fleet"),
         "clean_test_acc": (round(harness["acc_clean"], 4)
                            if harness else None),
     }

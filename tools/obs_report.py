@@ -42,8 +42,17 @@ join on ``run_id``) and prints a single JSON digest:
 Pure host tool: no jax import, safe to run on a login node against a
 live or finished run directory.
 
+Fleet mode (``--fleet DIR [DIR...]``) aggregates N per-host obs dirs
+through ``fps_tpu/obs/fleet.py`` (loaded by file path, still jax-free):
+windowed rollups (throughput, tiering hit rate, cold-route certification
+rate, write→servable freshness, restart/fence counts) plus SLO burn-rate
+evaluation, with each host's standard digest attached. ``--json`` pins
+the machine-readable contract: compact strict JSON, non-finite floats
+scrubbed to null, and a versioned ``schema`` field.
+
 Usage:
-  python tools/obs_report.py RUN_DIR [--pretty]
+  python tools/obs_report.py RUN_DIR [--pretty|--json]
+  python tools/obs_report.py --fleet HOST_DIR... [--window-s S] [--json]
 """
 
 from __future__ import annotations
@@ -78,6 +87,9 @@ _INCIDENT_EVENTS = (
     "supervisor_give_up",
     "supervised_run_end",
     "analysis.contract_violation",
+    # Runtime budget-drift detection (fps_tpu.obs.drift): measured
+    # collective traffic departed from the AUDIT_r*.json pinned shape.
+    "budget_drift",
     # Pod coordination (journal-pod.jsonl, written into the pod dir by
     # the lease-holding member — point this tool at the pod dir and the
     # digest narrates the whole pod run).
@@ -92,12 +104,16 @@ _INCIDENT_EVENTS = (
 )
 
 # Digest keys that must always be present (the smoke test asserts these —
-# consumers can rely on the shape even for an empty run).
+# consumers can rely on the shape even for an empty run). The digest is
+# versioned: DIGEST_SCHEMA_VERSION bumps whenever an existing field
+# changes meaning (new fields may appear without a bump) — `--json`
+# consumers (CI, fps_tpu/obs/fleet.py) key on it instead of scraping.
+DIGEST_SCHEMA_VERSION = 1
 REQUIRED_FIELDS = (
-    "obs_dir", "run_ids", "processes", "chunks", "epochs", "steps",
-    "examples", "phase_seconds", "health", "incidents", "checkpoint_saves",
-    "quarantined", "wall_span_s", "prefetch", "hot_tier", "tiering",
-    "source_stalls", "analysis", "serve", "pod",
+    "schema", "obs_dir", "run_ids", "processes", "chunks", "epochs",
+    "steps", "examples", "phase_seconds", "health", "incidents",
+    "checkpoint_saves", "quarantined", "wall_span_s", "prefetch",
+    "hot_tier", "tiering", "source_stalls", "analysis", "serve", "pod",
 )
 
 
@@ -248,6 +264,7 @@ def render_digest(obs_dir: str) -> dict:
         ph["max_s"] = round(ph["max_s"], 6)
 
     digest = {
+        "schema": DIGEST_SCHEMA_VERSION,
         "obs_dir": os.path.abspath(obs_dir),
         "run_ids": sorted(run_ids),
         "config_digests": sorted(config_digests),
@@ -308,6 +325,15 @@ def render_digest(obs_dir: str) -> dict:
                 counters.get("analysis.certified_programs", 0)),
             "contract_violations": int(
                 counters.get("analysis.contract_violations", 0)),
+            # Runtime budget drift (fps_tpu.obs.drift): the gauge's
+            # last/max measured-vs-pinned byte ratio and how many
+            # departure incidents fired (events ride incidents verbatim).
+            "budget_drift_ratio_last": gauges.get(
+                "analysis.budget_drift", {}).get("last"),
+            "budget_drift_ratio_max": gauges.get(
+                "analysis.budget_drift", {}).get("max"),
+            "budget_drift_incidents": len(
+                incidents.get("budget_drift", ())),
         },
         # Read-path serving tier (fps_tpu.serve; docs/serving.md): query
         # volume, exact request-latency quantiles over every recorded
@@ -388,33 +414,98 @@ def render_digest(obs_dir: str) -> dict:
     return digest
 
 
+# Strict JSON out: a NaN gauge (serving outage marker) prints as
+# null, never the Python-only NaN token — the digest's consumers
+# include jq and non-Python tooling. Mirrors
+# fps_tpu.obs.sinks.scrub_nonfinite (this tool stays import-free).
+def scrub(x):
+    if isinstance(x, dict):
+        return {k: scrub(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [scrub(v) for v in x]
+    if isinstance(x, float) and not math.isfinite(x):
+        return None
+    return x
+
+
+def digest_json(obs_dir: str) -> dict:
+    """The `--json` payload: the digest with every non-finite float
+    scrubbed to null — the stable machine-readable schema
+    (``DIGEST_SCHEMA_VERSION``) CI and ``fps_tpu/obs/fleet.py`` consume
+    without scraping text."""
+    return scrub(render_digest(obs_dir))
+
+
+def _load_fleet():
+    """fps_tpu/obs/fleet.py by FILE PATH (the tools/supervise.py
+    pattern): importing the package would drag fps_tpu/__init__ — and
+    with it jax — into a tool whose contract is running on login nodes."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "fps_tpu", "obs", "fleet.py")
+    spec = importlib.util.spec_from_file_location("_fps_obs_fleet", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclasses resolve via sys.modules
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="Render an fps_tpu --obs-dir into a one-line run digest")
-    ap.add_argument("obs_dir", help="directory written by --obs-dir / "
-                                    "fps_tpu.obs.open_run")
+        description="Render fps_tpu --obs-dir telemetry into a run "
+                    "digest (one dir) or a fleet rollup + SLO burn "
+                    "report (--fleet, N dirs)")
+    ap.add_argument("obs_dirs", nargs="+", metavar="OBS_DIR",
+                    help="directory written by --obs-dir / "
+                         "fps_tpu.obs.open_run (with --fleet: one per "
+                         "host/member)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="aggregate the dirs as one fleet: windowed "
+                         "rollups (throughput, tiering hit rate, "
+                         "cold-route certification rate, freshness, "
+                         "restart/fence counts) + SLO burn rates "
+                         "(fps_tpu.obs.fleet), with each host's "
+                         "standard digest attached")
+    ap.add_argument("--window-s", type=float, default=None,
+                    help="fleet rollup window width in seconds "
+                         "(default: span/6)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output: compact strict JSON "
+                         "with non-finite floats scrubbed to null and a "
+                         "versioned 'schema' field — the contract for "
+                         "CI and fleet consumers (the default output is "
+                         "the same JSON; --json pins it and refuses "
+                         "--pretty)")
     ap.add_argument("--pretty", action="store_true",
                     help="indent the JSON for humans")
     args = ap.parse_args(argv)
-    try:
-        digest = render_digest(args.obs_dir)
-    except FileNotFoundError as e:
-        print(str(e), file=sys.stderr)
-        return 2
-    # Strict JSON out: a NaN gauge (serving outage marker) prints as
-    # null, never the Python-only NaN token — the digest's consumers
-    # include jq and non-Python tooling. Mirrors
-    # fps_tpu.obs.sinks.scrub_nonfinite (this tool stays import-free).
-    def scrub(x):
-        if isinstance(x, dict):
-            return {k: scrub(v) for k, v in x.items()}
-        if isinstance(x, list):
-            return [scrub(v) for v in x]
-        if isinstance(x, float) and not math.isfinite(x):
-            return None
-        return x
+    if args.json and args.pretty:
+        ap.error("--json is the compact machine form; drop --pretty")
+    if not args.fleet and len(args.obs_dirs) > 1:
+        ap.error("multiple OBS_DIRs need --fleet")
 
-    print(json.dumps(scrub(digest), indent=2 if args.pretty else None,
+    if args.fleet:
+        fleet = _load_fleet()
+        def _digest_or_none(d):
+            try:
+                return render_digest(d)
+            except FileNotFoundError:
+                return None
+
+        out = fleet.fleet_digest(args.obs_dirs, window_s=args.window_s,
+                                 digest_fn=_digest_or_none)
+        if not out["rollup"]["windows"]:
+            print(f"no telemetry under {args.obs_dirs}", file=sys.stderr)
+            return 2
+    else:
+        try:
+            out = render_digest(args.obs_dirs[0])
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+
+    print(json.dumps(scrub(out), indent=2 if args.pretty else None,
                      allow_nan=False))
     return 0
 
