@@ -1668,9 +1668,169 @@ def run_ials(args):
     }
 
 
+def run_megastep_ab(args):
+    """Per-chunk dispatch vs K-chunk megastep on tiered MF (8-device
+    mesh): the SAME tiered, cold-budgeted, device-ingested workload
+    driven two ways —
+
+    * **per_chunk** — ``run_indexed`` with ``max_steps_per_call`` = one
+      chunk: every chunk pays Python dispatch, host key folding, and
+      metric bookkeeping between compiled calls;
+    * **megastep** — ``run_megastep`` fusing K of those chunks into ONE
+      compiled program (``fps_tpu.core.megastep``): reconcile / sketch
+      boundaries run in-graph and the device-side overflow VOTE selects
+      the compacted cold routes per window (no host id stream exists on
+      this path — the gap PR 10 left).
+
+    Acceptance signals: megastep examples/s >= 1.3x per-chunk, final
+    tables BIT-IDENTICAL across the two drivers, and the megastep
+    program's collective census unchanged when K doubles (the
+    O(traffic)-not-O(K) claim, also pinned statically by
+    ``tools/audit_programs.py``'s ``mf_megastep`` rows)."""
+    import dataclasses
+
+    import jax
+
+    from fps_tpu import obs
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.parallel.mesh import default_mesh_shape, make_ps_mesh
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        return _reexec_workload_subprocess("megastep")
+    nd, ns = default_mesh_shape(8)
+    mesh = make_ps_mesh(num_shards=ns, num_data=nd, devices=devs[:8])
+    W = num_workers_of(mesh)
+
+    NU, NI, RANK = 4096, 4096, 16
+    E_SYNC = 4
+    H_PART = 2048
+    COLD_BUDGET = 8  # ~3x the expected per-(step, worker) cold rows
+    # Sized for the dispatch-bound regime the megastep targets: small
+    # per-chunk compute (the TPU ratio — sub-ms steps behind a ~ms host
+    # round-trip per dispatch), many chunks. The per-chunk arm then
+    # pays ~CHUNKS host round-trips per epoch where the megastep pays
+    # CHUNKS/K.
+    LOCAL_BATCH, SPC, CHUNKS, K = 32, 2, 768, 16
+    EPOCHS = 2
+    data = _zipf_ratings(NU, NI, W * LOCAL_BATCH * SPC * CHUNKS, seed=0)
+
+    def make_trainer():
+        cfg = MFConfig(num_users=NU, num_items=NI, rank=RANK,
+                       learning_rate=0.05)
+        trainer, store = online_mf(mesh, cfg, combine="mean",
+                                   max_steps_per_call=SPC)
+        store.specs["item_factors"] = dataclasses.replace(
+            store.specs["item_factors"], hot_tier=H_PART,
+            cold_budget=COLD_BUDGET, dense_collectives=False)
+        trainer.config = dataclasses.replace(
+            trainer.config, hot_sync_every=E_SYNC)
+        plan = DeviceEpochPlan(
+            DeviceDataset(mesh, data), num_workers=W,
+            local_batch=LOCAL_BATCH, route_key="user", seed=5)
+        return trainer, store, plan
+
+    out = {"chunks_per_dispatch": K, "steps_per_chunk": SPC,
+           "partial_head": H_PART, "cold_budget": COLD_BUDGET,
+           "hot_sync_every": E_SYNC, "epochs": EPOCHS,
+           "mesh": dict(mesh.shape)}
+    finals = {}
+    for label in ("per_chunk", "megastep"):
+        trainer, store, plan = make_trainer()
+
+        def go(t, ls, key, epochs, _tr=trainer, _p=plan, _label=label):
+            if _label == "per_chunk":
+                return _tr.run_indexed(t, ls, _p, key, epochs=epochs)
+            return _tr.run_megastep(t, ls, _p, key, epochs=epochs,
+                                    chunks_per_dispatch=K)
+
+        # Warm-up pass (compile) on throwaway state, then the timed run
+        # on fresh state with a fresh aggregates-only recorder.
+        t0s, l0s = trainer.init_state(jax.random.key(0))
+        go(t0s, l0s, jax.random.key(9), 1)
+        rec = obs.Recorder(sinks=[])
+        trainer.recorder = rec
+        tables, ls = trainer.init_state(jax.random.key(0))
+        t0 = time.perf_counter()
+        tables, ls, m = go(tables, ls, jax.random.key(1), EPOCHS)
+        wall = time.perf_counter() - t0
+        n_ex = float(sum(np.asarray(mm["n"]).sum() for mm in m))
+        phases = {ph: round(v["s"], 4)
+                  for ph, v in sorted(rec.phase_totals().items())}
+        serial = sum(phases.get(ph, 0.0) for ph in HOST_SERIAL_PHASES)
+        arm = {
+            "examples_per_sec": round(n_ex / wall, 1),
+            "wall_s": round(wall, 4),
+            "host_serial_s": round(serial, 4),
+            "host_serial_share": (round(serial / wall, 4) if wall
+                                  else None),
+            "dispatches": int(plan.calls_per_epoch(SPC) * EPOCHS
+                              if label == "per_chunk" else
+                              -(-plan.calls_per_epoch(SPC) // K) * EPOCHS),
+            "phases": phases,
+        }
+        if label == "megastep":
+            arm["vote_compact_windows"] = int(
+                rec.counter_value("cold_route.vote_compact_windows"))
+            arm["vote_overflow_windows"] = int(rec.counter_value(
+                "cold_route.vote_overflow_windows", table="item_factors"))
+            arm["cold_dropped"] = int(rec.counter_value(
+                "hot_tier.cold_dropped", table="item_factors"))
+        finals[label] = {k: np.asarray(v) for k, v in store.tables.items()
+                        if "::" not in k}
+        out[label] = arm
+
+    out["numerics_bit_identical"] = all(
+        np.array_equal(finals["per_chunk"][k], finals["megastep"][k])
+        for k in finals["per_chunk"])
+    # The O(traffic)-not-O(K) claim, measured on the lowered programs:
+    # doubling K must leave the collective census byte-identical (the
+    # per-step collectives live inside the scan body; boundary ticks
+    # move O(window) bytes per window).
+    trainer, _, plan = make_trainer()
+    prof_k = collective_profile(trainer.lowered_megastep_text(
+        plan, chunks_per_dispatch=2))
+    trainer2, _, plan2 = make_trainer()
+    prof_2k = collective_profile(trainer2.lowered_megastep_text(
+        plan2, chunks_per_dispatch=4))
+    census = [(sum(1 for c in p), sum(c.payload_bytes for c in p))
+              for p in (prof_k, prof_2k)]
+    out["collective_census_k2"] = {"count": census[0][0],
+                                   "bytes": census[0][1]}
+    out["collective_census_k4"] = {"count": census[1][0],
+                                   "bytes": census[1][1]}
+    out["collective_bytes_k_independent"] = census[0] == census[1]
+    ratio = (out["megastep"]["examples_per_sec"]
+             / out["per_chunk"]["examples_per_sec"]
+             if out["per_chunk"]["examples_per_sec"] else None)
+    out["speedup"] = round(ratio, 3) if ratio else None
+    print(
+        f"megastep A/B: examples/s "
+        f"{out['per_chunk']['examples_per_sec']:.0f} -> "
+        f"{out['megastep']['examples_per_sec']:.0f} "
+        f"({out['speedup']}x at K={K}), host_serial_share "
+        f"{out['per_chunk']['host_serial_share']} -> "
+        f"{out['megastep']['host_serial_share']}, bit-identical "
+        f"{out['numerics_bit_identical']}, census K-independent "
+        f"{out['collective_bytes_k_independent']} (vote compact "
+        f"{out['megastep']['vote_compact_windows']} / overflow "
+        f"{out['megastep']['vote_overflow_windows']}, dropped "
+        f"{out['megastep']['cold_dropped']})", file=sys.stderr)
+    return {
+        "metric": "megastep_vs_per_chunk_examples_per_sec_ratio",
+        "value": out["megastep"]["examples_per_sec"],
+        "unit": "examples/s",
+        "vs_baseline": out["speedup"],
+        **out,
+    }
+
+
 RUNNERS = {"mf": run_mf, "w2v": run_w2v, "logreg": run_logreg,
            "pa": run_pa, "ials": run_ials, "tiered": run_tiered,
-           "tiered_drift": run_tiered_drift, "serve": run_serve}
+           "tiered_drift": run_tiered_drift, "serve": run_serve,
+           "megastep": run_megastep_ab}
 
 
 def compact_summary(results):
@@ -1731,7 +1891,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="all",
                     choices=["all", "mf", "w2v", "logreg", "pa", "ials",
-                             "tiered", "tiered_drift", "serve"])
+                             "tiered", "tiered_drift", "serve",
+                             "megastep"])
     ap.add_argument("--scale", default="20m", choices=["100k", "1m", "20m"])
     ap.add_argument("--rank", type=int, default=10)
     ap.add_argument("--local-batch", type=int, default=32768)
@@ -1757,7 +1918,7 @@ def main():
     if args.workload == "all":
         # Headline (mf) LAST among the per-workload lines.
         order = ["w2v", "logreg", "pa", "ials", "tiered", "tiered_drift",
-                 "serve", "mf"]
+                 "serve", "megastep", "mf"]
     else:
         order = [args.workload]
     results = {}

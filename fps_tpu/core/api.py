@@ -103,6 +103,25 @@ class WorkerLogic:
         provably hot."""
         return None
 
+    def pulled_ids_traced(self, batch: Pytree) -> Mapping[str, Array] | None:
+        """Optional TRACED certification stream for the compacted cold
+        routes where no host id stream exists — the device-side half of
+        :meth:`pulled_ids_host`, consumed by the megastep driver's
+        in-graph overflow VOTE (``fps_tpu.core.megastep``).
+
+        Called inside the compiled program with one worker's RAW
+        (un-``prepare``-d) per-step batch; return ``{table: int id
+        array}`` covering every id the step will pull OR push for that
+        table (any shape — the vote flattens), or ``None`` when the
+        logic cannot certify (ids synthesized in :meth:`prepare`).
+        Whether ``None`` is returned must be decided by the logic's
+        STATIC configuration, never by batch values — the megastep
+        probes it once by abstract evaluation to choose between the
+        voted and the always-static program. Padding positions may
+        carry any id; the vote counts them conservatively, exactly like
+        the host certifier."""
+        return None
+
     def head_prefix(self, batch: Pytree) -> Mapping[str, int]:
         """Optional STATIC guarantee: table name -> count of LEADING ids
         (in both :meth:`pull_ids` order and the step's push order) that

@@ -300,6 +300,18 @@ class DeviceEpochPlan:
             build, out_shardings=NamedSharding(self._mesh, P())
         )
 
+    def calls_per_epoch(self, steps_per_call: int) -> int:
+        """Compiled calls covering one epoch at ``steps_per_call`` steps
+        each (the final call's trailing steps are weight-0 padding).
+        One definition shared by the per-chunk driver
+        (``Trainer.run_indexed``) and the K-chunk megastep
+        (``fps_tpu.core.megastep``), so their chunk grids — and with
+        them the per-(epoch, chunk) PRNG derivation — cannot drift."""
+        if steps_per_call < 1:
+            raise ValueError(
+                f"steps_per_call must be >= 1, got {steps_per_call}")
+        return -(-self.steps_per_epoch // steps_per_call)
+
     def _epoch_rng(self, tag: int, epoch: int) -> np.random.Generator:
         """Deterministic host rng for (tag, seed, epoch) — accepts negative
         seeds (SeedSequence rejects negative entropy, so mask to 64 bits)."""

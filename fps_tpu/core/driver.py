@@ -98,6 +98,17 @@ WORKER_AXES = (DATA_AXIS, SHARD_AXIS)
 _STREAM_END = object()
 
 
+def calls_per_epoch_of(plan, steps_per_call: int) -> int:
+    """One chunk-grid definition for every indexed-plan consumer
+    (run_indexed, the megastep driver): the plan's own
+    ``calls_per_epoch`` when it has one (``DeviceEpochPlan``), else the
+    ceil-divide fallback for duck-typed plans (e.g. the w2v device
+    plan) that only expose ``steps_per_epoch``."""
+    if hasattr(plan, "calls_per_epoch"):
+        return plan.calls_per_epoch(steps_per_call)
+    return -(-plan.steps_per_epoch // steps_per_call)
+
+
 def _phase(timer: PhaseTimer | None, name: str):
     """Timer phase scope, or a free no-op when telemetry is off."""
     return timer.phase(name) if timer is not None else contextlib.nullcontext()
@@ -2021,6 +2032,89 @@ class Trainer:
                 self._build_indexed_fn(plan, mode), f"indexed/{mode}")
         return self._compiled[ck]
 
+    def _get_megastep_fn(self, plan, mode: str, K: int, tick=None):
+        """Compiled K-chunk megastep program (fps_tpu.core.megastep) for
+        the CURRENT config — cache-keyed like the indexed program, plus
+        the chunk count and the tick contract (its decayed-sketch spec,
+        cadence, and threshold are trace constants; the hot membership
+        and decayed state stay DATA, so in-graph re-ranks never miss
+        this entry)."""
+        tick_key = None
+        if tick is not None:
+            tick_key = (tick.spec, tick.check_every,
+                        tick.churn_threshold, tick.tables)
+        ck = ("megastep", mode, plan, K, ops.get_backend(),
+              self.config.step_tap,
+              resilience.as_guard(self.config.guard),
+              self._server_logic_key(), self.config.hot_sync_every,
+              tuple(sorted(self._hot_tier_map().items())),
+              tuple(sorted(self._mapped_tables().items())),
+              tuple(sorted(self._track_specs().items())),
+              tuple(sorted(self._cold_compact_map().items())),
+              tick_key)
+        if ck not in self._compiled:
+            from fps_tpu.core import megastep as _megastep
+
+            self._compiled[ck] = self._wrap_audit(
+                _megastep.build_megastep_fn(self, plan, mode, K, tick),
+                f"megastep/{mode}")
+        return self._compiled[ck]
+
+    def run_megastep(self, tables, local_state, plan, key, *,
+                     epochs: int = 1, chunks_per_dispatch: int = 4,
+                     on_megastep=None, checkpointer=None,
+                     checkpoint_every: int = 0, start_megastep: int = 0,
+                     as_numpy: bool = True,
+                     rollback: RollbackPolicy | None = None,
+                     recorder=None,
+                     health: HealthMonitor | None = None,
+                     watchdog: StepWatchdog | None = None,
+                     tick=None):
+        """Run ``epochs`` passes in K-chunk device-resident megasteps —
+        one compiled program per ``chunks_per_dispatch`` chunks, with
+        reconcile / sketch / tier-tick boundaries executed in-graph and
+        a device-side overflow vote selecting the compacted or static
+        cold routes per chunk. Bit-identical to the same run driven by
+        per-chunk ``run_indexed`` dispatches; see
+        :func:`fps_tpu.core.megastep.run_megastep` for the full
+        contract."""
+        from fps_tpu.core import megastep as _megastep
+
+        return _megastep.run_megastep(
+            self, tables, local_state, plan, key, epochs=epochs,
+            chunks_per_dispatch=chunks_per_dispatch,
+            on_megastep=on_megastep, checkpointer=checkpointer,
+            checkpoint_every=checkpoint_every,
+            start_megastep=start_megastep, as_numpy=as_numpy,
+            rollback=rollback, recorder=recorder, health=health,
+            watchdog=watchdog, tick=tick)
+
+    def lowered_megastep_text(self, plan, *, chunks_per_dispatch: int,
+                              mode: str = "sync", tick=None) -> str:
+        """StableHLO text of the exact megastep program ``run_megastep``
+        dispatches — the static-analysis entry point (the megastep rows
+        of ``tools/audit_programs.py`` pin its collective census as
+        K-independent). Read-only on the trainer, like
+        :meth:`lowered_chunk_text`."""
+        saved = dict(self.store.tables)
+        saved_rt = self.retierer
+        try:
+            if tick is not None and self.retierer is None:
+                self.retierer = tick
+            tables, ls = self.init_state(jax.random.key(0))
+            tables = self._attach_hot(tables)
+            iargs = plan.epoch_args(0)
+            ekey = key_to_replicated(
+                jax.random.fold_in(jax.random.key(1), 0), self.mesh)
+            tick_ops = tick.tick_ops(self) if tick is not None else {}
+            fn = self._get_megastep_fn(plan, mode, chunks_per_dispatch,
+                                       tick)
+            return fn.lower(tables, ls, iargs, np.int32(0), ekey,
+                            tick_ops).as_text()
+        finally:
+            self.store.tables = saved
+            self.retierer = saved_rt
+
     def run_indexed(self, tables, local_state, plan, key, *, epochs: int = 1,
                     on_epoch=None, checkpointer=None,
                     checkpoint_every: int = 0, start_epoch: int = 0,
@@ -2089,7 +2183,7 @@ class Trainer:
             raise ValueError("plan.sync_every must match TrainerConfig")
         T = plan.steps_per_epoch
         T_call = self._indexed_call_steps(plan)
-        n_calls = -(-T // T_call)
+        n_calls = calls_per_epoch_of(plan, T_call)
         all_metrics = []
         end_epoch = start_epoch + epochs
         self._enter_tiering()

@@ -376,6 +376,37 @@ def health_total(metrics: Pytree) -> int:
     return total
 
 
+def health_by_segment(metrics: Pytree, segments: int,
+                      steps_per_segment: int) -> list[int]:
+    """Per-segment poison totals of one megastep's HOST metrics pytree.
+
+    The megastep driver (``fps_tpu.core.megastep``) dispatches
+    ``segments`` in-graph chunk segments of ``steps_per_segment`` steps
+    in one call; adjudication happens at megastep granularity, but the
+    quarantine record should still name WHICH in-graph chunk reported
+    poison. Splits the stacked per-step counters on the segment grid
+    (the final, trimmed megastep may cover fewer rows — trailing
+    segments then report 0) and sums ``nonfinite`` + ``norm`` per
+    segment, mirroring :func:`health_total`'s counting rule.
+    """
+    h = metrics.get(HEALTH_KEY) if isinstance(metrics, Mapping) else None
+    totals = [0] * segments
+    if not h:
+        return totals
+    for counters in h.values():
+        for kind in ("nonfinite", "norm"):
+            if kind not in counters:
+                continue
+            v = np.asarray(counters[kind])
+            if not v.ndim:
+                totals[0] += int(v)
+                continue
+            for i in range(segments):
+                sl = v[i * steps_per_segment:(i + 1) * steps_per_segment]
+                totals[i] += int(np.sum(sl))
+    return totals
+
+
 @dataclasses.dataclass
 class RollbackPolicy:
     """Host-loop degradation policy for ``fit_stream`` / ``run_indexed``.

@@ -226,6 +226,38 @@ def lookup_hot_slots(slot_map: Array, ids: Array) -> Array:
     return jnp.take(slot_map, jnp.where(ids >= 0, ids, sentinel), axis=0)
 
 
+def device_slot_map(num_ids: int, hot_gids: Array) -> Array:
+    """Traced analog of :func:`hot_slot_map` for the in-graph tier tick:
+    rebuild the ``(num_ids + 1,)`` id->slot map from a replicated hot
+    gid array (all entries in ``[0, num_ids)`` — the tick selects from
+    ``arange``, so no validation is traced). Deterministic function of
+    the gid ORDER, so rebuilding for an unchanged set reproduces the
+    incoming map bit-for-bit."""
+    m = jnp.full((num_ids + 1,), -1, jnp.int32)
+    return m.at[hot_gids].set(
+        jnp.arange(hot_gids.shape[0], dtype=jnp.int32))
+
+
+def replica_from_shard(local_shard: Array, hot_gids: Array, *,
+                       num_shards: int,
+                       shard_axis: str = SHARD_AXIS) -> Array:
+    """In-graph re-split: gather arbitrary global ids' canonical rows
+    into a replicated ``(H, dim)`` replica from inside ``shard_map`` —
+    the traced analog of :meth:`ParamStore.rows_replica` for the
+    megastep's tier tick. Each shard contributes the rows it owns under
+    the owner-major cyclic layout (zero rows elsewhere); one psum makes
+    the result replicated. Bit-exact: every replica row is one owned
+    row plus zeros, and the boundary invariant (replica row ==
+    canonical row after a reconcile) makes the re-derivation of an
+    UNCHANGED hot set the identity."""
+    me = lax.axis_index(shard_axis)
+    owned = (hot_gids % num_shards) == me
+    lidx = jnp.where(owned, hot_gids // num_shards,
+                     jnp.asarray(-1, hot_gids.dtype))
+    vals = ops.gather_rows(local_shard, lidx)  # -1 slots read zero rows
+    return lax.psum(vals, shard_axis)
+
+
 def split_hot_push_slots(
     ids: Array, deltas: Array, slots: Array
 ) -> tuple[tuple[Array, Array], tuple[Array, Array]]:

@@ -154,6 +154,15 @@ class MatrixFactorizationWorker(WorkerLogic):
             return None
         return {ITEM_TABLE: chunk["item"]}
 
+    def pulled_ids_traced(self, batch):
+        """Device-side certification stream (the megastep's in-graph
+        overflow vote): same contract as :meth:`pulled_ids_host`, from
+        one worker's raw traced batch. Negative sampling synthesizes
+        ids in :meth:`prepare`, so those configs stay uncertifiable."""
+        if self.cfg.negative_samples:
+            return None
+        return {ITEM_TABLE: batch["item"].astype(jnp.int32)}
+
     def touched_local_rows(self, batch):
         """Ids-aware local-guard refinement: :meth:`step` scatters only
         into the batch's own users' LOCAL rows (``u // num_workers`` —

@@ -111,7 +111,28 @@ def default_registry() -> MetricsRegistry:
                    labels=("phase",),
                    help="host wall-clock per phase segment: ingest / place "
                         "/ dispatch / host_sync / checkpoint / callback / "
-                        "reconcile / retier"),
+                        "reconcile / retier / megastep"),
+        # Device-resident megastep (fps_tpu.core.megastep;
+        # docs/performance.md "Megastep").
+        MetricSpec("megastep.windows", "counter", unit="windows",
+                   help="in-graph chunk windows executed by megastep "
+                        "dispatches (chunks_per_dispatch per call — each "
+                        "ends with the flush reconcile + sketch merge the "
+                        "per-chunk host loop ran between dispatches)"),
+        MetricSpec("megastep.chunks_per_dispatch", "gauge", unit="chunks",
+                   help="K of the current megastep program: chunk "
+                        "segments fused into one compiled dispatch"),
+        MetricSpec("cold_route.vote_compact_windows", "counter",
+                   unit="windows",
+                   help="megastep chunk windows whose device-side "
+                        "overflow VOTE certified every cold_budget lane "
+                        "(the window ran the compacted cold routes; the "
+                        "in-graph analog of cold_route.compact_chunks)"),
+        MetricSpec("cold_route.vote_overflow_windows", "counter",
+                   unit="windows", labels=("table",),
+                   help="megastep chunk windows that overflowed (or "
+                        "could not certify) a table's cold_budget lane "
+                        "and ran the bit-identical static-route branch"),
         # Host pipeline (fps_tpu.core.prefetch).
         MetricSpec("prefetch.chunks", "counter", unit="chunks",
                    help="chunks assembled+placed by the background "

@@ -44,6 +44,10 @@ DRIVER_PHASES = (
     "checkpoint",  # snapshot save on the training thread
     "callback",    # user on_chunk / on_epoch hooks
     "reconcile",   # two-tier re-split at run entry (hot replica derive)
+    "megastep",    # K-chunk device-resident dispatch (enqueue + first-
+                   # call compile): the megastep driver's analog of
+                   # "dispatch", kept distinct so the A/B's host-serial
+                   # attribution can tell the two loop shapes apart
 )
 
 

@@ -172,6 +172,17 @@ def dcm_fold(spec: DecayedCountMinSpec, state, window, tick: int):
     return state + window
 
 
+def dcm_fold_traced(spec: DecayedCountMinSpec, state, window, tick):
+    """Traced :func:`dcm_fold` for in-graph ticks (``tick`` is a traced
+    int32 scalar, so the halve-on-schedule branch becomes a ``where``).
+    Bit-identical to the host fold: halving is an exact power-of-two
+    scale and the add is the same IEEE f32 sum, whichever side runs it
+    (tested in tests/test_megastep.py)."""
+    halve = (tick > 0) & (tick % spec.half_every == 0)
+    state = jnp.where(halve, state * 0.5, state)
+    return state + window
+
+
 def dcm_query(spec: DecayedCountMinSpec, state, ids) -> Array:
     """(B,) decayed frequency estimates (min over depth rows); same
     upward-bias guarantee as :func:`cm_query`, on the decayed counts."""

@@ -72,6 +72,13 @@ SCENARIO_FOLD_TIER = 400  # >= NF: full replication (hot_fold requires it)
 SCENARIO_FOLD_SYNC = 3
 SCENARIO_FOLD_KILL_AT = 3
 
+# Megastep scenario (fps_tpu.core.megastep): K chunks per compiled
+# dispatch over the device-ingest path; the kill lands after megastep
+# SCENARIO_MEGASTEP_KILL_AT trains, before its boundary checkpoint.
+MEGASTEP_T_CALL = 4          # steps per in-graph chunk segment
+SCENARIO_MEGASTEP_K = 2
+SCENARIO_MEGASTEP_KILL_AT = 3
+
 
 def run_supervised_scenario(tmpdir: str, *, timeout: float = 600):
     """THE end-to-end supervisor survival scenario, shared by
@@ -325,6 +332,91 @@ def run_hot_tier_kill_scenario(tmpdir: str, *, timeout: float = 600):
           # restored_step == SCENARIO_HOT_KILL_AT means exactly one chunk
           # was lost and replayed from a reconciled snapshot.
           and meta.get("restored_step") == SCENARIO_HOT_KILL_AT
+          and not detail["corrupt_files"]
+          and bit_identical)
+    return ok, detail
+
+
+def run_megastep_kill_scenario(tmpdir: str, *, timeout: float = 600):
+    """SIGKILL mid-megastep under the supervisor: the child trains
+    through the device-resident megastep driver (``--megastep K`` —
+    K chunks fused per compiled dispatch, checkpoints at megastep
+    boundaries) and dies after megastep ``SCENARIO_MEGASTEP_KILL_AT``
+    trains, before its boundary checkpoint lands. The restart must
+    restore the last window-boundary snapshot, resume at that megastep
+    index (the per-(epoch, chunk) PRNG/shuffle derivation continues
+    in-graph), and reproduce a straight megastep run's final weights
+    BIT-identical. A single crash must not quarantine anything.
+
+    Returns ``(ok, detail)`` like :func:`run_supervised_scenario`.
+    """
+    import numpy as np
+
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=_ROOT)
+    demo = [sys.executable, "-m", "fps_tpu.testing.supervised_demo",
+            *SCENARIO_DEMO_ARGS,
+            "--megastep", str(SCENARIO_MEGASTEP_K)]
+    straight_dir = os.path.join(tmpdir, "straight")
+    sup_dir = os.path.join(tmpdir, "sup")
+    straight_out = os.path.join(tmpdir, "straight.npz")
+    sup_out = os.path.join(tmpdir, "sup.npz")
+
+    r = subprocess.run(
+        demo + ["--ckpt-dir", straight_dir, "--out", straight_out],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=timeout,
+    )
+    if r.returncode != 0:
+        return False, {"error": "straight megastep run failed",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "supervise.py"),
+         "--state-dir", sup_dir, "--stall-timeout-s", "60",
+         "--startup-grace-s", "300", "--term-grace-s", "2",
+         "--backoff-base-s", "0.2", "--max-restarts", "2",
+         "--poll-s", "0.2", "--",
+         *demo, "--ckpt-dir", sup_dir, "--out", sup_out,
+         "--kill-at", str(SCENARIO_MEGASTEP_KILL_AT)],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=timeout,
+    )
+    try:
+        digest = json.loads(r.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return False, {"error": "no supervisor digest",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+    try:
+        with open(sup_out + ".meta.json", encoding="utf-8") as f:
+            meta = json.load(f)
+    except OSError:
+        meta = {}
+    bit_identical = (
+        os.path.exists(sup_out)
+        and np.array_equal(np.load(straight_out)["weights"],
+                           np.load(sup_out)["weights"])
+    )
+    detail = {
+        "supervisor": {k: digest.get(k) for k in
+                       ("success", "attempts", "restarts",
+                        "deadline_aborts", "quarantined")},
+        "restored_step": meta.get("restored_step"),
+        "bit_identical": bit_identical,
+        "corrupt_files": sorted(os.path.basename(p) for p in
+                                glob.glob(sup_dir + "/*.corrupt")),
+    }
+    ok = (r.returncode == 0 and digest.get("success")
+          and digest.get("restarts") == 1
+          # A SIGKILL crash is a death, not a stall: no deadline abort.
+          and digest.get("deadline_aborts") == 0
+          # One crash at one index is not quarantine evidence.
+          and digest.get("quarantined") == []
+          # The kill fires after megastep SCENARIO_MEGASTEP_KILL_AT
+          # trains (async writer flushed first), before its boundary
+          # checkpoint lands: restored_step == the kill index means
+          # exactly one megastep was lost and replayed.
+          and meta.get("restored_step") == SCENARIO_MEGASTEP_KILL_AT
           and not detail["corrupt_files"]
           and bit_identical)
     return ok, detail
@@ -1317,6 +1409,13 @@ def main(argv=None) -> int:
                     help="SIGKILL after this chunk trains (async writer "
                          "flushed first), before its checkpoint lands — "
                          "once, via marker file, unless --always")
+    ap.add_argument("--megastep", type=int, default=0,
+                    help="device-resident megastep mode "
+                         "(Trainer.run_megastep): train through the "
+                         "device-ingest path with this many chunks "
+                         "fused per compiled dispatch; checkpoints land "
+                         "at megastep boundaries and --kill-at counts "
+                         "megasteps")
     ap.add_argument("--hot-tier", type=int, default=0,
                     help="two-tier storage: replicate the leading H ids "
                          "(TableSpec.hot_tier)")
@@ -1561,6 +1660,45 @@ def main(argv=None) -> int:
             killer(i, metrics)
         if hb is not None:
             hb.beat(index=int(i) + 1, attempt=attempt)
+
+    if args.megastep:
+        # Device-resident megastep path (fps_tpu.core.megastep): the
+        # same logreg workload through device ingest, K chunks fused
+        # per dispatch, checkpoints at megastep boundaries. The
+        # --kill-at hook fires in on_megastep — after megastep i
+        # trains, before its checkpoint lands — so restored_step == i
+        # proves exactly one megastep was lost and replayed from the
+        # last window-boundary snapshot.
+        import dataclasses
+
+        from fps_tpu.core.device_ingest import (
+            DeviceDataset,
+            DeviceEpochPlan,
+        )
+
+        trainer.config = dataclasses.replace(
+            trainer.config, max_steps_per_call=MEGASTEP_T_CALL)
+        plan = DeviceEpochPlan(
+            DeviceDataset(mesh, train), num_workers=W, local_batch=32,
+            seed=3)
+        rollback = RollbackPolicy(preset=preset) if preset else None
+        tables, ls, _ = trainer.run_megastep(
+            tables, ls, plan, jax.random.key(1), epochs=args.epochs,
+            chunks_per_dispatch=args.megastep, checkpointer=ckpt,
+            checkpoint_every=1, start_megastep=start,
+            on_megastep=on_chunk, rollback=rollback, recorder=rec,
+        )
+        ckpt.close()
+        if args.obs_dir and rec is not None:
+            rec.close()
+        np.savez(args.out, weights=weights(store))
+        meta.update(finished=True,
+                    skipped=sorted(rollback.skipped) if rollback else [],
+                    megastep=args.megastep)
+        with open(args.out + ".meta.json", "w", encoding="utf-8") as f:
+            json.dump(meta, f)
+        print(json.dumps({"event": "demo_done", **meta}), flush=True)
+        return 0
 
     stream = chunks[start:]
     if (args.kill_prefetch_at is not None

@@ -35,10 +35,12 @@ from fps_tpu.tiering.planner import (
 )
 from fps_tpu.tiering.probe import ProbeLogic, lowered_plan_text, probe_chunk
 from fps_tpu.tiering.retier import Retierer, sidecar_path
+from fps_tpu.tiering.tick import MegastepTick, device_top_ids
 
 __all__ = [
     "TableDensity", "TierPlan", "plan_tables", "choose_sync_every",
     "global_sync_every", "head_coverage",
     "Retierer", "sidecar_path",
+    "MegastepTick", "device_top_ids",
     "ProbeLogic", "probe_chunk", "lowered_plan_text",
 ]

@@ -1,0 +1,132 @@
+"""In-graph tier ticks: the megastep's boundary contract.
+
+The host :class:`~fps_tpu.tiering.retier.Retierer` runs the adaptive-
+tiering boundary between compiled calls — fold the device count-min
+windows into a decayed sketch, re-rank the hot head, re-split the
+replica. The megastep driver (:mod:`fps_tpu.core.megastep`) has no host
+boundary to run it on: K chunks execute inside ONE compiled program, so
+the tick itself must trace.
+
+:class:`MegastepTick` is that contract. It subclasses ``Retierer`` so
+the trainer's resolution machinery (``manages`` / ``track_specs`` /
+``hot_ids_for`` / ``_table_cm`` / sidecar persistence) applies
+unchanged — the mapped tier, the device-side sketch updates, and the
+compile-cache keys are all the host tier's — but the boundary work runs
+in-graph with bit-matching arithmetic:
+
+* the decayed fold is :func:`fps_tpu.sketch.dcm_fold_traced` (exact
+  power-of-two halving + IEEE f32 add — identical to the host fold);
+* the ranking is :func:`device_top_ids` — the same (count desc, id asc)
+  TOTAL order as :func:`fps_tpu.tiering.retier.top_ids`, so both sides
+  select the identical head for identical estimates (tested);
+* the re-split re-derives the replica from the canonical table via
+  :func:`fps_tpu.core.store.replica_from_shard`, valid because every
+  segment ends with a flush reconcile.
+
+The decayed state and fold counter round-trip between dispatches as
+device arrays (:meth:`tick_ops` in, ``aux["tick"]`` out); host mirrors
+(:attr:`state` / :attr:`tick` / :attr:`hot_ids`) sync lazily at
+checkpoint boundaries so the hot loop never blocks on them, and the
+inherited sidecar machinery persists them for bit-identical supervised
+resume.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fps_tpu import sketch as sklib
+from fps_tpu.core.store import ids_key, sketch_key
+from fps_tpu.tiering.retier import Retierer
+
+
+def device_top_ids(est, H: int):
+    """Traced analog of :func:`fps_tpu.tiering.retier.top_ids`: the
+    deterministic top-H ids of an estimate vector by (count desc, id
+    asc). Both implementations realize the same TOTAL order (ids are
+    unique), so the selected head is identical whichever side ranks —
+    the property that keeps in-graph and host re-rank decisions
+    interchangeable."""
+    import jax.numpy as jnp
+
+    n = est.shape[0]
+    order = jnp.lexsort((jnp.arange(n, dtype=jnp.int32),
+                         -est.astype(jnp.float32)))
+    return order[:H].astype(jnp.int32)
+
+
+class MegastepTick(Retierer):
+    """Boundary-tick spec for ``Trainer.run_megastep``.
+
+    Args mirror :class:`~fps_tpu.tiering.retier.Retierer` where shared:
+
+      tables: table names to manage (default: every store spec).
+      spec: the decayed count-min config (per-table hash seeds derive
+        from the table name, like the host tracker).
+      check_every: tick cadence in CHUNK SEGMENTS inside the megastep —
+        must divide ``chunks_per_dispatch`` so every tick lands on a
+        static in-graph boundary.
+      churn_threshold: re-rank when ``|top-H \\ current| / H`` exceeds
+        this; ``< 0`` re-ranks on every tick (forced-cadence mode).
+      state_dir / keep: sidecar persistence beside the checkpoints
+        (inherited) — written at megastep checkpoint boundaries.
+
+    Auto-planning is deliberately unsupported: the planner's recompile
+    has no boundary inside one compiled program (``run_megastep``
+    rejects ``auto_tier`` too).
+    """
+
+    def __init__(self, tables=None, *,
+                 spec: sklib.DecayedCountMinSpec | None = None,
+                 check_every: int = 1,
+                 churn_threshold: float = 0.25,
+                 state_dir: str | None = None,
+                 keep: int = 3):
+        super().__init__(tables, spec=spec, check_every=check_every,
+                         churn_threshold=churn_threshold,
+                         state_dir=state_dir, keep=keep)
+
+    # -- dispatch plumbing (consumed by fps_tpu.core.megastep) ------------
+
+    def tick_ops(self, trainer) -> dict:
+        """First-dispatch operands: the host-mirror decayed states (or
+        fresh zeros) plus the fold counter. Later dispatches feed the
+        previous dispatch's device-resident ``aux["tick"]`` back in
+        directly — no per-dispatch host round trip."""
+        dcm = {}
+        for name in sorted(trainer._track_specs()):
+            st = self.state.get(name)
+            if st is None or st.shape != (self.spec.depth,
+                                          self.spec.width):
+                st = sklib.dcm_init(self.spec)
+            dcm[name] = np.asarray(st, np.float32)
+        return {"dcm": dcm, "tick": np.int32(self.tick)}
+
+    def absorb(self, trainer, tick_dev, tables) -> None:
+        """Sync the host mirrors from device state (blocking reads —
+        called only at checkpoint boundaries / end of run): decayed
+        sketches, fold counter, and the hot id sets the program
+        currently carries (``::hotids`` — rank order, like a host
+        re-rank would have left them)."""
+        for name in sorted(tick_dev["dcm"]):
+            self.state[name] = np.asarray(tick_dev["dcm"][name])
+        self.tick = int(tick_dev["tick"])
+        self.checks = self.tick
+        for name in sorted(trainer._mapped_tables()):
+            k = ids_key(name)
+            if k in tables:
+                self.hot_ids[name] = np.asarray(
+                    tables[k]).astype(np.int64)
+
+    def save_boundary(self, step: int, tables) -> None:
+        """Sidecar write at a megastep checkpoint boundary: the pending
+        (merged, un-folded) windows still live in the tables dict's
+        ``::sketch`` entries, so persist them alongside the mirrors —
+        a resume re-seeds them via ``_attach_hot`` exactly like the
+        host tracker's restore path."""
+        windows = {}
+        for name in sorted(self.state):
+            k = sketch_key(name)
+            if k in tables:
+                windows[name] = np.asarray(tables[k])
+        self._save_sidecar(step, windows)

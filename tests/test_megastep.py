@@ -1,0 +1,430 @@
+"""Device-resident megastep (fps_tpu.core.megastep): bit-identity with
+the per-chunk host loop, the device-side overflow vote, and the in-graph
+tier tick.
+
+The load-bearing contract: ``run_megastep`` fusing K chunks into one
+compiled program must reproduce the per-chunk ``run_indexed`` loop
+BIT-for-bit — tables, metrics, and checkpoints — across guard on/off,
+tiered/untiered, SSP, and the cold_budget overflow-vote fallback. The
+vote itself must mirror the host certifier (fit → compacted branch,
+overflow/uncertifiable → the bit-identical static branch), and the
+in-graph tick's arithmetic (decayed fold, top-H ranking) must match the
+host tracker's exactly.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from fps_tpu import obs
+from fps_tpu import sketch as sklib
+from fps_tpu.core import resilience
+from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+from fps_tpu.core.driver import num_workers_of
+from fps_tpu.core.store import hot_key, ids_key, map_key
+from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+from fps_tpu.parallel.mesh import make_ps_mesh
+from fps_tpu.tiering import MegastepTick, device_top_ids
+from fps_tpu.tiering.retier import top_ids
+from fps_tpu.utils.datasets import synthetic_ratings
+
+NU, NI, RANK = 57, 31, 4
+LOCAL_BATCH, T_CALL = 8, 4
+
+
+@pytest.fixture(scope="module")
+def mesh(devices8):
+    return make_ps_mesh(num_shards=4, num_data=2, devices=devices8[:8])
+
+
+@pytest.fixture(scope="module")
+def data():
+    return synthetic_ratings(NU, NI, 1003, seed=0)
+
+
+@pytest.fixture(scope="module")
+def skewed_data():
+    """Item stream concentrated on the leading head [0, 16) — certifies
+    small cold budgets."""
+    rng = np.random.default_rng(0)
+    n = 1000
+    item = np.where(rng.random(n) < 0.95, rng.integers(0, 16, n),
+                    rng.integers(16, NI, n)).astype(np.int32)
+    return {"user": rng.integers(0, NU, n).astype(np.int32),
+            "item": item,
+            "rating": rng.normal(size=n).astype(np.float32)}
+
+
+def _make(mesh, data, *, hot_tier=0, cold_budget=0, hot_sync_every=1,
+          sync_every=None, guard=None, negative_samples=0):
+    cfg = MFConfig(num_users=NU, num_items=NI, rank=RANK,
+                   negative_samples=negative_samples)
+    trainer, store = online_mf(mesh, cfg, sync_every=sync_every,
+                               max_steps_per_call=T_CALL, guard=guard)
+    if hot_tier:
+        store.specs["item_factors"] = dataclasses.replace(
+            store.specs["item_factors"], hot_tier=hot_tier,
+            cold_budget=cold_budget, dense_collectives=False)
+        trainer.config = dataclasses.replace(
+            trainer.config, hot_sync_every=hot_sync_every)
+    plan = DeviceEpochPlan(
+        DeviceDataset(mesh, data), num_workers=num_workers_of(mesh),
+        local_batch=LOCAL_BATCH, route_key="user", seed=3,
+        sync_every=sync_every)
+    return trainer, store, plan
+
+
+def _epoch_concat(per_megastep, epochs):
+    """Per-epoch metric trees from the per-megastep list (trimmed parts
+    concatenate to exactly the epoch's rows)."""
+    M = len(per_megastep) // epochs
+    out = []
+    for e in range(epochs):
+        parts = [jax.tree.map(np.asarray, p)
+                 for p in per_megastep[e * M:(e + 1) * M]]
+        out.append(jax.tree.map(
+            lambda *xs: np.concatenate(xs), *parts)
+            if len(parts) > 1 else parts[0])
+    return out
+
+
+def _strip_vote_counters(tree):
+    """Drop the megastep-only cold_dropped telemetry leaves (the
+    compacted program's observability net — run_indexed's static
+    program never traces them) so metric trees compare structurally."""
+    if not isinstance(tree, dict):
+        return tree
+    out = {}
+    for k, v in tree.items():
+        if k == "cold_dropped":
+            continue
+        out[k] = _strip_vote_counters(v) if isinstance(v, dict) else v
+    return out
+
+
+def _assert_pair_identical(tr1, st1, m1, tr2, st2, m2, epochs,
+                           strip_votes=False):
+    for k in st1.tables:
+        np.testing.assert_array_equal(
+            np.asarray(st1.tables[k]), np.asarray(tr2.store.tables[k]),
+            err_msg=f"table {k} diverged")
+    mega = _epoch_concat(m2, epochs)
+    for e in range(epochs):
+        a = jax.tree.map(np.asarray, m1[e])
+        b = mega[e]
+        if strip_votes:
+            b = _strip_vote_counters(b)
+        la, ta = jax.tree.flatten(a)
+        lb, tb = jax.tree.flatten(b)
+        assert str(ta) == str(tb)
+        for x, y in zip(la, lb):
+            np.testing.assert_array_equal(x, y)
+
+
+def _run_pair(mesh, data, *, epochs=2, K=2, strip_votes=False,
+              rec=None, **kw):
+    tr1, st1, p1 = _make(mesh, data, **kw)
+    tr2, st2, p2 = _make(mesh, data, **kw)
+    t1, l1 = tr1.init_state(jax.random.key(0))
+    t2, l2 = tr2.init_state(jax.random.key(0))
+    t1, l1, m1 = tr1.run_indexed(t1, l1, p1, jax.random.key(1),
+                                 epochs=epochs)
+    t2, l2, m2 = tr2.run_megastep(t2, l2, p2, jax.random.key(1),
+                                  epochs=epochs, chunks_per_dispatch=K,
+                                  recorder=rec)
+    _assert_pair_identical(tr1, st1, m1, tr2, st2, m2, epochs,
+                           strip_votes=strip_votes)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    return tr2, st2, m2
+
+
+# -- bit-identity with the per-chunk host loop ---------------------------
+
+
+def test_megastep_matches_indexed_untiered(mesh, data):
+    _run_pair(mesh, data)
+
+
+def test_megastep_matches_indexed_guard_mask(mesh, data):
+    _run_pair(mesh, data, guard="mask")
+
+
+def test_megastep_matches_indexed_tiered_partial(mesh, data):
+    _run_pair(mesh, data, hot_tier=16, hot_sync_every=2)
+
+
+def test_megastep_matches_indexed_ssp(mesh, data):
+    _run_pair(mesh, data, sync_every=2)
+
+
+def test_megastep_uncertifiable_logic_stays_static(mesh, data):
+    """A logic whose prepare synthesizes ids (negative sampling) cannot
+    vote — every window runs the static routes, counted as overflow,
+    still bit-identical to the per-chunk loop."""
+    rec = obs.Recorder(sinks=[])
+    _run_pair(mesh, data, hot_tier=16, hot_sync_every=2, cold_budget=4,
+              negative_samples=2, rec=rec)
+    assert rec.counter_value("cold_route.vote_compact_windows") == 0
+    assert rec.counter_value("cold_route.vote_overflow_windows",
+                             table="item_factors") > 0
+
+
+# -- the overflow vote ---------------------------------------------------
+
+
+def test_vote_fits_runs_compacted_and_matches(mesh, skewed_data):
+    rec = obs.Recorder(sinks=[])
+    tr, st, m = _run_pair(mesh, skewed_data, hot_tier=16,
+                          hot_sync_every=2, cold_budget=8,
+                          strip_votes=True, rec=rec)
+    assert rec.counter_value("cold_route.vote_compact_windows") > 0
+    # The drop net: zero for every certified window, by construction.
+    dropped = sum(
+        float(np.sum(np.asarray(
+            mm["hot_tier"]["item_factors"].get("cold_dropped", 0))))
+        for mm in m)
+    assert dropped == 0
+
+
+def test_vote_overflow_falls_back_bit_identical(mesh, skewed_data):
+    rec = obs.Recorder(sinks=[])
+    _run_pair(mesh, skewed_data, hot_tier=16, hot_sync_every=2,
+              cold_budget=1, strip_votes=True, rec=rec)
+    assert rec.counter_value("cold_route.vote_overflow_windows",
+                             table="item_factors") > 0
+
+
+# -- checkpoints ---------------------------------------------------------
+
+
+def test_megastep_checkpoint_resume_bit_identical(mesh, data, tmp_path):
+    from fps_tpu.core.checkpoint import Checkpointer
+
+    kw = dict(hot_tier=16, hot_sync_every=2)
+    # Straight run with boundary checkpoints.
+    tr1, st1, p1 = _make(mesh, data, **kw)
+    t1, l1 = tr1.init_state(jax.random.key(0))
+    ck1 = Checkpointer(str(tmp_path / "straight"), keep=20)
+    tr1.run_megastep(t1, l1, p1, jax.random.key(1), epochs=2,
+                     chunks_per_dispatch=2, checkpointer=ck1,
+                     checkpoint_every=1)
+    # Interrupted run: stop after 3 megasteps, restore, resume.
+    tr2, st2, p2 = _make(mesh, data, **kw)
+    t2, l2 = tr2.init_state(jax.random.key(0))
+    ck2 = Checkpointer(str(tmp_path / "resumed"), keep=20)
+    tr2.run_megastep(t2, l2, p2, jax.random.key(1), epochs=1,
+                     chunks_per_dispatch=2, checkpointer=ck2,
+                     checkpoint_every=1)
+    n_calls = p2.calls_per_epoch(T_CALL)
+    M = -(-n_calls // 2)
+    assert ck2.latest_valid_step() == M
+    tr3, st3, p3 = _make(mesh, data, **kw)
+    t3, l3 = tr3.init_state(jax.random.key(0))
+    ck3 = Checkpointer(str(tmp_path / "resumed"), keep=20)
+    t3, l3, _ = tr3.restore_checkpoint(ck3, l3)
+    tr3.run_megastep(t3, l3, p3, jax.random.key(1), epochs=2,
+                     chunks_per_dispatch=2, checkpointer=ck3,
+                     checkpoint_every=1, start_megastep=M)
+    # Logical rows bit-identical (the padding row of a restored table is
+    # re-derived, not round-tripped — same as every other driver), and
+    # every post-resume boundary checkpoint byte-compatible with the
+    # straight run's.
+    ids = np.arange(NI)
+    np.testing.assert_array_equal(
+        st1.lookup_host("item_factors", ids),
+        st3.lookup_host("item_factors", ids),
+        err_msg="resumed item_factors diverged from straight")
+    np.testing.assert_array_equal(
+        np.asarray(st1.tables[hot_key("item_factors")]),
+        np.asarray(tr3.store.tables[hot_key("item_factors")]))
+    for g in range(M, 2 * M + 1):
+        _, va, la, _ = ck1.read_snapshot(g)
+        _, vb, lb, _ = ck3.read_snapshot(g)
+        for k in va:
+            np.testing.assert_array_equal(
+                np.asarray(va[k]), np.asarray(vb[k]),
+                err_msg=f"checkpoint {g} table {k} diverged")
+        for x, y in zip(la, lb):
+            np.testing.assert_array_equal(x, y)
+
+
+# -- guard / rollback at megastep granularity ----------------------------
+
+
+def test_megastep_quarantine_matches_preset_skip(mesh):
+    """A poisoned megastep (NaN ratings in its chunks) is quarantined —
+    pre-dispatch state restored, index recorded — and the result equals
+    a fresh run that preset-skips the same megastep."""
+    rng = np.random.default_rng(1)
+    n = 1003
+    d = {"user": rng.integers(0, NU, n).astype(np.int32),
+         "item": rng.integers(0, NI, n).astype(np.int32),
+         "rating": rng.normal(size=n).astype(np.float32)}
+    # Poison a slab of the stream so one megastep's chunks see NaNs.
+    d["rating"][100:160] = np.nan
+
+    def go(rollback):
+        tr, st, p = _make(mesh, d, guard="mask")
+        t, ls = tr.init_state(jax.random.key(0))
+        t, ls, m = tr.run_megastep(t, ls, p, jax.random.key(1),
+                                   epochs=1, chunks_per_dispatch=2,
+                                   rollback=rollback)
+        return tr, st, rollback
+
+    rb1 = resilience.RollbackPolicy()
+    tr1, st1, rb1 = go(rb1)
+    assert rb1.quarantined, "poison megastep was not quarantined"
+    rb2 = resilience.RollbackPolicy(preset=frozenset(rb1.quarantined))
+    tr2, st2, rb2 = go(rb2)
+    assert sorted(rb2.skipped) == sorted(rb1.quarantined)
+    for k in st1.tables:
+        np.testing.assert_array_equal(np.asarray(st1.tables[k]),
+                                      np.asarray(st2.tables[k]))
+
+
+def test_health_by_segment_unit():
+    metrics = {"health": {"t": {
+        "nonfinite": np.array([0, 0, 3, 0, 0, 1, 0, 0]),
+        "norm": np.array([0, 0, 0, 0, 0, 0, 0, 2]),
+    }}}
+    assert resilience.health_by_segment(metrics, 2, 4) == [3, 3]
+    # Trimmed final megastep: missing trailing rows report 0.
+    short = {"health": {"t": {"nonfinite": np.array([1, 0, 0])}}}
+    assert resilience.health_by_segment(short, 2, 4) == [1, 0]
+    assert resilience.health_by_segment({}, 3, 4) == [0, 0, 0]
+
+
+# -- the in-graph tier tick ----------------------------------------------
+
+
+def test_device_dcm_fold_matches_host():
+    spec = sklib.DecayedCountMinSpec(depth=3, width=64, half_every=2)
+    rng = np.random.default_rng(0)
+    state = rng.random((3, 64)).astype(np.float32)
+    window = rng.random((3, 64)).astype(np.float32)
+    for tick in (0, 1, 2, 3, 4):
+        host = sklib.dcm_fold(spec, state, window, tick)
+        dev = jax.jit(
+            lambda s, w, t: sklib.dcm_fold_traced(spec, s, w, t)
+        )(state, window, np.int32(tick))
+        np.testing.assert_array_equal(host, np.asarray(dev))
+
+
+def test_device_top_ids_matches_host():
+    rng = np.random.default_rng(0)
+    # Heavy ties: a small value alphabet forces the id tie-break.
+    est = rng.integers(0, 5, 200).astype(np.float32)
+    for H in (1, 7, 50, 200):
+        np.testing.assert_array_equal(
+            top_ids(est, H),
+            np.asarray(device_top_ids(est, H)).astype(np.int64))
+
+
+def test_megastep_tick_reranks_deterministic(mesh):
+    """E2E: a stream whose true head is NOT the static [0, H) must be
+    re-ranked onto it by the in-graph tick; the replica stays consistent
+    with the canonical table, host mirrors sync, and the whole run is
+    deterministic."""
+    rng = np.random.default_rng(0)
+    n = 1200
+    item = np.where(rng.random(n) < 0.9, rng.integers(15, NI, n),
+                    rng.integers(0, 15, n)).astype(np.int32)
+    d = {"user": rng.integers(0, NU, n).astype(np.int32), "item": item,
+         "rating": rng.normal(size=n).astype(np.float32)}
+
+    def go():
+        tr, st, p = _make(mesh, d, hot_tier=16, hot_sync_every=2)
+        tick = MegastepTick(check_every=1, churn_threshold=-1.0)
+        t, ls = tr.init_state(jax.random.key(0))
+        rec = obs.Recorder(sinks=[])
+        t, ls, _ = tr.run_megastep(t, ls, p, jax.random.key(1),
+                                   epochs=2, chunks_per_dispatch=2,
+                                   tick=tick, recorder=rec)
+        return tr, tick, rec, t
+
+    tr, tick, rec, tables = go()
+    gids = np.asarray(tr.store.tables[ids_key("item_factors")])
+    # The sketched head found the hot ids (id 0 may ride along: padding
+    # rows gather row 0, and the sketch counts them like the host
+    # tracker does).
+    assert len(set(gids.tolist()) & set(range(15, NI))) >= 14
+    assert rec.counter_value("tiering.re_ranks",
+                             table="item_factors") >= 1
+    # Replica rows == canonical rows at the final hot ids (boundary
+    # invariant survives in-graph re-derivation).
+    np.testing.assert_array_equal(
+        np.asarray(tr.store.tables[hot_key("item_factors")]),
+        tr.store.lookup_host("item_factors", gids))
+    # Slot map consistent with the gid order.
+    smap = np.asarray(tr.store.tables[map_key("item_factors")])
+    np.testing.assert_array_equal(smap[gids], np.arange(len(gids)))
+    # Host mirrors synced at end of run.
+    np.testing.assert_array_equal(tick.hot_ids["item_factors"], gids)
+    assert tick.tick > 0
+    # Determinism: an identical second run lands identical state.
+    tr2, tick2, _, _ = go()
+    np.testing.assert_array_equal(
+        gids, np.asarray(tr2.store.tables[ids_key("item_factors")]))
+    for k in tr.store.tables:
+        np.testing.assert_array_equal(
+            np.asarray(tr.store.tables[k]),
+            np.asarray(tr2.store.tables[k]))
+
+
+# -- validation ----------------------------------------------------------
+
+
+def test_megastep_validations(mesh, data):
+    tr, st, p = _make(mesh, data)
+    t, ls = tr.init_state(jax.random.key(0))
+    tr.config = dataclasses.replace(tr.config, push_delay=2)
+    with pytest.raises(ValueError, match="push_delay"):
+        tr.run_megastep(t, ls, p, jax.random.key(1))
+    tr.config = dataclasses.replace(tr.config, push_delay=0,
+                                    auto_tier=True)
+    with pytest.raises(ValueError, match="auto_tier"):
+        tr.run_megastep(t, ls, p, jax.random.key(1))
+    tr.config = dataclasses.replace(tr.config, auto_tier=False)
+    with pytest.raises(ValueError, match="chunks_per_dispatch"):
+        tr.run_megastep(t, ls, p, jax.random.key(1),
+                        chunks_per_dispatch=0)
+    # A host Retierer has no in-graph boundary to run on.
+    from fps_tpu.tiering import Retierer
+
+    tr.retierer = Retierer()
+    with pytest.raises(ValueError, match="MegastepTick"):
+        tr.run_megastep(t, ls, p, jax.random.key(1))
+    tr.retierer = None
+    # A tick without a mapped table is a config error, loudly — and the
+    # rejected tick must NOT stay attached as the trainer's retierer.
+    with pytest.raises(ValueError, match="mapped tier"):
+        tr.run_megastep(t, ls, p, jax.random.key(1),
+                        tick=MegastepTick())
+    assert tr.retierer is None
+    # Tick cadence must divide the dispatch — both at the runner and at
+    # the direct-builder entry point (lowered_megastep_text must raise,
+    # never silently truncate the dispatch).
+    tr2, st2, p2 = _make(mesh, data, hot_tier=16, hot_sync_every=2)
+    t2, l2 = tr2.init_state(jax.random.key(0))
+    with pytest.raises(ValueError, match="multiple"):
+        tr2.run_megastep(t2, l2, p2, jax.random.key(1),
+                         chunks_per_dispatch=3,
+                         tick=MegastepTick(check_every=2))
+    assert tr2.retierer is None
+    with pytest.raises(ValueError, match="multiple"):
+        tr2.lowered_megastep_text(p2, chunks_per_dispatch=3,
+                                  tick=MegastepTick(check_every=2))
+
+
+# -- chaos ---------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_megastep_kill_scenario(tmp_path):
+    from fps_tpu.testing.supervised_demo import run_megastep_kill_scenario
+
+    ok, detail = run_megastep_kill_scenario(str(tmp_path))
+    assert ok, detail

@@ -135,7 +135,11 @@ if __name__ == "__main__":
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
-from fps_tpu.analysis import ProgramContract, certify  # noqa: E402
+from fps_tpu.analysis import (  # noqa: E402
+    ProgramContract,
+    certify,
+    collective_profile,
+)
 from fps_tpu.core.driver import num_workers_of  # noqa: E402
 from fps_tpu.core.ingest import multi_epoch_chunks  # noqa: E402
 from fps_tpu.parallel.mesh import make_ps_mesh  # noqa: E402
@@ -197,6 +201,19 @@ BUDGETS: dict[str, dict] = {
                       per_kind_max={"all_gather": 2, "all_to_all": 1,
                                     "all_reduce": 1,
                                     "reduce_scatter": 1}),
+    # Device-resident megastep over the compacted tiered config (H=32
+    # of 64, cold_budget=8, K chunk segments fused into one program —
+    # fps_tpu.core.megastep). The census covers BOTH cold-route
+    # branches of the per-window overflow vote's lax.cond (compacted
+    # and bit-identical static — the compact branch's 8-wide lanes sit
+    # below the 1024B payload threshold, so the counted collectives are
+    # the static branch's cold routes plus each branch's sharded
+    # reconcile RS+AG). Pinned IDENTICAL for any K — the
+    # megastep_k_independence check asserts the census does not move
+    # between K=2 and K=4 (collective cost is O(traffic), never O(K)).
+    "mf_megastep": dict(max_collectives=10, max_collective_bytes=26624,
+                        per_kind_max={"all_gather": 6,
+                                      "reduce_scatter": 4}),
     # Sparse logreg, gathered route + adagrad server fold.
     "logreg": dict(max_collectives=2, max_collective_bytes=3200,
                    per_kind_max={"all_gather": 1, "all_to_all": 1}),
@@ -331,6 +348,60 @@ def rerank_byte_identity(mesh) -> bool:
     return t1 == t2
 
 
+def _mf_megastep_pieces(mesh, K: int):
+    """Tiered partial-head MF (H=32 of 64, cold_budget=8, gathered cold
+    routes) over the device-ingest path, fused into a K-chunk megastep —
+    the program contains BOTH cold-route branches (the device-side
+    overflow VOTE ``lax.cond``-selects per window), so the pinned census
+    covers the compacted AND the static branch bodies plus the vote's
+    verdict psum and the window reconcile."""
+    from fps_tpu.core.device_ingest import DeviceDataset, DeviceEpochPlan
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.utils.datasets import synthetic_ratings
+
+    cfg = MFConfig(num_users=NU, num_items=NI, rank=RANK)
+    trainer, store = online_mf(mesh, cfg, max_steps_per_call=STEPS)
+    for name, spec in store.specs.items():
+        store.specs[name] = dataclasses.replace(
+            spec, hot_tier=32, cold_budget=8, dense_collectives=False)
+    trainer.config = dataclasses.replace(trainer.config, hot_sync_every=2)
+    data = synthetic_ratings(NU, NI, 2000, rank=3, seed=3)
+    plan = DeviceEpochPlan(
+        DeviceDataset(mesh, data), num_workers=num_workers_of(mesh),
+        local_batch=LOCAL_BATCH, route_key="user", seed=11)
+    return trainer, plan
+
+
+def build_mf_megastep(mesh) -> str:
+    trainer, plan = _mf_megastep_pieces(mesh, 2)
+    return trainer.lowered_megastep_text(plan, chunks_per_dispatch=2)
+
+
+def megastep_k_independence(mesh) -> bool:
+    """THE megastep scaling claim as a pinned contract: collective count
+    AND payload bytes must be IDENTICAL when K doubles — the per-step
+    collectives live inside the scan body (one static occurrence
+    whatever K is) and the boundary ticks move O(window) payload per
+    window, so megastep collective cost scales with traffic, never with
+    how many chunks are fused into the dispatch. A change that unrolls
+    the segment loop (or adds a per-segment collective outside the scan
+    body) fails this audit."""
+    t2, p2 = _mf_megastep_pieces(mesh, 2)
+    t4, p4 = _mf_megastep_pieces(mesh, 4)
+    prof2 = collective_profile(
+        t2.lowered_megastep_text(p2, chunks_per_dispatch=2))
+    prof4 = collective_profile(
+        t4.lowered_megastep_text(p4, chunks_per_dispatch=4))
+
+    def census(prof):
+        kinds: dict[str, list] = {}
+        for c in prof:
+            kinds.setdefault(c.kind, []).append(c.payload_bytes)
+        return {k: sorted(v) for k, v in sorted(kinds.items())}
+
+    return census(prof2) == census(prof4)
+
+
 def build_logreg(mesh) -> str:
     from fps_tpu.models.logistic_regression import (
         LogRegConfig,
@@ -427,6 +498,7 @@ BUILDERS = {
     "mf_tiered_gathered": build_mf_tiered_gathered,
     "mf_tiered_compact": build_mf_tiered_compact,
     "mf_retier": build_mf_retier,
+    "mf_megastep": build_mf_megastep,
     "logreg": build_logreg,
     "w2v": build_w2v,
     "pa": build_pa,
@@ -434,7 +506,7 @@ BUILDERS = {
 }
 
 _TIERED_ROWS = ("mf_tiered", "mf_tiered_gathered", "mf_tiered_compact",
-                "mf_retier")
+                "mf_retier", "mf_megastep")
 
 
 def diff_budgets(old_doc: dict, measured: dict) -> list[str]:
@@ -554,6 +626,17 @@ def main(argv=None) -> int:
               f"({'identical' if rerank_identical else 'programs DIFFER'}"
               " across disjoint hot id sets)", file=sys.stderr)
 
+    megastep_k_ind = None
+    if "mf_megastep" in names:
+        # The megastep scaling contract: collective census identical as
+        # K doubles — megastep collective cost is O(traffic), not O(K).
+        megastep_k_ind = megastep_k_independence(mesh)
+        mark = "OK " if megastep_k_ind else "FAIL"
+        verdict = ("census identical" if megastep_k_ind
+                   else "census DIFFERS")
+        print(f"[{mark}] mf_megastep: K-independence ({verdict} across "
+              "K=2 vs K=4)", file=sys.stderr)
+
     diff_problems = []
     if args.diff:
         with open(args.diff, encoding="utf-8") as f:
@@ -580,10 +663,12 @@ def main(argv=None) -> int:
 
     ok = (all(c.ok for c in certs.values())
           and rerank_identical is not False
+          and megastep_k_ind is not False
           and not diff_problems)
     doc = {
         "audit_programs": {n: c.to_json() for n, c in certs.items()},
         "rerank_byte_identical": rerank_identical,
+        "megastep_k_independent": megastep_k_ind,
         "ok": ok,
         "mesh": {"shard": 8, "data": 1},
         "scale": {"nu": NU, "ni": NI, "rank": RANK, "nf": NF,

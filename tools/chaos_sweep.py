@@ -288,6 +288,7 @@ def _harness_scenarios():
         "prefetch_kill": _subprocess_scenario("run_prefetch_kill_scenario"),
         "hot_tier_kill": _subprocess_scenario("run_hot_tier_kill_scenario"),
         "retier_kill": _subprocess_scenario("run_retier_kill_scenario"),
+        "megastep_kill": _subprocess_scenario("run_megastep_kill_scenario"),
         "reconcile_shard_kill": _subprocess_scenario(
             "run_reconcile_shard_kill_scenario"),
         "serve_while_train": _subprocess_scenario(

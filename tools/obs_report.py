@@ -113,7 +113,8 @@ REQUIRED_FIELDS = (
     "schema", "obs_dir", "run_ids", "processes", "chunks", "epochs",
     "steps", "examples", "phase_seconds", "health", "incidents",
     "checkpoint_saves", "quarantined", "wall_span_s", "prefetch",
-    "hot_tier", "tiering", "source_stalls", "analysis", "serve", "pod",
+    "hot_tier", "megastep", "tiering", "source_stalls", "analysis",
+    "serve", "pod",
 )
 
 
@@ -305,6 +306,18 @@ def render_digest(obs_dir: str) -> dict:
                 counters.get("cold_route.overflow_chunks", 0)),
             "cold_dropped": int(
                 counters.get("hot_tier.cold_dropped", 0)),
+        },
+        # Device-resident megastep (fps_tpu.core.megastep): K-chunk
+        # fused dispatches with in-graph boundaries, plus the
+        # device-side overflow vote's window-level program selection.
+        "megastep": {
+            "windows": int(counters.get("megastep.windows", 0)),
+            "chunks_per_dispatch": gauges.get(
+                "megastep.chunks_per_dispatch", {}).get("last"),
+            "vote_compact_windows": int(
+                counters.get("cold_route.vote_compact_windows", 0)),
+            "vote_overflow_windows": int(
+                counters.get("cold_route.vote_overflow_windows", 0)),
         },
         # Adaptive tiering (fps_tpu.tiering): online hot-set re-ranking
         # + auto-planner activity — re-rank/promotion totals (labels
