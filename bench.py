@@ -1827,10 +1827,236 @@ def run_megastep_ab(args):
     }
 
 
+def run_delta(args):
+    """Delta-snapshot + serving-fleet A/B (ISSUE 14; docs/serving.md,
+    docs/resilience.md) on the tiered zipf-MF workload at a ~0.94 hot
+    hit rate: the same stream trained twice with per-chunk async
+    checkpoints —
+
+    * **full**  — every publication rewrites whole tables (the PR-7
+      baseline: publish bytes and write→servable lag are O(table));
+    * **delta** — ``DeltaPolicy`` chains: one full + row-sparse deltas
+      sourced from the driver's touched-rows tracker, so publish bytes
+      track rows actually touched since the last publication.
+
+    A SnapshotWatcher tails each arm for write→servable lag; the delta
+    arm additionally runs the step-fenced SERVING FLEET (N >= 3
+    ``FleetReader``s under quorum fencing) with a per-reader query load,
+    reporting p50/p99 pull latency under concurrent training.
+
+    Acceptance: >= 3x fewer publish bytes than full snapshots, states
+    bit-identical, and the fleet converged on one fenced step."""
+    import dataclasses
+    import tempfile
+    import threading
+
+    import jax
+
+    from fps_tpu.core.checkpoint import AsyncCheckpointer, DeltaPolicy
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.core.ingest import epoch_chunks
+    from fps_tpu.models.matrix_factorization import MFConfig, online_mf
+    from fps_tpu.parallel.mesh import default_mesh_shape, make_ps_mesh
+    from fps_tpu.serve import NoSnapshotError, ServingFleet, SnapshotWatcher
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        return _reexec_workload_subprocess("delta")
+    nd, ns = default_mesh_shape(8)
+    mesh = make_ps_mesh(num_shards=ns, num_data=nd, devices=devs[:8])
+    W = num_workers_of(mesh)
+
+    # Table large relative to per-chunk traffic (that is the regime the
+    # delta encoding exists for); H = half the table gives the tiered
+    # arm's ~0.94 hit rate at alpha 1.05 (run_tiered's coverage rule).
+    NU, NI, RANK = 32768, 32768, 16
+    H, E_SYNC = 12288, 4  # ~0.94 hot hit rate at alpha 1.05
+    LOCAL_BATCH, SPC, CHUNKS = 256, 4, 10
+    N_READERS = 3
+    data = _zipf_ratings(NU, NI, W * LOCAL_BATCH * SPC * CHUNKS, seed=0)
+
+    def make_chunks():
+        return epoch_chunks(data, num_workers=W, local_batch=LOCAL_BATCH,
+                            steps_per_chunk=SPC, route_key="user", seed=5)
+
+    def make_trainer():
+        from fps_tpu import obs
+
+        cfg = MFConfig(num_users=NU, num_items=NI, rank=RANK,
+                       learning_rate=0.05)
+        trainer, store = online_mf(mesh, cfg, combine="mean")
+        store.specs["item_factors"] = dataclasses.replace(
+            store.specs["item_factors"], hot_tier=H,
+            dense_collectives=False)
+        trainer.config = dataclasses.replace(trainer.config,
+                                             hot_sync_every=E_SYNC)
+        rec = obs.Recorder(sinks=[])
+        trainer.recorder = rec
+        return trainer, store, rec
+
+    def run_arm(d, policy, *, fleet=None):
+        trainer, store, rec = make_trainer()
+        tables, ls = trainer.init_state(jax.random.key(0))
+        ck = AsyncCheckpointer(d, keep=CHUNKS + 2, delta=policy)
+        lags = []
+        watcher = SnapshotWatcher(
+            d, on_swap=lambda s, _dir: lags.append(
+                watcher.write_to_servable_s))
+        stop = threading.Event()
+        threads = [threading.Thread(
+            target=watcher.run, kwargs={"interval_s": 0.05, "stop": stop},
+            name="bench-delta-watcher", daemon=True)]
+        qcounts = [0] * (len(fleet.readers) if fleet is not None else 0)
+        qerr = []
+        if fleet is not None:
+            fleet.start(interval_s=0.05)
+
+            def load(idx, reader):
+                rng = np.random.default_rng(idx)
+                while not stop.is_set():
+                    try:
+                        reader.server.pull(
+                            "item_factors", rng.integers(0, NI, 256))
+                    except NoSnapshotError:
+                        time.sleep(0.005)
+                        continue
+                    except Exception as e:  # noqa: BLE001 — re-raised
+                        qerr.append(e)
+                        return
+                    qcounts[idx] += 1
+
+            threads += [threading.Thread(
+                target=load, args=(i, r), daemon=True,
+                name=f"bench-delta-load-{i}")
+                for i, r in enumerate(fleet.readers)]
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        tables, ls, m = trainer.fit_stream(
+            tables, ls, make_chunks(), jax.random.key(1),
+            checkpointer=ck, checkpoint_every=1)
+        wall = time.perf_counter() - t0
+        ck.close()
+        stop.set()
+        if fleet is not None:
+            fleet.stop()
+        for t in threads:
+            t.join(timeout=10.0)
+        if qerr:
+            raise RuntimeError("delta fleet query load died") from qerr[0]
+        n_ex = float(sum(np.asarray(mm["n"]).sum() for mm in m))
+        hr = rec.counter_value("hot_tier.hot_rows", table="item_factors")
+        pr = rec.counter_value("hot_tier.pulled_rows",
+                               table="item_factors")
+        pubs = ck.full_publishes + ck.delta_publishes
+        arm = {
+            "examples_per_sec": round(n_ex / wall, 1),
+            "publish_bytes_total": ck.publish_bytes_total,
+            "publish_bytes_per_publication": (
+                round(ck.publish_bytes_total / pubs) if pubs else None),
+            "publications": pubs,
+            "delta_publishes": ck.delta_publishes,
+            "full_publishes": ck.full_publishes,
+            "hot_hit_rate": round(hr / pr, 4) if pr else None,
+            "write_to_servable_s_mean": (round(float(np.mean(lags)), 4)
+                                         if lags else None),
+            "write_to_servable_s_max": (round(float(np.max(lags)), 4)
+                                        if lags else None),
+        }
+        final = store.lookup_host("item_factors", np.arange(NI))
+        return arm, final, (qcounts, wall)
+
+    # Warm-up (compile) outside every timed region.
+    from itertools import islice
+
+    trainer, _store, _rec = make_trainer()
+    tables, ls = trainer.init_state(jax.random.key(9))
+    with tempfile.TemporaryDirectory() as d:
+        ck = AsyncCheckpointer(d, keep=2)
+        trainer.fit_stream(tables, ls, islice(make_chunks(), 2),
+                           jax.random.key(9), checkpointer=ck,
+                           checkpoint_every=1)
+        ck.close()
+
+    policy = DeltaPolicy(full_every=CHUNKS + 4)
+    with tempfile.TemporaryDirectory() as d:
+        full_arm, full_state, _ = run_arm(d, None)
+    # The lag/throughput A/B runs WITHOUT the fleet attached (same
+    # topology as the full arm: one watcher) so write->servable compares
+    # the PUBLISH paths, not GIL contention from the load generators.
+    with tempfile.TemporaryDirectory() as d:
+        delta_arm, delta_state, _ = run_arm(d, policy)
+    # Fleet pass: same delta-publishing stream with N fence-coordinated
+    # readers + per-reader query load hammering them mid-train.
+    with tempfile.TemporaryDirectory() as d:
+        fleet = ServingFleet(d, N_READERS, quorum=2)
+        fleet_arm, _fleet_state, (qcounts, wall) = run_arm(
+            d, policy, fleet=fleet)
+        # Converge after the end-of-run flush (a reader mid-swap at
+        # stop() catches up here; chain failures are retried).
+        for _ in range(8):
+            fleet.poll()
+            if len({r.server._snap.step if r.server._snap else None
+                    for r in fleet.readers}) == 1:
+                break
+        fleet_stats = fleet.stats()
+
+    ratio = (full_arm["publish_bytes_total"]
+             / max(delta_arm["publish_bytes_total"], 1))
+    readers = []
+    for i, st in enumerate(fleet_stats):
+        readers.append({
+            "reader": st["reader"],
+            "queries_per_sec": round(qcounts[i] / wall, 1),
+            "latency_p50_s": st.get("latency_p50_s"),
+            "latency_p99_s": st.get("latency_p99_s"),
+            "final_step": st.get("step"),
+            "fence": st.get("fence"),
+            "chain_len": st.get("chain_len"),
+        })
+    fence_steps = {st.get("step") for st in fleet_stats}
+    out = {
+        "mesh": dict(mesh.shape), "hot_tier_rows": H,
+        "hot_sync_every": E_SYNC, "zipf_alpha": 1.05,
+        "table_rows": NI, "rank": RANK,
+        "full": full_arm, "delta": delta_arm,
+        "publish_bytes_reduction_x": round(ratio, 2),
+        "states_bit_identical": bool(
+            np.array_equal(full_state, delta_state)),
+        "fleet": {
+            "n_readers": N_READERS, "quorum": 2,
+            "readers": readers,
+            "converged_single_step": len(fence_steps) == 1,
+            "queries_per_sec_total": round(sum(qcounts) / wall, 1),
+        },
+    }
+    print(
+        f"delta A/B: publish bytes {full_arm['publish_bytes_total']} -> "
+        f"{delta_arm['publish_bytes_total']} ({out['publish_bytes_reduction_x']}x"
+        f" fewer; {delta_arm['delta_publishes']} deltas + "
+        f"{delta_arm['full_publishes']} fulls), hit rate "
+        f"{delta_arm['hot_hit_rate']}, write->servable mean "
+        f"{full_arm['write_to_servable_s_mean']}s -> "
+        f"{delta_arm['write_to_servable_s_mean']}s, fleet "
+        f"{out['fleet']['queries_per_sec_total']:.0f} q/s over "
+        f"{N_READERS} readers (p99 "
+        f"{[r['latency_p99_s'] for r in readers]}), bit-identical "
+        f"{out['states_bit_identical']}", file=sys.stderr)
+    return {
+        "metric": "delta_publish_bytes_reduction",
+        "value": out["publish_bytes_reduction_x"],
+        "unit": "x_fewer_bytes",
+        # The A/B's own ratio mirrors the headline: full-arm publish
+        # bytes over delta-arm publish bytes on the same stream.
+        "vs_baseline": out["publish_bytes_reduction_x"],
+        **out,
+    }
+
+
 RUNNERS = {"mf": run_mf, "w2v": run_w2v, "logreg": run_logreg,
            "pa": run_pa, "ials": run_ials, "tiered": run_tiered,
            "tiered_drift": run_tiered_drift, "serve": run_serve,
-           "megastep": run_megastep_ab}
+           "megastep": run_megastep_ab, "delta": run_delta}
 
 
 def compact_summary(results):
@@ -1892,7 +2118,7 @@ def main():
     ap.add_argument("--workload", default="all",
                     choices=["all", "mf", "w2v", "logreg", "pa", "ials",
                              "tiered", "tiered_drift", "serve",
-                             "megastep"])
+                             "megastep", "delta"])
     ap.add_argument("--scale", default="20m", choices=["100k", "1m", "20m"])
     ap.add_argument("--rank", type=int, default=10)
     ap.add_argument("--local-batch", type=int, default=32768)
@@ -1918,7 +2144,7 @@ def main():
     if args.workload == "all":
         # Headline (mf) LAST among the per-workload lines.
         order = ["w2v", "logreg", "pa", "ials", "tiered", "tiered_drift",
-                 "serve", "megastep", "mf"]
+                 "serve", "megastep", "delta", "mf"]
     else:
         order = [args.workload]
     results = {}

@@ -40,6 +40,7 @@ against the copy after the next chunk has been dispatched, so the dump's
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import os
@@ -239,6 +240,92 @@ def load_model(
 
 
 # ---------------------------------------------------------------------------
+# Delta publications (ISSUE 14): crash-safe incremental snapshot chains.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DeltaPolicy:
+    """Knobs for delta-snapshot chains (``Checkpointer(delta=...)``).
+
+    With a policy attached, a save whose state can be described as a
+    row-sparse diff against the previous publication writes a DELTA
+    (``delta_{step}_{base}.npz``: per-key touched-row ids + values, each
+    entry CRC-tagged like a full's, carrying ``meta::base_step`` and the
+    fencing epoch) instead of rewriting whole tables — publish bytes and
+    write→servable lag become O(touched rows), not O(table).
+
+    * ``full_every`` — hard chain-length bound: at most ``full_every-1``
+      consecutive deltas before the writer publishes a fresh full
+      (bounds recovery-walk depth and blast radius; ``<= 1`` disables
+      deltas entirely).
+    * ``compact_every`` — LSM-style compaction trigger: when the live
+      on-disk chain carries at least this many deltas, the next publish
+      folds the chain into a fresh full at the chain head (on the
+      AsyncCheckpointer this runs on the background writer thread) and
+      sweeps the folded links. ``0`` = compaction only via an explicit
+      :meth:`Checkpointer.compact` call.
+
+    Touched-row sourcing: per-table supersets handed to ``save(...,
+    touched_rows=...)`` (the drivers accumulate them from the PR-8/10
+    traffic stream, ``WorkerLogic.pulled_ids_host``) make the diff
+    O(touched); tables without a supplied set fall back to an exact
+    vectorized row compare against the retained base (O(table) compute,
+    still O(changed) bytes). Worker-local state (``ls::``) and hot-fold
+    state (``fold::``) always use the exact compare. Either way a delta
+    restores bit-identically to the full it stands in for.
+    """
+
+    full_every: int = 8
+    compact_every: int = 0
+
+
+class TouchedRowsTracker:
+    """Accumulates per-table touched-row id supersets between
+    publications (driver-side source for ``save(touched_rows=...)``).
+
+    Append-only log of per-chunk observations; :meth:`capture` unions
+    the current prefix WITHOUT consuming it (a deferred/overlapped save
+    may be re-captured after a quarantine recompute), and
+    :meth:`commit` drops the prefix once its publication was actually
+    accepted. ``observe(None)`` (an uncertifiable chunk) poisons every
+    table in the prefix — those tables publish via the exact-diff
+    fallback instead.
+    """
+
+    def __init__(self, tables):
+        self.tables = tuple(sorted(tables))
+        self._log: list = []  # per-chunk: dict[name -> ids] | None
+
+    def observe(self, ids_by_table) -> None:
+        if ids_by_table is None:
+            self._log.append(None)
+            return
+        self._log.append({
+            name: np.unique(np.asarray(ids, np.int64).reshape(-1))
+            for name, ids in ids_by_table.items()})
+
+    def capture(self) -> tuple[dict, int]:
+        """``(touched_rows, marker)`` over the current prefix — tables
+        unseen by every observation (or covered by an uncertifiable
+        chunk) map to ``None`` (exact-diff fallback)."""
+        marker = len(self._log)
+        prefix = self._log[:marker]
+        unknown = any(obs is None for obs in prefix)
+        out = {}
+        for name in self.tables:
+            if unknown or any(name not in obs for obs in prefix):
+                out[name] = None
+                continue
+            parts = [obs[name] for obs in prefix]
+            out[name] = (np.unique(np.concatenate(parts)) if parts
+                         else np.zeros(0, np.int64))
+        return out, marker
+
+    def commit(self, marker: int) -> None:
+        del self._log[:marker]
+
+
+# ---------------------------------------------------------------------------
 # Periodic checkpointing (tables + worker-local state + step counter).
 # ---------------------------------------------------------------------------
 
@@ -269,10 +356,21 @@ class Checkpointer:
     that died mid-write before its atomic rename) — but only ones older
     than :attr:`TMP_SWEEP_AGE_S`, so a concurrent writer's in-flight tmp
     file is never deleted from under it.
+
+    Delta chains (``delta=DeltaPolicy(...)``, ISSUE 14): saves publish
+    row-sparse DELTAS against the previous publication when that is
+    smaller — publish bytes become O(touched rows) — with recovery
+    walking full→delta chains (a torn/CRC-failing/epoch-stale link
+    truncates back to the last verified one, and quarantining a full
+    quarantines every delta chained on it) and :meth:`compact` folding
+    chains back into fulls LSM-style under the same atomic-rename +
+    fence-precommit discipline. ``docs/resilience.md`` has the failure
+    model; ``docs/serving.md`` the read-side contract.
     """
 
     def __init__(self, directory: str, *, keep: int = 3,
-                 fence_epoch: int | None = None):
+                 fence_epoch: int | None = None,
+                 delta: DeltaPolicy | None = None):
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
         self.dir = directory
@@ -285,6 +383,31 @@ class Checkpointer:
         # checkpoint into the pod's new attempt). Children read their
         # epoch from the pod env contract: ``fence_epoch_from_env()``.
         self.fence_epoch = fence_epoch
+        # Delta-snapshot chains (DeltaPolicy): _chain_base retains the
+        # last publication's full-form host arrays (one snapshot's worth
+        # of host memory — the same order the async writer's queue slot
+        # already costs) so a save can be planned as a row-sparse diff;
+        # _chain_head/_chain_len track the live chain. All three are
+        # advisory plan state: the ON-DISK chain is the source of truth
+        # and a restart re-derives them from read_snapshot.
+        self.delta_policy = delta
+        self._chain_base: dict | None = None
+        self._chain_head: int | None = None
+        self._chain_len = 0
+        # Publication accounting (bench / chaos evidence; the writer
+        # thread is the single mutator under the async subclass).
+        self.full_publishes = 0
+        self.delta_publishes = 0
+        self.compactions = 0
+        self.publish_bytes_total = 0
+        self.delta_bytes_total = 0
+        # Test seam for the compaction chaos scenarios: called with a
+        # phase name ("precommit" — after the new full's fsync, before
+        # its publishing rename; "published" — after the rename, before
+        # the sweep; "swept_one" — after the first folded link is
+        # removed). A chaos victim SIGKILLs itself here to pin the
+        # recovery contract at every phase. None in production.
+        self._compact_phase_hook = None
         os.makedirs(directory, exist_ok=True)
         self._sweep_tmp()
         self._sweep_corrupt()
@@ -428,19 +551,35 @@ class Checkpointer:
             "attempt the pod has aborted and restarted past"
         )
 
-    def _write(self, step: int, arrays: dict[str, np.ndarray]) -> str:
+    def _write(self, step: int, arrays: dict[str, np.ndarray], *,
+               base: int | None = None) -> str:
         """Serialize half of a save: CRC tags, atomic fsync'd write,
         telemetry, retention GC. Runs on the caller's thread here; the
-        AsyncCheckpointer runs it on its writer thread."""
+        AsyncCheckpointer runs it on its writer thread. ``base`` is not
+        None for a DELTA publication (``arrays`` already holds the
+        sparse entries from :meth:`_plan_publication`)."""
         self._check_fence(step)
+        if base is not None and base not in self._pubs():
+            # The async writer may reach this delta AFTER its base's
+            # write failed (the plan ran on the caller thread while the
+            # base was still in flight): publishing it would leave a
+            # broken chain head on disk. Refuse — the caller sees the
+            # error (and the base's original failure) on its next
+            # save/flush, and the chain plan resets to a full.
+            raise RuntimeError(
+                f"refusing orphan delta step {step}: base publication "
+                f"{base} never landed under {self.dir}")
         arrays = dict(arrays)
         for k in list(arrays):
             arrays[_CRC_PREFIX + k] = np.uint32(array_crc32(arrays[k]))
-        path = self._path(step)
+        path = (self._path(step) if base is None
+                else snapshot_format.delta_path(self.dir, step, base))
         t0 = time.perf_counter()
         # The fence is re-checked as the PRE-COMMIT hook, after the slow
         # serialize+fsync and immediately before the publishing rename —
         # a fence that lands while a big table is serializing still wins.
+        # Every link of a delta chain re-reads it the same way: a stale
+        # zombie can no more extend a chain than publish a full.
         _atomic_savez(path, arrays,
                       precommit=lambda: self._check_fence(step))
         secs = time.perf_counter() - t0
@@ -448,26 +587,179 @@ class Checkpointer:
             nbytes = os.path.getsize(path)
         except OSError:
             nbytes = -1
+        # "publication", not "kind": the record envelope already uses
+        # the "kind" key for event-vs-metric.
         _obs_event("checkpoint_saved", step=int(step), path=path,
-                   seconds=round(secs, 4), bytes=nbytes)
+                   seconds=round(secs, 4), bytes=nbytes,
+                   publication="full" if base is None else "delta",
+                   **({} if base is None else {"base": int(base)}))
         _obs_metric("inc", "checkpoint.saves", 1)
         _obs_metric("observe", "checkpoint.save_seconds", secs)
         if nbytes >= 0:
-            _obs_metric("set", "checkpoint.bytes", nbytes)
+            if base is None:
+                # FULLS only: this gauge is the payload-proportionality
+                # reference checkpoint.delta_bytes is compared against —
+                # letting a small delta overwrite it would make the
+                # obs_report ratio meaningless.
+                _obs_metric("set", "checkpoint.bytes", nbytes)
+            self.publish_bytes_total += nbytes
+        if base is None:
+            self.full_publishes += 1
+        else:
+            self.delta_publishes += 1
+            if nbytes >= 0:
+                self.delta_bytes_total += nbytes
+                _obs_metric("inc", "checkpoint.delta_bytes", nbytes)
+            _obs_metric("inc", "checkpoint.delta_publishes", 1)
         self._gc()
+        self._maybe_auto_compact()
         return path
 
     def save(self, step: int, store: ParamStore, local_state: Pytree = None,
-             *, local_state_format: str = "raw") -> str:
+             *, local_state_format: str = "raw",
+             touched_rows: Mapping | None = None) -> str:
         """``local_state_format`` tags how the local-state leaves are laid
         out: ``"raw"`` (device layout, restorable via :meth:`restore` at
         the same worker count) or ``"exported"`` (the worker logic's
         worker-count-independent form, written by the Trainer path and
         restorable only via ``Trainer.restore_checkpoint``). The tag makes
         a mismatched restore fail loudly instead of silently permuting
-        state when shapes happen to coincide."""
-        return self._write(step, self._collect_timed(
-            store, local_state, local_state_format))
+        state when shapes happen to coincide.
+
+        ``touched_rows`` (delta chains only): per-table id SUPERSETS of
+        the rows touched since the last publication (``None`` entries /
+        a ``None`` dict fall back to the exact row compare). Ignored
+        without a :class:`DeltaPolicy`."""
+        arrays = self._collect_timed(store, local_state, local_state_format)
+        step, base, payload = self._plan_publication(
+            int(step), arrays, touched_rows)
+        try:
+            return self._write(step, payload, base=base)
+        except BaseException:
+            # The planned chain state described a publication that never
+            # landed — a later delta must not chain onto it.
+            self._chain_reset()
+            raise
+
+    # -- delta-chain planning (caller thread, serial) ----------------------
+
+    def _chain_reset(self) -> None:
+        self._chain_base = None
+        self._chain_head = None
+        self._chain_len = 0
+
+    def _plan_publication(self, step: int, arrays: dict,
+                          touched_rows: Mapping | None
+                          ) -> tuple[int, int | None, dict]:
+        """Decide full vs delta for one save: returns ``(step, base,
+        payload)`` (``base is None`` = full, payload = the entries to
+        serialize) and advances the in-memory chain plan. Exactness
+        rule: a delta is only planned when EVERY entry of the new state
+        is either bit-carried from the retained base or explicitly in
+        the payload — anything surprising (no policy, no base, key/shape
+        drift, non-monotone step, chain at its length bound, delta not
+        actually smaller) publishes a full."""
+        policy = self.delta_policy
+        if policy is None or policy.full_every <= 1:
+            return step, None, arrays
+        # The retained base must OWN its memory: a zero-copy view of a
+        # device buffer the next step donates away would silently rot
+        # the diff baseline (the async writer makes the same copy for
+        # its queue slot; here it protects the sync path too).
+        arrays = dict(arrays)
+        for k, v in arrays.items():
+            if isinstance(v, np.ndarray) and not v.flags["OWNDATA"]:
+                arrays[k] = np.array(v, copy=True)
+        base_ok = (self._chain_base is not None
+                   and self._chain_head is not None
+                   and step > self._chain_head
+                   and self._chain_len + 1 < policy.full_every)
+        payload = (self._delta_entries(arrays, touched_rows)
+                   if base_ok else None)
+        if payload is not None:
+            full_bytes = sum(getattr(v, "nbytes", 0)
+                             for v in arrays.values())
+            delta_bytes = sum(getattr(v, "nbytes", 0)
+                              for v in payload.values())
+            if delta_bytes >= full_bytes:
+                payload = None  # no savings: a full is strictly better
+        if payload is None:
+            self._chain_base = dict(arrays)
+            self._chain_head = step
+            self._chain_len = 0
+            return step, None, arrays
+        base = self._chain_head
+        payload[snapshot_format.BASE_STEP_KEY] = np.int64(base)
+        # Advance the retained base to the state this delta describes
+        # (overlay by reference: the arrays are fresh host buffers).
+        new_base = dict(self._chain_base)
+        for k, v in arrays.items():
+            new_base[k] = v
+        self._chain_base = new_base
+        self._chain_head = step
+        self._chain_len += 1
+        return step, base, payload
+
+    def _delta_entries(self, arrays: dict, touched_rows: Mapping | None
+                       ) -> dict | None:
+        """Row-sparse diff of ``arrays`` against the retained chain base:
+        ``dids::K``/``drows::K`` pairs for row-sparse keys, plain-key
+        full replacements for everything else that changed, nothing for
+        bit-identical entries. ``None`` when the structural contract
+        broke (key set / shape / dtype drift on a row-sparse kind)."""
+        base = self._chain_base
+        fmt = snapshot_format
+        sparse_kinds = (f"table{_SEP}", fmt.FOLD_PREFIX, f"ls{_SEP}")
+        out: dict[str, np.ndarray] = {}
+        for k, v in arrays.items():
+            if k.startswith(f"meta{_SEP}"):
+                # Meta tags ride every link in full (tiny, and the
+                # chain verifier needs each delta's OWN fencing epoch —
+                # an omitted-because-unchanged epoch would blind the
+                # read-side staleness check).
+                out[k] = v
+                continue
+            bv = base.get(k)
+            row_sparse = (k.startswith(sparse_kinds)
+                          and getattr(v, "ndim", 0) >= 2)
+            if bv is None:
+                if row_sparse:
+                    return None  # a new table/leaf appeared: full
+                out[k] = v
+                continue
+            same_layout = (getattr(bv, "shape", None) == v.shape
+                           and getattr(bv, "dtype", None) == v.dtype)
+            if not same_layout:
+                if row_sparse:
+                    return None
+                out[k] = v
+                continue
+            if not row_sparse:
+                if not np.array_equal(bv, v):
+                    out[k] = v
+                continue
+            ids = None
+            if touched_rows is not None and k.startswith(f"table{_SEP}"):
+                ids = touched_rows.get(k.split(_SEP, 1)[1])
+            if ids is not None:
+                # Tracker-sourced superset: O(touched) work, no compare.
+                ids = np.unique(np.asarray(ids, np.int64).reshape(-1))
+                ids = ids[(ids >= 0) & (ids < len(v))]
+            else:
+                # Exact vectorized row compare against the base.
+                tail = tuple(range(1, v.ndim))
+                neq = (v != bv)
+                ids = np.flatnonzero(np.any(neq, axis=tail)
+                                     if tail else neq)
+            out[fmt.DELTA_IDS_PREFIX + k] = np.asarray(ids, np.int64)
+            out[fmt.DELTA_ROWS_PREFIX + k] = np.ascontiguousarray(v[ids])
+        # Row-sparse keys present in the base but dropped from the new
+        # state (a model-definition change): structural — publish full.
+        for k in base:
+            if (k.startswith(sparse_kinds) and k not in arrays
+                    and not k.startswith(_CRC_PREFIX)):
+                return None
+        return out
 
     def _collect_timed(self, store, local_state, local_state_format):
         """:meth:`_collect` plus the ``checkpoint.dump_seconds`` metric —
@@ -496,12 +788,15 @@ class Checkpointer:
         self.close()
 
     def steps(self) -> list[int]:
-        out = []
-        for f in os.listdir(self.dir):
-            m = SNAPSHOT_RE.fullmatch(f)
-            if m:
-                out.append(int(m.group(1)))
-        return sorted(out)
+        """Published steps, ascending — every publication counts: fulls
+        AND delta links (a delta step restores via its chain)."""
+        return sorted(self._pubs())
+
+    def _pubs(self) -> dict:
+        """Live publication index ({step: Publication}) — re-scanned per
+        call; the directory is the source of truth (concurrent writers,
+        compaction, quarantine all mutate it)."""
+        return snapshot_format.publications(self.dir)
 
     def latest_step(self) -> int | None:
         steps = self.steps()
@@ -514,32 +809,107 @@ class Checkpointer:
                 raise FileNotFoundError(f"no checkpoints under {self.dir}")
         return step
 
-    def _read_verified(self, step: int, verify: bool) -> tuple[dict, list, str]:
-        """Load EVERY entry of one snapshot, checking each against its
-        ``meta::crc`` tag; any read error or checksum mismatch raises
-        :class:`SnapshotCorruptionError`. Pre-integrity snapshots (no crc
-        tags) still get the structural checks — an unreadable zip fails
-        either way."""
+    def _read_entries(self, step: int, path: str, verify: bool) -> dict:
+        """Load every non-CRC entry of ONE publication file, verifying
+        each against its ``meta::crc`` tag. Raises
+        :class:`SnapshotCorruptionError` carrying ``.step`` (the failing
+        link — chain reads truncate back to the last verified one)."""
         try:
-            with np.load(self._path(step)) as z:
+            with np.load(path) as z:
                 entries = {k: z[k] for k in z.files
                            if not k.startswith(_CRC_PREFIX)}
                 if verify:
                     for k, v in entries.items():
                         ck = _CRC_PREFIX + k
                         if ck in z.files and int(z[ck]) != array_crc32(v):
-                            raise SnapshotCorruptionError(
+                            err = SnapshotCorruptionError(
                                 f"snapshot step {step}: checksum mismatch "
                                 f"on entry {k!r}"
                             )
+                            err.step = step
+                            raise err
         except (SnapshotCorruptionError, FileNotFoundError):
             # A missing file is "no such checkpoint", not disk corruption —
             # a pinned-but-gc'd step must keep raising FileNotFoundError.
             raise
         except _IO_ERRORS as e:
-            raise SnapshotCorruptionError(
+            err = SnapshotCorruptionError(
                 f"snapshot step {step} unreadable: {e!r}"
-            ) from e
+            )
+            err.step = step
+            raise err from e
+        return entries
+
+    def _resolve_entries(self, step: int, verify: bool) -> dict:
+        """Full-form entries of publication ``step`` — a full reads one
+        file; a delta walks its chain (every link verified) and overlays
+        base→head. A broken/stale/corrupt link raises
+        :class:`SnapshotCorruptionError` with ``.step`` naming the LINK,
+        so the auto-resolve fallback quarantines exactly the failing
+        suffix and truncates the chain back to the last verified one."""
+        pubs = self._pubs()
+        pub = pubs.get(step)
+        if pub is None:
+            # Historical contract: a never-published step reads as "no
+            # such checkpoint" from the single-file open.
+            return self._read_entries(step, self._path(step), verify)
+        if pub.kind == "full":
+            return self._read_entries(step, pub.path, verify)
+        try:
+            members = snapshot_format.chain_members(pubs, step)
+        except snapshot_format.ChainError as e:
+            err = SnapshotCorruptionError(str(e))
+            err.step = e.step if e.step is not None else step
+            raise err from e
+        ok, reason, failing = snapshot_format._check_chain_meta(members)
+        if not ok:
+            err = SnapshotCorruptionError(
+                f"delta chain for step {step} refused: {reason}")
+            err.step = failing if failing is not None else step
+            raise err
+        entries = self._read_entries(members[0].step, members[0].path,
+                                     verify)
+        for link in members[1:]:
+            delta = self._read_entries(link.step, link.path, verify)
+            try:
+                entries = snapshot_format.apply_delta_entries(
+                    entries, delta)
+            except snapshot_format.ChainError as e:
+                err = SnapshotCorruptionError(
+                    f"delta step {link.step} does not apply: {e}")
+                err.step = link.step
+                raise err from e
+        entries.pop(snapshot_format.BASE_STEP_KEY, None)
+        return entries
+
+    def _read_verified(self, step: int, verify: bool, *,
+                       anchor: bool = False) -> tuple[dict, list, str]:
+        """Load EVERY entry of one publication (chain-resolved for
+        deltas), checking each against its ``meta::crc`` tag; any read
+        error, checksum mismatch, or broken chain raises
+        :class:`SnapshotCorruptionError`. Pre-integrity snapshots (no crc
+        tags) still get the structural checks — an unreadable zip fails
+        either way.
+
+        ``anchor=True`` (the RESTORE path only — ``read_snapshot``)
+        re-anchors the delta chain plan on the resolved state so the
+        next save may chain from it. Verification reads
+        (``verify_snapshot`` / ``latest_valid_step``) must NOT anchor:
+        resetting the plan's length on every monitoring probe would
+        defeat the ``full_every`` chain-depth bound."""
+        entries = self._resolve_entries(step, verify)
+        if anchor and self.delta_policy is not None:
+            self._chain_base = dict(entries)
+            self._chain_head = step
+            # Plan length = the resolved publication's ACTUAL on-disk
+            # chain depth, so full_every bounds total recovery-walk
+            # depth across restarts, not just deltas-since-restore.
+            try:
+                self._chain_len = sum(
+                    1 for p in snapshot_format.chain_members(
+                        self._pubs(), step) if p.kind == "delta")
+            except snapshot_format.ChainError:
+                self._chain_len = 0
         tables = {
             k.split(_SEP, 1)[1]: v
             for k, v in entries.items()
@@ -560,10 +930,14 @@ class Checkpointer:
         return tables, _ls_leaves(entries), _ls_format(entries)
 
     def _quarantine(self, step: int, err: Exception) -> None:
-        """Take a corrupt snapshot out of the rotation (rename to
+        """Take a corrupt publication out of the rotation (rename to
         ``*.corrupt`` — preserved for forensics, invisible to
-        :meth:`steps`)."""
-        path = self._path(step)
+        :meth:`steps`) — AND every delta chained on it, transitively: a
+        descendant's state is defined in terms of the quarantined link,
+        so no reader may ever resolve a chain through it."""
+        pubs = self._pubs()
+        pub = pubs.get(step)
+        path = pub.path if pub is not None else self._path(step)
         _log.warning(
             "discarding corrupt snapshot step %d (%s); falling back to the "
             "previous surviving snapshot", step, err,
@@ -571,15 +945,36 @@ class Checkpointer:
         _obs_event("checkpoint_fallback", step=int(step), path=path,
                    error=repr(err))
         _obs_metric("inc", "checkpoint.fallbacks", 1)
-        try:
-            os.replace(path, path + ".corrupt")
-            # Age from NOW: the rename preserves the snapshot's original
-            # mtime, and an old-enough snapshot would otherwise be
-            # deleted by the very sweep below — the sweep's age bound is
-            # about time-in-quarantine, not snapshot age.
-            os.utime(path + ".corrupt")
-        except OSError:
-            pass
+        bad = {step}
+        doomed = [path]
+        # Transitive descendants: any delta whose back-chain passes
+        # through a quarantined step.
+        changed = True
+        while changed:
+            changed = False
+            for s, p in pubs.items():
+                if s not in bad and p.kind == "delta" and p.base in bad:
+                    bad.add(s)
+                    doomed.append(p.path)
+                    changed = True
+        for i, p in enumerate(doomed):
+            if i:  # the failing link was already logged/evented above
+                _log.warning(
+                    "quarantining %s: chained on corrupt step %d",
+                    os.path.basename(p), step)
+                _obs_event("checkpoint_fallback", path=p,
+                           step=int(step), chained=True,
+                           error="chained on quarantined step")
+            try:
+                os.replace(p, p + ".corrupt")
+                # Age from NOW: the rename preserves the snapshot's
+                # original mtime, and an old-enough snapshot would
+                # otherwise be deleted by the very sweep below — the
+                # sweep's age bound is about time-in-quarantine, not
+                # snapshot age.
+                os.utime(p + ".corrupt")
+            except OSError:
+                pass
         self._sweep_corrupt()  # keep the quarantine bounded (age + count)
 
     def read_snapshot(
@@ -601,13 +996,17 @@ class Checkpointer:
         tried: set[int] = set()
         while True:
             try:
-                tables, leaves, fmt = self._read_verified(step, verify)
+                tables, leaves, fmt = self._read_verified(step, verify,
+                                                          anchor=True)
                 return step, tables, leaves, fmt
             except SnapshotCorruptionError as err:
                 if explicit:
                     raise
                 tried.add(step)  # terminates even if quarantine can't
-                self._quarantine(step, err)  # rename the file (RO dir)
+                # Quarantine the FAILING link (a mid-chain delta names
+                # itself via err.step) plus everything chained on it —
+                # the fallback then lands on the last verified link.
+                self._quarantine(getattr(err, "step", step), err)
                 candidates = [s for s in self.steps() if s not in tried]
                 if not candidates:
                     raise FileNotFoundError(
@@ -785,12 +1184,165 @@ class Checkpointer:
         return dict(store.tables), local_state, step
 
     def _gc(self) -> None:
-        steps = self.steps()
-        for s in steps[: max(0, len(steps) - self.keep)]:
+        """Retention by PATH protection: the newest ``keep`` publication
+        heads plus every link their back-chains reference survive;
+        everything else (superseded fulls, folded/orphaned deltas, the
+        shadowed delta a compaction's full replaced) is removed. For a
+        fulls-only directory this is exactly the legacy newest-``keep``
+        rule. A head whose chain is BROKEN (base swept mid-crash) is
+        unrestorable and therefore unprotected."""
+        pubs = self._pubs()
+        heads = sorted(pubs)[max(0, len(pubs) - self.keep):]
+        protected: set[str] = set()
+        for h in heads:
             try:
-                os.remove(self._path(s))
+                members = snapshot_format.chain_members(pubs, h)
+            except snapshot_format.ChainError:
+                continue
+            protected.update(p.path for p in members)
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return
+        for f in names:
+            if not (SNAPSHOT_RE.fullmatch(f)
+                    or snapshot_format.DELTA_RE.fullmatch(f)):
+                continue
+            path = os.path.join(self.dir, f)
+            if path in protected:
+                continue
+            try:
+                os.remove(path)
             except OSError:
                 pass
+
+    # -- LSM-style chain compaction ----------------------------------------
+
+    def _maybe_auto_compact(self) -> None:
+        """Fold the live chain when it carries >= ``compact_every``
+        deltas (DeltaPolicy). Runs where :meth:`_write` runs — the
+        background writer thread under :class:`AsyncCheckpointer`, so a
+        training loop never blocks on compaction."""
+        policy = self.delta_policy
+        if policy is None or policy.compact_every <= 0:
+            return
+        pubs = self._pubs()
+        if not pubs:
+            return
+        head = max(pubs)
+        if pubs[head].kind != "delta":
+            return
+        try:
+            members = snapshot_format.chain_members(pubs, head)
+        except snapshot_format.ChainError:
+            return
+        if sum(1 for p in members if p.kind == "delta") >= \
+                policy.compact_every:
+            try:
+                self.compact()
+            except Exception as e:
+                # A fence refusal is the zombie-writer signal and must
+                # propagate (the publish path treats it as fatal); any
+                # other compaction failure is a deferred optimization —
+                # the chain is still fully recoverable, so the SAVE that
+                # triggered us must not be poisoned.
+                from fps_tpu.supervise.child import StaleEpochError
+
+                cause = e
+                while cause is not None:
+                    if isinstance(cause, StaleEpochError):
+                        raise
+                    cause = cause.__cause__
+                _log.warning("background chain compaction failed "
+                             "(chain left as-is): %r", e)
+
+    def compact(self) -> str | None:
+        """Fold the newest chain into a fresh FULL at its head step —
+        the LSM compaction of the delta chain. Same discipline as every
+        publish: serialize to a tmp file, fsync, re-read the pod fence
+        as the pre-commit hook, atomic rename; then sweep the folded
+        links. A SIGKILL at ANY point leaves a recoverable chain:
+
+        * before the rename — at most a ``*.tmp.npz`` leftover, the
+          chain untouched;
+        * after the rename, before/mid sweep — the full and (some of)
+          the folded links coexist; publication resolution prefers the
+          full at the shared head step, every newer delta's ``base``
+          resolves to it bit-identically (the fold IS the chain's
+          resolved state), and the next GC/compaction finishes the
+          sweep.
+
+        Returns the new full's path, or None when the newest publication
+        is already a full (nothing to fold). Verification failures
+        surface as the usual corruption errors — compaction never folds
+        an unverified link."""
+        pubs = self._pubs()
+        if not pubs:
+            return None
+        head = max(pubs)
+        if pubs[head].kind != "delta":
+            return None
+        members = snapshot_format.chain_members(pubs, head)
+        entries = self._resolve_entries(head, True)
+        hook = self._compact_phase_hook
+
+        def precommit():
+            self._check_fence(head)
+            if hook is not None:
+                hook("precommit")
+
+        arrays = dict(entries)
+        for k in list(arrays):
+            arrays[_CRC_PREFIX + k] = np.uint32(array_crc32(arrays[k]))
+        path = self._path(head)
+        t0 = time.perf_counter()
+        _atomic_savez(path, arrays, precommit=precommit)
+        if hook is not None:
+            hook("published")
+        secs = time.perf_counter() - t0
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            nbytes = -1
+        self.compactions += 1
+        if nbytes >= 0:
+            # A compaction is a real publish (an O(table) full hits the
+            # disk): it must ride the same payload accounting the bench
+            # ratios and the obs_report delta-vs-full comparison read.
+            self.publish_bytes_total += nbytes
+            _obs_metric("set", "checkpoint.bytes", nbytes)
+        _obs_event("checkpoint_compacted", step=int(head), path=path,
+                   folded=len(members), seconds=round(secs, 4),
+                   bytes=nbytes)
+        _obs_metric("inc", "checkpoint.compactions", 1)
+        # Sweep the folded DELTA links (the head's delta file is now
+        # shadowed by the full; the others are folded into it). The base
+        # full is deliberately left to normal retention — it remains a
+        # valid standalone restore point, so ``keep >= 2`` stays a real
+        # redundancy contract across compactions. Read-side safety is
+        # the inode contract: a reader mid-open keeps its maps.
+        swept = False
+        for pub in members:
+            if pub.kind != "delta" or pub.path == path:
+                continue
+            try:
+                os.remove(pub.path)
+            except OSError:
+                continue
+            if hook is not None and not swept:
+                swept = True
+                hook("swept_one")
+        self._gc()
+        # The fold stands in for a fresh full: credit the folded deltas
+        # back to the chain-length plan so the publisher keeps emitting
+        # deltas instead of hitting its full_every bound against an
+        # already-compacted chain (under the async writer the caller may
+        # have planned newer, unfolded links meanwhile — those stay
+        # counted). Advisory plan state, like the rest of the chain
+        # plan: a lost race costs one early full, never correctness.
+        folded = sum(1 for p in members if p.kind == "delta")
+        self._chain_len = max(0, self._chain_len - folded)
+        return path
 
 
 class AsyncCheckpointer(Checkpointer):
@@ -829,10 +1381,13 @@ class AsyncCheckpointer(Checkpointer):
     """
 
     def __init__(self, directory: str, *, keep: int = 3,
-                 fence_epoch: int | None = None):
-        super().__init__(directory, keep=keep, fence_epoch=fence_epoch)
+                 fence_epoch: int | None = None,
+                 delta: DeltaPolicy | None = None):
+        super().__init__(directory, keep=keep, fence_epoch=fence_epoch,
+                         delta=delta)
         self._cv = threading.Condition()
-        self._queued: tuple[int, dict] | None = None
+        # One queue slot: (step, base_step_or_None, payload_arrays).
+        self._queued: tuple[int, int | None, dict] | None = None
         self._writing = False
         self._error: BaseException | None = None
         self._closed = False
@@ -846,16 +1401,25 @@ class AsyncCheckpointer(Checkpointer):
     # -- caller side ------------------------------------------------------
 
     def save(self, step: int, store: ParamStore, local_state: Pytree = None,
-             *, local_state_format: str = "raw") -> str:
+             *, local_state_format: str = "raw",
+             touched_rows: Mapping | None = None) -> str:
         arrays = self._collect_timed(store, local_state, local_state_format)
+        # Delta planning happens HERE, serially on the caller's thread —
+        # chain order is save order, and planning against the retained
+        # base must see publications in that order. The enqueued payload
+        # for a delta is O(touched rows): the queue slot shrinks with
+        # the publish.
+        step, base, payload = self._plan_publication(
+            int(step), arrays, touched_rows)
         # The writer consumes these arrays on another thread while the
         # training loop runs on: every entry must OWN its memory. Dump
         # paths normally produce fresh arrays (fancy indexing), but e.g.
         # a CPU-backend jax leaf can surface as a zero-copy view of a
         # device buffer that the next step donates away.
-        for k, v in arrays.items():
+        payload = dict(payload)
+        for k, v in payload.items():
             if isinstance(v, np.ndarray) and not v.flags["OWNDATA"]:
-                arrays[k] = np.array(v, copy=True)
+                payload[k] = np.array(v, copy=True)
         with self._cv:
             self._raise_pending_error()
             while self._queued is not None and not self._closed:
@@ -864,8 +1428,9 @@ class AsyncCheckpointer(Checkpointer):
             if self._closed:
                 raise RuntimeError(
                     f"AsyncCheckpointer for {self.dir} is closed")
-            self._queued = (int(step), arrays)
-            path = self._path(step)
+            self._queued = (int(step), base, payload)
+            path = (self._path(step) if base is None
+                    else snapshot_format.delta_path(self.dir, step, base))
             # Emitted while still HOLDING the cv (the writer can't pop
             # the slot until we release), so the journal's enqueued →
             # saved ordering holds even for an instantaneous write. No
@@ -895,6 +1460,9 @@ class AsyncCheckpointer(Checkpointer):
         # Called under self._cv.
         if self._error is not None:
             err, self._error = self._error, None
+            # The failed write may have been a planned chain link: later
+            # deltas must not chain onto a publication that never landed.
+            self._chain_reset()
             raise RuntimeError(
                 f"background checkpoint write failed under {self.dir}"
             ) from err
@@ -922,15 +1490,24 @@ class AsyncCheckpointer(Checkpointer):
                     self._cv.wait()
                 if self._queued is None:  # closed and drained
                     return
-                step, arrays = self._queued
+                step, base, arrays = self._queued
                 self._queued = None
                 self._writing = True
                 self._cv.notify_all()  # free the queue slot for save()
             try:
-                self._write(step, arrays)
+                self._write(step, arrays, base=base)
             except BaseException as e:  # noqa: BLE001 - re-raised on caller
                 with self._cv:
-                    self._error = e
+                    if self._error is None:
+                        self._error = e
+                    else:
+                        # Keep the FIRST failure (the root cause): a
+                        # derived refusal — e.g. the orphan-delta guard
+                        # firing because the base's write just failed —
+                        # must not mask the original error.
+                        _log.warning(
+                            "suppressing follow-on checkpoint write "
+                            "error (first failure pending): %r", e)
             finally:
                 del arrays  # drop the buffer before blocking on the cv
                 with self._cv:

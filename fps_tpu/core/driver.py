@@ -379,7 +379,7 @@ class Trainer:
         return jax.tree.map(to_host, local_state)
 
     def _save_checkpoint(self, checkpointer, step: int, local_state, *,
-                         tables=None) -> None:
+                         tables=None, touched=None) -> None:
         """Snapshot tables + local state, with the local state in the
         logic's worker-count-independent export form (default: the raw
         layout, tagged either way so a mismatched restore fails loudly).
@@ -389,11 +389,22 @@ class Trainer:
         the chunk boundary and runs the save after the NEXT dispatch, by
         which time the live tables already hold a later chunk's state.
         The store's table view is swapped in for the duration of the dump
-        (single-threaded: only the driver thread touches the store)."""
+        (single-threaded: only the driver thread touches the store).
+
+        ``touched``: delta-chain sourcing — an ``(ids_by_table, marker,
+        tracker)`` capture from a :class:`~fps_tpu.core.checkpoint.
+        TouchedRowsTracker`, taken at the SAME boundary as the state
+        being saved (the overlapped paths capture alongside their
+        on-device boundary copies). The tracker prefix is committed only
+        after the checkpointer ACCEPTED the save, so a failed/raced save
+        never loses touched ids for the next publication."""
         prev = None
         if tables is not None:
             prev = self.store.tables
             self.store.tables = dict(tables)
+        kwargs = {}
+        if touched is not None:
+            kwargs["touched_rows"] = touched[0]
         try:
             checkpointer.save(
                 step, self.store,
@@ -401,7 +412,10 @@ class Trainer:
                     self._host_local_state(local_state)
                 ),
                 local_state_format="exported",
+                **kwargs,
             )
+            if touched is not None:
+                touched[2].commit(touched[1])
         except Exception as e:
             # A pod fence refusal (StaleEpochError, possibly re-raised
             # from the async writer wrapped in RuntimeError) means this
@@ -2539,6 +2553,39 @@ class Trainer:
                         and (cfg.prefetch > 0 or lag > 0))
         saved_at = None  # step of the last periodic save (quarantine-aware)
         all_metrics = []
+        # Delta-snapshot sourcing (DeltaPolicy on the checkpointer): the
+        # tracker accumulates each dispatched chunk's pulled-id stream
+        # (WorkerLogic.pulled_ids_host — the same exact host stream the
+        # cold-route certifier consumes) so every save can publish a
+        # row-sparse delta whose touched set is O(traffic), not
+        # O(table). Uncertifiable chunks degrade that table to the
+        # checkpointer's exact-diff fallback, never to corruption.
+        delta_touched = None
+        if (checkpointer is not None and checkpoint_every > 0
+                and getattr(checkpointer, "delta_policy", None) is not None):
+            from fps_tpu.core.checkpoint import TouchedRowsTracker
+
+            delta_touched = TouchedRowsTracker(self.store.specs)
+
+        def capture_touched():
+            if delta_touched is None:
+                return None
+            ids, marker = delta_touched.capture()
+            return (ids, marker, delta_touched)
+
+        def chunk_touched_ids(c):
+            if isinstance(c, PlacedChunk):
+                return c.host_ids
+            if any(isinstance(x, jax.Array)
+                   for x in jax.tree.leaves(c)):
+                # Device-resident chunk: pulling the id columns back to
+                # host per chunk would reintroduce the dispatch-time
+                # stall (and raises outright on non-addressable sharded
+                # arrays) — same guard as the cold-route certifier.
+                # None = the exact-diff fallback at save time.
+                return None
+            return self.logic.pulled_ids_host(c)
+
         it = iter(chunks)
         pf = None
         if cfg.prefetch:
@@ -2548,9 +2595,13 @@ class Trainer:
                 # Placement on the worker thread, but retain the raw id
                 # columns the cold-route certifier needs: certification
                 # itself runs at dispatch (hot membership can re-rank
-                # between placement and dispatch).
+                # between placement and dispatch). With delta tracking
+                # on, the same capture feeds the touched-rows tracker.
+                ids = self._host_cert_ids(b)
+                if ids is None and delta_touched is not None:
+                    ids = self.logic.pulled_ids_host(b)
                 return PlacedChunk(self._place_chunk(b, _m),
-                                   host_ids=self._host_cert_ids(b))
+                                   host_ids=ids)
 
             pf = ChunkPrefetcher(
                 it, _place_for_pf,
@@ -2593,9 +2644,12 @@ class Trainer:
         def boundary_copy(j):
             """Post-chunk-``j`` state as fresh on-device buffers (futures —
             no host block): the double-buffered snapshot the overlapped
-            dump writes from after the next dispatch."""
+            dump writes from after the next dispatch. The touched-rows
+            capture rides along — it must describe the SAME boundary as
+            the copied state, not whatever the tracker holds when the
+            deferred write finally runs."""
             return (j + 1, resilience.tree_copy(tables),
-                    resilience.tree_copy(local_state))
+                    resilience.tree_copy(local_state), capture_touched())
 
         def flush_save():
             """Write the deferred boundary snapshot (when set, always a
@@ -2603,10 +2657,11 @@ class Trainer:
             nonlocal pending_save, saved_at
             if pending_save is None:
                 return
-            step, tb, lsd = pending_save
+            step, tb, lsd, tc = pending_save
             pending_save = None
             with _phase(timer, "checkpoint"):
-                self._save_checkpoint(checkpointer, step, lsd, tables=tb)
+                self._save_checkpoint(checkpointer, step, lsd, tables=tb,
+                                      touched=tc)
             saved_at = step
 
         def sync_entry(entry):
@@ -2696,7 +2751,8 @@ class Trainer:
                 else:
                     with _phase(timer, "checkpoint"):
                         self._save_checkpoint(checkpointer, j + 1,
-                                              local_state)
+                                              local_state,
+                                              touched=capture_touched())
                     saved_at = j + 1
             if rec is not None:
                 # Emitted AFTER the checkpoint/callback phases so the
@@ -2726,6 +2782,11 @@ class Trainer:
                         rec.inc("rollback.preset_skipped")
                         rec.flush()
                     continue
+                if delta_touched is not None:
+                    # Every DISPATCHED chunk's pulled ids feed the delta
+                    # tracker (a quarantined chunk's ids are a harmless
+                    # superset — its rows revert to pre-chunk values).
+                    delta_touched.observe(chunk_touched_ids(chunk))
                 if quarantine is not None:
                     last_good = (resilience.tree_copy(tables),
                                  resilience.tree_copy(local_state))
@@ -2805,7 +2866,8 @@ class Trainer:
             # under the final step number, so a resume skips the poison).
             if checkpointer is not None and i >= start_step and saved_at != i + 1:
                 with _phase(timer, "checkpoint"):
-                    self._save_checkpoint(checkpointer, i + 1, local_state)
+                    self._save_checkpoint(checkpointer, i + 1, local_state,
+                                          touched=capture_touched())
         finally:
             if pf is not None:
                 # Every exit path — normal end, raising on_chunk, health

@@ -46,6 +46,14 @@ __all__ = [
     "CRC_PREFIX", "IO_ERRORS", "array_crc32", "snapshot_path",
     "snapshot_steps", "verify_snapshot_file", "latest_valid_snapshot",
     "map_snapshot_arrays",
+    # Delta-snapshot chains (ISSUE 14): jax-free chain discovery,
+    # verification, and resolution shared by the checkpoint layer, the
+    # serving plane, and the chaos harness.
+    "DELTA_RE", "DELTA_FMT", "BASE_STEP_KEY", "DELTA_IDS_PREFIX",
+    "DELTA_ROWS_PREFIX", "NO_SUCH_FILE", "ChainError", "Publication",
+    "delta_path", "publications", "chain_members", "read_pub_meta",
+    "verify_chain", "latest_valid_chain", "read_delta_arrays",
+    "apply_delta_entries", "resolve_chain_entries",
 ]
 
 # Snapshot filename contract — the single source of truth (the
@@ -53,6 +61,12 @@ __all__ = [
 # fps_tpu.core.checkpoint's re-export).
 SNAPSHOT_RE = re.compile(r"ckpt_(\d{12})\.npz")
 SNAPSHOT_FMT = "ckpt_{step:012d}.npz"
+# Delta publication filename contract: ``delta_{step}_{base}.npz`` — the
+# base step rides the NAME so chain walking is a pure directory listing
+# (no file opens); the authoritative link is the CRC-tagged
+# ``meta::base_step`` entry inside, cross-checked by every reader.
+DELTA_RE = re.compile(r"delta_(\d{12})_(\d{12})\.npz")
+DELTA_FMT = "delta_{step:012d}_{base:012d}.npz"
 
 # npz key layout: kind::name. ``table::<name>`` entries hold each table
 # in LOGICAL id order with padding rows stripped (``(num_ids, dim)``) —
@@ -80,6 +94,31 @@ MESH_SHAPE_KEY = f"meta{SEP}mesh_shape"
 # runs only): forensic evidence that no epoch-stale publish ever landed
 # behind a fence.
 POD_EPOCH_KEY = f"meta{SEP}pod_epoch"
+# Delta entry layout: a delta publication carries, for each row-sparse
+# full-form key ``K`` (``table::name`` / ``ls::i`` / ``fold::name``), the
+# pair ``dids::K`` (sorted int64 row ids) and ``drows::K`` (the touched
+# rows' values). A key appearing under its PLAIN name inside a delta is a
+# full replacement (shape/dtype changed, or a non-row-sparse leaf); a key
+# absent entirely is carried unchanged from the base. ``meta::base_step``
+# names the publication this delta chains from.
+BASE_STEP_KEY = f"meta{SEP}base_step"
+DELTA_IDS_PREFIX = f"dids{SEP}"
+DELTA_ROWS_PREFIX = f"drows{SEP}"
+# verify_snapshot_file's reason string for a vanished candidate — the
+# poll-loop race (swept/renamed between stat and open) must be treated
+# as "gone, retry next poll", never as corruption.
+NO_SUCH_FILE = "no such file"
+
+
+class ChainError(Exception):
+    """A delta chain cannot be resolved (missing/broken/stale link).
+
+    ``step`` names the FAILING link — everything chained past it is
+    unrecoverable; everything before it is the surviving prefix."""
+
+    def __init__(self, msg: str, *, step: int | None = None):
+        super().__init__(msg)
+        self.step = step
 
 # Everything a torn/corrupted .npz throws on open or member read (zip
 # magic, central directory, member CRC, npy header parsing, ...).
@@ -128,6 +167,214 @@ def snapshot_steps(directory: str) -> list[int]:
     return sorted(out)
 
 
+def delta_path(directory: str, step: int, base: int) -> str:
+    return os.path.join(directory, DELTA_FMT.format(step=step, base=base))
+
+
+class Publication:
+    """One discovered publication: a full snapshot or a delta link."""
+
+    __slots__ = ("step", "kind", "base", "path")
+
+    def __init__(self, step: int, kind: str, base: int | None, path: str):
+        self.step = step
+        self.kind = kind  # "full" | "delta"
+        self.base = base  # delta only: the step it chains from
+        self.path = path
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"Publication(step={self.step}, kind={self.kind!r}, "
+                f"base={self.base}, path={self.path!r})")
+
+
+def publications(directory: str) -> dict:
+    """``{step: Publication}`` for every live publication under
+    ``directory``. A full and a delta at the SAME step (the window while
+    a background compaction's sweep hasn't finished) resolve to the full
+    — the compactor's fold is bit-exact, so the two describe identical
+    state and the standalone file wins. Missing directory reads empty."""
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return {}
+    out: dict[int, Publication] = {}
+    for f in names:
+        m = DELTA_RE.fullmatch(f)
+        if m:
+            step = int(m.group(1))
+            if step not in out:  # full-wins handled below (fulls override)
+                out[step] = Publication(step, "delta", int(m.group(2)),
+                                        os.path.join(directory, f))
+    for f in names:
+        m = SNAPSHOT_RE.fullmatch(f)
+        if m:
+            step = int(m.group(1))
+            out[step] = Publication(step, "full", None,
+                                    os.path.join(directory, f))
+    return out
+
+
+def chain_members(pubs: dict, step: int) -> list:
+    """The back-chain of publication ``step`` as a base-FIRST list
+    ``[full, delta, ..., head]``. Raises :class:`ChainError` (naming the
+    failing link) when a base is missing — a quarantined (``*.corrupt``)
+    base is simply absent from ``pubs``, so a chain through it is broken
+    by construction."""
+    head = pubs.get(step)
+    if head is None:
+        raise ChainError(f"no publication at step {step}", step=step)
+    members = [head]
+    seen = {step}
+    cur = head
+    while cur.kind == "delta":
+        nxt = pubs.get(cur.base)
+        if nxt is None:
+            raise ChainError(
+                f"delta step {cur.step} chains from step {cur.base}, "
+                "which has no live publication (swept, quarantined, or "
+                "never landed)", step=cur.step)
+        if nxt.step in seen or nxt.step >= cur.step:
+            raise ChainError(
+                f"delta step {cur.step} has a non-monotone base "
+                f"{cur.base}", step=cur.step)
+        seen.add(nxt.step)
+        members.append(nxt)
+        cur = nxt
+    members.reverse()
+    return members
+
+
+def read_pub_meta(path: str) -> dict:
+    """``{"base_step": int|None, "pod_epoch": int|None}`` of one
+    publication, via numpy's lazy member access (only these entries'
+    bytes are read). Structural failures surface as the usual torn-file
+    errors — callers verifying chains treat them as a failing link."""
+    out = {"base_step": None, "pod_epoch": None}
+    with np.load(path) as z:
+        if BASE_STEP_KEY in z.files:
+            out["base_step"] = int(z[BASE_STEP_KEY])
+        if POD_EPOCH_KEY in z.files:
+            out["pod_epoch"] = int(z[POD_EPOCH_KEY])
+    return out
+
+
+def _check_chain_meta(members: list) -> tuple[bool, str | None, int | None]:
+    """Cross-check each link's CRC-tagged ``meta::base_step`` against the
+    filename chain and enforce fencing-epoch MONOTONICITY base→head: a
+    delta carrying an epoch OLDER than an earlier link's is a stale
+    zombie's publish that must truncate the chain there (the read-side
+    half of the pod fence). Returns ``(ok, reason, failing_step)``."""
+    max_epoch = None
+    prev_step = None
+    for pub in members:
+        try:
+            meta = read_pub_meta(pub.path)
+        except FileNotFoundError:
+            return False, NO_SUCH_FILE, pub.step
+        except IO_ERRORS as e:
+            return False, f"unreadable: {e!r}", pub.step
+        if pub.kind == "delta":
+            if meta["base_step"] is None or meta["base_step"] != pub.base:
+                return (False,
+                        f"delta step {pub.step}: meta::base_step "
+                        f"{meta['base_step']} != filename base {pub.base}",
+                        pub.step)
+            if prev_step is not None and pub.base != prev_step:
+                return (False,
+                        f"delta step {pub.step} chains from {pub.base}, "
+                        f"not the previous link {prev_step}", pub.step)
+        epoch = meta["pod_epoch"]
+        if epoch is not None:
+            if max_epoch is not None and epoch < max_epoch:
+                return (False,
+                        f"step {pub.step}: fencing epoch {epoch} is "
+                        f"behind an earlier link's epoch {max_epoch} — "
+                        "stale-zombie publish", pub.step)
+            max_epoch = epoch if max_epoch is None else max(max_epoch,
+                                                            epoch)
+        prev_step = pub.step
+    return True, None, None
+
+
+def verify_chain(directory: str, step: int, *, pubs: dict | None = None
+                 ) -> tuple[bool, str | None, int | None]:
+    """Full integrity pass over the whole chain ending at ``step``:
+    every link exists, CRC-verifies, cross-links correctly, and carries
+    a monotone fencing epoch. Returns ``(ok, reason, failing_step)`` —
+    read-only and exception-free, like :func:`verify_snapshot_file`."""
+    if pubs is None:
+        pubs = publications(directory)
+    try:
+        members = chain_members(pubs, step)
+    except ChainError as e:
+        return False, str(e), e.step
+    for pub in members:
+        ok, reason = verify_snapshot_file(pub.path)
+        if not ok:
+            return False, f"step {pub.step}: {reason}", pub.step
+    return _check_chain_meta(members)
+
+
+def latest_valid_chain(directory: str) -> tuple[int, list] | None:
+    """Newest ``(step, chain_members)`` whose whole chain passes
+    :func:`verify_chain`, scanning newest→oldest; ``None`` when none
+    does. The chain-aware twin of :func:`latest_valid_snapshot` — a
+    torn/CRC-failing/epoch-stale link truncates eligibility back to the
+    last verified prefix (its own head steps are still candidates)."""
+    pubs = publications(directory)
+    for step in sorted(pubs, reverse=True):
+        ok, _, _ = verify_chain(directory, step, pubs=pubs)
+        if ok:
+            return step, chain_members(pubs, step)
+    return None
+
+
+def read_delta_arrays(path: str) -> dict:
+    """All non-CRC entries of one delta publication, materialized (a
+    delta is O(touched rows) by construction — mapping buys nothing)."""
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files if not k.startswith(CRC_PREFIX)}
+
+
+def apply_delta_entries(entries: dict, delta: dict) -> dict:
+    """Overlay one delta's entries onto a full-form ``entries`` dict
+    (``{key: array}`` in the full snapshot's key layout). Sparse pairs
+    patch rows copy-on-write; plain keys replace; ``meta::base_step``
+    never propagates (the result is full-form state, not a link)."""
+    out = dict(entries)
+    for k, v in delta.items():
+        if k.startswith(DELTA_IDS_PREFIX) or k == BASE_STEP_KEY:
+            continue
+        if k.startswith(DELTA_ROWS_PREFIX):
+            key = k[len(DELTA_ROWS_PREFIX):]
+            ids = np.asarray(delta[DELTA_IDS_PREFIX + key], np.int64)
+            if key not in out:
+                raise ChainError(
+                    f"delta patches {key!r}, absent from the base")
+            arr = np.array(out[key], copy=True)
+            if len(ids) and (ids.min() < 0 or ids.max() >= len(arr)):
+                raise ChainError(
+                    f"delta row ids out of range for {key!r}")
+            arr[ids] = v
+            out[key] = arr
+        else:
+            out[k] = v
+    return out
+
+
+def resolve_chain_entries(members: list) -> dict:
+    """Materialize the full-form state described by a chain (base-first
+    :class:`Publication` list): load the full, then fold every delta in
+    order. Integrity is the caller's job (:func:`verify_chain` first)."""
+    base = members[0]
+    with np.load(base.path) as z:
+        entries = {k: z[k] for k in z.files if not k.startswith(CRC_PREFIX)}
+    for pub in members[1:]:
+        entries = apply_delta_entries(entries, read_delta_arrays(pub.path))
+    entries.pop(BASE_STEP_KEY, None)
+    return entries
+
+
 def verify_snapshot_file(path: str) -> tuple[bool, str | None]:
     """Full integrity pass over one snapshot file: ``(True, None)`` iff
     every entry reads back and matches its ``meta::crc`` tag; otherwise
@@ -149,7 +396,7 @@ def verify_snapshot_file(path: str) -> tuple[bool, str | None]:
                 if ck in z.files and int(z[ck]) != array_crc32(v):
                     return False, f"checksum mismatch on entry {k!r}"
     except FileNotFoundError:
-        return False, "no such file"
+        return False, NO_SUCH_FILE
     except IO_ERRORS as e:
         return False, f"unreadable: {e!r}"
     return True, None

@@ -91,6 +91,25 @@ class LogisticRegressionWorker(WorkerLogic):
             )
         }
 
+    def pulled_ids_host(self, chunk):
+        """Host certification/traffic stream (cold-route certifier +
+        the delta-snapshot touched-rows tracker): the raw feature-id
+        column covers every id the step pulls AND pushes. Multi-id
+        contract shape: ``(T, B, nnz)`` flattens to ``(T, B*nnz)`` —
+        worker-major blocks survive the flatten. A dense head pulls its
+        ``d`` leading ids every step OUTSIDE the batch columns, which
+        the per-position stream cannot express: those configs stay
+        host-uncertifiable (None), like negative-sampling MF."""
+        if self.cfg.dense_features:
+            return None
+        import numpy as np
+
+        ids = np.asarray(chunk["feat_ids"])
+        if ids.ndim >= 2:
+            # (..., B, nnz) -> (..., B*nnz): worker-major blocks survive.
+            ids = ids.reshape(*ids.shape[:-2], -1)
+        return {WEIGHT_TABLE: ids}
+
     def step(self, batch, pulled, local_state, key) -> StepOutput:
         cfg = self.cfg
         d = cfg.dense_features

@@ -226,9 +226,28 @@ def default_registry() -> MetricsRegistry:
                         "a save the training thread pays; the overlapped "
                         "pipeline hides it behind the next dispatch)"),
         MetricSpec("checkpoint.bytes", "gauge", unit="bytes",
-                   help="size of the last written snapshot"),
+                   help="size of the last written FULL snapshot (delta "
+                        "publications ride checkpoint.delta_bytes; the "
+                        "two together are the payload-proportionality "
+                        "ratio)"),
         MetricSpec("checkpoint.fallbacks", "counter", unit="snapshots",
                    help="corrupt snapshots quarantined by fallback restore"),
+        # Delta-snapshot chains (Checkpointer(delta=DeltaPolicy(...))).
+        MetricSpec("checkpoint.delta_publishes", "counter",
+                   unit="snapshots",
+                   help="publications written as row-sparse DELTAS "
+                        "against the previous publication (checkpoint."
+                        "saves counts fulls and deltas alike)"),
+        MetricSpec("checkpoint.delta_bytes", "counter", unit="bytes",
+                   help="total bytes published as deltas — against "
+                        "checkpoint.bytes' full-snapshot size, the "
+                        "payload-proportionality evidence (publish "
+                        "bytes ~ touched rows, not table size)"),
+        MetricSpec("checkpoint.compactions", "counter", unit="folds",
+                   help="LSM-style chain compactions: a delta chain "
+                        "folded into a fresh full at its head step "
+                        "(atomic-rename + fence-precommit, crash-safe "
+                        "at every phase)"),
         MetricSpec("checkpoint.fenced_publishes", "counter",
                    unit="snapshots",
                    help="publishes refused by a pod fence (the writer's "
@@ -271,6 +290,13 @@ def default_registry() -> MetricsRegistry:
         MetricSpec("serve.rejected_snapshots", "counter", unit="snapshots",
                    help="snapshot candidates that failed CRC/structural "
                         "verification and were never served"),
+        MetricSpec("serve.fence_step", "gauge", unit="step",
+                   help="the serving fleet's shared step fence "
+                        "(fps_tpu.serve.fleet): the step this reader "
+                        "last swapped to under the fence — "
+                        "forward-monotone fleet-wide within a fencing "
+                        "epoch; backward only on a coordinated "
+                        "quarantine rollback (epoch bump)"),
         # Program contract auditor (fps_tpu.analysis; Trainer(audit=...)).
         MetricSpec("analysis.certified_programs", "counter",
                    unit="programs",

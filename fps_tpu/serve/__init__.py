@@ -13,23 +13,41 @@ on, and the swap cost is independent of table size. ``docs/serving.md``
 is the architecture note; the freshness SLO ("write→servable" lag) and
 swap/rollback semantics live there.
 
+Delta publications (``DeltaPolicy`` chains on the write side) hot-swap
+INCREMENTALLY — ``ServableSnapshot.with_delta`` overlays the touched
+rows on the still-mapped base (:class:`DeltaView`) instead of re-opening
+the world — and the single reader grows into a step-fenced FLEET
+(:mod:`fps_tpu.serve.fleet`): N readers over one snapshot dir whose
+swaps are coordinated by a shared fence no reader ever answers behind.
+
 jax-optional by construction (stdlib + numpy; the on-disk contract comes
 from the jax-free :mod:`fps_tpu.core.snapshot_format`): ``tools/serve.py``
 runs this whole plane on a machine with no accelerator runtime.
 """
 
+from fps_tpu.serve.fleet import (
+    FleetReader,
+    ServingFleet,
+    StepFence,
+    tiering_hot_ids,
+)
 from fps_tpu.serve.net import JsonlClient, TcpServe, handle_request
 from fps_tpu.serve.server import NoSnapshotError, ReadServer
-from fps_tpu.serve.snapshot import ServableSnapshot, SnapshotRejected
+from fps_tpu.serve.snapshot import DeltaView, ServableSnapshot, SnapshotRejected
 from fps_tpu.serve.watcher import SnapshotWatcher
 
 __all__ = [
+    "DeltaView",
+    "FleetReader",
     "JsonlClient",
     "NoSnapshotError",
     "ReadServer",
     "ServableSnapshot",
+    "ServingFleet",
     "SnapshotRejected",
     "SnapshotWatcher",
+    "StepFence",
     "TcpServe",
     "handle_request",
+    "tiering_hot_ids",
 ]

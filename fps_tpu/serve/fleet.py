@@ -1,0 +1,456 @@
+"""A step-fenced fleet of ReadServers over one snapshot directory.
+
+PR 7 opened the read plane with ONE ``ReadServer`` — a throughput
+ceiling and a single point of failure for the serving half Parameter Box
+(PAPERS.md) treats as the product. This module grows it into N readers
+with **consistent step fencing**: the write side's fencing (PR 11's pod
+epochs) keeps stale trainers from publishing; this is the READ side's
+twin, keeping stale readers from answering.
+
+The fence protocol (all files live under ``<ckpt_dir>/fleet/``, shared
+by every reader over the same filesystem the snapshots ride):
+
+* each reader continuously verifies candidates with its own
+  :class:`~fps_tpu.serve.watcher.SnapshotWatcher` and records the newest
+  step it could serve in its READINESS slot (``ready_<id>.json``,
+  atomic-rename JSON like everything here);
+* any reader may ADVANCE the shared fence (``serve_fence.json``) to the
+  highest step at least ``quorum`` readers are ready on — forward-
+  monotone within a fencing epoch, last-writer-wins races are harmless
+  because every write is a step at/behind quorum readiness and readers
+  clamp to the max ``(epoch, step)`` they have ever observed;
+* readers swap their servers to EXACTLY the fence step — never ahead of
+  it (a reader ahead would supersede every fence-step answer in flight),
+  never behind it (a reader killed and restarted mid-swap re-reads the
+  fence at boot and refuses to serve anything older — the
+  restart-never-regresses contract the chaos scenario pins);
+* BACKWARD swaps stay coordinated: when the trainer quarantines the
+  fence step (``*.corrupt``), the reader that observes it rolls the
+  fence back to the newest survivor with an incremented fence EPOCH —
+  readers accept a lower step only under a higher epoch, so a delayed
+  stale fence write can never drag the fleet backward by accident.
+
+Freshness rides the same machinery as the single-reader plane:
+``serve.fence_step`` is the fleet-wide published step; delta publishes
+hot-swap INCREMENTALLY (``ServableSnapshot.with_delta``: touched rows
+overlaid on the still-mapped base); and each reader admits a WARM-ROW
+cache from the hot-tier frequency ranking (the adaptive tier's sidecar
+``hot::`` ids, or any explicit id set) so hot lookups come from resident
+buffers instead of faulting mapped pages.
+
+jax-free (stdlib + numpy), like the rest of ``fps_tpu.serve``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from fps_tpu.core import snapshot_format as fmt
+from fps_tpu.serve.snapshot import ServableSnapshot, SnapshotRejected
+from fps_tpu.serve.server import ReadServer
+from fps_tpu.serve.watcher import SnapshotWatcher, _emit_metric
+
+__all__ = ["StepFence", "FleetReader", "ServingFleet",
+           "tiering_hot_ids"]
+
+FLEET_DIR = "fleet"
+FENCE_NAME = "serve_fence.json"
+
+
+def _atomic_write_json(path: str, obj: dict) -> None:
+    # Deliberately a local twin of the helpers in
+    # supervise/supervisor.py and supervise/pod.py: those modules are
+    # loaded BY FILE PATH from tools/supervise.py (zero package
+    # imports, by contract), so a shared package-level helper cannot
+    # serve all three without breaking that load mode.
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.json")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(obj, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class StepFence:
+    """The shared fleet fence + this reader's readiness slot.
+
+    The fence value is a ``(epoch, step)`` pair ordered
+    lexicographically: higher epoch wins outright (a coordinated
+    rollback), otherwise higher step wins (normal forward motion). Each
+    reader clamps to the maximum pair it has ever OBSERVED, so a
+    last-writer-wins race between two advancing readers (both writing
+    quorum-backed values) can never move any observer backward within an
+    epoch.
+    """
+
+    def __init__(self, ckpt_dir: str, reader_id: str):
+        self.dir = os.path.join(ckpt_dir, FLEET_DIR)
+        self.reader_id = str(reader_id)
+        os.makedirs(self.dir, exist_ok=True)
+        self._seen = (0, -1)  # max (epoch, step) ever observed
+        self._last_ready: int | None = None  # skip unchanged rewrites
+
+    @property
+    def fence_path(self) -> str:
+        return os.path.join(self.dir, FENCE_NAME)
+
+    def _ready_path(self, reader_id: str) -> str:
+        return os.path.join(self.dir, f"ready_{reader_id}.json")
+
+    # -- observation -------------------------------------------------------
+
+    def read(self) -> tuple[int, int] | None:
+        """Current effective fence as ``(epoch, step)`` (clamped to the
+        max ever observed), or None before the first advance. A FILE
+        regressed below this reader's max (a racing advance's
+        last-writer-wins clobbering a rollback's epoch bump) is
+        REPAIRED back up — anti-entropy, so peers that never observed
+        the higher pair converge instead of serving past it."""
+        rec = _read_json(self.fence_path)
+        pair = None
+        if rec is not None:
+            try:
+                pair = (int(rec["epoch"]), int(rec["step"]))
+            except (KeyError, TypeError, ValueError):
+                pair = None
+        if pair is not None and pair > self._seen:
+            self._seen = pair
+        elif (pair is not None and pair < self._seen
+                and self._seen[1] >= 0):
+            _atomic_write_json(self.fence_path,
+                               {"epoch": self._seen[0],
+                                "step": self._seen[1],
+                                "by": self.reader_id, "repair": True})
+        return self._seen if self._seen[1] >= 0 else None
+
+    # -- participation -----------------------------------------------------
+
+    def ready(self, step: int) -> None:
+        """Record the newest step THIS reader has verified locally.
+        Idempotent per step: an unchanged readiness is not rewritten —
+        the poll loop calls this every tick, and ~20 fsync'd renames per
+        second per reader against a (possibly networked) shared
+        filesystem would be pure churn."""
+        if self._last_ready == int(step):
+            return
+        _atomic_write_json(self._ready_path(self.reader_id),
+                           {"reader": self.reader_id, "step": int(step),
+                            "t": time.time()})
+        self._last_ready = int(step)
+
+    def ready_steps(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return out
+        for f in names:
+            if not (f.startswith("ready_") and f.endswith(".json")):
+                continue
+            rec = _read_json(os.path.join(self.dir, f))
+            if rec is None:
+                continue
+            try:
+                out[str(rec["reader"])] = int(rec["step"])
+            except (KeyError, TypeError, ValueError):
+                continue
+        return out
+
+    def advance(self, quorum: int, *, max_step: int | None = None
+                ) -> tuple[int, int] | None:
+        """Advance the fence to the highest step at least ``quorum``
+        readers are ready on (forward-monotone within the current
+        epoch); returns the effective fence either way. ``max_step``
+        caps the target at the ADVANCING reader's own verified step —
+        after a coordinated rollback, peers' not-yet-refreshed readiness
+        slots (still naming the quarantined step) must not be able to
+        drag the fence forward past what this reader just verified."""
+        cur = self.read()
+        steps = sorted(self.ready_steps().values(), reverse=True)
+        if len(steps) >= max(1, quorum):
+            target = steps[max(0, quorum - 1)]
+            if max_step is not None:
+                target = min(target, int(max_step))
+            epoch = cur[0] if cur is not None else 0
+            if cur is None or target > cur[1]:
+                _atomic_write_json(self.fence_path,
+                                   {"epoch": int(epoch),
+                                    "step": int(target),
+                                    "by": self.reader_id})
+                self._seen = max(self._seen, (epoch, target))
+        return self.read()
+
+    def rollback(self, step: int) -> tuple[int, int]:
+        """Coordinated BACKWARD fence move (served step quarantined):
+        bump the epoch so every reader accepts the lower step as a
+        deliberate rollback, never as a stale write."""
+        cur = self.read()
+        epoch = (cur[0] if cur is not None else 0) + 1
+        _atomic_write_json(self.fence_path,
+                           {"epoch": int(epoch), "step": int(step),
+                            "by": self.reader_id, "rollback": True})
+        self._seen = (epoch, int(step))
+        return self._seen
+
+
+def tiering_hot_ids(ckpt_dir: str, table: str | None = None) -> dict:
+    """Warm-cache admission from the adaptive tier's frequency ranking:
+    the newest ``tiering-*.npz`` sidecar's ``hot::<table>`` id arrays
+    (``fps_tpu.tiering.Retierer`` writes them beside the checkpoints).
+    Returns ``{table: ids}`` (optionally filtered to one table); empty
+    when no sidecar exists — warm caching simply stays off."""
+    import re
+
+    sidecar_re = re.compile(r"tiering-(\d+)\.npz")
+    newest, newest_step = None, -1
+    try:
+        names = os.listdir(ckpt_dir)
+    except FileNotFoundError:
+        return {}
+    for f in names:
+        m = sidecar_re.fullmatch(f)
+        if m and int(m.group(1)) > newest_step:
+            newest, newest_step = os.path.join(ckpt_dir, f), int(m.group(1))
+    if newest is None:
+        return {}
+    out: dict[str, np.ndarray] = {}
+    try:
+        with np.load(newest) as z:
+            for k in z.files:
+                if k.startswith("hot::"):
+                    name = k[len("hot::"):]
+                    if table is None or name == table:
+                        out[name] = np.asarray(z[k], np.int64)
+    except (OSError, *fmt.IO_ERRORS):
+        return {}
+    return out
+
+
+class FleetReader:
+    """One member of the serving fleet: a ReadServer whose hot-swaps are
+    gated on the shared step fence.
+
+    ``poll()`` drives everything: candidate discovery/verification (the
+    embedded :class:`SnapshotWatcher` — including delta chains and
+    quarantine tracking), readiness publication, fence advancement, and
+    the actual server swap to the fence step. Construction re-reads the
+    fence FIRST: a reader restarted mid-swap never answers a step older
+    than the fleet's published fence.
+    """
+
+    def __init__(self, ckpt_dir: str, reader_id: str, *, quorum: int = 1,
+                 journal: str | None = None, recorder=None,
+                 warm_from=None, verify: bool = True):
+        self.ckpt_dir = ckpt_dir
+        self.reader_id = str(reader_id)
+        self.quorum = int(quorum)
+        self.recorder = recorder
+        self.verify = verify
+        # warm_from: None | {table: ids} | "tiering" (sidecar ranking).
+        self.warm_from = warm_from
+        self.server = ReadServer(recorder=recorder)
+        self.fence = StepFence(ckpt_dir, reader_id)
+        self._candidate: ServableSnapshot | None = None
+        self._rollback_due = False
+        self.fence_swaps = 0
+        self.poll_errors = 0  # transient poll failures (loop survives)
+        self.served_steps: list[int] = []  # trail for the chaos harness
+        self.watcher = SnapshotWatcher(
+            ckpt_dir, journal=journal, recorder=recorder,
+            on_swap=self._on_candidate, verify=verify)
+        # Boot protocol: observe the existing fence before serving
+        # anything — the restart-never-regresses half of the contract.
+        self.fence.read()
+
+    # -- candidate tracking (watcher callback) -----------------------------
+
+    def _on_candidate(self, snap: ServableSnapshot, direction: str):
+        self._candidate = snap
+        if direction == "backward":
+            # The watcher only ever swaps backward past a quarantine /
+            # vanish of the served candidate: propose a coordinated
+            # fence rollback instead of silently diverging.
+            self._rollback_due = True
+
+    def _fence_step_dead(self, step: int) -> bool:
+        """True when the fence names a step this reader can PROVE is no
+        longer servable: quarantined — its own ``*.corrupt`` marker or
+        one on a chain link. Persistent on-disk evidence only: "absent
+        from my last scan" is NOT proof (a reader whose scan is one
+        poll stale would spuriously epoch-bump a fence its peers just
+        legitimately advanced — a backward fleet swap off a live step).
+        A step swept with no marker at all simply holds the fence until
+        newer publications advance it: lost liveness, never
+        split-brain."""
+        w = self.watcher
+        return step in w._quarantined or w._chain_quarantined(step)
+
+    # -- the poll ----------------------------------------------------------
+
+    def poll(self) -> int | None:
+        """One pass: verify candidates, publish readiness, advance (or
+        roll back) the fence, swap the server to the fence step. Returns
+        the served step (None while nothing servable)."""
+        self.watcher.poll()
+        cand = self._candidate
+        if cand is not None:
+            self.fence.ready(cand.step)
+        cur = self.fence.read()
+        # Coordinated rollback, EVIDENCE-based and re-assertable: when
+        # the fence names a step this reader's watcher has proven
+        # quarantined/unresolvable (persistent on-disk evidence — not a
+        # one-shot flag), bump the epoch down to the surviving
+        # candidate. Re-checked every poll, so a racing advance that
+        # clobbers the rollback write gets rolled back again until the
+        # fleet converges.
+        if (cand is not None and cur is not None
+                and cand.step < cur[1]
+                and (self._rollback_due
+                     or self._fence_step_dead(cur[1]))):
+            cur = self.fence.rollback(cand.step)
+        self._rollback_due = False
+        cur = self.fence.advance(
+            self.quorum,
+            max_step=None if cand is None else cand.step)
+        self._apply_fence(cur)
+        snap = self.server._snap
+        return None if snap is None else snap.step
+
+    def _apply_fence(self, fence: tuple[int, int] | None) -> None:
+        if fence is None:
+            return
+        _epoch, step = fence
+        snap = self.server._snap
+        if snap is not None and snap.step == step:
+            return
+        cand = self._candidate
+        nxt = None
+        if cand is not None and cand.step == step:
+            nxt = cand
+        else:
+            # The fence names a step this reader hasn't verified as its
+            # newest candidate (it is behind, ahead, or freshly booted):
+            # open that exact step from the shared dir — chains welcome.
+            try:
+                nxt = ServableSnapshot.open_chain(self.ckpt_dir, step,
+                                                  verify=self.verify)
+            except (FileNotFoundError, SnapshotRejected):
+                if snap is not None and snap.step > step:
+                    # The fence moved BACKWARD (coordinated quarantine
+                    # rollback) and the lower step isn't openable yet:
+                    # answering from the old higher step would serve the
+                    # quarantined state the fence just rolled past.
+                    # Refuse (NoSnapshotError to clients) until a poll
+                    # can open the fence step — behind is lag, ahead is
+                    # split-brain.
+                    self.server.swap_to(None)
+                return  # otherwise hold the current (older) snapshot
+        if self.warm_from is not None:
+            ids = (tiering_hot_ids(self.ckpt_dir)
+                   if self.warm_from == "tiering" else self.warm_from)
+            if ids:
+                nxt = nxt.warmed(ids)
+        self.server.swap_to(nxt)
+        self.fence_swaps += 1
+        self.served_steps.append(int(step))
+        _emit_metric(self.recorder, "set", "serve.fence_step",
+                     float(step))
+
+    def stats(self) -> dict:
+        snap = self.server._snap
+        return {
+            "reader": self.reader_id,
+            "step": None if snap is None else snap.step,
+            "fence": self.fence.read(),
+            "fence_swaps": self.fence_swaps,
+            "chain_len": None if snap is None else snap.chain_len,
+            "warm_rows": 0 if snap is None else snap.warm_rows,
+            **self.server.stats(),
+        }
+
+
+class ServingFleet:
+    """N fence-coordinated readers over one snapshot dir (the bench and
+    chaos harness topology; production runs one FleetReader per serving
+    process over a shared filesystem).
+
+    ``quorum`` defaults to a majority of the fleet — the fence advances
+    once most readers verified a step, and laggards converge to it."""
+
+    def __init__(self, ckpt_dir: str, n_readers: int = 3, *,
+                 quorum: int | None = None, journal: str | None = None,
+                 recorder=None, warm_from=None, verify: bool = True):
+        if n_readers < 1:
+            raise ValueError(f"n_readers must be >= 1, got {n_readers}")
+        self.quorum = (n_readers // 2 + 1) if quorum is None else quorum
+        self.readers = [
+            FleetReader(ckpt_dir, f"r{i}", quorum=self.quorum,
+                        journal=journal, recorder=recorder,
+                        warm_from=warm_from, verify=verify)
+            for i in range(n_readers)
+        ]
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def poll(self) -> None:
+        for r in self.readers:
+            r.poll()
+
+    def start(self, interval_s: float = 0.05) -> None:
+        """One polling thread per reader (the fleet topology in one
+        process). ``stop()`` joins them."""
+        self._stop.clear()
+
+        def loop(reader):
+            import logging
+
+            log = logging.getLogger("fps_tpu.serve.fleet")
+            while not self._stop.is_set():
+                try:
+                    reader.poll()
+                except Exception:  # noqa: BLE001 — the loop must live
+                    # A transient shared-filesystem error (ENOSPC/NFS
+                    # hiccup in the fence/readiness writes) must not
+                    # silently kill the poller and freeze this reader on
+                    # a stale snapshot while its peers move on — log,
+                    # count, retry next tick.
+                    reader.poll_errors += 1
+                    log.exception("fleet reader %s poll failed "
+                                  "(retrying)", reader.reader_id)
+                self._stop.wait(interval_s)
+
+        self._threads = [
+            threading.Thread(target=loop, args=(r,), daemon=True,
+                             name=f"fps-fleet-{r.reader_id}")
+            for r in self.readers
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self._threads = []
+
+    def stats(self) -> list[dict]:
+        return [r.stats() for r in self.readers]

@@ -122,10 +122,13 @@ class ReadServer:
 
     # -- publication -------------------------------------------------------
 
-    def swap_to(self, snapshot: ServableSnapshot) -> None:
+    def swap_to(self, snapshot: ServableSnapshot | None) -> None:
         """Atomic hot swap: one reference rebind, no data movement — safe
         to call (from the watcher thread) while requests are in flight;
-        each request keeps the snapshot it bound at entry."""
+        each request keeps the snapshot it bound at entry. ``None``
+        un-publishes: later requests refuse with NoSnapshotError (the
+        fleet's quarantine-rollback path uses this rather than answer
+        ahead of a rolled-back fence)."""
         self._snap = snapshot
 
     @property

@@ -17,7 +17,12 @@ The training plane already defines the publication contract:
 the supervisor restart path rewrites journals underneath a live tailer)
 and/or polling the checkpoint directory — CRC-verifies every new
 candidate (:meth:`ServableSnapshot.open`), and publishes the newest
-verified snapshot through ``on_swap``. Swaps are monotone FORWARD except
+verified snapshot through ``on_swap``. DELTA publications
+(``DeltaPolicy`` chains) are candidates too: a delta serves only when
+its whole chain verifies (a chain through a ``*.corrupt`` base never
+resolves), and when the served snapshot is on the candidate's chain the
+swap is INCREMENTAL — touched rows overlaid on the still-mapped base
+(:meth:`ServableSnapshot.with_delta`), O(touched rows) per link. Swaps are monotone FORWARD except
 for exactly one cause: when the currently served step is quarantined (or
 its file vanishes with nothing newer), the watcher swaps BACKWARD to the
 newest surviving verified snapshot — readers must never keep answering
@@ -165,6 +170,15 @@ class SnapshotWatcher:
         # re-publish of the same step gets a fresh verdict, a known-torn
         # file is not re-read every poll).
         self._rejected: dict[int, tuple] = {}
+        # Live publication index from the last dir scan ({step:
+        # Publication}) — empty in journal-only mode (chain resolution
+        # then re-scans inside open_chain).
+        self._pubs: dict = {}
+        # Chain failures are re-CHECKED every poll (transient by
+        # nature) but COUNTED once per (step, head file identity) — a
+        # lingering broken chain head must not inflate
+        # serve.rejected_snapshots at poll frequency.
+        self._chain_rejected_seen: set = set()
         self.swaps = {"forward": 0, "backward": 0}
         self.rejected = 0
         # Durability → servable wall-clock lag of the LAST publish (the
@@ -219,8 +233,10 @@ class SnapshotWatcher:
             names = os.listdir(self.ckpt_dir)
         except FileNotFoundError:
             names = []
-        steps = sorted(int(m.group(1)) for f in names
-                       if (m := fmt.SNAPSHOT_RE.fullmatch(f)))
+        # Full snapshots AND delta links are candidates — a delta step
+        # serves by resolving its chain (fps_tpu.core.snapshot_format).
+        self._pubs = fmt.publications(self.ckpt_dir)
+        steps = sorted(self._pubs)
         live = set(steps)
         for s in steps:
             self._see_step(s)
@@ -230,14 +246,35 @@ class SnapshotWatcher:
         # supersedes it: the rollback-replay path re-publishes the step
         # it quarantined, and the fresh snapshot must be servable (the
         # CRC gate still decides — a lingering corrupt live file just
-        # lands in the per-inode rejection cache).
+        # lands in the per-inode rejection cache). Quarantined DELTA
+        # links count too: any candidate whose chain would pass through
+        # one is ineligible until re-published.
         for f in names:
             if f.endswith(".corrupt"):
-                m = fmt.SNAPSHOT_RE.fullmatch(f[: -len(".corrupt")])
-                if m and int(m.group(1)) not in live:
-                    self._quarantined.add(int(m.group(1)))
+                base = f[: -len(".corrupt")]
+                m = fmt.SNAPSHOT_RE.fullmatch(base)
+                dm = fmt.DELTA_RE.fullmatch(base)
+                s = int(m.group(1)) if m else (
+                    int(dm.group(1)) if dm else None)
+                if s is not None and s not in live:
+                    self._quarantined.add(s)
         self._quarantined -= live
         return steps
+
+    def _chain_quarantined(self, step: int) -> bool:
+        """True when ``step``'s back-chain passes through a quarantined
+        step — a reader must never resolve a chain through a
+        ``*.corrupt`` base, even when the head file itself is intact."""
+        pub = self._pubs.get(step)
+        seen = set()
+        while pub is not None and pub.kind == "delta":
+            if pub.base in self._quarantined:
+                return True
+            if pub.base in seen:
+                return True  # cyclic garbage: never servable
+            seen.add(pub.base)
+            pub = self._pubs.get(pub.base)
+        return False
 
     # -- the poll ----------------------------------------------------------
 
@@ -298,26 +335,123 @@ class SnapshotWatcher:
         return swapped
 
     def _try_open(self, step: int) -> ServableSnapshot | None:
-        path, _ = self._saved_events.get(
-            step, (fmt.snapshot_path(self.ckpt_dir, step), 0.0))
+        pub = self._pubs.get(step)
+        if step in self._saved_events:
+            path = self._saved_events[step][0]
+        elif pub is not None:
+            path = pub.path
+        else:
+            path = fmt.snapshot_path(self.ckpt_dir, step)
+        delta_m = fmt.DELTA_RE.fullmatch(os.path.basename(path))
         file_id = _file_id(path)
         if file_id is None:
+            # Swept/renamed between the candidate scan and this open:
+            # gone, retry next poll — never a rejection verdict.
             return None
         if self._rejected.get(step) == file_id:
             return None  # known-bad file; only a re-publish re-checks
+        if delta_m is not None and self._chain_quarantined(step):
+            # The head file may be pristine, but its chain passes
+            # through a *.corrupt base: state past the quarantine is
+            # unrecoverable — never resolve through it. Not cached: a
+            # re-publish of the base lifts the verdict.
+            return None
         try:
-            return ServableSnapshot.open(path, step=step,
-                                         verify=self.verify)
+            if delta_m is None:
+                return ServableSnapshot.open(path, step=step,
+                                             verify=self.verify)
+            base = int(delta_m.group(2))
+            cur = self.current
+            if (cur is not None and cur.step == base
+                    and step not in self._quarantined
+                    and self._cur_matches_disk(cur)):
+                # Delta-aware INCREMENTAL hot-swap: the served snapshot
+                # is the delta's base — apply the touched rows to the
+                # mapped view instead of re-opening the world.
+                return cur.with_delta(path, verify=self.verify)
+            inc = self._catch_up(cur, step)
+            if inc is not None:
+                return inc
+            return ServableSnapshot.open_chain(self.ckpt_dir, step,
+                                               verify=self.verify)
         except FileNotFoundError:
+            # The poll-loop race, mid-open this time: a candidate swept
+            # between stat and open is skipped, not raised and not
+            # counted as a rejection (regression-tested).
             return None
         except (SnapshotRejected, ValueError):
-            # Keyed by (inode, mtime) like every identity check here —
-            # mtime alone can collide with an atomic re-publish landing
-            # in the same clock tick, pinning a now-valid step as bad.
-            self._rejected[step] = file_id
-            self.rejected += 1
-            _emit_metric(self.recorder, "inc", "serve.rejected_snapshots", 1)
+            if delta_m is None:
+                self.rejected += 1
+                _emit_metric(self.recorder, "inc",
+                             "serve.rejected_snapshots", 1)
+                # Keyed by (inode, mtime) like every identity check here
+                # — mtime alone can collide with an atomic re-publish
+                # landing in the same clock tick, pinning a now-valid
+                # step as bad. Only SINGLE-file verdicts are cached: a
+                # full's content is immutable at that identity, so the
+                # verdict is permanent evidence.
+                self._rejected[step] = file_id
+                return None
+            # A CHAIN failure is not cached — the head file may be
+            # pristine while a link was mid-sweep/compaction/quarantine
+            # when we walked it; the verdict can lift without the head
+            # changing, so eligibility is re-checked next poll (chains
+            # are bounded by DeltaPolicy.full_every, the retry is
+            # cheap). It is COUNTED once per head identity, though: a
+            # lingering broken head polled at 20 Hz must not turn the
+            # rejected counter into a poll counter.
+            key = (step, file_id)
+            if key not in self._chain_rejected_seen:
+                if len(self._chain_rejected_seen) > 1024:
+                    self._chain_rejected_seen.clear()  # bounded memory
+                self._chain_rejected_seen.add(key)
+                self.rejected += 1
+                _emit_metric(self.recorder, "inc",
+                             "serve.rejected_snapshots", 1)
             return None
+
+    def _cur_matches_disk(self, cur) -> bool:
+        """The incremental paths extend the served snapshot's IN-MEMORY
+        state — legal only while the on-disk publication at that step is
+        still the very file (inode+mtime) the snapshot mapped. After a
+        quarantine → rollback-replay re-publish, the step number matches
+        but the CONTENT may not: overlaying a new delta on the old maps
+        would serve rows that exist in no publication. The full-snapshot
+        path's ``cur_alive`` check; applied to chain extension."""
+        if cur is None or cur.src_id is None:
+            return False
+        pub = self._pubs.get(cur.step)
+        if pub is None or pub.path != cur.path:
+            return False
+        return _file_id(pub.path) == cur.src_id
+
+    def _catch_up(self, cur, step: int) -> ServableSnapshot | None:
+        """Multi-delta incremental catch-up: when the candidate's chain
+        passes THROUGH the served step, extend the served snapshot
+        link by link (each link verifies just its own delta) instead of
+        re-opening and re-CRCing the whole chain from the base full —
+        the reader that missed a few polls pays O(missed deltas), not
+        O(table). None = not applicable (fall back to open_chain);
+        raises like :meth:`ServableSnapshot.with_delta` on bad links."""
+        if cur is None or not self._cur_matches_disk(cur):
+            return None
+        pubs = self._pubs or fmt.publications(self.ckpt_dir)
+        try:
+            members = fmt.chain_members(pubs, step)
+        except fmt.ChainError:
+            return None
+        idx = next((i for i, p in enumerate(members)
+                    if p.step == cur.step), None)
+        if idx is None:
+            return None
+        tail = members[idx + 1:]
+        if not tail or any(p.kind != "delta"
+                           or p.step in self._quarantined for p in tail):
+            return None
+        snap = cur
+        for link in tail:
+            snap = snap.with_delta(link.path, verify=self.verify)
+        return snap
 
     def _publish(self, snap: ServableSnapshot, direction: str) -> None:
         self.current = snap

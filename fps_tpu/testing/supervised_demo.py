@@ -78,6 +78,22 @@ SCENARIO_FOLD_KILL_AT = 3
 MEGASTEP_T_CALL = 4          # steps per in-graph chunk segment
 SCENARIO_MEGASTEP_K = 2
 SCENARIO_MEGASTEP_KILL_AT = 3
+# Delta-chain kill scenario (DeltaPolicy): a big feature table so each
+# chunk touches a small fraction of rows (deltas actually engage — at
+# tiny NF the size guard would publish fulls), a chain bound high enough
+# that the whole run is one full + deltas, and a SIGKILL after chunk
+# SCENARIO_DELTA_KILL_AT trains, before its delta lands — the restart
+# must recover by walking the chain to its last verified link.
+SCENARIO_DELTA_NF = 65536
+SCENARIO_DELTA_BASE_ARGS = ("--examples", "8000", "--epochs", "2",
+                            "--num-features", str(SCENARIO_DELTA_NF),
+                            "--keep", "30")
+SCENARIO_DELTA_ARGS = SCENARIO_DELTA_BASE_ARGS + (
+    "--delta-full-every", "100")
+SCENARIO_DELTA_KILL_AT = 3
+# Fleet-fence scenario: N readers under quorum-2 fencing over the same
+# delta-publishing child; one reader is killed+restarted mid-run.
+SCENARIO_FLEET_READERS = 3
 
 
 def run_supervised_scenario(tmpdir: str, *, timeout: float = 600):
@@ -768,6 +784,336 @@ def run_serve_while_train_scenario(tmpdir: str, *, timeout: float = 600):
               and watcher.rejected >= 1
               and final_consistent
               and backward_ok)
+    return ok, detail
+
+
+def _compaction_victim(ckpt_dir: str, phase: str) -> None:
+    """Subprocess body for the delta-chain compaction kill: build a real
+    delta chain, record its resolved state as ``expected.npz``, then
+    SIGKILL OURSELVES at the named compaction phase (``precommit`` /
+    ``published`` / ``swept_one`` — the Checkpointer's chaos seam). The
+    parent verifies recovery with pure snapshot_format (no jax)."""
+    import numpy as np
+
+    import jax
+
+    from fps_tpu.core.checkpoint import (
+        Checkpointer,
+        DeltaPolicy,
+        load_rows,
+    )
+    from fps_tpu.core.store import ParamStore, TableSpec
+    from fps_tpu.parallel.mesh import make_ps_mesh
+    from fps_tpu.testing import chaos
+
+    mesh = make_ps_mesh()
+    store = ParamStore(mesh, [TableSpec("w", num_ids=1024, dim=8)])
+    store.init(jax.random.key(0))
+    ck = Checkpointer(ckpt_dir, keep=30, delta=DeltaPolicy(full_every=50))
+    ck.save(1, store, None)
+    rng = np.random.default_rng(7)
+    for step in range(2, 6):
+        ids = np.unique(rng.integers(0, 1024, 16))
+        rows = store.lookup_host("w", ids)
+        load_rows(store, "w", ids, rows + float(step))
+        ck.save(step, store, None, touched_rows={"w": ids})
+    np.savez(os.path.join(ckpt_dir, "expected.npz"),
+             w=store.lookup_host("w", np.arange(1024)))
+    if phase != "none":
+        ck._compact_phase_hook = (
+            lambda p: chaos.sigkill_self() if p == phase else None)
+    ck.compact()
+
+
+def run_delta_chain_kill_scenario(tmpdir: str, *, timeout: float = 600):
+    """Delta-snapshot chains are crash-safe under injection
+    (``docs/resilience.md``), in two legs:
+
+    * **mid-chain publish kill** — a supervised child publishing one
+      full + per-chunk deltas (``DeltaPolicy``) is SIGKILLed after chunk
+      ``SCENARIO_DELTA_KILL_AT`` trains (async writer flushed, its delta
+      not yet landed): the restart must walk the chain to its last
+      verified link (``restored_step == kill_at``), replay exactly one
+      chunk, and finish BIT-identical to a straight delta run — which
+      itself must be bit-identical to a straight FULL-snapshot run (the
+      delta encoding changes bytes-on-disk, never state);
+    * **compaction kill, every phase** — a victim process folding a real
+      chain is SIGKILLed at each compaction phase (post-fsync
+      pre-rename / post-rename pre-sweep / mid-sweep): after every
+      crash the directory must still resolve to the SAME state
+      (``latest_valid_chain`` + ``resolve_chain_entries``, pure
+      numpy), and a rerun compaction must complete and preserve it.
+    """
+    import subprocess as sp
+
+    import numpy as np
+
+    from fps_tpu.core import snapshot_format as fmt
+
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=_ROOT)
+    demo = [sys.executable, "-m", "fps_tpu.testing.supervised_demo",
+            *SCENARIO_DELTA_ARGS]
+    detail: dict = {}
+
+    # Straight runs: full-snapshot baseline and delta chain.
+    base_dir = os.path.join(tmpdir, "base")
+    base_out = os.path.join(tmpdir, "base.npz")
+    r = sp.run([sys.executable, "-m", "fps_tpu.testing.supervised_demo",
+                *SCENARIO_DELTA_BASE_ARGS,
+                "--ckpt-dir", base_dir, "--out", base_out],
+               env=env, cwd=_ROOT, capture_output=True, text=True,
+               timeout=timeout)
+    if r.returncode != 0:
+        return False, {"error": "straight full run failed",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+    straight_dir = os.path.join(tmpdir, "straight")
+    straight_out = os.path.join(tmpdir, "straight.npz")
+    r = sp.run(demo + ["--ckpt-dir", straight_dir, "--out", straight_out],
+               env=env, cwd=_ROOT, capture_output=True, text=True,
+               timeout=timeout)
+    if r.returncode != 0:
+        return False, {"error": "straight delta run failed",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+    with open(straight_out + ".meta.json", encoding="utf-8") as f:
+        straight_meta = json.load(f)
+    detail["delta_publishes"] = straight_meta.get("delta_publishes")
+    delta_vs_full = np.array_equal(np.load(base_out)["weights"],
+                                   np.load(straight_out)["weights"])
+    detail["delta_encoding_bit_identical"] = bool(delta_vs_full)
+
+    # Supervised leg: SIGKILL mid-chain, supervisor restarts, resume
+    # walks the chain.
+    sup_dir = os.path.join(tmpdir, "sup")
+    sup_out = os.path.join(tmpdir, "sup.npz")
+    r = sp.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "supervise.py"),
+         "--state-dir", sup_dir, "--stall-timeout-s", "60",
+         "--startup-grace-s", "300", "--term-grace-s", "2",
+         "--backoff-base-s", "0.2", "--max-restarts", "2",
+         "--poll-s", "0.2", "--",
+         *demo, "--ckpt-dir", sup_dir, "--out", sup_out,
+         "--kill-at", str(SCENARIO_DELTA_KILL_AT)],
+        env=env, cwd=_ROOT, capture_output=True, text=True,
+        timeout=timeout)
+    try:
+        digest = json.loads(r.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return False, {"error": "no supervisor digest",
+                       "tail": (r.stdout + r.stderr)[-1000:]}
+    try:
+        with open(sup_out + ".meta.json", encoding="utf-8") as f:
+            meta = json.load(f)
+    except OSError:
+        meta = {}
+    bit_identical = (os.path.exists(sup_out)
+                     and np.array_equal(np.load(straight_out)["weights"],
+                                        np.load(sup_out)["weights"]))
+    detail["supervised"] = {
+        "restarts": digest.get("restarts"),
+        "restored_step": meta.get("restored_step"),
+        "delta_publishes": meta.get("delta_publishes"),
+        "bit_identical": bit_identical,
+    }
+    sup_ok = bool(r.returncode == 0 and digest.get("success")
+                  and digest.get("restarts") == 1
+                  and meta.get("restored_step") == SCENARIO_DELTA_KILL_AT
+                  and (straight_meta.get("delta_publishes") or 0) >= 2
+                  and (meta.get("delta_publishes") or 0) >= 1
+                  and delta_vs_full and bit_identical)
+
+    # Compaction kill legs: every phase of the fold must leave a
+    # recoverable, state-preserving directory.
+    phases = {}
+    for phase in ("precommit", "published", "swept_one"):
+        d = os.path.join(tmpdir, f"compact_{phase}")
+        victim = sp.run(
+            [sys.executable, "-c",
+             "from fps_tpu.testing.supervised_demo import "
+             f"_compaction_victim; _compaction_victim({d!r}, {phase!r})"],
+            env=env, cwd=_ROOT, capture_output=True, text=True,
+            timeout=timeout)
+        killed = victim.returncode == -9
+        want = np.load(os.path.join(d, "expected.npz"))["w"]
+        ok_state = False
+        resolved = fmt.latest_valid_chain(d)
+        if resolved is not None:
+            entries = fmt.resolve_chain_entries(resolved[1])
+            ok_state = (resolved[0] == 5
+                        and np.array_equal(entries["table::w"], want))
+        # Restartability: a rerun compaction (no kill) completes and
+        # preserves the state.
+        rerun = sp.run(
+            [sys.executable, "-c",
+             "from fps_tpu.core.checkpoint import Checkpointer, "
+             "DeltaPolicy; Checkpointer("
+             f"{d!r}, keep=30, delta=DeltaPolicy()).compact()"],
+            env=env, cwd=_ROOT, capture_output=True, text=True,
+            timeout=timeout)
+        ok_rerun = False
+        resolved2 = fmt.latest_valid_chain(d)
+        if rerun.returncode == 0 and resolved2 is not None:
+            entries2 = fmt.resolve_chain_entries(resolved2[1])
+            ok_rerun = (resolved2[0] == 5
+                        and resolved2[1][-1].kind == "full"
+                        and np.array_equal(entries2["table::w"], want))
+        phases[phase] = {"killed": killed, "recovered": ok_state,
+                         "rerun_compacts": ok_rerun}
+    detail["compaction"] = phases
+    compact_ok = all(v["killed"] and v["recovered"] and
+                     v["rerun_compacts"] for v in phases.values())
+    return sup_ok and compact_ok, detail
+
+
+def run_fleet_fence_scenario(tmpdir: str, *, timeout: float = 600):
+    """Step-fenced serving fleet under churn (``docs/serving.md``):
+    ``SCENARIO_FLEET_READERS`` fence-coordinated readers poll a
+    supervised delta-publishing child's checkpoint dir while the child
+    is SIGKILLed and restarted mid-run, and ONE reader is itself killed
+    and restarted (a fresh FleetReader with the same id) mid-swap. The
+    contract:
+
+    * the shared fence is forward-monotone for the whole run (one
+      fencing epoch — no quarantine here);
+    * no reader ever serves a step older than the fence it observed at
+      its own swap (per-reader served-step trails are monotone), and
+      every answered pull returns finite rows;
+    * the RESTARTED reader's first served step is >= the fence at its
+      construction — a reader killed mid-swap never comes back
+      answering a superseded step;
+    * the fleet converges: every reader ends on the newest valid
+      publication, byte-identical to the resolved chain.
+    """
+    import subprocess as sp
+    import time as _time
+
+    import numpy as np
+
+    from fps_tpu.core import snapshot_format as fmt
+    from fps_tpu.serve import FleetReader, ServingFleet
+
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=_ROOT)
+    demo = [sys.executable, "-m", "fps_tpu.testing.supervised_demo",
+            *SCENARIO_DELTA_ARGS]
+    sup_dir = os.path.join(tmpdir, "sup")
+    sup_out = os.path.join(tmpdir, "sup.npz")
+    proc = sp.Popen(
+        [sys.executable, os.path.join(_ROOT, "tools", "supervise.py"),
+         "--state-dir", sup_dir, "--stall-timeout-s", "60",
+         "--startup-grace-s", "300", "--term-grace-s", "2",
+         "--backoff-base-s", "0.2", "--max-restarts", "2",
+         "--poll-s", "0.2", "--",
+         *demo, "--ckpt-dir", sup_dir, "--out", sup_out,
+         "--kill-at", str(SCENARIO_DELTA_KILL_AT)],
+        env=env, cwd=_ROOT, stdout=sp.PIPE, stderr=sp.PIPE, text=True)
+
+    fleet = ServingFleet(sup_dir, SCENARIO_FLEET_READERS, quorum=2)
+    violations: list[str] = []
+    fence_trail: list[tuple[int, int]] = []
+    restarted_first: list[tuple[int, int | None]] = []
+    reader_killed = False
+    deadline = _time.monotonic() + timeout
+    polls = 0
+    while proc.poll() is None and _time.monotonic() < deadline:
+        fleet.poll()
+        polls += 1
+        fence = fleet.readers[0].fence.read()
+        if fence is not None:
+            if fence_trail and fence < fence_trail[-1]:
+                violations.append(
+                    f"fence went backward: {fence_trail[-1]} -> {fence}")
+            if not fence_trail or fence != fence_trail[-1]:
+                fence_trail.append(fence)
+        for r in fleet.readers:
+            snap = r.server._snap
+            if snap is None:
+                continue
+            step, rows = r.server.pull("weights", np.arange(64))
+            if not np.all(np.isfinite(rows)):
+                violations.append(
+                    f"{r.reader_id}: non-finite rows at step {step}")
+        if (not reader_killed and fence_trail
+                and fence_trail[-1][1] >= 2):
+            # Kill reader r1 mid-run (drop it on the floor — a SIGKILL
+            # from the reader's own point of view) and restart it as a
+            # fresh process-equivalent: a brand-new FleetReader that
+            # must re-read the fence BEFORE serving anything.
+            reader_killed = True
+            fence_at_boot = fleet.readers[1].fence.read()
+            fleet.readers[1] = FleetReader(sup_dir, "r1", quorum=2)
+            nr = fleet.readers[1]
+            nr.poll()
+            first = (None if nr.server._snap is None
+                     else nr.server._snap.step)
+            restarted_first.append((fence_at_boot[1], first))
+            if first is not None and first < fence_at_boot[1]:
+                violations.append(
+                    f"restarted reader served {first} below the boot "
+                    f"fence {fence_at_boot[1]}")
+        _time.sleep(0.05)
+
+    try:
+        stdout, stderr = proc.communicate(timeout=max(
+            5.0, deadline - _time.monotonic()))
+    except sp.TimeoutExpired:
+        proc.kill()
+        return False, {"error": "supervised run timed out"}
+    try:
+        digest = json.loads(stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return False, {"error": "no supervisor digest",
+                       "tail": (stdout + stderr)[-1000:]}
+
+    # Convergence: every reader ends on the newest valid publication
+    # with exactly the resolved chain's bytes.
+    for _ in range(6):
+        fleet.poll()
+    final = fmt.latest_valid_chain(sup_dir)
+    converged = False
+    if final is not None:
+        want = fmt.resolve_chain_entries(final[1])["table::weights"]
+        converged = True
+        for r in fleet.readers:
+            snap = r.server._snap
+            if snap is None or snap.step != final[0]:
+                converged = False
+                break
+            _, got = r.server.pull("weights",
+                                   np.arange(want.shape[0]))
+            if not np.array_equal(got, want):
+                converged = False
+                break
+    # Per-reader monotonicity of fence swaps (single epoch — no
+    # quarantine in this scenario).
+    monotone = all(all(b >= a for a, b in zip(r.served_steps,
+                                              r.served_steps[1:]))
+                   for r in fleet.readers)
+    chain_served = max((r.server._snap.chain_len
+                        for r in fleet.readers if r.server._snap
+                        is not None), default=0)
+    detail = {
+        "supervisor": {k: digest.get(k) for k in
+                       ("success", "restarts")},
+        "polls": polls,
+        "fence_trail": fence_trail[-8:],
+        "restarted_reader": restarted_first,
+        "served_monotone": monotone,
+        "max_chain_len_served": chain_served,
+        "violations": violations,
+        "converged": converged,
+    }
+    ok = bool(proc.returncode == 0 and digest.get("success")
+              and digest.get("restarts") == 1
+              and reader_killed and not violations and monotone
+              and len(fence_trail) >= 2
+              # Delta chains actually served (incremental swaps ran).
+              and chain_served >= 2
+              and converged)
     return ok, detail
 
 
@@ -1467,6 +1813,21 @@ def main(argv=None) -> int:
                          "from the supervisor env contract — the pod "
                          "chaos scenarios point tools/trace_export.py "
                          "and the fleet rollups at these")
+    ap.add_argument("--num-features", type=int, default=0,
+                    help="override the workload's feature-table size "
+                         "(0 = the standard tiny NF). The delta-chain "
+                         "scenarios raise it so per-chunk touched rows "
+                         "are a small fraction of the table and delta "
+                         "publications actually engage")
+    ap.add_argument("--delta-full-every", type=int, default=0,
+                    help="delta-snapshot chains (DeltaPolicy.full_every "
+                         "> 1): publish row-sparse deltas between "
+                         "fulls, sourced from the driver's touched-rows "
+                         "tracker")
+    ap.add_argument("--delta-compact-every", type=int, default=0,
+                    help="DeltaPolicy.compact_every: background "
+                         "LSM-style chain compaction once the live "
+                         "chain carries this many deltas")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -1538,10 +1899,25 @@ def main(argv=None) -> int:
 
     mesh = make_ps_mesh()
     W = num_workers_of(mesh)
-    train, _ = logreg_data(args.examples)
+    nf = args.num_features or NF
+    if nf == NF:
+        train, _ = logreg_data(args.examples)
+    else:
+        # Same planted workload at a custom table size (the delta-chain
+        # scenarios need touched-rows << table, which tiny NF can't
+        # give); same seeds, so straight vs supervised stay comparable.
+        from fps_tpu.utils.datasets import (
+            synthetic_sparse_classification,
+            train_test_split,
+        )
+
+        data = synthetic_sparse_classification(args.examples, nf, 8,
+                                               seed=7, noise=0.05)
+        data = dict(data, label=(data["label"] > 0).astype(np.float32))
+        train, _ = train_test_split(data)
     chunks = logreg_chunks(train, W, epochs=args.epochs)
 
-    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    cfg = LogRegConfig(num_features=nf, learning_rate=0.5)
     trainer, store = logistic_regression(mesh, cfg)
     if args.prefetch:
         import dataclasses
@@ -1565,11 +1941,18 @@ def main(argv=None) -> int:
     tables, ls = trainer.init_state(jax.random.key(0))
 
     ckpt_cls = Checkpointer if args.sync_checkpointer else AsyncCheckpointer
+    delta_policy = None
+    if args.delta_full_every > 1:
+        from fps_tpu.core.checkpoint import DeltaPolicy
+
+        delta_policy = DeltaPolicy(
+            full_every=args.delta_full_every,
+            compact_every=args.delta_compact_every)
     # Under a pod, publishes carry (and are fenced by) this child's
     # attempt epoch — a zombie of an aborted pod attempt dies loudly on
     # its next save instead of leaking state into the new attempt.
     ckpt = ckpt_cls(args.ckpt_dir, keep=args.keep,
-                    fence_epoch=pod["epoch"])
+                    fence_epoch=pod["epoch"], delta=delta_policy)
     if pod["step"] is not None:
         # Pod-commanded COMMON restart step: prefer it exactly, fall back
         # to the newest verified snapshot at-or-below it (retention may
@@ -1721,10 +2104,15 @@ def main(argv=None) -> int:
     if args.obs_dir and rec is not None:
         rec.close()  # run_end + final flush (journal = the trace spine)
 
-    np.savez(args.out, weights=weights(store))
+    np.savez(args.out, weights=(weights(store) if nf == NF else
+                                store.lookup_host("weights",
+                                                  np.arange(nf))))
     meta.update(finished=True,
                 skipped=sorted(rollback.skipped) if rollback else [],
                 tiering_restored=tiering_restored,
+                delta_publishes=ckpt.delta_publishes,
+                full_publishes=ckpt.full_publishes,
+                compactions=ckpt.compactions,
                 re_ranks=(trainer.retierer.re_ranks
                           if trainer.retierer is not None else None))
     with open(args.out + ".meta.json", "w", encoding="utf-8") as f:

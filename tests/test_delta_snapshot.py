@@ -1,0 +1,626 @@
+"""Crash-safe delta-snapshot chains (ISSUE 14).
+
+Contract under test (``docs/resilience.md`` failure model,
+``docs/serving.md`` "Delta chains", ``docs/STALENESS.md`` publish-cadence
+row):
+
+* the jax-free chain layer in ``core/snapshot_format``: publication
+  discovery (full-wins at a shared step), chain walking, per-link
+  CRC + ``meta::base_step`` cross-link + fencing-epoch monotonicity
+  verification, and pure-numpy chain resolution;
+* ``Checkpointer(delta=DeltaPolicy(...))``: delta saves restore
+  BIT-identically to the fulls they stand in for (tracker-sourced
+  touched ids and the exact row-diff fallback agree), structural
+  surprises publish fulls, the chain plan re-anchors across restarts,
+  and the pod fence is re-read on EVERY publish in a chain;
+* recovery semantics: a torn/CRC-failing/epoch-stale link truncates the
+  chain back to the last verified link; quarantining a full quarantines
+  every delta chained on it; retention GC never deletes a live chain's
+  link;
+* LSM-style compaction: the fold is bit-exact, shadows its chain head,
+  sweeps folded deltas, and leaves a recoverable chain when killed at
+  any phase (the chaos scenario runs the real SIGKILLs; here the
+  phases are simulated in-process);
+* the driver path: ``fit_stream`` with a delta checkpointer publishes
+  deltas sourced from ``WorkerLogic.pulled_ids_host`` and resumes from
+  a mid-chain state bit-identically.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from fps_tpu.core import snapshot_format as fmt
+from fps_tpu.core.checkpoint import (
+    AsyncCheckpointer,
+    Checkpointer,
+    DeltaPolicy,
+    TouchedRowsTracker,
+    load_rows,
+)
+from fps_tpu.core.resilience import SnapshotCorruptionError
+from fps_tpu.testing import chaos
+
+
+def _store(jax, mesh, *, num_ids=256, dim=4, name="w"):
+    from fps_tpu.core.store import ParamStore, TableSpec
+
+    store = ParamStore(mesh, [TableSpec(name, num_ids=num_ids, dim=dim)])
+    store.init(jax.random.key(0))
+    return store
+
+
+def _touch(store, name, ids, val):
+    ids = np.asarray(ids)
+    rows = store.lookup_host(name, ids)
+    load_rows(store, name, ids, rows + val)
+
+
+@pytest.fixture
+def jx(devices8):
+    import jax
+
+    return jax
+
+
+@pytest.fixture
+def mesh(jx):
+    from fps_tpu.parallel.mesh import make_ps_mesh
+
+    return make_ps_mesh()
+
+
+def _chain(dirpath, jx, mesh, *, steps=4, policy=None, seed=3):
+    """A store + checkpointer with one full and ``steps - 1`` deltas;
+    returns (store, checkpointer, expected_final_table)."""
+    store = _store(jx, mesh)
+    ck = Checkpointer(dirpath, keep=30,
+                      delta=policy or DeltaPolicy(full_every=50))
+    ck.save(1, store, None)
+    rng = np.random.default_rng(seed)
+    for step in range(2, steps + 1):
+        ids = np.unique(rng.integers(0, 256, 12))
+        _touch(store, "w", ids, float(step))
+        ck.save(step, store, None, touched_rows={"w": ids})
+    return store, ck, store.lookup_host("w", np.arange(256)).copy()
+
+
+# ---------------------------------------------------------------------------
+# snapshot_format: the jax-free chain layer.
+# ---------------------------------------------------------------------------
+
+def test_publications_and_chain_members(tmp_path, jx, mesh):
+    d = str(tmp_path)
+    _chain(d, jx, mesh, steps=4)
+    pubs = fmt.publications(d)
+    assert sorted(pubs) == [1, 2, 3, 4]
+    assert pubs[1].kind == "full" and pubs[1].base is None
+    assert pubs[3].kind == "delta" and pubs[3].base == 2
+    members = fmt.chain_members(pubs, 4)
+    assert [(p.step, p.kind) for p in members] == [
+        (1, "full"), (2, "delta"), (3, "delta"), (4, "delta")]
+    # full-wins: a full at a delta's step shadows the delta.
+    Checkpointer(d, keep=30, delta=DeltaPolicy()).compact()
+    pubs = fmt.publications(d)
+    assert pubs[4].kind == "full"
+    assert [p.step for p in fmt.chain_members(pubs, 4)] == [4]
+
+
+def test_chain_members_broken_base_raises(tmp_path, jx, mesh):
+    d = str(tmp_path)
+    _chain(d, jx, mesh, steps=3)
+    os.remove(fmt.delta_path(d, 2, 1))
+    pubs = fmt.publications(d)
+    with pytest.raises(fmt.ChainError) as ei:
+        fmt.chain_members(pubs, 3)
+    assert ei.value.step == 3  # the link whose base is gone
+
+
+def test_verify_chain_and_resolution(tmp_path, jx, mesh):
+    d = str(tmp_path)
+    store, _, want = _chain(d, jx, mesh, steps=4)
+    ok, reason, failing = fmt.verify_chain(d, 4)
+    assert ok and reason is None and failing is None
+    step, members = fmt.latest_valid_chain(d)
+    assert step == 4
+    entries = fmt.resolve_chain_entries(members)
+    np.testing.assert_array_equal(entries["table::w"], want)
+    # Corrupting a mid-chain link fails verification AT that link and
+    # truncates latest_valid_chain to the last verified head.
+    chaos.bitflip_file(fmt.delta_path(d, 3, 2), nflips=8, seed=0)
+    ok, reason, failing = fmt.verify_chain(d, 4)
+    assert not ok and failing == 3
+    assert fmt.latest_valid_chain(d)[0] == 2
+
+
+def test_verify_chain_epoch_staleness(tmp_path, jx, mesh):
+    """A delta carrying an OLDER fencing epoch than an earlier link is a
+    stale zombie's publish: chain verification refuses at that link."""
+    d = str(tmp_path)
+    store = _store(jx, mesh)
+    ck2 = Checkpointer(d, keep=30, fence_epoch=2,
+                       delta=DeltaPolicy(full_every=50))
+    ck2.save(1, store, None)
+    _touch(store, "w", [3], 1.0)
+    ck2.save(2, store, None, touched_rows={"w": np.array([3])})
+    # Forge an epoch-1 delta chaining on the epoch-2 head (the fence
+    # file itself is absent, so only the READ side can catch this).
+    entries = {
+        fmt.BASE_STEP_KEY: np.int64(2),
+        fmt.POD_EPOCH_KEY: np.int64(1),
+        fmt.DELTA_IDS_PREFIX + "table::w": np.array([5], np.int64),
+        fmt.DELTA_ROWS_PREFIX + "table::w": np.zeros((1, 4), np.float32),
+    }
+    arrays = dict(entries)
+    for k in list(arrays):
+        arrays[fmt.CRC_PREFIX + k] = np.uint32(fmt.array_crc32(arrays[k]))
+    np.savez(fmt.delta_path(d, 3, 2), **arrays)
+    ok, reason, failing = fmt.verify_chain(d, 3)
+    assert not ok and failing == 3 and "epoch" in reason
+    assert fmt.latest_valid_chain(d)[0] == 2
+    # The checkpoint reader refuses it the same way (auto-resolve
+    # quarantines the stale link and lands on the verified prefix).
+    step, tables, _, _ = Checkpointer(d, keep=30).read_snapshot()
+    assert step == 2
+    assert os.path.exists(fmt.delta_path(d, 3, 2) + ".corrupt")
+
+
+# ---------------------------------------------------------------------------
+# Checkpointer: delta planning + restore identity.
+# ---------------------------------------------------------------------------
+
+def test_delta_restore_bit_identical(tmp_path, jx, mesh):
+    d = str(tmp_path)
+    store, ck, want = _chain(d, jx, mesh, steps=5)
+    assert ck.delta_publishes == 4 and ck.full_publishes == 1
+    store2 = _store(jx, mesh)
+    _, step = Checkpointer(d, keep=30).restore_tables(store2)
+    assert step == 5
+    np.testing.assert_array_equal(
+        store2.lookup_host("w", np.arange(256)), want)
+
+
+def test_tracker_sourced_equals_diff_fallback(tmp_path, jx, mesh):
+    """touched_rows is a SUPERSET hint: the published state must be
+    identical whether the tracker supplies ids or the exact row compare
+    runs (and a superset only costs bytes, never correctness)."""
+    d1, d2, d3 = (str(tmp_path / s) for s in ("a", "b", "c"))
+    for d, touched in ((d1, "ids"), (d2, None), (d3, "superset")):
+        store = _store(jx, mesh)
+        ck = Checkpointer(d, keep=30, delta=DeltaPolicy(full_every=50))
+        ck.save(1, store, None)
+        ids = np.array([7, 9, 100])
+        _touch(store, "w", ids, 2.0)
+        tr = {"ids": {"w": ids}, None: None,
+              "superset": {"w": np.arange(0, 200)}}[touched]
+        ck.save(2, store, None, touched_rows=tr)
+    states = []
+    for d in (d1, d2, d3):
+        s = _store(jx, mesh)
+        Checkpointer(d, keep=30).restore_tables(s)
+        states.append(s.lookup_host("w", np.arange(256)))
+    np.testing.assert_array_equal(states[0], states[1])
+    np.testing.assert_array_equal(states[0], states[2])
+    # The diff fallback writes exactly the changed rows; the tracker
+    # path writes its (3-row) superset too — both strictly smaller than
+    # a full.
+    assert fmt.publications(d2)[2].kind == "delta"
+    assert fmt.publications(d1)[2].kind == "delta"
+
+
+def test_full_published_when_delta_not_smaller(tmp_path, jx, mesh):
+    """Touching every row (or an unknown touched set on a tiny table)
+    makes the delta encoding >= the full: the planner must publish a
+    full, not a pointless delta."""
+    d = str(tmp_path)
+    store = _store(jx, mesh)
+    ck = Checkpointer(d, keep=30, delta=DeltaPolicy(full_every=50))
+    ck.save(1, store, None)
+    _touch(store, "w", np.arange(256), 1.0)
+    ck.save(2, store, None, touched_rows={"w": np.arange(256)})
+    assert fmt.publications(d)[2].kind == "full"
+    assert ck.delta_publishes == 0
+
+
+def test_full_every_bounds_chain_length(tmp_path, jx, mesh):
+    d = str(tmp_path)
+    store = _store(jx, mesh)
+    ck = Checkpointer(d, keep=30, delta=DeltaPolicy(full_every=3))
+    ck.save(1, store, None)
+    for step in range(2, 8):
+        _touch(store, "w", [step], 1.0)
+        ck.save(step, store, None, touched_rows={"w": np.array([step])})
+    kinds = [fmt.publications(d)[s].kind for s in range(1, 8)]
+    # full, d, d, full, d, d, full — at most full_every-1 deltas/chain.
+    assert kinds == ["full", "delta", "delta", "full", "delta", "delta",
+                     "full"]
+
+
+def test_chain_reanchors_across_restart(tmp_path, jx, mesh):
+    """A fresh Checkpointer (new process) continues the on-disk chain
+    after read_snapshot instead of restarting with a full."""
+    d = str(tmp_path)
+    store, _, want = _chain(d, jx, mesh, steps=3)
+    store2 = _store(jx, mesh)
+    ck2 = Checkpointer(d, keep=30, delta=DeltaPolicy(full_every=50))
+    ck2.restore_tables(store2)
+    _touch(store2, "w", [11], 5.0)
+    path = ck2.save(4, store2, None, touched_rows={"w": np.array([11])})
+    assert os.path.basename(path) == os.path.basename(
+        fmt.delta_path(d, 4, 3))
+    s3 = _store(jx, mesh)
+    Checkpointer(d, keep=30).restore_tables(s3)
+    np.testing.assert_array_equal(
+        s3.lookup_host("w", np.arange(256)),
+        store2.lookup_host("w", np.arange(256)))
+
+
+def test_quarantined_full_cascades_to_chained_deltas(tmp_path, jx, mesh):
+    """Satellite: quarantining a full must quarantine every delta
+    chained on it — no reader may resolve a chain through a *.corrupt
+    base, and latest_valid_step knows delta files."""
+    d = str(tmp_path)
+    store, ck, _ = _chain(d, jx, mesh, steps=4)
+    # Corrupt the chain's BASE full: every chained step is unservable
+    # (their state is defined in terms of the bad link).
+    chaos.bitflip_file(fmt.snapshot_path(d, 1), nflips=8, seed=1)
+    assert Checkpointer(d, keep=30).latest_valid_step() is None
+    ck3 = Checkpointer(d, keep=30)
+    with pytest.raises(SnapshotCorruptionError):
+        ck3.read_snapshot(step=4)  # explicit pin: raises, no fallback
+    # Auto-resolve walks 4 -> trips on the corrupt base -> quarantines
+    # the full AND every delta chained on it -> nothing survives.
+    with pytest.raises(FileNotFoundError):
+        ck3.read_snapshot(step=None)
+    names = sorted(os.listdir(d))
+    assert fmt.SNAPSHOT_FMT.format(step=1) + ".corrupt" in names
+    for s, b in ((2, 1), (3, 2), (4, 3)):
+        assert os.path.basename(
+            fmt.delta_path(d, s, b)) + ".corrupt" in names
+    # No live chain resolves through the corrupt base anymore.
+    assert fmt.publications(d) == {}
+    assert fmt.latest_valid_chain(d) is None
+
+
+def test_corrupt_midchain_truncates_to_last_verified(tmp_path, jx, mesh):
+    d = str(tmp_path)
+    store, ck, _ = _chain(d, jx, mesh, steps=5)
+    chaos.truncate_file(fmt.delta_path(d, 4, 3))
+    assert Checkpointer(d, keep=30).latest_valid_step() == 3
+    step, tables, _, _ = Checkpointer(d, keep=30).read_snapshot()
+    assert step == 3  # truncation: lost recency, never corruption
+    # The failing link and its descendant are quarantined; the prefix
+    # survives untouched.
+    assert os.path.exists(fmt.delta_path(d, 2, 1))
+    assert os.path.exists(fmt.delta_path(d, 4, 3) + ".corrupt")
+    assert os.path.exists(fmt.delta_path(d, 5, 4) + ".corrupt")
+
+
+def test_gc_protects_live_chain_links(tmp_path, jx, mesh):
+    """keep=2 on a 5-link chain: every link of the newest heads'
+    back-chains survives GC (deleting the base full would orphan every
+    delta)."""
+    d = str(tmp_path)
+    store = _store(jx, mesh)
+    ck = Checkpointer(d, keep=2, delta=DeltaPolicy(full_every=50))
+    ck.save(1, store, None)
+    for step in range(2, 6):
+        _touch(store, "w", [step], 1.0)
+        ck.save(step, store, None, touched_rows={"w": np.array([step])})
+    assert sorted(fmt.publications(d)) == [1, 2, 3, 4, 5]
+    s2 = _store(jx, mesh)
+    _, step = Checkpointer(d, keep=2).restore_tables(s2)
+    assert step == 5
+
+
+def test_compaction_folds_and_sweeps(tmp_path, jx, mesh):
+    d = str(tmp_path)
+    store, ck, want = _chain(d, jx, mesh, steps=5)
+    path = ck.compact()
+    assert os.path.basename(path) == fmt.SNAPSHOT_FMT.format(step=5)
+    assert ck.compactions == 1
+    pubs = fmt.publications(d)
+    # Folded deltas swept; the base full kept for redundancy (keep>=2).
+    assert [(s, pubs[s].kind) for s in sorted(pubs)] == [
+        (1, "full"), (5, "full")]
+    s2 = _store(jx, mesh)
+    _, step = Checkpointer(d, keep=30).restore_tables(s2)
+    assert step == 5
+    np.testing.assert_array_equal(
+        s2.lookup_host("w", np.arange(256)), want)
+    # Nothing to fold on a full head.
+    assert Checkpointer(d, keep=30, delta=DeltaPolicy()).compact() is None
+
+
+def test_compaction_phase_crashes_recoverable(tmp_path, jx, mesh):
+    """The in-process twin of the chaos scenario's SIGKILL legs: abort
+    compaction at each phase and assert the directory still resolves to
+    the same state (and a rerun compaction completes)."""
+    class _Stop(Exception):
+        pass
+
+    for phase in ("precommit", "published", "swept_one"):
+        d = str(tmp_path / phase)
+        store, ck, want = _chain(d, jx, mesh, steps=5)
+        ck._compact_phase_hook = (
+            lambda p, _ph=phase: (_ for _ in ()).throw(_Stop())
+            if p == _ph else None)
+        with pytest.raises(_Stop):
+            ck.compact()
+        step, members = fmt.latest_valid_chain(d)
+        assert step == 5, phase
+        np.testing.assert_array_equal(
+            fmt.resolve_chain_entries(members)["table::w"], want)
+        ck2 = Checkpointer(d, keep=30, delta=DeltaPolicy())
+        ck2.compact()
+        step2, members2 = fmt.latest_valid_chain(d)
+        assert step2 == 5 and members2[-1].kind == "full", phase
+        np.testing.assert_array_equal(
+            fmt.resolve_chain_entries(members2)["table::w"], want)
+
+
+def test_auto_compaction_via_policy(tmp_path, jx, mesh):
+    d = str(tmp_path)
+    store = _store(jx, mesh)
+    ck = Checkpointer(d, keep=30,
+                      delta=DeltaPolicy(full_every=50, compact_every=3))
+    ck.save(1, store, None)
+    for step in range(2, 9):
+        _touch(store, "w", [step], 1.0)
+        ck.save(step, store, None, touched_rows={"w": np.array([step])})
+    assert ck.compactions >= 1
+    step, members = fmt.latest_valid_chain(d)
+    assert step == 8
+    # The live chain stays short: compaction keeps folding it.
+    assert sum(1 for p in members if p.kind == "delta") <= 3
+    s2 = _store(jx, mesh)
+    Checkpointer(d, keep=30).restore_tables(s2)
+    np.testing.assert_array_equal(
+        s2.lookup_host("w", np.arange(256)),
+        store.lookup_host("w", np.arange(256)))
+
+
+# ---------------------------------------------------------------------------
+# Fence re-read on EVERY publish in a chain (satellite).
+# ---------------------------------------------------------------------------
+
+def _drop_fence(dirpath, min_epoch):
+    import json
+
+    with open(os.path.join(dirpath, "pod_fence.json"), "w",
+              encoding="utf-8") as f:
+        json.dump({"min_epoch": min_epoch}, f)
+
+
+@pytest.mark.parametrize("async_writer", [False, True])
+def test_fence_refuses_midchain_delta(tmp_path, jx, mesh, async_writer):
+    """A fence landing MID-CHAIN must refuse the next delta publish with
+    StaleEpochError — the fence is re-read on every publish, full or
+    delta, sync or async."""
+    from fps_tpu.supervise.child import StaleEpochError
+
+    d = str(tmp_path)
+    store = _store(jx, mesh)
+    cls = AsyncCheckpointer if async_writer else Checkpointer
+    ck = cls(d, keep=30, fence_epoch=1, delta=DeltaPolicy(full_every=50))
+    try:
+        ck.save(1, store, None)
+        _touch(store, "w", [3], 1.0)
+        ck.save(2, store, None, touched_rows={"w": np.array([3])})
+        ck.flush()
+        assert fmt.publications(d)[2].kind == "delta"
+        _drop_fence(d, 2)  # the pod moved on: this writer is a zombie
+        _touch(store, "w", [4], 1.0)
+        with pytest.raises((StaleEpochError, RuntimeError)) as ei:
+            ck.save(3, store, None, touched_rows={"w": np.array([4])})
+            ck.flush()
+        if not isinstance(ei.value, StaleEpochError):
+            # Async path wraps the writer-thread error.
+            assert isinstance(ei.value.__cause__, StaleEpochError)
+        # Nothing stale landed; the chain still resolves to step 2.
+        assert fmt.latest_valid_chain(d)[0] == 2
+    finally:
+        try:
+            ck.close()
+        except RuntimeError:
+            pass  # the surfaced fence error re-raises on close
+
+
+def test_epochless_writer_refused_by_fenced_dir_midchain(tmp_path, jx,
+                                                         mesh):
+    from fps_tpu.supervise.child import StaleEpochError
+
+    d = str(tmp_path)
+    store = _store(jx, mesh)
+    ck = Checkpointer(d, keep=30, delta=DeltaPolicy(full_every=50))
+    ck.save(1, store, None)
+    _touch(store, "w", [3], 1.0)
+    ck.save(2, store, None, touched_rows={"w": np.array([3])})
+    _drop_fence(d, 1)
+    _touch(store, "w", [4], 1.0)
+    with pytest.raises(StaleEpochError):
+        ck.save(3, store, None, touched_rows={"w": np.array([4])})
+    assert fmt.latest_valid_chain(d)[0] == 2
+
+
+def test_fenced_delta_carries_epoch_stamp(tmp_path, jx, mesh):
+    d = str(tmp_path)
+    store = _store(jx, mesh)
+    ck = Checkpointer(d, keep=30, fence_epoch=3,
+                      delta=DeltaPolicy(full_every=50))
+    ck.save(1, store, None)
+    _touch(store, "w", [3], 1.0)
+    ck.save(2, store, None, touched_rows={"w": np.array([3])})
+    meta = fmt.read_pub_meta(fmt.delta_path(d, 2, 1))
+    assert meta["base_step"] == 1 and meta["pod_epoch"] == 3
+
+
+# ---------------------------------------------------------------------------
+# TouchedRowsTracker.
+# ---------------------------------------------------------------------------
+
+def test_touched_tracker_capture_commit():
+    tr = TouchedRowsTracker(["a", "b"])
+    tr.observe({"a": np.array([3, 1, 3])})
+    tr.observe({"a": np.array([5]), "b": np.array([2])})
+    ids, marker = tr.capture()
+    # 'b' was absent from the first observation: unknown (diff fallback).
+    np.testing.assert_array_equal(ids["a"], [1, 3, 5])
+    assert ids["b"] is None
+    # Capture is non-destructive: re-capture sees the same prefix.
+    ids2, marker2 = tr.capture()
+    np.testing.assert_array_equal(ids2["a"], [1, 3, 5])
+    tr.commit(marker2)
+    ids3, _ = tr.capture()
+    assert len(ids3["a"]) == 0
+    # An uncertifiable chunk poisons every table in its prefix.
+    tr.observe(None)
+    tr.observe({"a": np.array([9]), "b": np.array([9])})
+    ids4, _ = tr.capture()
+    assert ids4["a"] is None and ids4["b"] is None
+
+
+# ---------------------------------------------------------------------------
+# Driver path: deltas from the pulled-id stream + resume identity.
+# ---------------------------------------------------------------------------
+
+def _sparse_logreg(jx, mesh):
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.core.ingest import epoch_chunks
+    from fps_tpu.models.logistic_regression import (
+        LogRegConfig,
+        logistic_regression,
+    )
+    from fps_tpu.utils.datasets import synthetic_sparse_classification
+
+    W = num_workers_of(mesh)
+    NF = 1 << 14
+    data = synthetic_sparse_classification(W * 32 * 4 * 5, NF, 8, seed=0)
+    data["label"] = (data["label"] > 0).astype(np.float32)
+    chunks = list(epoch_chunks(data, num_workers=W, local_batch=32,
+                               steps_per_chunk=4, seed=5))
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.1)
+    return cfg, chunks, NF, logistic_regression
+
+
+def test_fit_stream_publishes_tracker_sourced_deltas(tmp_path, jx, mesh):
+    cfg, chunks, NF, factory = _sparse_logreg(jx, mesh)
+
+    def run(d, policy, stop_at=None, start=0):
+        trainer, store = factory(mesh, cfg)
+        tables, ls = trainer.init_state(jx.random.key(0))
+        ck = AsyncCheckpointer(d, keep=30, delta=policy)
+        if start:
+            tables, ls, start = trainer.restore_checkpoint(ck, ls)
+        trainer.fit_stream(tables, ls, iter(chunks[start:stop_at]),
+                           jx.random.key(1), checkpointer=ck,
+                           checkpoint_every=1, start_step=start)
+        ck.close()
+        return (store.lookup_host("weights", np.arange(NF)),
+                ck.delta_publishes, ck.publish_bytes_total)
+
+    d_full = str(tmp_path / "full")
+    d_delta = str(tmp_path / "delta")
+    d_res = str(tmp_path / "resume")
+    w_full, _, full_bytes = run(d_full, None)
+    w_delta, deltas, delta_bytes = run(d_delta,
+                                       DeltaPolicy(full_every=50))
+    assert deltas >= 3  # the tracker-sourced chain actually engaged
+    assert delta_bytes < full_bytes  # publish bytes track touched rows
+    np.testing.assert_array_equal(w_full, w_delta)
+    # Crash-resume mid-chain: stop after 2 chunks, restart from the
+    # chain, finish — bit-identical to the uninterrupted run.
+    run(d_res, DeltaPolicy(full_every=50), stop_at=2)
+    w_res, _, _ = run(d_res, DeltaPolicy(full_every=50), start=1)
+    np.testing.assert_array_equal(w_res, w_full)
+
+
+def test_delta_metric_specs_registered():
+    from fps_tpu.obs.registry import default_registry
+
+    reg = default_registry()
+    for name in ("checkpoint.delta_publishes", "checkpoint.delta_bytes",
+                 "checkpoint.compactions", "serve.fence_step"):
+        assert reg.get(name) is not None, name
+
+
+@pytest.mark.slow
+def test_delta_chain_kill_scenario_end_to_end(tmp_path):
+    """The full chaos leg (shared with tools/chaos_sweep.py so the two
+    cannot drift): SIGKILL mid-chain under the supervisor + SIGKILL at
+    every compaction phase — recovery to the last verified link,
+    bit-identical resume."""
+    from fps_tpu.testing.supervised_demo import (
+        run_delta_chain_kill_scenario,
+    )
+
+    ok, detail = run_delta_chain_kill_scenario(str(tmp_path))
+    assert ok, detail
+
+
+def test_orphan_delta_never_published_after_failed_base(tmp_path, jx, mesh,
+                                                        monkeypatch):
+    """A delta planned while its base's BACKGROUND write was in flight
+    must never land if that write fails — the writer refuses the orphan
+    (broken chain heads never reach disk) and the caller sees the
+    error; the next save publishes a full."""
+    import threading
+
+    import fps_tpu.core.checkpoint as ckmod
+
+    d = str(tmp_path)
+    store = _store(jx, mesh)
+    ck = AsyncCheckpointer(d, keep=30, delta=DeltaPolicy(full_every=50))
+    ck.save(1, store, None)
+    ck.flush()
+    real = ckmod._atomic_savez
+    gate = threading.Event()
+    state = {"fails": 0}
+
+    def failing(path, arrays, precommit=None):
+        if state["fails"] == 0:
+            state["fails"] = 1
+            gate.wait(10)  # hold until the NEXT save is enqueued
+            raise OSError("disk full")
+        return real(path, arrays, precommit)
+
+    monkeypatch.setattr(ckmod, "_atomic_savez", failing)
+    _touch(store, "w", [3], 1.0)
+    ck.save(2, store, None, touched_rows={"w": np.array([3])})
+    _touch(store, "w", [4], 1.0)
+    ck.save(3, store, None, touched_rows={"w": np.array([4])})
+    gate.set()  # write(2) now fails; queued delta(3, base 2) is refused
+    with pytest.raises(RuntimeError):
+        ck.flush()
+    assert set(fmt.publications(d)) == {1}  # no orphan on disk
+    # Recovery: the chain plan reset — the next save is a clean FULL.
+    _touch(store, "w", [5], 1.0)
+    ck.save(4, store, None, touched_rows={"w": np.array([5])})
+    ck.close()
+    assert fmt.publications(d)[4].kind == "full"
+    s2 = _store(jx, mesh)
+    _, step = Checkpointer(d, keep=30).restore_tables(s2)
+    assert step == 4
+    np.testing.assert_array_equal(
+        s2.lookup_host("w", np.arange(256)),
+        store.lookup_host("w", np.arange(256)))
+
+
+def test_compaction_credits_chain_plan(tmp_path, jx, mesh):
+    """compact() credits the folded deltas back to the publisher's
+    chain-length plan: auto-compaction must not cause premature
+    full_every fulls against an already-folded chain."""
+    d = str(tmp_path)
+    store = _store(jx, mesh)
+    ck = Checkpointer(d, keep=30,
+                      delta=DeltaPolicy(full_every=6, compact_every=3))
+    ck.save(1, store, None)
+    for step in range(2, 12):
+        _touch(store, "w", [step], 1.0)
+        ck.save(step, store, None, touched_rows={"w": np.array([step])})
+    # Every post-base publication stayed a delta (compaction kept the
+    # live chain under full_every; without the credit, steps 6/11 would
+    # have been whole-table fulls).
+    assert ck.delta_publishes == 10 and ck.full_publishes == 1
+    assert ck.compactions >= 2

@@ -62,6 +62,21 @@ fps_tpu.testing.workloads):
   and replays to final weights bit-identical to a straight run — a
   zero-restarted Adagrad accumulator would diverge.
 
+* ``delta_chain_kill``         — delta-snapshot chains
+  (``Checkpointer(delta=DeltaPolicy(...))``): a supervised child
+  publishing one full + per-chunk deltas is SIGKILLed mid-chain, and a
+  compaction victim is SIGKILLed at EVERY fold phase (pre-rename /
+  pre-sweep / mid-sweep): survives iff every crash recovers to the last
+  verified chain link (resume bit-identical; the delta encoding itself
+  bit-identical to full snapshots) and a rerun compaction completes.
+* ``fleet_fence``              — step-fenced serving fleet
+  (``fps_tpu.serve.fleet``): N readers under quorum fencing over a
+  SIGKILLed+restarted delta-publishing child, with one READER killed
+  and restarted mid-swap: survives iff the fence stays forward-monotone,
+  no reader ever answers a superseded step (restart included), delta
+  chains hot-swap incrementally, and the fleet converges byte-identical
+  to the resolved chain.
+
 * ``pod_kill_one_host``        — pod of 3 member agents
   (``fps_tpu.supervise.pod``) over one shared pod dir; ONE member's
   child is SIGKILLed: survives iff the leader makes one pod-wide
@@ -293,6 +308,13 @@ def _harness_scenarios():
             "run_reconcile_shard_kill_scenario"),
         "serve_while_train": _subprocess_scenario(
             "run_serve_while_train_scenario"),
+        # Delta-snapshot chains + the step-fenced serving fleet
+        # (ISSUE 14; docs/resilience.md failure model rows, docs/
+        # serving.md fleet sections).
+        "delta_chain_kill": _subprocess_scenario(
+            "run_delta_chain_kill_scenario"),
+        "fleet_fence": _subprocess_scenario(
+            "run_fleet_fence_scenario"),
         # Pod-level scenarios (fps_tpu.supervise.pod): N member agents
         # over one shared pod dir — one failure domain.
         "pod_kill_one_host": _subprocess_scenario(
@@ -332,7 +354,15 @@ def main(argv=None):
                     help="run only these scenarios (repeatable / "
                          "comma-separated) — lets CI shard the sweep; "
                          f"known: {', '.join(scenarios)}")
+    ap.add_argument("--list", action="store_true",
+                    help="print registered scenario names (one per "
+                         "line) and exit — CI shards build their "
+                         "--only sets from this instead of hardcoding")
     args = ap.parse_args(argv)
+    if args.list:
+        for name in scenarios:
+            print(name)
+        return 0
     selected = [s for arg in args.only for s in arg.split(",") if s]
     unknown = sorted(set(selected) - set(scenarios))
     if unknown:

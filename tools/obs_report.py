@@ -370,6 +370,24 @@ def render_digest(obs_dir: str) -> dict:
             "swaps": dict(sorted(swap_directions.items())),
             "rejected_snapshots": int(
                 counters.get("serve.rejected_snapshots", 0)),
+            # Delta-snapshot chains + the step-fenced serving fleet
+            # (ISSUE 14): publish-bytes proportionality on the write
+            # side, the shared fence's last published step on the read
+            # side (forward-monotone within a fencing epoch).
+            "delta": {
+                "delta_publishes": int(
+                    counters.get("checkpoint.delta_publishes", 0)),
+                "delta_bytes": int(
+                    counters.get("checkpoint.delta_bytes", 0)),
+                "compactions": int(
+                    counters.get("checkpoint.compactions", 0)),
+                "full_bytes_last": gauges.get(
+                    "checkpoint.bytes", {}).get("last"),
+            },
+            "fence_step_last": gauges.get(
+                "serve.fence_step", {}).get("last"),
+            "fence_step_max": gauges.get(
+                "serve.fence_step", {}).get("max"),
         },
         # Pod coordination (fps_tpu.supervise.pod): the control-plane
         # narrative folded from journal-pod.jsonl — lease churn, the
