@@ -1774,10 +1774,19 @@ def run_megastep_ab(args):
         if label == "megastep":
             arm["vote_compact_windows"] = int(
                 rec.counter_value("cold_route.vote_compact_windows"))
+            # Unlabeled since the phantom-window fix: ONE AND-ed verdict
+            # per window, weighted by real (non-weight-0) segments.
             arm["vote_overflow_windows"] = int(rec.counter_value(
-                "cold_route.vote_overflow_windows", table="item_factors"))
+                "cold_route.vote_overflow_windows"))
             arm["cold_dropped"] = int(rec.counter_value(
                 "hot_tier.cold_dropped", table="item_factors"))
+            arm["windows"] = int(rec.counter_value("megastep.windows"))
+            # Phantom-window fix (PR-13 carried-over item): the counter
+            # must equal the REAL dispatched chunk count — the same
+            # number the per-chunk arm dispatches — not M * K.
+            arm["windows_match_dispatched"] = (
+                arm["windows"]
+                == plan.calls_per_epoch(SPC) * EPOCHS)
         finals[label] = {k: np.asarray(v) for k, v in store.tables.items()
                         if "::" not in k}
         out[label] = arm
@@ -2053,10 +2062,186 @@ def run_delta(args):
     }
 
 
+def run_storage(args):
+    """Hostile-filesystem brownout A/B (docs/resilience.md "Hostile
+    filesystem"): the same logreg stream trained twice with per-chunk
+    async publishes —
+
+    * **clean**    — healthy storage;
+    * **brownout** — ``fps_tpu.testing.faultfs`` injects a deterministic
+      schedule against the snapshot plane: an EIO blackout window wide
+      enough to exhaust the publish retry budget (the writer DEGRADES:
+      skips the publish, raises checkpoint.publish_backlog) plus
+      recurring slow-fsync latency, then recovery.
+
+    Reported: training throughput retention (faulted/clean examples/s —
+    the degradation must stay on the writer thread, not the training
+    loop), the publish-backlog drain curve (rise through the blackout,
+    cliff to 0 at the first landed publish), retry/degraded counts, and
+    the headline invariant: final weights AND the final recovered
+    snapshot's state are BIT-identical to the clean run's."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from fps_tpu import obs
+    from fps_tpu.core.checkpoint import AsyncCheckpointer
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.core.ingest import multi_epoch_chunks
+    from fps_tpu.models.logistic_regression import (
+        LogRegConfig,
+        logistic_regression,
+    )
+    from fps_tpu.parallel.mesh import make_ps_mesh
+    from fps_tpu.testing import faultfs
+    from fps_tpu.testing.faultfs import FaultRule
+
+    from fps_tpu.utils.datasets import synthetic_sparse_classification
+
+    mesh = make_ps_mesh()
+    W = num_workers_of(mesh)
+    NF, NNZ, EPOCHS = 2048, 16, 2
+    data = synthetic_sparse_classification(120_000, NF, NNZ, seed=7,
+                                           noise=0.05)
+    data = dict(data, label=(data["label"] > 0).astype(np.float32))
+
+    def make_chunks():
+        return multi_epoch_chunks(data, EPOCHS, num_workers=W,
+                                  local_batch=256, steps_per_chunk=8,
+                                  seed=3)
+
+    n_chunks = sum(1 for _ in make_chunks())
+    # The blackout window: wide enough that one publish exhausts its
+    # whole retry budget (4 attempts) and degrades, while the NEXT
+    # publish fails twice and lands on its third attempt — both the
+    # degrade and the retried-then-success paths are exercised.
+    brownout_rules = [
+        FaultRule("snapshot", "write", "errno", errno_name="EIO",
+                  start=2, count=6),
+        FaultRule("snapshot", "fsync", "delay", delay_s=0.01,
+                  start=0, count=None, every=3),
+    ]
+
+    def run_arm(faulted: bool):
+        cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+        trainer, store = logistic_regression(mesh, cfg)
+        rec = obs.Recorder(sinks=[])
+        trainer.recorder = rec
+        # Checkpoint-layer telemetry (storage.retries, the backlog
+        # gauge, checkpoint_degraded events) fires through the process
+        # default, not the trainer's recorder.
+        obs.events.set_default_recorder(rec)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        fs = (faultfs.install(brownout_rules, seed=0)
+              if faulted else None)
+        curve = []  # (t_rel, backlog) drain-curve samples
+        stop = threading.Event()
+        with tempfile.TemporaryDirectory() as d:
+            ck = AsyncCheckpointer(d, keep=n_chunks + 2)
+            t0 = time.perf_counter()
+
+            def sample():
+                while not stop.is_set():
+                    curve.append((round(time.perf_counter() - t0, 3),
+                                  ck._publish_backlog))
+                    stop.wait(0.02)
+
+            sampler = threading.Thread(target=sample, daemon=True,
+                                       name="bench-storage-sampler")
+            sampler.start()
+            try:
+                tables, ls, m = trainer.fit_stream(
+                    tables, ls, make_chunks(), jax.random.key(1),
+                    checkpointer=ck, checkpoint_every=1)
+                wall = time.perf_counter() - t0
+                ck.flush()
+            finally:
+                stop.set()
+                sampler.join(timeout=5.0)
+                if fs is not None:
+                    faultfs.uninstall()
+                obs.events.set_default_recorder(None)
+            curve.append((round(time.perf_counter() - t0, 3),
+                          ck._publish_backlog))
+            final_step = ck.latest_valid_step()
+            _, snap_tables, _, _ = ck.read_snapshot(final_step)
+            ck.close()
+        n_ex = float(sum(np.asarray(mm["n"]).sum() for mm in m))
+        # Downsample the curve: keep every change point (the drain
+        # cliff) plus bounded padding.
+        keep, last = [], None
+        for t, b in curve:
+            if b != last or len(keep) < 2:
+                keep.append([t, int(b)])
+                last = b
+        arm = {
+            "examples_per_sec": round(n_ex / wall, 1),
+            "wall_s": round(wall, 4),
+            "publishes_landed": ck.full_publishes + ck.delta_publishes,
+            "degraded_publishes": ck.degraded_publishes,
+            "retries": int(rec.counter_value("storage.retries",
+                                             plane="checkpoint")),
+            "backlog_final": ck._publish_backlog,
+            "backlog_max": max((b for _, b in curve), default=0),
+            "backlog_curve": keep[:40],
+            "final_snapshot_step": final_step,
+            "injected": (dict((f"{k[0]}/{k[1]}/{k[2]}", v) for k, v in
+                              fs.injected_counts().items())
+                         if fs is not None else None),
+        }
+        weights = store.lookup_host("weights", np.arange(NF))
+        return arm, weights, snap_tables["weights"]
+
+    # Warm-up (compile) outside the timed arms.
+    from itertools import islice
+
+    cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+    tw, sw = logistic_regression(mesh, cfg)
+    t0s, l0s = tw.init_state(jax.random.key(9))
+    tw.fit_stream(t0s, l0s, islice(make_chunks(), 2), jax.random.key(9))
+
+    clean_arm, clean_w, clean_snap = run_arm(False)
+    faulted_arm, faulted_w, faulted_snap = run_arm(True)
+    retention = (faulted_arm["examples_per_sec"]
+                 / clean_arm["examples_per_sec"]
+                 if clean_arm["examples_per_sec"] else None)
+    out = {
+        "mesh": dict(mesh.shape), "chunks": n_chunks,
+        "clean": clean_arm, "brownout": faulted_arm,
+        "throughput_retention": (round(retention, 4)
+                                 if retention else None),
+        "weights_bit_identical": bool(
+            np.array_equal(clean_w, faulted_w)),
+        "recovered_snapshot_bit_identical": bool(
+            np.array_equal(clean_snap, faulted_snap)),
+        "backlog_drained": faulted_arm["backlog_final"] == 0,
+    }
+    print(
+        f"storage brownout A/B: examples/s "
+        f"{clean_arm['examples_per_sec']:.0f} -> "
+        f"{faulted_arm['examples_per_sec']:.0f} (retention "
+        f"{out['throughput_retention']}), degraded "
+        f"{faulted_arm['degraded_publishes']} / retries "
+        f"{faulted_arm['retries']}, backlog max "
+        f"{faulted_arm['backlog_max']} drained "
+        f"{out['backlog_drained']}, bit-identical "
+        f"{out['weights_bit_identical']} (snapshot "
+        f"{out['recovered_snapshot_bit_identical']})", file=sys.stderr)
+    return {
+        "metric": "storage_brownout_throughput_retention",
+        "value": out["throughput_retention"],
+        "unit": "x_retention",
+        "vs_baseline": out["throughput_retention"],
+        **out,
+    }
+
+
 RUNNERS = {"mf": run_mf, "w2v": run_w2v, "logreg": run_logreg,
            "pa": run_pa, "ials": run_ials, "tiered": run_tiered,
            "tiered_drift": run_tiered_drift, "serve": run_serve,
-           "megastep": run_megastep_ab, "delta": run_delta}
+           "megastep": run_megastep_ab, "delta": run_delta,
+           "storage": run_storage}
 
 
 def compact_summary(results):
@@ -2118,7 +2303,7 @@ def main():
     ap.add_argument("--workload", default="all",
                     choices=["all", "mf", "w2v", "logreg", "pa", "ials",
                              "tiered", "tiered_drift", "serve",
-                             "megastep", "delta"])
+                             "megastep", "delta", "storage"])
     ap.add_argument("--scale", default="20m", choices=["100k", "1m", "20m"])
     ap.add_argument("--rank", type=int, default=10)
     ap.add_argument("--local-batch", type=int, default=32768)
@@ -2144,7 +2329,7 @@ def main():
     if args.workload == "all":
         # Headline (mf) LAST among the per-workload lines.
         order = ["w2v", "logreg", "pa", "ials", "tiered", "tiered_drift",
-                 "serve", "megastep", "delta", "mf"]
+                 "serve", "megastep", "delta", "storage", "mf"]
     else:
         order = [args.workload]
     results = {}

@@ -41,6 +41,7 @@ against the copy after the next chunk has been dispatched, so the dump's
 from __future__ import annotations
 
 import dataclasses
+import errno as _errno_mod
 import json
 import logging
 import os
@@ -52,6 +53,7 @@ from typing import Any, Mapping
 import jax
 import numpy as np
 
+from fps_tpu.core import retry as _retry
 from fps_tpu.core import snapshot_format
 from fps_tpu.core.resilience import SnapshotCorruptionError, array_crc32
 from fps_tpu.core.store import ParamStore, id_to_phys, rows_per_shard
@@ -279,6 +281,14 @@ class DeltaPolicy:
     compact_every: int = 0
 
 
+class OrphanDeltaError(RuntimeError):
+    """A planned delta's base publication never landed (its write
+    failed or was degraded): publishing the delta would leave a broken
+    chain head on disk, so the writer refuses it. Under the async
+    writer's degraded mode this skips like any other degraded publish —
+    the chain plan resets and the next save publishes a full."""
+
+
 class TouchedRowsTracker:
     """Accumulates per-table touched-row id supersets between
     publications (driver-side source for ``save(touched_rows=...)``).
@@ -370,11 +380,26 @@ class Checkpointer:
 
     def __init__(self, directory: str, *, keep: int = 3,
                  fence_epoch: int | None = None,
-                 delta: DeltaPolicy | None = None):
+                 delta: DeltaPolicy | None = None,
+                 retry: _retry.RetryPolicy | None = None):
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
         self.dir = directory
         self.keep = keep
+        # Hostile-filesystem survival (fps_tpu.core.retry): every publish
+        # retries transient I/O errors (ENOSPC/EIO/ETIMEDOUT/...) under a
+        # bounded, deterministically-jittered backoff before failing —
+        # seeded per directory so co-located writers desynchronize.
+        # RetryPolicy(retries=0) disables retries entirely.
+        self.retry_policy = (retry if retry is not None
+                             else dataclasses.replace(
+                                 _retry.DEFAULT_PUBLISH_RETRY,
+                                 seed=directory))
+        # Degraded-mode accounting (the AsyncCheckpointer skips a
+        # publish after retries instead of crashing training; the sync
+        # base class raises, so these stay 0 here).
+        self.degraded_publishes = 0
+        self._publish_backlog = 0
         # Pod fencing epoch (fps_tpu.supervise.pod): checked against the
         # directory's ``pod_fence.json`` immediately before every
         # publish. ``None`` = this writer predates/ignores the pod
@@ -566,7 +591,7 @@ class Checkpointer:
             # broken chain head on disk. Refuse — the caller sees the
             # error (and the base's original failure) on its next
             # save/flush, and the chain plan resets to a full.
-            raise RuntimeError(
+            raise OrphanDeltaError(
                 f"refusing orphan delta step {step}: base publication "
                 f"{base} never landed under {self.dir}")
         arrays = dict(arrays)
@@ -580,8 +605,8 @@ class Checkpointer:
         # a fence that lands while a big table is serializing still wins.
         # Every link of a delta chain re-reads it the same way: a stale
         # zombie can no more extend a chain than publish a full.
-        _atomic_savez(path, arrays,
-                      precommit=lambda: self._check_fence(step))
+        self._savez_with_retry(path, arrays,
+                               precommit=lambda: self._check_fence(step))
         secs = time.perf_counter() - t0
         try:
             nbytes = os.path.getsize(path)
@@ -614,6 +639,27 @@ class Checkpointer:
         self._gc()
         self._maybe_auto_compact()
         return path
+
+    def _savez_with_retry(self, path: str, arrays, *, precommit=None
+                          ) -> None:
+        """``_atomic_savez`` under this writer's :class:`RetryPolicy`:
+        transient I/O failures (errno-classified by
+        ``fps_tpu.core.retry``) retry with bounded deterministic
+        backoff; a fence refusal in ``precommit`` is fatal and raises
+        through immediately (a zombie must never keep hammering the
+        directory). Each retry leaves no partial state: a failed
+        attempt's tmp file is removed by ``_atomic_savez`` itself."""
+
+        def on_retry(attempt, err, delay):
+            _log.warning(
+                "transient I/O failure publishing %s (attempt %d, "
+                "retrying in %.3fs): %r", os.path.basename(path),
+                attempt + 1, delay, err)
+            _obs_metric("inc", "storage.retries", 1, plane="checkpoint")
+
+        _retry.call_with_retry(
+            lambda: _atomic_savez(path, arrays, precommit=precommit),
+            policy=self.retry_policy, op="publish", on_retry=on_retry)
 
     def save(self, step: int, store: ParamStore, local_state: Pytree = None,
              *, local_state_format: str = "raw",
@@ -815,6 +861,7 @@ class Checkpointer:
         :class:`SnapshotCorruptionError` carrying ``.step`` (the failing
         link — chain reads truncate back to the last verified one)."""
         try:
+            path = _retry.read_path(path)  # stale read-after-rename seam
             with np.load(path) as z:
                 entries = {k: z[k] for k in z.files
                            if not k.startswith(_CRC_PREFIX)}
@@ -994,19 +1041,54 @@ class Checkpointer:
         explicit = step is not None
         step = self._resolve_step(step)
         tried: set[int] = set()
+        reread: set[int] = set()
         while True:
             try:
                 tables, leaves, fmt = self._read_verified(step, verify,
                                                           anchor=True)
                 return step, tables, leaves, fmt
+            except FileNotFoundError:
+                if explicit:
+                    raise
+                # Transient ENOENT / sweep race: a listed file is gone
+                # or invisible on THIS read (stale mount, a compaction
+                # sweep between list and open). Retry the step once —
+                # the stale-mount case recovers — then fall back to
+                # older survivors WITHOUT quarantining: there is
+                # nothing on disk to quarantine, and the brownout
+                # contract says a read hiccup must not crash a restore
+                # that has intact older snapshots.
+                if step not in reread:
+                    reread.add(step)
+                    continue
+                tried.add(step)
+                candidates = [s for s in self.steps() if s not in tried]
+                if not candidates:
+                    raise
+                step = candidates[-1]
             except SnapshotCorruptionError as err:
                 if explicit:
                     raise
+                bad = getattr(err, "step", step)
+                # Transient-read guard (hostile filesystems): a stale
+                # or flaky read can make durable, VALID bytes look
+                # corrupt for one open — quarantining on that verdict
+                # would destroy landed state over a read hiccup. Before
+                # quarantining, re-verify the failing link on a fresh
+                # read, once: clean ⇒ retry the resolve; still bad ⇒
+                # real corruption, quarantine as before.
+                if bad not in reread:
+                    reread.add(bad)
+                    pub = self._pubs().get(bad)
+                    p = pub.path if pub is not None else self._path(bad)
+                    ok, _ = snapshot_format.verify_snapshot_file(p)
+                    if ok:
+                        continue
                 tried.add(step)  # terminates even if quarantine can't
                 # Quarantine the FAILING link (a mid-chain delta names
                 # itself via err.step) plus everything chained on it —
                 # the fallback then lands on the last verified link.
-                self._quarantine(getattr(err, "step", step), err)
+                self._quarantine(bad, err)
                 candidates = [s for s in self.steps() if s not in tried]
                 if not candidates:
                     raise FileNotFoundError(
@@ -1253,8 +1335,17 @@ class Checkpointer:
                     if isinstance(cause, StaleEpochError):
                         raise
                     cause = cause.__cause__
+                # ENOSPC/EIO mid-fold (after the publish retry budget):
+                # the fold aborts, the chain stays fully recoverable,
+                # and the next publish re-triggers compaction — lost
+                # optimization, never lost state (the enospc_compaction
+                # chaos scenario pins this).
                 _log.warning("background chain compaction failed "
-                             "(chain left as-is): %r", e)
+                             "(chain left as-is, retried at the next "
+                             "publish): %r", e)
+                _obs_event("compaction_aborted", error=repr(e),
+                           dir=self.dir)
+                _obs_metric("inc", "storage.compaction_aborts", 1)
 
     def compact(self) -> str | None:
         """Fold the newest chain into a fresh FULL at its head step —
@@ -1296,7 +1387,7 @@ class Checkpointer:
             arrays[_CRC_PREFIX + k] = np.uint32(array_crc32(arrays[k]))
         path = self._path(head)
         t0 = time.perf_counter()
-        _atomic_savez(path, arrays, precommit=precommit)
+        self._savez_with_retry(path, arrays, precommit=precommit)
         if hook is not None:
             hook("published")
         secs = time.perf_counter() - t0
@@ -1382,15 +1473,26 @@ class AsyncCheckpointer(Checkpointer):
 
     def __init__(self, directory: str, *, keep: int = 3,
                  fence_epoch: int | None = None,
-                 delta: DeltaPolicy | None = None):
+                 delta: DeltaPolicy | None = None,
+                 retry: _retry.RetryPolicy | None = None,
+                 degrade: bool = True):
         super().__init__(directory, keep=keep, fence_epoch=fence_epoch,
-                         delta=delta)
+                         delta=delta, retry=retry)
         self._cv = threading.Condition()
         # One queue slot: (step, base_step_or_None, payload_arrays).
         self._queued: tuple[int, int | None, dict] | None = None
         self._writing = False
         self._error: BaseException | None = None
         self._closed = False
+        # Degraded-mode storage (hostile-filesystem survival): with
+        # ``degrade`` on, a publish that still fails TRANSIENTLY after
+        # the retry budget is SKIPPED — checkpoint.publish_backlog
+        # rises, storage.degraded_publishes counts, the staleness SLO
+        # burns — instead of crashing training on its next save().
+        # Fatal errors (EACCES/EROFS, fence refusals, corruption) keep
+        # the first-error retention contract and re-raise on the caller.
+        self.degrade = bool(degrade)
+        self._degraded_chain = False
         self._writer = threading.Thread(
             target=self._writer_loop,
             name=f"fps-ckpt-writer:{os.path.basename(directory)}",
@@ -1404,6 +1506,15 @@ class AsyncCheckpointer(Checkpointer):
              *, local_state_format: str = "raw",
              touched_rows: Mapping | None = None) -> str:
         arrays = self._collect_timed(store, local_state, local_state_format)
+        with self._cv:
+            self._raise_pending_error()
+            if self._degraded_chain:
+                # A degraded (skipped) publication may be the head the
+                # planner would diff against: force the next
+                # publication to a FULL so no delta ever chains onto a
+                # publish that never landed.
+                self._chain_reset()
+                self._degraded_chain = False
         # Delta planning happens HERE, serially on the caller's thread —
         # chain order is save order, and planning against the retained
         # base must see publications in that order. The enqueued payload
@@ -1483,6 +1594,32 @@ class AsyncCheckpointer(Checkpointer):
 
     # -- writer thread ----------------------------------------------------
 
+    def _degradable(self, e: BaseException) -> bool:
+        """True when a failed publish may be SKIPPED (degraded) rather
+        than surfaced as a caller error: transient storage errors after
+        the retry budget, and the orphan-delta refusal that follows a
+        degraded base. A fence refusal anywhere in the cause chain is
+        never degradable — a zombie of an aborted pod attempt must die
+        loudly, not quietly skip publishes forever."""
+        from fps_tpu.supervise.child import StaleEpochError
+
+        cause = e
+        while cause is not None:
+            if isinstance(cause, StaleEpochError):
+                return False
+            cause = cause.__cause__
+        if isinstance(e, OrphanDeltaError):
+            return True
+        if isinstance(e, OSError) and e.errno == _errno_mod.ENOENT:
+            # ENOENT is retry-worthy (a just-renamed file can be
+            # transiently invisible on a caching mount) but NOT
+            # degrade-worthy: persisting past the whole retry budget
+            # means the checkpoint DIRECTORY is gone — silently
+            # skipping every publish would end the run "successfully"
+            # with zero durable state. Fail loudly instead.
+            return False
+        return _retry.classify_error(e) == "retryable"
+
     def _writer_loop(self) -> None:
         while True:
             with self._cv:
@@ -1496,18 +1633,48 @@ class AsyncCheckpointer(Checkpointer):
                 self._cv.notify_all()  # free the queue slot for save()
             try:
                 self._write(step, arrays, base=base)
+                if self._publish_backlog:
+                    # Recovery: a landed publish is a FULL description
+                    # of its step (or a delta whose chain landed), so
+                    # the whole backlog of skipped recency drains here.
+                    with self._cv:
+                        self._publish_backlog = 0
+                    _obs_metric("set", "checkpoint.publish_backlog", 0)
+                    _obs_event("checkpoint_backlog_drained",
+                               step=int(step))
             except BaseException as e:  # noqa: BLE001 - re-raised on caller
-                with self._cv:
-                    if self._error is None:
-                        self._error = e
-                    else:
-                        # Keep the FIRST failure (the root cause): a
-                        # derived refusal — e.g. the orphan-delta guard
-                        # firing because the base's write just failed —
-                        # must not mask the original error.
-                        _log.warning(
-                            "suppressing follow-on checkpoint write "
-                            "error (first failure pending): %r", e)
+                if self.degrade and self._degradable(e):
+                    # Degraded-mode storage: SKIP the publish instead of
+                    # poisoning the caller — training keeps running on
+                    # last-good durable state, the backlog gauge and the
+                    # storage-staleness SLO carry the cost (lost
+                    # recency, never corruption or a crash).
+                    with self._cv:
+                        self.degraded_publishes += 1
+                        self._publish_backlog += 1
+                        self._degraded_chain = True
+                        backlog = self._publish_backlog
+                    _log.warning(
+                        "checkpoint publish step %d DEGRADED (skipped "
+                        "after retries; backlog %d): %r", step, backlog,
+                        e)
+                    _obs_event("checkpoint_degraded", step=int(step),
+                               backlog=backlog, error=repr(e))
+                    _obs_metric("inc", "storage.degraded_publishes", 1)
+                    _obs_metric("set", "checkpoint.publish_backlog",
+                                backlog)
+                else:
+                    with self._cv:
+                        if self._error is None:
+                            self._error = e
+                        else:
+                            # Keep the FIRST failure (the root cause): a
+                            # derived refusal — e.g. the orphan-delta
+                            # guard firing because the base's write just
+                            # failed — must not mask the original error.
+                            _log.warning(
+                                "suppressing follow-on checkpoint write "
+                                "error (first failure pending): %r", e)
             finally:
                 del arrays  # drop the buffer before blocking on the cv
                 with self._cv:
@@ -1537,16 +1704,31 @@ def _atomic_savez(path: str, arrays: Mapping[str, np.ndarray],
     an unfsync'd rename can publish an empty file); the directory fsync
     after makes the rename itself survive. ``precommit`` (optional) runs
     after the fsync and immediately before the publishing rename; if it
-    raises, nothing is published (the pod fence hook)."""
+    raises, nothing is published (the pod fence hook).
+
+    Fault seams (``fps_tpu.core.retry.fault_check``): the deterministic
+    injector may fail/slow the serialize, the fsync, or the rename —
+    and a ``"torn"`` rename directive publishes a truncated prefix at
+    the destination before failing, the hostile-rename case the CRC
+    gates downstream must catch. A failed attempt always removes its
+    tmp file, so retries start clean."""
+    _retry.fault_check("write", path)
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **arrays)
             f.flush()
+            _retry.fault_check("fsync", path)
             os.fsync(f.fileno())
         if precommit is not None:
             precommit()
+        if _retry.fault_check("replace", path) == "torn":
+            with open(tmp, "rb") as src, open(path, "wb") as dst:
+                dst.write(src.read(max(1, os.path.getsize(tmp) // 3)))
+            raise OSError(_errno_mod.EIO,
+                          "injected torn rename (truncated publish)",
+                          path)
         os.replace(tmp, path)
         try:
             dfd = os.open(d, os.O_RDONLY)

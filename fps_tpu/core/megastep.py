@@ -571,20 +571,29 @@ def run_megastep(trainer, tables, local_state, plan, key, *,
     def fold_votes(rec):
         if rec is None or not compact_cfg:
             return
-        for votes in deferred_votes:
+        for votes, real in deferred_votes:
+            # Weight the fold by REAL segments: a trimmed final
+            # dispatch still runs K in-graph segments, but its trailing
+            # weight-0 phantoms did no work — counting them would make
+            # megastep.windows (and the vote counters) disagree with
+            # the dispatched-work totals the bench asserts on. Phantom
+            # segments are always the trailing ones, so the first
+            # ``real`` votes are exactly the real windows' verdicts.
             if votes is None:
-                # Uncertifiable dispatch: every segment fell back to the
-                # static routes for every compacted table.
-                for t in sorted(compact_cfg):
-                    rec.inc("cold_route.vote_overflow_windows", K, table=t)
+                # Uncertifiable dispatch: every real segment fell back
+                # to the static routes. The verdict is ONE AND-ed bit
+                # per window over every compacted table — per-table
+                # attribution would multiply-count it, so the counter
+                # is unlabeled.
+                rec.inc("cold_route.vote_overflow_windows", real)
                 continue
-            v = np.asarray(votes).reshape(-1)
+            v = np.asarray(votes).reshape(-1)[:real]
             ok = int((v != 0).sum())
-            rec.inc("cold_route.vote_compact_windows", ok)
+            if ok:
+                rec.inc("cold_route.vote_compact_windows", ok)
             if ok < v.size:
-                for t in sorted(compact_cfg):
-                    rec.inc("cold_route.vote_overflow_windows",
-                            int(v.size) - ok, table=t)
+                rec.inc("cold_route.vote_overflow_windows",
+                        int(v.size) - ok)
         deferred_votes.clear()
 
     def fold_ticks(rec):
@@ -635,6 +644,9 @@ def run_megastep(trainer, tables, local_state, plan, key, *,
                 # Trim phantom weight-0 trailing rows so the epoch's
                 # concatenated metrics match run_indexed's exactly.
                 keep = max(0, min(K * T_call, T - m * K * T_call))
+                # Real (non-phantom) chunk segments of this dispatch —
+                # the unit megastep.windows and the vote fold count in.
+                real_segs = min(K, -(-keep // T_call)) if T_call else K
                 if keep < K * T_call:
                     metrics = jax.tree.map(lambda x: x[:keep], metrics)
                 if quarantine is not None:
@@ -650,14 +662,17 @@ def run_megastep(trainer, tables, local_state, plan, key, *,
                 # Votes count at dispatch time even for a later-
                 # quarantined megastep — the same convention as the host
                 # certifier's cold_route.compact_chunks, which run_chunk
-                # increments before adjudication.
-                deferred_votes.append(aux["votes"] if vote_on else None)
+                # increments before adjudication. Each entry carries the
+                # dispatch's REAL segment count so the fold can drop
+                # trailing phantom windows.
+                deferred_votes.append(
+                    (aux["votes"] if vote_on else None, real_segs))
             ev = {"index": g} if rec is not None else None
             poison = 0
             if sync_each and (rec is not None or health is not None):
                 poison = trainer._fold_metrics_accounting(rec, metrics, ev)
             if rec is not None:
-                rec.inc("megastep.windows", K)
+                rec.inc("megastep.windows", real_segs)
                 if restored is not None:
                     rec.inc("rollback.quarantined")
                     ev["quarantined"] = True

@@ -40,6 +40,8 @@ import zlib
 
 import numpy as np
 
+from fps_tpu.core import retry as _retry
+
 __all__ = [
     "SNAPSHOT_RE", "SNAPSHOT_FMT", "SEP", "TABLE_PREFIX", "LS_PREFIX",
     "FOLD_PREFIX", "MESH_SHAPE_KEY", "POD_EPOCH_KEY",
@@ -135,6 +137,15 @@ IO_ERRORS = (
     zipfile.LargeZipFile,
     zlib.error,
 )
+
+
+# Hostile-filesystem read seam: the deterministic injector may fail a
+# read (transient ENOENT / EIO raise here) or redirect it to the
+# PRE-rename content of the path — the stale read-after-rename of a
+# caching network filesystem. Identity (and zero-cost) with no injector
+# installed. One shared helper (fps_tpu.core.retry.read_path) so the
+# checkpoint / snapshot-format / fleet read sites cannot drift.
+_stale_read_seam = _retry.read_path
 
 
 def array_crc32(arr) -> int:
@@ -250,6 +261,7 @@ def read_pub_meta(path: str) -> dict:
     bytes are read). Structural failures surface as the usual torn-file
     errors — callers verifying chains treat them as a failing link."""
     out = {"base_step": None, "pod_epoch": None}
+    path = _stale_read_seam(path)
     with np.load(path) as z:
         if BASE_STEP_KEY in z.files:
             out["base_step"] = int(z[BASE_STEP_KEY])
@@ -332,6 +344,7 @@ def latest_valid_chain(directory: str) -> tuple[int, list] | None:
 def read_delta_arrays(path: str) -> dict:
     """All non-CRC entries of one delta publication, materialized (a
     delta is O(touched rows) by construction — mapping buys nothing)."""
+    path = _stale_read_seam(path)
     with np.load(path) as z:
         return {k: z[k] for k in z.files if not k.startswith(CRC_PREFIX)}
 
@@ -387,6 +400,7 @@ def verify_snapshot_file(path: str) -> tuple[bool, str | None]:
     directory.
     """
     try:
+        path = _stale_read_seam(path)
         with np.load(path) as z:
             for k in z.files:
                 if k.startswith(CRC_PREFIX):
@@ -469,6 +483,7 @@ def map_snapshot_arrays(path: str, *, keys=None) -> dict[str, np.ndarray]:
     checkpoint writer produces.
     """
     out: dict[str, np.ndarray] = {}
+    path = _stale_read_seam(path)
     with zipfile.ZipFile(path) as zf, open(path, "rb") as f:
         for zinfo in zf.infolist():
             name = zinfo.filename

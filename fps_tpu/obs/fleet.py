@@ -57,12 +57,20 @@ _WINDOW_COUNTERS = (
     "cold_route.overflow_chunks",
     "checkpoint.fenced_publishes",
     "checkpoint.saves",
+    # Hostile-filesystem degradation (fps_tpu.core.retry + the async
+    # writer's degraded mode): skipped publishes spend the storage-
+    # staleness budget; degraded read-plane polls count liveness cost.
+    "storage.degraded_publishes",
+    "storage.poll_errors",
 )
 # Gauge/sample names kept as (t, value) series for per-window max/last.
-_WINDOW_SAMPLES = ("serve.write_to_servable_s",)
+# serve.fence_step feeds the fleet fence-lag rollup: the fence's last
+# published step per window, compared against the newest
+# checkpoint_saved step the trainers reported by then.
+_WINDOW_SAMPLES = ("serve.write_to_servable_s", "serve.fence_step")
 # Journal events counted per window.
 _WINDOW_EVENTS = ("pod_restart", "supervisor_restart", "budget_drift",
-                  "checkpoint_fenced")
+                  "checkpoint_fenced", "checkpoint_degraded")
 
 
 def _read_jsonl(path):
@@ -90,6 +98,7 @@ def host_series(obs_dir: str) -> dict:
     counters = {n: [] for n in _WINDOW_COUNTERS}
     samples = {n: [] for n in _WINDOW_SAMPLES}
     events = {n: [] for n in _WINDOW_EVENTS}
+    published = []  # (t, step) from checkpoint_saved — fence-lag ref
     seen_events = set()
     for path in sorted(glob.glob(os.path.join(obs_dir, "events-p*.jsonl"))):
         for rec in _read_jsonl(path):
@@ -104,24 +113,35 @@ def host_series(obs_dir: str) -> dict:
                 elif name in samples and t is not None:
                     samples[name].append((float(t), v))
             elif kind == "event":
-                _fold_event(rec, events, seen_events)
+                _fold_event(rec, events, seen_events, published)
     for path in sorted(glob.glob(os.path.join(obs_dir,
                                               "journal-*.jsonl"))):
         for rec in _read_jsonl(path):
             if rec.get("kind") == "event":
-                _fold_event(rec, events, seen_events)
-    return {"counters": counters, "samples": samples, "events": events}
+                _fold_event(rec, events, seen_events, published)
+    return {"counters": counters, "samples": samples, "events": events,
+            "published": published}
 
 
-def _fold_event(rec, events, seen) -> None:
+def _fold_event(rec, events, seen, published=None) -> None:
     et = rec.get("event")
-    if et not in events:
+    capture_pub = (published is not None and et == "checkpoint_saved"
+                   and rec.get("t") is not None
+                   and rec.get("step") is not None)
+    if et not in events and not capture_pub:
         return
     key = json.dumps(rec, sort_keys=True, default=str)
     if key in seen:
         return
     seen.add(key)
-    if rec.get("t") is not None:
+    if rec.get("t") is None:
+        return
+    if capture_pub:
+        try:
+            published.append((float(rec["t"]), int(rec["step"])))
+        except (TypeError, ValueError):
+            pass
+    if et in events:
         events[et].append(float(rec["t"]))
 
 
@@ -134,6 +154,13 @@ def _window_stats(series_by_host, t0, t1) -> dict:
     c = {n: 0.0 for n in _WINDOW_COUNTERS}
     ev = {n: 0 for n in _WINDOW_EVENTS}
     fresh = []
+    fence_lag = None
+    # The fence-lag reference: newest step ANY trainer durably
+    # published by the end of this window (fence readers lag it by
+    # design; the SLO bounds by how much).
+    newest_pub = max((s for series in series_by_host.values()
+                      for t, s in series.get("published", ())
+                      if t < t1), default=None)
     for series in series_by_host.values():
         for name, pts in series["counters"].items():
             c[name] += sum(v for t, v in pts
@@ -141,6 +168,16 @@ def _window_stats(series_by_host, t0, t1) -> dict:
         for t, v in series["samples"]["serve.write_to_servable_s"]:
             if t0 <= t < t1 and math.isfinite(v):
                 fresh.append(v)
+        # serve.fence_step lag vs the newest published step: per host,
+        # the LAST fence sample in the window; fold as the worst lag
+        # across hosts (one straggling reader burns the SLO).
+        fence_last = None
+        for t, v in series["samples"]["serve.fence_step"]:
+            if t0 <= t < t1 and math.isfinite(v):
+                fence_last = v  # samples arrive in time order
+        if fence_last is not None and newest_pub is not None:
+            lag = max(0.0, float(newest_pub) - float(fence_last))
+            fence_lag = lag if fence_lag is None else max(fence_lag, lag)
         for name, ts in series["events"].items():
             ev[name] += sum(1 for t in ts if t0 <= t < t1)
     dt = max(t1 - t0, 1e-9)
@@ -164,6 +201,14 @@ def _window_stats(series_by_host, t0, t1) -> dict:
                                 ev["checkpoint_fenced"]),
         "budget_drift_incidents": ev["budget_drift"],
         "checkpoint_saves": int(c["checkpoint.saves"]),
+        # Hostile-filesystem degradation (same max() dedup rule as the
+        # fence counter: event and counter fire together).
+        "degraded_publishes": max(
+            int(c["storage.degraded_publishes"]),
+            ev["checkpoint_degraded"]),
+        "storage_poll_errors": int(c["storage.poll_errors"]),
+        "fence_lag_steps": (round(fence_lag, 1)
+                            if fence_lag is not None else None),
     }
 
 
@@ -255,6 +300,22 @@ DEFAULT_SLOS = (
         objective=0.9,
         description="windows free of measured-vs-certified collective "
                     "budget drift incidents (fps_tpu.obs.drift)"),
+    # Hostile-filesystem survival (docs/resilience.md, docs/STALENESS.md
+    # storage row): degraded publishes are the storage-STALENESS budget —
+    # each one is recency deliberately spent to keep training alive
+    # through a brownout, never corruption; sustained burn means the
+    # filesystem (not the framework) needs attention.
+    SLO("storage_staleness_budget", "degraded_publishes", "<=", 0.0,
+        objective=0.75,
+        description="windows free of degraded (skipped) checkpoint "
+                    "publishes — burn = the shared filesystem is "
+                    "costing snapshot recency"),
+    # Fleet fence lag vs the newest published step (PR-14 remaining
+    # item): the fence trails the trainer by verification + quorum; the
+    # SLO bounds how far before the serving plane counts as stale.
+    SLO("serve_fence_lag", "fence_lag_steps", "<=", 8.0, objective=0.75,
+        description="fleet fence (serve.fence_step) within budget of "
+                    "the newest checkpoint_saved step"),
 )
 
 

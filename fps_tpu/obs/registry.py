@@ -129,10 +129,13 @@ def default_registry() -> MetricsRegistry:
                         "(the window ran the compacted cold routes; the "
                         "in-graph analog of cold_route.compact_chunks)"),
         MetricSpec("cold_route.vote_overflow_windows", "counter",
-                   unit="windows", labels=("table",),
-                   help="megastep chunk windows that overflowed (or "
-                        "could not certify) a table's cold_budget lane "
-                        "and ran the bit-identical static-route branch"),
+                   unit="windows",
+                   help="megastep chunk windows whose single AND-ed "
+                        "device vote overflowed (or could not certify) "
+                        "some cold_budget lane and ran the bit-identical "
+                        "static-route branch — unlabeled: the verdict is "
+                        "one bit per window, a per-table attribution "
+                        "would multiply-count it"),
         # Host pipeline (fps_tpu.core.prefetch).
         MetricSpec("prefetch.chunks", "counter", unit="chunks",
                    help="chunks assembled+placed by the background "
@@ -257,6 +260,42 @@ def default_registry() -> MetricsRegistry:
                    help="restores that re-split tables onto a different "
                         "mesh shape than the snapshot's (the elastic "
                         "W±1 path; each is asserted bit-identical)"),
+        # Hostile-filesystem survival (fps_tpu.core.retry + degraded-
+        # mode storage; docs/resilience.md "Hostile filesystem").
+        MetricSpec("storage.retries", "counter", unit="ops",
+                   labels=("plane",),
+                   help="file operations retried after a transient I/O "
+                        "error (bounded deterministic backoff; plane: "
+                        "checkpoint / sidecar / ...)"),
+        MetricSpec("storage.degraded_publishes", "counter",
+                   unit="snapshots",
+                   help="checkpoint publishes SKIPPED after the retry "
+                        "budget on a transient storage failure — "
+                        "training continues on last-good durable state; "
+                        "each skip spends recency (the storage-"
+                        "staleness SLO), never correctness"),
+        MetricSpec("checkpoint.publish_backlog", "gauge",
+                   unit="snapshots",
+                   help="consecutive degraded (skipped) publishes since "
+                        "the last landed one — drains to 0 the moment a "
+                        "publish lands, because a landed snapshot fully "
+                        "describes its step"),
+        MetricSpec("storage.poll_errors", "counter", unit="polls",
+                   labels=("plane",),
+                   help="read-plane polls degraded by a transient "
+                        "filesystem error (plane: watcher / fleet) — "
+                        "the reader served last-good state and retried "
+                        "next tick, never froze or crashed"),
+        MetricSpec("storage.sidecar_skips", "counter", unit="writes",
+                   help="tiering sidecar writes skipped after the retry "
+                        "budget (advisory state: a resume past that "
+                        "boundary cold-starts the tracker, warned "
+                        "loudly)"),
+        MetricSpec("storage.compaction_aborts", "counter", unit="folds",
+                   help="LSM chain compactions aborted by an I/O "
+                        "failure mid-fold (ENOSPC and kin): the chain "
+                        "stays intact and the fold retries at the next "
+                        "publish"),
         # Watchdog.
         MetricSpec("watchdog.stalls", "counter", unit="stalls",
                    help="chunk/epoch dispatches that overran the deadline"),

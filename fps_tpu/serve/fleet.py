@@ -44,6 +44,7 @@ jax-free (stdlib + numpy), like the rest of ``fps_tpu.serve``.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import tempfile
 import threading
@@ -51,6 +52,7 @@ import time
 
 import numpy as np
 
+from fps_tpu.core import retry as _retry
 from fps_tpu.core import snapshot_format as fmt
 from fps_tpu.serve.snapshot import ServableSnapshot, SnapshotRejected
 from fps_tpu.serve.server import ReadServer
@@ -69,13 +71,16 @@ def _atomic_write_json(path: str, obj: dict) -> None:
     # loaded BY FILE PATH from tools/supervise.py (zero package
     # imports, by contract), so a shared package-level helper cannot
     # serve all three without breaking that load mode.
+    _retry.fault_check("write", path)
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.json")
     try:
         with os.fdopen(fd, "w", encoding="utf-8") as f:
             json.dump(obj, f)
             f.flush()
+            _retry.fault_check("fsync", path)
             os.fsync(f.fileno())
+        _retry.fault_check("replace", path)
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
@@ -84,6 +89,7 @@ def _atomic_write_json(path: str, obj: dict) -> None:
 
 def _read_json(path: str) -> dict | None:
     try:
+        path = _retry.read_path(path)  # stale read-after-rename seam
         with open(path, encoding="utf-8") as f:
             return json.load(f)
     except (OSError, json.JSONDecodeError):
@@ -108,6 +114,12 @@ class StepFence:
         os.makedirs(self.dir, exist_ok=True)
         self._seen = (0, -1)  # max (epoch, step) ever observed
         self._last_ready: int | None = None  # skip unchanged rewrites
+        # Transient fence-I/O failures (storage brownout): every write
+        # here is re-attempted by the next poll tick anyway, so a
+        # failed one is counted and SKIPPED — degraded liveness, never
+        # a crashed poller or a split-brain (reads clamp to the max
+        # observed pair regardless of what lands on disk when).
+        self.io_errors = 0
 
     @property
     def fence_path(self) -> str:
@@ -136,10 +148,14 @@ class StepFence:
             self._seen = pair
         elif (pair is not None and pair < self._seen
                 and self._seen[1] >= 0):
-            _atomic_write_json(self.fence_path,
-                               {"epoch": self._seen[0],
-                                "step": self._seen[1],
-                                "by": self.reader_id, "repair": True})
+            try:
+                _atomic_write_json(self.fence_path,
+                                   {"epoch": self._seen[0],
+                                    "step": self._seen[1],
+                                    "by": self.reader_id,
+                                    "repair": True})
+            except OSError:
+                self.io_errors += 1  # anti-entropy retried next read
         return self._seen if self._seen[1] >= 0 else None
 
     # -- participation -----------------------------------------------------
@@ -152,9 +168,13 @@ class StepFence:
         filesystem would be pure churn."""
         if self._last_ready == int(step):
             return
-        _atomic_write_json(self._ready_path(self.reader_id),
-                           {"reader": self.reader_id, "step": int(step),
-                            "t": time.time()})
+        try:
+            _atomic_write_json(self._ready_path(self.reader_id),
+                               {"reader": self.reader_id,
+                                "step": int(step), "t": time.time()})
+        except OSError:
+            self.io_errors += 1
+            return  # _last_ready stays unset: retried next tick
         self._last_ready = int(step)
 
     def ready_steps(self) -> dict[str, int]:
@@ -192,11 +212,14 @@ class StepFence:
                 target = min(target, int(max_step))
             epoch = cur[0] if cur is not None else 0
             if cur is None or target > cur[1]:
-                _atomic_write_json(self.fence_path,
-                                   {"epoch": int(epoch),
-                                    "step": int(target),
-                                    "by": self.reader_id})
-                self._seen = max(self._seen, (epoch, target))
+                try:
+                    _atomic_write_json(self.fence_path,
+                                       {"epoch": int(epoch),
+                                        "step": int(target),
+                                        "by": self.reader_id})
+                    self._seen = max(self._seen, (epoch, target))
+                except OSError:
+                    self.io_errors += 1  # fence unchanged; next tick
         return self.read()
 
     def rollback(self, step: int) -> tuple[int, int]:
@@ -205,9 +228,16 @@ class StepFence:
         deliberate rollback, never as a stale write."""
         cur = self.read()
         epoch = (cur[0] if cur is not None else 0) + 1
-        _atomic_write_json(self.fence_path,
-                           {"epoch": int(epoch), "step": int(step),
-                            "by": self.reader_id, "rollback": True})
+        try:
+            _atomic_write_json(self.fence_path,
+                               {"epoch": int(epoch), "step": int(step),
+                                "by": self.reader_id, "rollback": True})
+        except OSError:
+            # Count and adopt the bumped pair LOCALLY anyway: this
+            # reader must stop serving the dead step now; the on-disk
+            # fence converges via read()'s anti-entropy repair (the
+            # rollback is re-asserted every poll regardless).
+            self.io_errors += 1
         self._seen = (epoch, int(step))
         return self._seen
 
@@ -309,7 +339,23 @@ class FleetReader:
     def poll(self) -> int | None:
         """One pass: verify candidates, publish readiness, advance (or
         roll back) the fence, swap the server to the fence step. Returns
-        the served step (None while nothing servable)."""
+        the served step (None while nothing servable). Transient
+        filesystem errors degrade (served state unchanged, counted in
+        ``poll_errors`` / ``storage.poll_errors{plane=fleet}``) —
+        a storage brownout must never freeze or crash a reader."""
+        try:
+            return self._poll_once()
+        except OSError as e:
+            self.poll_errors += 1
+            _emit_metric(self.recorder, "inc", "storage.poll_errors", 1,
+                         plane="fleet")
+            logging.getLogger("fps_tpu.serve.fleet").warning(
+                "fleet reader %s poll degraded (serving last-good): %r",
+                self.reader_id, e)
+            snap = self.server._snap
+            return None if snap is None else snap.step
+
+    def _poll_once(self) -> int | None:
         self.watcher.poll()
         cand = self._candidate
         if cand is not None:
@@ -339,6 +385,14 @@ class FleetReader:
         if fence is None:
             return
         _epoch, step = fence
+        # Gauge every poll, not just on swaps: the fleet fence-lag SLO
+        # (obs_report --fleet) compares the LAST sample per window
+        # against the newest published step — a fence STALLED behind
+        # failing readiness writes must keep reporting its (stale)
+        # step, or the lag rollup goes blind in exactly the windows
+        # the SLO exists for.
+        _emit_metric(self.recorder, "set", "serve.fence_step",
+                     float(step))
         snap = self.server._snap
         if snap is not None and snap.step == step:
             return
@@ -372,8 +426,6 @@ class FleetReader:
         self.server.swap_to(nxt)
         self.fence_swaps += 1
         self.served_steps.append(int(step))
-        _emit_metric(self.recorder, "set", "serve.fence_step",
-                     float(step))
 
     def stats(self) -> dict:
         snap = self.server._snap

@@ -42,13 +42,17 @@ the server side is the concurrent part).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 
+from fps_tpu.core import retry as _retry
 from fps_tpu.core import snapshot_format as fmt
 from fps_tpu.serve.snapshot import ServableSnapshot, SnapshotRejected
 
 __all__ = ["SnapshotWatcher", "_JournalTail"]
+
+_log = logging.getLogger("fps_tpu.serve.watcher")
 
 
 def _emit_metric(recorder, kind: str, name: str, value, **labels) -> None:
@@ -170,6 +174,13 @@ class SnapshotWatcher:
         # re-publish of the same step gets a fresh verdict, a known-torn
         # file is not re-read every poll).
         self._rejected: dict[int, tuple] = {}
+        # First-rejection holding pen: a verdict is pinned into
+        # _rejected only when the SAME (step, identity) fails twice —
+        # on a hostile filesystem one failing open can be a stale read
+        # of pre-rename content while the durable bytes are fine, and
+        # pinning on that would blind the reader to a valid publish
+        # forever (the identity keys the REAL file, not what was read).
+        self._reject_pending: set = set()
         # Live publication index from the last dir scan ({step:
         # Publication}) — empty in journal-only mode (chain resolution
         # then re-scans inside open_chain).
@@ -181,6 +192,12 @@ class SnapshotWatcher:
         self._chain_rejected_seen: set = set()
         self.swaps = {"forward": 0, "backward": 0}
         self.rejected = 0
+        # Storage-brownout degradation: polls that died on a transient
+        # filesystem error (EIO on a listdir, a flaky open) are COUNTED
+        # and the reader keeps serving last-good mapped state — a
+        # misbehaving shared filesystem must never freeze or crash the
+        # read plane (docs/resilience.md "Hostile filesystem").
+        self.poll_errors = 0
         # Durability → servable wall-clock lag of the LAST publish (the
         # end-to-end freshness SLO sample; also a serve.* gauge).
         self.write_to_servable_s: float | None = None
@@ -230,6 +247,7 @@ class SnapshotWatcher:
 
     def _scan_dir(self) -> list[int]:
         try:
+            _retry.fault_check("listdir", self.ckpt_dir)
             names = os.listdir(self.ckpt_dir)
         except FileNotFoundError:
             names = []
@@ -281,7 +299,23 @@ class SnapshotWatcher:
     def poll(self) -> ServableSnapshot | None:
         """One pass over all sources; publishes (and returns) a new
         snapshot when one is due, else returns None. Never raises on
-        torn/corrupt candidates — they are counted and skipped."""
+        torn/corrupt candidates — they are counted and skipped — and
+        never raises on a TRANSIENT filesystem error either (storage
+        brownout: EIO/ENOSPC/stale-mount hiccups on the scan or an
+        open): the poll degrades to last-good served state, counts
+        ``poll_errors`` / ``storage.poll_errors{plane=watcher}``, and
+        retries next tick."""
+        try:
+            return self._poll_once()
+        except OSError as e:
+            self.poll_errors += 1
+            _emit_metric(self.recorder, "inc", "storage.poll_errors", 1,
+                         plane="watcher")
+            _log.warning("snapshot watcher poll degraded (serving "
+                         "last-good, retrying next poll): %r", e)
+            return None
+
+    def _poll_once(self) -> ServableSnapshot | None:
         self._drain_journal()
         listed = self._scan_dir() if self.poll_dir else []
         candidates = set(listed) | set(self._saved_events)
@@ -387,10 +421,19 @@ class SnapshotWatcher:
                 # Keyed by (inode, mtime) like every identity check here
                 # — mtime alone can collide with an atomic re-publish
                 # landing in the same clock tick, pinning a now-valid
-                # step as bad. Only SINGLE-file verdicts are cached: a
-                # full's content is immutable at that identity, so the
-                # verdict is permanent evidence.
-                self._rejected[step] = file_id
+                # step as bad. Only SINGLE-file verdicts are cached (a
+                # full's content is immutable at that identity), and
+                # only once CONFIRMED by a second failing read — one
+                # verdict can be a transient stale read of pre-rename
+                # content, not evidence about the durable bytes.
+                key = (step, file_id)
+                if key in self._reject_pending:
+                    self._reject_pending.discard(key)
+                    self._rejected[step] = file_id
+                else:
+                    if len(self._reject_pending) > 1024:
+                        self._reject_pending.clear()  # bounded memory
+                    self._reject_pending.add(key)
                 return None
             # A CHAIN failure is not cached — the head file may be
             # pristine while a link was mid-sweep/compaction/quarantine

@@ -77,11 +77,11 @@ import zipfile
 import sys as _sys
 
 
-def _load_sibling(name: str):
+def _load_sibling(name: str, *parts: str):
     import importlib.util as _ilu
 
     path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), name + ".py")
+        os.path.dirname(os.path.abspath(__file__)), *parts, name + ".py")
     spec = _ilu.spec_from_file_location("_fps_pod_" + name, path)
     mod = _ilu.module_from_spec(spec)
     _sys.modules[spec.name] = mod  # pre-registered for 3.10 dataclasses
@@ -93,6 +93,12 @@ _child = (_sys.modules.get("fps_tpu.supervise.child")
           or _load_sibling("child"))
 _sup = (_sys.modules.get("fps_tpu.supervise.supervisor")
         or _load_sibling("supervisor"))
+# fps_tpu/core/retry.py is stdlib-only by the same contract as this
+# module: in package context it is already in sys.modules (checkpoint
+# imports it before supervise loads); by file path it loads the same
+# way the siblings do — never a package import, which would drag jax.
+_retry = (_sys.modules.get("fps_tpu.core.retry")
+          or _load_sibling("retry", os.pardir, "core"))
 
 LEASE_FILENAME = "pod_lease.json"
 CONTROL_FILENAME = "pod_control.json"
@@ -109,6 +115,16 @@ SNAPSHOT_RE = re.compile(r"ckpt_(\d{12})\.npz")
 
 
 def _atomic_write_json(path: str, obj: dict) -> None:
+    # The hostile-filesystem seam (fps_tpu.core.retry.fault_check): the
+    # deterministic injector may fail or slow this write — lease
+    # renewals, control records, fences, member beacons all cross here.
+    # All three sub-ops are exposed, matching the sibling write seams
+    # (_atomic_savez, serve/fleet, the retier sidecar), so a schedule
+    # written in the documented ('write'|'fsync'|'replace') vocabulary
+    # targets the pod plane too instead of matching nothing.
+    _retry.fault_check("write", path)
+    _retry.fault_check("fsync", path)
+    _retry.fault_check("replace", path)
     _sup._atomic_write_json(path, obj)
 
 
@@ -132,6 +148,7 @@ def latest_valid_snapshot_step(directory: str, _cache: dict | None = None
     re-reading files already verified at the same identity."""
     best = None
     try:
+        _retry.fault_check("listdir", directory)
         names = os.listdir(directory)
     except OSError:
         return None
@@ -197,6 +214,22 @@ class Lease:
         # the max, so the fencing epoch stays monotone for every
         # observer even across that race.
         self._max_epoch = 0
+        # Slow-lease step-down state (hostile-filesystem survival): a
+        # holder whose renewal cannot LAND before TTL/2 relinquishes —
+        # ``_lapsed`` stops further renewals so the record expires on
+        # schedule and a follower seizes with a monotone epoch bump,
+        # instead of a slow filesystem silently carrying a leader past
+        # its own TTL. ``stepdowns``/``renew_failures`` are evidence
+        # counters for the slow_lease_near_ttl chaos scenario.
+        self._lapsed = False
+        self.stepdowns = 0
+        self.renew_failures = 0
+        # Consecutive slow renewals (landed, but slower than TTL/2).
+        # ONE is tolerated — an isolated fsync latency spike on a
+        # loaded box must not depose a healthy leader and churn the
+        # pod through seizures; two in a row mean the filesystem is
+        # persistently slow and holding on risks blowing the TTL.
+        self._slow_strikes = 0
 
     def read(self) -> dict | None:
         try:
@@ -236,22 +269,72 @@ class Lease:
             rec_epoch = 0
         regressed = rec is not None and rec_epoch < self._max_epoch
         self._max_epoch = max(self._max_epoch, rec_epoch)
-        if self._is_mine(rec) and not regressed:
+        mine = self._is_mine(rec)
+        if mine and not regressed and not self._lapsed:
             confirmed = self._claimed
             self._claimed = False
+            renew_failed = False
             if self.clock() - float(rec["t"]) > self.ttl_s / 3.0:
-                self._write(rec_epoch)
-                rec = self.read()
+                t0 = self.clock()
+                try:
+                    self._write(rec_epoch)
+                    write_s = self.clock() - t0
+                    rec = self.read() or rec
+                except OSError:
+                    # Renewal write failed (ENOSPC/EIO brownout): the
+                    # on-disk record keeps its old t; the step-down
+                    # check below decides whether we can carry on.
+                    renew_failed = True
+                    self.renew_failures += 1
+                else:
+                    if write_s > self.ttl_s / 2.0:
+                        self._slow_strikes += 1
+                    else:
+                        self._slow_strikes = 0
+            # Slow-lease step-down: relinquish when renewals cannot
+            # LAND within TTL/2 — two CONSECUTIVE slow writes (the
+            # WRITE's own measured duration, so a scheduler hiccup
+            # between ticks never deposes a leader, and one isolated
+            # fsync spike is tolerated), a failing write stream whose
+            # record has aged past TTL/2, or a record that is no
+            # longer ours. Followers seize only after the full TTL, so
+            # a stepping-down leader is always out before any
+            # successor exists — clean handover, never two writers.
+            # The record is then left to expire (no further renewals:
+            # a slow-landing renewal stream must not extend a hold we
+            # gave up).
+            try:
+                age = self.clock() - float((rec or {}).get("t", 0) or 0)
+            except (TypeError, ValueError):
+                age = float("inf")
+            if (not self._is_mine(rec)
+                    or self._slow_strikes >= 2
+                    or (renew_failed and age > self.ttl_s / 2.0)):
+                self._lapsed = True
+                self._slow_strikes = 0
+                self.stepdowns += 1
+                return False, rec, False
             return True, rec, confirmed
         self._claimed = False
+        if (mine and self._lapsed and not regressed
+                and not self.expired(rec)):
+            # Our own relinquished record, still unexpired: wait it out
+            # like any other observer (re-entry only via the ordinary
+            # expired-seize path, with its epoch bump).
+            return False, rec, False
         if regressed or self.expired(rec):
             # Seize strictly ABOVE everything ever observed — a
             # regressed record's writer may believe it leads at its old
             # epoch, and only a higher epoch orders it out.
             epoch = max(rec_epoch, self._max_epoch) + 1
-            self._write(epoch)
+            try:
+                self._write(epoch)
+            except OSError:
+                return False, rec, False  # brownout: retry next tick
             self._max_epoch = epoch
             self._claimed = True  # confirm (or lose) next tick
+            self._lapsed = False
+            self._slow_strikes = 0
         return False, rec, False
 
     def advance_epoch(self, epoch: int) -> None:
@@ -354,6 +437,9 @@ class PodMember(_sup.RunSupervisor):
         self._last_signal = None
         self._deadline_s = None
         self._respawns = 0
+        # Transient shared-filesystem failures this agent degraded
+        # through (failed beacons/journal lines, retried leader ticks).
+        self.io_errors = 0
 
     # -- journaling --------------------------------------------------------
 
@@ -365,10 +451,15 @@ class PodMember(_sup.RunSupervisor):
         trace = (self.pod_state or {}).get("trace_id") or self.trace_id
         rec = {"kind": "event", "t": time.time(), "event": etype,
                "host": self.host, "trace_id": trace, **fields}
-        with open(self.pod_journal_path, "a", encoding="utf-8") as f:
-            f.write(json.dumps(rec) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        try:
+            with open(self.pod_journal_path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            # Journal evidence is best-effort under a storage brownout:
+            # losing a line must never take the coordinator down with it.
+            self.io_errors += 1
 
     # -- pod state (leader-persisted) --------------------------------------
 
@@ -972,7 +1063,10 @@ class PodMember(_sup.RunSupervisor):
                     pod_size=cfg.pod_size, elastic=cfg.elastic,
                     trace_id=self.trace_id, span_id=self.run_span,
                     parent_id=self.trace_parent)
-        self._write_member()
+        try:
+            self._write_member()
+        except OSError:
+            self.io_errors += 1
         terminal = None
         try:
             while terminal is None:
@@ -1007,15 +1101,28 @@ class PodMember(_sup.RunSupervisor):
                 self.is_leader = held
 
                 if self.is_leader:
-                    if deadline is not None and now >= deadline:
-                        self._decide_terminal(now, "give_up",
-                                              reason="wall_deadline")
-                    elif (not self.pod_state["plan"]
-                          and now >= startup_deadline):
-                        self._decide_terminal(now, "give_up",
-                                              reason="startup_deadline")
-                    else:
-                        self._leader_tick(now)
+                    try:
+                        if deadline is not None and now >= deadline:
+                            self._decide_terminal(now, "give_up",
+                                                  reason="wall_deadline")
+                        elif (not self.pod_state["plan"]
+                              and now >= startup_deadline):
+                            self._decide_terminal(
+                                now, "give_up",
+                                reason="startup_deadline")
+                        else:
+                            self._leader_tick(now)
+                    except OSError as e:
+                        # Transient shared-filesystem failure mid-
+                        # decision: every leader write is either
+                        # idempotent (fences, beacons) or self-healing
+                        # (pod_control rewrites from last_control each
+                        # tick), so the safe move is to log, count, and
+                        # retry the whole tick — never to crash the
+                        # agent and orphan its child.
+                        self.io_errors += 1
+                        self._pod_event("leader_io_error",
+                                        error=repr(e))
 
                 terminal = self._consume_control(now)
                 if terminal is None:
@@ -1029,7 +1136,12 @@ class PodMember(_sup.RunSupervisor):
                             and self._ready_at is not None
                             and now >= self._ready_at):
                         self._status = "ready"
-                self._write_member()
+                try:
+                    self._write_member()
+                except OSError:
+                    # A failed beacon is one stale liveness sample — the
+                    # leader's pacing tolerates it; retried next tick.
+                    self.io_errors += 1
                 if terminal is None:
                     # Non-leader failsafe: a member must not outlive the
                     # pod wall deadline even if no leader ever emerges.
@@ -1040,7 +1152,10 @@ class PodMember(_sup.RunSupervisor):
                     time.sleep(self.config.poll_interval_s)
         finally:
             self._abort_child("pod_member_exit")
-            self._write_member()
+            try:
+                self._write_member()
+            except OSError:
+                pass  # exiting anyway; the beacon just goes stale
         success = terminal == "shutdown"
         pod = self._load_pod_state()
         digest = {

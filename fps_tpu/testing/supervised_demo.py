@@ -1723,6 +1723,460 @@ def run_pod_elastic_resize_scenario(tmpdir: str, *, timeout: float = 600):
     return ok, detail
 
 
+# ---------------------------------------------------------------------------
+# Hostile-filesystem scenarios (fps_tpu.testing.faultfs + fps_tpu/core/
+# retry.py): deterministic, seed-replayable I/O fault injection against
+# the framework's own storage seams. In-process by design — the injector
+# is process-global and the faults are in the FILESYSTEM, not the
+# process tree; docs/resilience.md "Hostile filesystem" is the failure-
+# model table these scenarios pin.
+# ---------------------------------------------------------------------------
+
+
+def _storage_harness():
+    """Tiny logreg harness shared by the storage scenarios: returns
+    ``(mesh, chunks, make_trainer)`` — same workload both arms, so
+    bit-identity is meaningful. Sized for exactly 12 chunks (6 per
+    epoch) at ANY mesh width, so the deterministic per-operation fault
+    schedules land on the same publishes everywhere."""
+    import numpy as np
+
+    import jax
+
+    from fps_tpu.core.driver import num_workers_of
+    from fps_tpu.core.ingest import multi_epoch_chunks
+    from fps_tpu.models.logistic_regression import (
+        LogRegConfig,
+        logistic_regression,
+    )
+    from fps_tpu.parallel.mesh import make_ps_mesh
+    from fps_tpu.testing.workloads import NF, NNZ
+
+    mesh = make_ps_mesh()
+    W = num_workers_of(mesh)
+    from fps_tpu.utils.datasets import synthetic_sparse_classification
+
+    n = W * 32 * 8 * 6  # exactly 6 chunks/epoch at any mesh width
+    data = synthetic_sparse_classification(n, NF, NNZ, seed=7,
+                                           noise=0.05)
+    data = dict(data, label=(data["label"] > 0).astype(np.float32))
+    chunks = list(multi_epoch_chunks(data, 2, num_workers=W,
+                                     local_batch=32, steps_per_chunk=8,
+                                     seed=3))
+
+    def make_trainer():
+        cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
+        trainer, store = logistic_regression(mesh, cfg)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        return trainer, store, tables, ls
+
+    return mesh, chunks, make_trainer
+
+
+def run_storage_brownout_scenario(tmpdir: str, *, timeout: float = 600):
+    """Storage BROWNOUT under live training + a serving fleet: a mixed
+    deterministic fault schedule (transient EIO writes, slow fsyncs,
+    EIO/stale/ENOENT reads, one torn rename, flaky directory scans)
+    hits the snapshot plane mid-run, then recovers. The contract:
+
+    * training never crashes; final weights are BIT-identical to the
+      fault-free run (storage faults cost recency, never state);
+    * at least one publish DEGRADES (skipped, backlog raised) and the
+      backlog drains to 0 after recovery, with the final snapshot's
+      state bit-identical to the clean run's;
+    * the 2-reader quorum fleet serves last-good throughout — fence
+      forward-monotone (single epoch), no reader ever serves a step
+      ahead of the fence or an unverified/torn candidate — and
+      converges on the newest valid publication after recovery;
+    * the read plane's degradation is VISIBLE (poll_errors > 0), never
+      a frozen reader.
+    """
+    import numpy as np
+
+    import jax
+
+    from fps_tpu.core import snapshot_format as fmt
+    from fps_tpu.core.checkpoint import AsyncCheckpointer
+    from fps_tpu.serve import ServingFleet
+    from fps_tpu.testing import faultfs
+    from fps_tpu.testing.faultfs import FaultRule
+    from fps_tpu.testing.workloads import weights
+
+    _mesh, chunks, make_trainer = _storage_harness()
+
+    # Clean arm (no injector): the bit-identity reference.
+    trainer, store, tables, ls = make_trainer()
+    trainer.fit_stream(tables, ls, iter(chunks), jax.random.key(1))
+    want_w = weights(store).copy()
+
+    n_chunks = len(chunks)
+    rules = [
+        # One publish's whole retry budget fails (degrade), the next
+        # fails twice then lands (retried-then-successful).
+        FaultRule("snapshot", "write", "errno", errno_name="EIO",
+                  start=2, count=6),
+        # A torn rename mid-run: a truncated file lands at the
+        # destination and the CRC gates must reject it until the retry
+        # overwrites it.
+        FaultRule("snapshot", "replace", "torn", start=8, count=1),
+        # Brownout latency on every 4th fsync.
+        FaultRule("snapshot", "fsync", "delay", delay_s=0.01,
+                  start=0, count=None, every=4),
+        # Read-plane hostility: transient EIO, stale read-after-rename,
+        # and flaky directory scans against the fleet's watcher.
+        FaultRule("snapshot", "read", "errno", errno_name="EIO",
+                  start=4, count=3),
+        FaultRule("snapshot", "read", "stale", start=12, count=2),
+        FaultRule("snapshot", "listdir", "errno", errno_name="EIO",
+                  start=6, count=3),
+    ]
+    d = os.path.join(tmpdir, "brownout")
+    trainer, store, tables, ls = make_trainer()
+    fs = faultfs.install(rules, seed=0)
+    violations: list[str] = []
+    fence_trail: list[tuple[int, int]] = []
+    try:
+        ck = AsyncCheckpointer(d, keep=n_chunks + 2)
+        fleet = ServingFleet(d, 2, quorum=2)
+
+        def on_chunk(step, _metrics):
+            fleet.poll()
+            fence = fleet.readers[0].fence.read()
+            if fence is not None:
+                if fence_trail and fence < fence_trail[-1]:
+                    violations.append(
+                        f"fence went backward: {fence_trail[-1]} -> "
+                        f"{fence}")
+                if not fence_trail or fence != fence_trail[-1]:
+                    fence_trail.append(fence)
+            for r in fleet.readers:
+                snap = r.server._snap
+                if snap is not None and fence is not None \
+                        and snap.step > fence[1]:
+                    violations.append(
+                        f"{r.reader_id} served {snap.step} ahead of "
+                        f"fence {fence[1]}")
+
+        tables, ls, _ = trainer.fit_stream(
+            tables, ls, iter(chunks), jax.random.key(1),
+            checkpointer=ck, checkpoint_every=1, on_chunk=on_chunk)
+        ck.flush()
+        degraded = ck.degraded_publishes
+        backlog = ck._publish_backlog
+        # Recovery convergence: rules are exhausted by now (bounded
+        # counts); the fleet must converge on the newest valid step.
+        fs.quiesce()
+        for _ in range(12):
+            fleet.poll()
+        final_step = ck.latest_valid_step()
+        _, snap_tables, _, _ = ck.read_snapshot(final_step)
+        converged = all(
+            r.server._snap is not None
+            and r.server._snap.step == final_step
+            for r in fleet.readers)
+        poll_errors = (sum(r.poll_errors for r in fleet.readers)
+                       + sum(r.watcher.poll_errors
+                             for r in fleet.readers))
+        served_monotone = all(
+            all(b >= a for a, b in zip(r.served_steps,
+                                       r.served_steps[1:]))
+            for r in fleet.readers)
+        ck.close()
+    finally:
+        faultfs.uninstall()
+    got_w = weights(store)
+    detail = {
+        "chunks": n_chunks,
+        "degraded_publishes": degraded,
+        "backlog_after_flush": backlog,
+        "injected": {f"{k[0]}/{k[1]}/{k[2]}": v
+                     for k, v in fs.injected_counts().items()},
+        "rejected_candidates": sum(r.watcher.rejected
+                                   for r in fleet.readers),
+        "poll_errors": poll_errors,
+        "fence_trail": fence_trail[-6:],
+        "violations": violations,
+        "converged": converged,
+        "final_step": final_step,
+        "weights_bit_identical": bool(np.array_equal(got_w, want_w)),
+        "snapshot_bit_identical": bool(np.array_equal(
+            np.asarray(snap_tables["weights"]), want_w)),
+    }
+    ok = (not violations and converged and served_monotone
+          and degraded >= 1 and backlog == 0
+          and poll_errors > 0
+          and final_step == n_chunks
+          and detail["weights_bit_identical"]
+          and detail["snapshot_bit_identical"]
+          and len(fence_trail) >= 2)
+    return ok, detail
+
+
+def run_storage_blackout_recover_scenario(tmpdir: str, *,
+                                          timeout: float = 600):
+    """Total storage BLACKOUT mid-run: every snapshot write fails for a
+    window covering three consecutive publishes' full retry budgets,
+    then storage recovers. Training must survive with a BOUNDED publish
+    backlog (exactly the blacked-out publishes, drained to 0 at the
+    first landed one), finish bit-identical to the fault-free run, and
+    leave a directory whose newest snapshot holds the same state the
+    clean run published — then actually RESUME from it."""
+    import numpy as np
+
+    import jax
+
+    from fps_tpu.core.checkpoint import AsyncCheckpointer
+    from fps_tpu.testing import faultfs
+    from fps_tpu.testing.faultfs import FaultRule
+    from fps_tpu.testing.workloads import weights
+
+    _mesh, chunks, make_trainer = _storage_harness()
+    n_chunks = len(chunks)
+
+    # Clean arm.
+    d_clean = os.path.join(tmpdir, "clean")
+    trainer, store, tables, ls = make_trainer()
+    ck = AsyncCheckpointer(d_clean, keep=n_chunks + 2)
+    trainer.fit_stream(tables, ls, iter(chunks), jax.random.key(1),
+                       checkpointer=ck, checkpoint_every=1)
+    ck.close()
+    want_w = weights(store).copy()
+    _, clean_snap, _, _ = AsyncCheckpointer(
+        d_clean, keep=n_chunks + 2).read_snapshot(n_chunks)
+
+    # Blackout arm: publishes 3, 4, 5 each exhaust their 4-attempt
+    # budget (ops 2..13), then the filesystem recovers.
+    D = 3
+    rules = [FaultRule("snapshot", "write", "errno", errno_name="EIO",
+                       start=2, count=4 * D)]
+    d_fault = os.path.join(tmpdir, "blackout")
+    trainer, store, tables, ls = make_trainer()
+    fs = faultfs.install(rules, seed=0)
+    try:
+        ck = AsyncCheckpointer(d_fault, keep=n_chunks + 2)
+        tables, ls, _ = trainer.fit_stream(
+            tables, ls, iter(chunks), jax.random.key(1),
+            checkpointer=ck, checkpoint_every=1)
+        ck.flush()
+        degraded = ck.degraded_publishes
+        backlog = ck._publish_backlog
+        final_step = ck.latest_valid_step()
+        _, fault_snap, _, _ = ck.read_snapshot(final_step)
+        ck.close()
+    finally:
+        faultfs.uninstall()
+    got_w = weights(store)
+
+    # Resume leg: the recovered directory is a real restart point.
+    trainer2, store2, t2, l2 = make_trainer()
+    ck2 = AsyncCheckpointer(d_fault, keep=n_chunks + 2)
+    _t, _l, step = trainer2.restore_checkpoint(ck2, l2)
+    ck2.close()
+    resumed_w = weights(store2)
+    detail = {
+        "chunks": n_chunks,
+        "degraded_publishes": degraded,
+        "backlog_after_flush": backlog,
+        "injected": {f"{k[0]}/{k[1]}/{k[2]}": v
+                     for k, v in fs.injected_counts().items()},
+        "final_step": final_step,
+        "restored_step": step,
+        "weights_bit_identical": bool(np.array_equal(got_w, want_w)),
+        "snapshot_bit_identical": bool(np.array_equal(
+            np.asarray(fault_snap["weights"]),
+            np.asarray(clean_snap["weights"]))),
+        "resume_bit_identical": bool(np.array_equal(resumed_w, got_w)),
+    }
+    ok = (degraded == D and backlog == 0
+          and final_step == n_chunks and step == n_chunks
+          and detail["weights_bit_identical"]
+          and detail["snapshot_bit_identical"]
+          and detail["resume_bit_identical"])
+    return ok, detail
+
+
+def run_enospc_compaction_scenario(tmpdir: str, *, timeout: float = 600):
+    """ENOSPC mid-compaction: the LSM fold's full-snapshot write fails
+    through its whole retry budget. The fold must ABORT without
+    touching the chain (every link still resolves, reads serve the
+    resolved head), storage.compaction_aborts counts it, and — after
+    recovery — the next publish re-triggers the compaction, which
+    completes and preserves the state bit-exactly."""
+    import numpy as np
+
+    from fps_tpu import obs
+    from fps_tpu.core import snapshot_format as fmt
+    from fps_tpu.core.checkpoint import (
+        Checkpointer,
+        DeltaPolicy,
+        load_rows,
+    )
+
+    from fps_tpu.testing import faultfs
+    from fps_tpu.testing.faultfs import FaultRule
+
+    _mesh, _chunks, make_trainer = _storage_harness()
+    trainer, store, tables, ls = make_trainer()
+    rec = obs.Recorder(sinks=[])
+    obs.events.set_default_recorder(rec)
+    d = os.path.join(tmpdir, "enospc")
+    # Writes by op index: save1 full (0), save2 delta (1), save3 delta
+    # (2) -> auto-compaction (compact_every=2) writes the fold at ops
+    # 3..6 (4 attempts, all ENOSPC -> abort); save4's delta is op 7
+    # (lands), and ITS auto-compaction at op 8 succeeds. Saves perturb
+    # a HANDFUL of rows each, so the publications really are row-sparse
+    # deltas (a whole-table change would publish fulls and never build
+    # a chain to fold).
+    rules = [FaultRule("snapshot", "write", "errno",
+                       errno_name="ENOSPC", start=3, count=4)]
+    fs = faultfs.install(rules, seed=0)
+    spec = store.specs["weights"]
+    rng = np.random.default_rng(0)
+    try:
+        ck = Checkpointer(d, keep=20,
+                          delta=DeltaPolicy(full_every=10,
+                                            compact_every=2))
+        state_at = {}
+        for i in range(4):
+            ids = np.arange(i * 4, i * 4 + 4) % spec.num_ids
+            load_rows(store, "weights", ids,
+                      rng.normal(size=(len(ids), spec.dim))
+                      .astype(np.float32))
+            ck.save(i + 1, store, None)
+            state_at[i + 1] = store.dump_model("weights")[1].copy()
+            if i + 1 == 3:
+                # The fold at step 3 just aborted: chain must be
+                # intact and resolvable.
+                pubs = fmt.publications(d)
+                kinds_mid = {s: p.kind for s, p in pubs.items()}
+                aborted = ck.compactions == 0
+                resolved_mid = fmt.latest_valid_chain(d)
+                mid_ok = (resolved_mid is not None
+                          and resolved_mid[0] == 3)
+    finally:
+        faultfs.uninstall()
+        obs.events.set_default_recorder(None)
+    aborts = int(rec.counter_value("storage.compaction_aborts"))
+    pubs = fmt.publications(d)
+    resolved = fmt.latest_valid_chain(d)
+    head_ok = (resolved is not None and resolved[0] == 4
+               and resolved[1][-1].kind == "full")
+    state_ok = False
+    if resolved is not None:
+        entries = fmt.resolve_chain_entries(resolved[1])
+        state_ok = bool(np.array_equal(
+            np.asarray(entries["table::weights"]), state_at[4]))
+    detail = {
+        "kinds_mid_abort": {str(k): v for k, v in
+                            sorted(kinds_mid.items())},
+        "mid_abort_resolvable": mid_ok,
+        "compaction_aborts_counted": aborts,
+        "compactions_completed": ck.compactions,
+        "injected": {f"{k[0]}/{k[1]}/{k[2]}": v
+                     for k, v in fs.injected_counts().items()},
+        "final_kinds": {str(s): p.kind for s, p in sorted(pubs.items())},
+        "head_is_compacted_full": head_ok,
+        "state_bit_exact": state_ok,
+    }
+    ok = (aborted and mid_ok and aborts >= 1
+          and ck.compactions >= 1 and head_ok and state_ok)
+    return ok, detail
+
+
+def run_slow_lease_near_ttl_scenario(tmpdir: str, *,
+                                     timeout: float = 600):
+    """A live leader's lease renewals hit injected slow writes (1.2s
+    against a 2s TTL, two consecutive renewals — one isolated spike is
+    tolerated by design): the holder must STEP DOWN cleanly before its
+    own record expires (a slow filesystem must never let a leader
+    silently blow its TTL), stop renewing so the record lapses on
+    schedule, and a follower must seize with a strictly-higher
+    (monotone) fencing epoch — after which the deposed leader stays
+    out."""
+    import time as _time
+
+    from fps_tpu.supervise.pod import Lease
+    from fps_tpu.testing import faultfs
+    from fps_tpu.testing.faultfs import FaultRule
+
+    TTL = 2.0
+    path = os.path.join(tmpdir, "pod_lease.json")
+    A = Lease(path, "hA", TTL)
+    B = Lease(path, "hB", TTL)
+    A.tick()  # claim
+    held, rec, _ = A.tick()  # confirm
+    if not held:
+        return False, {"error": "A never acquired the lease"}
+    epoch_a = int(rec["epoch"])
+    # Lease writes so far: A's claim (op 0). The next renewals (ops
+    # 1..2) are slowed past TTL/2.
+    fs = faultfs.install([FaultRule("lease", "replace", "delay",
+                                    delay_s=0.6 * TTL, start=1,
+                                    count=2)])
+    try:
+        stepped_at = None
+        last_landed_t = float(rec["t"])
+        deadline = _time.monotonic() + min(timeout, 30.0)
+        while _time.monotonic() < deadline:
+            held, rec, _ = A.tick()
+            if held:
+                last_landed_t = float(rec["t"])
+            else:
+                # The step-down tick's own (slow-landed) renewal still
+                # counts as the freshest landed record — expiry runs
+                # from ITS timestamp.
+                if rec and rec.get("host") == "hA":
+                    last_landed_t = float(rec["t"])
+                stepped_at = _time.time()
+                break
+            _time.sleep(0.05)
+        if stepped_at is None:
+            return False, {"error": "A never stepped down"}
+        # Stepped down BEFORE its record's expiry.
+        before_expiry = stepped_at < last_landed_t + TTL
+        # B seizes after the record lapses, with a monotone epoch bump.
+        seized_epoch = None
+        deadline = _time.monotonic() + min(timeout, 30.0)
+        while _time.monotonic() < deadline:
+            held_b, rec_b, _ = B.tick()
+            if held_b:
+                seized_epoch = int(rec_b["epoch"])
+                seized_at = _time.time()
+                break
+            _time.sleep(0.05)
+        if seized_epoch is None:
+            return False, {"error": "B never seized the lease"}
+        # The deposed leader stays out while B renews (and its stale
+        # epoch can never regress the record for any observer).
+        stays_out = True
+        regress = False
+        for _ in range(6):
+            held_a, rec_a, _ = A.tick()
+            stays_out = stays_out and not held_a
+            B.tick()
+            cur = B.read() or {}
+            if int(cur.get("epoch", seized_epoch)) < seized_epoch:
+                regress = True
+            _time.sleep(0.05)
+    finally:
+        faultfs.uninstall()
+    detail = {
+        "ttl_s": TTL,
+        "leader_epoch": epoch_a,
+        "stepdowns": A.stepdowns,
+        "renew_failures": A.renew_failures,
+        "stepped_down_before_expiry": before_expiry,
+        "stepdown_to_seizure_s": round(seized_at - stepped_at, 3),
+        "seized_epoch": seized_epoch,
+        "epoch_monotone": seized_epoch > epoch_a and not regress,
+        "deposed_stays_out": stays_out,
+    }
+    ok = (A.stepdowns >= 1 and before_expiry
+          and seized_epoch > epoch_a and not regress and stays_out
+          and seized_at > stepped_at)
+    return ok, detail
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="supervised tiny-logreg child (fps_tpu.supervise demo)")

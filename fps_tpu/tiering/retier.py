@@ -485,6 +485,14 @@ class Retierer:
         return jax.device_put(np.asarray(arr), trainer._replicated)
 
     def _save_sidecar(self, step: int, windows: dict) -> None:
+        """Write the boundary sidecar — retried through the storage
+        retry policy, then DEGRADED on persistent transient failure:
+        the sidecar is advisory (a missing one only cold-starts the
+        tracker on resume, warned loudly by :meth:`restore`), so a
+        storage brownout at a boundary must not crash training over
+        it. ``storage.sidecar_skips`` counts the lost durability."""
+        from fps_tpu.core import retry as _retry
+
         os.makedirs(self.state_dir, exist_ok=True)
         path = sidecar_path(self.state_dir, step)
         arrays = {"meta": np.frombuffer(json.dumps({
@@ -499,13 +507,51 @@ class Retierer:
             arrays[f"hot::{name}"] = self.hot_ids[name]
         for name in sorted(windows):
             arrays[f"window::{name}"] = windows[name]
-        tmp = path + ".tmp.npz"
-        with open(tmp, "wb") as f:
-            np.savez(f, **arrays)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        try:
+            _retry.call_with_retry(
+                lambda: self._write_sidecar_file(path, arrays),
+                policy=dataclasses.replace(_retry.DEFAULT_PUBLISH_RETRY,
+                                           seed=path),
+                op="sidecar",
+                on_retry=lambda a, e, d: self._obs_metric(
+                    "inc", "storage.retries", 1, plane="sidecar"))
+        except OSError as e:
+            if _retry.classify_error(e) != "retryable":
+                raise
+            _log.warning("tiering: sidecar write for step %d DEGRADED "
+                         "(skipped after retries): %r — a resume past "
+                         "this boundary cold-starts the tracker", step,
+                         e)
+            self._obs_metric("inc", "storage.sidecar_skips", 1)
+            return
         self._sweep_sidecars()
+
+    @staticmethod
+    def _obs_metric(kind: str, name: str, value, **labels) -> None:
+        from fps_tpu.obs import events
+
+        events.record_metric(kind, name, value, **labels)
+
+    @staticmethod
+    def _write_sidecar_file(path: str, arrays: dict) -> None:
+        from fps_tpu.core import retry as _retry
+
+        _retry.fault_check("write", path)
+        tmp = path + ".tmp.npz"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+                f.flush()
+                _retry.fault_check("fsync", path)
+                os.fsync(f.fileno())
+            _retry.fault_check("replace", path)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
 
     def _sweep_sidecars(self) -> None:
         """Retention must track RESTORABILITY, not recency: a resume

@@ -224,13 +224,17 @@ def test_watcher_forward_swaps_and_rejection_cache(tmp_path):
     assert watcher.poll().step == 2
     assert watcher.swaps == {"forward": 2, "backward": 0}
 
-    # A torn candidate is rejected ONCE per inode (no re-verify churn),
-    # and never served.
+    # A torn candidate is rejected TWICE per inode — the second read
+    # CONFIRMS the verdict (one failing open can be a transient stale
+    # read on a hostile filesystem, not evidence about the durable
+    # bytes) — then the cache pins it: no further re-verify churn, and
+    # it is never served.
     with open(fmt.snapshot_path(d, 9), "wb") as f:
         f.write(b"PK\x03\x04junk")
     assert watcher.poll() is None
     assert watcher.poll() is None
-    assert watcher.rejected == 1
+    assert watcher.poll() is None
+    assert watcher.rejected == 2
     assert server.snapshot.step == 2
     # An atomic RE-publish of the same step gets a fresh verdict.
     write_snapshot(d, 9, seed=9)

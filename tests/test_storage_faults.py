@@ -1,0 +1,610 @@
+"""Hostile-filesystem survival (docs/resilience.md "Hostile
+filesystem"): errno classification, bounded deterministic retry/backoff
+(``fps_tpu.core.retry``), seed-replayable fault injection
+(``fps_tpu.testing.faultfs``), and degraded-mode storage across the
+planes — the async writer skips-not-crashes, the watcher/fleet polls
+serve last-good, the sidecar degrades, the lease steps down.
+
+The satellite acceptance contract (ISSUE 15):
+
+* the retryable/fatal errno split is EXACT (ENOSPC/EIO/ETIMEDOUT
+  retry; EACCES/EROFS fatal);
+* a retried-then-successful async publish is byte-identical to an
+  unfaulted one;
+* first-error retention survives interleaved retries.
+"""
+
+import errno
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fps_tpu.core import retry as retry_mod
+from fps_tpu.core.retry import (
+    RetryPolicy,
+    call_with_retry,
+    classify_error,
+    classify_path,
+)
+from fps_tpu.testing import faultfs
+from fps_tpu.testing.faultfs import FaultFS, FaultRule
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    """Every test leaves the process injector uninstalled — a leaked
+    schedule would fault unrelated tests' checkpoints."""
+    yield
+    faultfs.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# Errno classification + retry policy units.
+# ---------------------------------------------------------------------------
+
+
+def test_errno_classification_exact():
+    for code in (errno.ENOSPC, errno.EIO, errno.ETIMEDOUT):
+        assert classify_error(OSError(code, "x")) == "retryable", code
+    for code in (errno.EACCES, errno.EROFS):
+        assert classify_error(OSError(code, "x")) == "fatal", code
+    # Non-OSError, errno-less OSError, and unknown-errno exceptions are
+    # all fatal: retrying what we do not understand hides bugs.
+    assert classify_error(ValueError("x")) == "fatal"
+    assert classify_error(OSError("no errno")) == "fatal"
+
+
+def test_backoff_deterministic_jittered_bounded():
+    p = RetryPolicy(seed="s", base_s=0.1, factor=2.0, max_backoff_s=0.5,
+                    jitter=0.25)
+    seq = [p.backoff_s(i) for i in range(6)]
+    assert seq == [p.backoff_s(i) for i in range(6)]  # replayable
+    for i, b in enumerate(seq):
+        base = min(0.1 * 2.0 ** i, 0.5)
+        assert base <= b <= base * 1.25  # jitter bounded
+    q = RetryPolicy(seed="t", base_s=0.1, factor=2.0, max_backoff_s=0.5,
+                    jitter=0.25)
+    assert q.backoff_s(0) != p.backoff_s(0)  # seeds desynchronize
+
+
+def test_call_with_retry_transient_then_success():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(errno.EIO, "flaky")
+        return "ok"
+
+    sleeps = []
+    assert call_with_retry(
+        flaky, policy=RetryPolicy(retries=3, base_s=0.0, jitter=0.0),
+        sleep=sleeps.append) == "ok"
+    assert calls["n"] == 3 and len(sleeps) == 2
+
+
+def test_call_with_retry_fatal_immediate_and_budget():
+    def eacces():
+        raise OSError(errno.EACCES, "nope")
+
+    with pytest.raises(PermissionError):
+        call_with_retry(eacces, policy=RetryPolicy(retries=5, base_s=0.0))
+
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError(errno.ENOSPC, "full")
+
+    with pytest.raises(OSError):
+        call_with_retry(always, policy=RetryPolicy(retries=2, base_s=0.0,
+                                                   jitter=0.0),
+                        sleep=lambda _s: None)
+    assert calls["n"] == 3  # retries + 1 attempts, bounded
+
+
+def test_call_with_retry_deadline_cap():
+    clock = {"t": 0.0}
+
+    def tick():
+        return clock["t"]
+
+    def sleep(s):
+        clock["t"] += s
+
+    def always():
+        clock["t"] += 1.0
+        raise OSError(errno.EIO, "slow and failing")
+
+    calls_before = clock["t"]
+    with pytest.raises(OSError):
+        call_with_retry(always,
+                        policy=RetryPolicy(retries=100, base_s=1.0,
+                                           factor=1.0, jitter=0.0,
+                                           deadline_s=5.0),
+                        clock=tick, sleep=sleep)
+    # Bounded by the deadline, not the huge retry budget.
+    assert clock["t"] - calls_before <= 7.0
+
+
+def test_classify_path_planes():
+    assert classify_path("/a/ckpt_000000000001.npz") == "snapshot"
+    assert classify_path("/a/delta_000000000002_000000000001.npz") \
+        == "snapshot"
+    assert classify_path("/a/xyz123.tmp.npz") == "snapshot"
+    assert classify_path("/a/ckpt_000000000001.npz.corrupt") == "snapshot"
+    assert classify_path("/a/pod_lease.json") == "lease"
+    assert classify_path("/a/pod_fence.json") == "fence"
+    assert classify_path("/a/serve_fence.json") == "fence"
+    assert classify_path("/a/ready_r0.json") == "fence"
+    assert classify_path("/a/tiering-00000003.npz") == "sidecar"
+    assert classify_path("/a/pod_control.json") == "control"
+    assert classify_path("/a/journal-p0.jsonl") == "journal"
+    assert classify_path("/a/whatever.txt") == "other"
+
+
+def test_env_name_mirror():
+    # faultfs must stay loadable by file path (zero package imports),
+    # so it mirrors the env name; the two must never drift.
+    assert faultfs.FAULTFS_ENV == retry_mod.FAULTFS_ENV
+
+
+# ---------------------------------------------------------------------------
+# FaultFS: deterministic scheduling + the directive faults.
+# ---------------------------------------------------------------------------
+
+
+def _drive(fs, n=20, cls="snapshot", op="write", path="/d/ckpt_x.npz"):
+    out = []
+    for _ in range(n):
+        try:
+            d = fs.check(op, cls, path)
+            out.append(("ok", d))
+        except OSError as e:
+            out.append(("err", e.errno))
+    return out
+
+
+def test_faultfs_schedule_replayable():
+    rules = [FaultRule("snapshot", "write", "errno", errno_name="EIO",
+                       start=3, count=4, every=2),
+             FaultRule("snapshot", "write", "errno", errno_name="ENOSPC",
+                       start=10, count=None, every=5, prob=0.5)]
+    a = _drive(FaultFS(rules, seed=7), 40)
+    b = _drive(FaultFS(rules, seed=7), 40)
+    assert a == b  # same seed + same op stream = same faults
+    c = _drive(FaultFS(rules, seed=8), 40)
+    # The windowed deterministic rule fires identically; only the
+    # probabilistic tail may differ with the seed.
+    assert a[:10] == c[:10]
+    # Window semantics: [start, start+count) hitting every 2nd.
+    errs = [i for i, (k, _) in enumerate(a[:10]) if k == "err"]
+    assert errs == [3, 5]
+
+
+def test_faultfs_class_isolation():
+    fs = FaultFS([FaultRule("lease", "*", "errno", errno_name="EIO",
+                            count=None)])
+    assert fs.check("write", "snapshot", "/d/ckpt_x.npz") is None
+    with pytest.raises(OSError):
+        fs.check("replace", "lease", "/d/pod_lease.json")
+
+
+def test_faultfs_spec_roundtrip(tmp_path):
+    fs = FaultFS([FaultRule("snapshot", "read", "stale", start=2)],
+                 seed=9)
+    clone = FaultFS.from_spec(fs.to_spec())
+    assert clone.seed == 9 and clone.rules == fs.rules
+    env = fs.to_env({})
+    assert retry_mod.FAULTFS_ENV in env
+    # File-path form of the env value.
+    p = tmp_path / "spec.json"
+    p.write_text(fs.to_spec())
+    assert FaultFS.from_spec(str(p)).rules == fs.rules
+
+
+def test_stale_read_serves_pre_rename_content(tmp_path):
+    target = tmp_path / "ckpt_000000000001.npz"
+    target.write_bytes(b"OLD")
+    fs = FaultFS([FaultRule("snapshot", "read", "stale", start=0,
+                            count=1)])
+    # The injector snoops the replace and shadows the pre-rename bytes.
+    fs.check("replace", "snapshot", str(target))
+    target.write_bytes(b"NEW")
+    d = fs.check("read", "snapshot", str(target))
+    assert isinstance(d, tuple) and d[0] == "redirect"
+    assert open(d[1], "rb").read() == b"OLD"
+    fs.close()
+
+
+# ---------------------------------------------------------------------------
+# Degraded-mode storage on the async writer (the ISSUE's test triad).
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def jaxmods():
+    import jax
+
+    from fps_tpu.core import checkpoint as ck
+    from fps_tpu.models.logistic_regression import (
+        LogRegConfig,
+        logistic_regression,
+    )
+    from fps_tpu.parallel.mesh import make_ps_mesh
+
+    mesh = make_ps_mesh(num_shards=1, num_data=1,
+                        devices=jax.devices()[:1])
+    return dict(jax=jax, ck=ck, mesh=mesh, LogRegConfig=LogRegConfig,
+                logistic_regression=logistic_regression)
+
+
+def _store(jaxmods, seed=0):
+    cfg = jaxmods["LogRegConfig"](num_features=32, learning_rate=0.5)
+    trainer, store = jaxmods["logistic_regression"](jaxmods["mesh"], cfg)
+    trainer.init_state(jaxmods["jax"].random.key(seed))
+    return store
+
+
+def test_retried_publish_byte_identical_to_unfaulted(jaxmods, tmp_path):
+    """A publish that fails transiently twice and lands on its third
+    attempt must leave EXACTLY the bytes an unfaulted publish leaves."""
+    ck = jaxmods["ck"]
+    store = _store(jaxmods)
+    clean = ck.Checkpointer(str(tmp_path / "clean"), keep=3)
+    clean.save(1, store, None)
+    faultfs.install([FaultRule("snapshot", "write", "errno",
+                               errno_name="EIO", start=0, count=2)])
+    faulted = ck.AsyncCheckpointer(str(tmp_path / "faulted"), keep=3)
+    faulted.save(1, store, None)
+    faulted.close()
+    faultfs.uninstall()
+    a = np.load(str(tmp_path / "clean" / "ckpt_000000000001.npz"))
+    b = np.load(str(tmp_path / "faulted" / "ckpt_000000000001.npz"))
+    assert set(a.files) == set(b.files)
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k])
+    assert faulted.degraded_publishes == 0  # retried, never degraded
+
+
+def test_degrade_skips_transient_failure_without_crashing(jaxmods,
+                                                          tmp_path):
+    ck = jaxmods["ck"]
+    store = _store(jaxmods)
+    # The whole retry budget (4 attempts) fails -> the publish degrades.
+    faultfs.install([FaultRule("snapshot", "write", "errno",
+                               errno_name="ENOSPC", start=0, count=4)])
+    ckpt = ck.AsyncCheckpointer(str(tmp_path / "d"), keep=5)
+    ckpt.save(1, store, None)
+    ckpt.flush()  # must NOT raise: degraded, not failed
+    assert ckpt.degraded_publishes == 1
+    assert ckpt._publish_backlog == 1
+    assert ckpt.steps() == []
+    # Storage recovered: the next save lands and drains the backlog.
+    ckpt.save(2, store, None)
+    ckpt.flush()
+    assert ckpt.steps() == [2]
+    assert ckpt._publish_backlog == 0
+    ckpt.close()
+
+
+def test_fatal_error_keeps_first_error_retention(jaxmods, tmp_path):
+    """First-error retention survives interleaved retries: a FATAL
+    failure (EROFS) raises on the caller — and keeps raising the FIRST
+    error even when later (retried, transient) failures interleave."""
+    ck = jaxmods["ck"]
+    store = _store(jaxmods)
+    faultfs.install([
+        FaultRule("snapshot", "write", "errno", errno_name="EROFS",
+                  start=0, count=1),
+        # The next publish fails transiently once, then retries fine —
+        # its retry traffic must not displace the pending EROFS.
+        FaultRule("snapshot", "write", "errno", errno_name="EIO",
+                  start=1, count=1),
+    ])
+    ckpt = ck.AsyncCheckpointer(str(tmp_path / "d"), keep=5)
+    ckpt.save(1, store, None)
+    with ckpt._cv:
+        while ckpt._queued is not None or ckpt._writing:
+            ckpt._cv.wait(0.05)
+    with pytest.raises(RuntimeError, match="background checkpoint"):
+        ckpt.save(2, store, None)
+    # The pending error was consumed; the writer keeps working (the
+    # EIO rule retries through).
+    ckpt.save(3, store, None)
+    ckpt.flush()
+    assert ckpt.steps() == [3]
+    ckpt.close()
+
+
+def test_degraded_delta_chain_resets_to_full(jaxmods, tmp_path):
+    """A degraded (skipped) publication must never become a delta
+    base: the next save publishes a FULL, and no delta on disk chains
+    from the skipped step."""
+    from fps_tpu.core import snapshot_format as fmt
+    from fps_tpu.core.checkpoint import load_rows
+
+    ck = jaxmods["ck"]
+    store = _store(jaxmods)
+    ckpt = ck.AsyncCheckpointer(str(tmp_path / "d"), keep=10,
+                                delta=ck.DeltaPolicy(full_every=10))
+    ckpt.save(1, store, None)
+    ckpt.flush()
+    # Sparse perturbations so deltas are genuinely smaller than fulls.
+    load_rows(store, "weights", np.arange(2),
+              np.ones((2, store.specs["weights"].dim), np.float32))
+    faultfs.install([FaultRule("snapshot", "write", "errno",
+                               errno_name="EIO", start=0, count=4)])
+    ckpt.save(2, store, None)  # planned as delta vs 1; degrades
+    ckpt.flush()
+    faultfs.uninstall()
+    assert ckpt.degraded_publishes == 1
+    load_rows(store, "weights", np.arange(2, 4),
+              np.ones((2, store.specs["weights"].dim), np.float32))
+    ckpt.save(3, store, None)
+    ckpt.flush()
+    pubs = fmt.publications(str(tmp_path / "d"))
+    assert pubs[3].kind == "full"  # chain reset: never an orphan base
+    assert all(p.base != 2 for p in pubs.values() if p.kind == "delta")
+    ckpt.close()
+
+
+def test_transient_stale_read_never_quarantines_valid_snapshot(jaxmods,
+                                                               tmp_path):
+    """A stale read of pre-rename (truncated) content must not make the
+    auto-resolve restore quarantine the durable, VALID snapshot: the
+    failing link is re-verified on a fresh read before any rename —
+    faults cost recency, never state."""
+    ck = jaxmods["ck"]
+    store = _store(jaxmods)
+    d = str(tmp_path / "d")
+    ckpt = ck.Checkpointer(d, keep=5)
+    ckpt.save(1, store, None)
+    target = os.path.join(d, "ckpt_000000000001.npz")
+    # Shadow truncated pre-rename content, then schedule ONE stale read.
+    fs = faultfs.install([FaultRule("snapshot", "read", "stale",
+                                    start=0, count=1)])
+    good = open(target, "rb").read()
+    shadowed = str(tmp_path / "shadow.npz")
+    with open(shadowed, "wb") as f:
+        f.write(good[: len(good) // 3])
+    fs._shadows[os.path.abspath(target)] = shadowed
+    step, tables, _, _ = ckpt.read_snapshot()  # first read is stale
+    assert step == 1
+    assert not [f for f in os.listdir(d) if f.endswith(".corrupt")]
+    faultfs.uninstall()
+
+
+def test_transient_enoent_restore_falls_back_not_crash(jaxmods,
+                                                       tmp_path):
+    """A transient ENOENT on the newest snapshot's read (stale mount /
+    sweep race) must not crash an auto-resolve restore that has intact
+    older snapshots: retry once, then fall back — and quarantine
+    NOTHING (the file is invisible, not corrupt)."""
+    ck = jaxmods["ck"]
+    store = _store(jaxmods)
+    d = str(tmp_path / "d")
+    ckpt = ck.Checkpointer(d, keep=5)
+    ckpt.save(1, store, None)
+    ckpt.save(2, store, None)
+    faultfs.install([FaultRule("snapshot", "read", "errno",
+                               errno_name="ENOENT", start=0, count=2)])
+    step, _tables, _, _ = ckpt.read_snapshot()
+    assert step == 1  # fell back past the invisible newest
+    assert not [f for f in os.listdir(d) if f.endswith(".corrupt")]
+    faultfs.uninstall()
+    assert ckpt.read_snapshot()[0] == 2  # recovered
+
+
+def test_persistent_enoent_on_writes_raises_not_degrades(jaxmods,
+                                                         tmp_path):
+    """ENOENT persisting past the whole retry budget means the
+    checkpoint DIRECTORY is gone — that must raise on the caller, not
+    quietly degrade every publish into a checkpoint-free 'success'."""
+    ck = jaxmods["ck"]
+    store = _store(jaxmods)
+    ckpt = ck.AsyncCheckpointer(str(tmp_path / "d"), keep=3)
+    faultfs.install([FaultRule("snapshot", "write", "errno",
+                               errno_name="ENOENT", start=0, count=8)])
+    ckpt.save(1, store, None)
+    with pytest.raises(RuntimeError, match="background checkpoint"):
+        ckpt.flush()
+    assert ckpt.degraded_publishes == 0
+    faultfs.uninstall()
+    ckpt.close()
+
+
+def test_stale_read_does_not_pin_valid_publish_rejected(jaxmods,
+                                                        tmp_path):
+    """One stale read that makes a valid publish LOOK torn must not pin
+    it in the watcher's permanent rejection cache — the next poll's
+    fresh read serves it."""
+    from fps_tpu.serve import SnapshotWatcher
+
+    ck = jaxmods["ck"]
+    store = _store(jaxmods)
+    d = str(tmp_path / "d")
+    ckpt = ck.Checkpointer(d, keep=5)
+    ckpt.save(1, store, None)
+    target = os.path.join(d, "ckpt_000000000001.npz")
+    fs = faultfs.install([FaultRule("snapshot", "read", "stale",
+                                    start=0, count=1)])
+    good = open(target, "rb").read()
+    shadowed = str(tmp_path / "shadow.npz")
+    with open(shadowed, "wb") as f:
+        f.write(good[: len(good) // 3])
+    fs._shadows[os.path.abspath(target)] = shadowed
+    w = SnapshotWatcher(d)
+    assert w.poll() is None  # stale read: looks torn, rejected once
+    snap = w.poll()  # fresh read: served, never pinned
+    assert snap is not None and snap.step == 1
+    faultfs.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# Read-plane degradation: watcher + fleet polls survive brownouts.
+# ---------------------------------------------------------------------------
+
+
+def test_watcher_poll_degrades_to_last_good(jaxmods, tmp_path):
+    from fps_tpu.serve import SnapshotWatcher
+
+    ck = jaxmods["ck"]
+    store = _store(jaxmods)
+    d = str(tmp_path / "d")
+    ckpt = ck.Checkpointer(d, keep=5)
+    ckpt.save(1, store, None)
+    w = SnapshotWatcher(d)
+    snap = w.poll()
+    assert snap is not None and snap.step == 1
+    # Brownout: the directory scan fails transiently — the poll must
+    # degrade (count, serve last-good), never raise or unpublish.
+    faultfs.install([FaultRule("snapshot", "listdir", "errno",
+                               errno_name="EIO", start=0, count=2)])
+    assert w.poll() is None
+    assert w.poll_errors == 1
+    assert w.current is not None and w.current.step == 1
+    faultfs.uninstall()
+    ckpt.save(2, store, None)
+    snap = w.poll()
+    assert snap is not None and snap.step == 2  # recovered
+
+
+def test_fleet_reader_poll_survives_fence_io_errors(jaxmods, tmp_path):
+    from fps_tpu.serve.fleet import FleetReader
+
+    ck = jaxmods["ck"]
+    store = _store(jaxmods)
+    d = str(tmp_path / "d")
+    ckpt = ck.Checkpointer(d, keep=5)
+    ckpt.save(1, store, None)
+    r = FleetReader(d, "r0", quorum=1)
+    assert r.poll() == 1
+    # Fence/readiness writes fail for a stretch: polls degrade but the
+    # reader keeps serving and recovers.
+    faultfs.install([FaultRule("fence", "*", "errno", errno_name="EIO",
+                               start=0, count=6)])
+    ckpt.save(2, store, None)
+    for _ in range(4):
+        served = r.poll()
+        assert served in (1, 2)  # never None, never wedged
+    faultfs.uninstall()
+    for _ in range(4):
+        served = r.poll()
+    assert served == 2
+    assert (r.poll_errors + r.fence.io_errors
+            + r.watcher.poll_errors) > 0
+
+
+def test_sidecar_write_degrades(jaxmods, tmp_path, caplog):
+    """A sidecar write that fails transiently through its retry budget
+    is SKIPPED (advisory state), never a crash."""
+    import logging
+
+    from fps_tpu.tiering.retier import Retierer
+
+    rt = Retierer.__new__(Retierer)  # only the sidecar path under test
+    rt.state_dir = str(tmp_path / "sc")
+    rt.keep = 2
+    rt.tick = 1
+    rt.planned = False
+    rt.plans = None
+    rt.state, rt.hot_ids = {}, {}
+    faultfs.install([FaultRule("sidecar", "write", "errno",
+                               errno_name="EIO", start=0, count=8)])
+    with caplog.at_level(logging.WARNING, logger="fps_tpu.tiering"):
+        rt._save_sidecar(3, {})
+    assert "DEGRADED" in caplog.text
+    assert not os.listdir(rt.state_dir)
+    faultfs.uninstall()
+    rt._save_sidecar(4, {})
+    assert os.listdir(rt.state_dir) == ["tiering-00000004.npz"]
+
+
+# ---------------------------------------------------------------------------
+# Fleet SLO rollup: storage staleness + fence lag (satellite 2).
+# ---------------------------------------------------------------------------
+
+
+def _write_events(d, records):
+    os.makedirs(d, exist_ok=True)
+    import json
+
+    with open(os.path.join(d, "events-p0.jsonl"), "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_fleet_rollup_degraded_publishes_and_fence_lag(tmp_path):
+    from fps_tpu.obs.fleet import DEFAULT_SLOS, evaluate_slos, rollup
+
+    d = str(tmp_path / "h0")
+    t0 = 1000.0
+    _write_events(d, [
+        {"kind": "metric", "t": t0 + 1, "name": "driver.examples",
+         "mtype": "counter", "value": 100.0},
+        {"kind": "event", "t": t0 + 1, "event": "checkpoint_saved",
+         "step": 10, "path": "x"},
+        {"kind": "metric", "t": t0 + 2, "name": "serve.fence_step",
+         "mtype": "gauge", "value": 4.0},
+        {"kind": "metric", "t": t0 + 3,
+         "name": "storage.degraded_publishes", "mtype": "counter",
+         "value": 2.0},
+        {"kind": "event", "t": t0 + 3, "event": "checkpoint_degraded",
+         "step": 11, "backlog": 1},
+    ])
+    roll = rollup([d], window_s=10.0)
+    tot = roll["totals"]
+    # Counter/event dedup rule: both sources fire together -> max().
+    assert tot["degraded_publishes"] == 2
+    assert tot["fence_lag_steps"] == 6.0  # newest published 10, fence 4
+    slo = evaluate_slos(roll, DEFAULT_SLOS)
+    assert slo["storage_staleness_budget"]["bad_windows"] >= 1
+    assert not slo["storage_staleness_budget"]["ok"]
+    assert slo["serve_fence_lag"]["windows_evaluated"] >= 1
+
+
+def test_fleet_rollup_fence_lag_within_budget_ok(tmp_path):
+    from fps_tpu.obs.fleet import DEFAULT_SLOS, evaluate_slos, rollup
+
+    d = str(tmp_path / "h0")
+    t0 = 2000.0
+    _write_events(d, [
+        {"kind": "event", "t": t0 + 1, "event": "checkpoint_saved",
+         "step": 10, "path": "x"},
+        {"kind": "metric", "t": t0 + 2, "name": "serve.fence_step",
+         "mtype": "gauge", "value": 9.0},
+    ])
+    roll = rollup([d], window_s=10.0)
+    assert roll["totals"]["fence_lag_steps"] == 1.0
+    slo = evaluate_slos(roll, DEFAULT_SLOS)
+    assert slo["serve_fence_lag"]["ok"]
+    assert slo["storage_staleness_budget"]["ok"]  # nothing degraded
+
+
+# ---------------------------------------------------------------------------
+# End-to-end (slow): the chaos scenarios, shared with the sweep.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_storage_brownout_scenario_end_to_end(tmp_path):
+    from fps_tpu.testing.supervised_demo import (
+        run_storage_brownout_scenario,
+    )
+
+    ok, detail = run_storage_brownout_scenario(str(tmp_path))
+    assert ok, detail
+
+
+@pytest.mark.slow
+def test_slow_lease_scenario_end_to_end(tmp_path):
+    from fps_tpu.testing.supervised_demo import (
+        run_slow_lease_near_ttl_scenario,
+    )
+
+    ok, detail = run_slow_lease_near_ttl_scenario(str(tmp_path))
+    assert ok, detail

@@ -101,6 +101,32 @@ fps_tpu.testing.workloads):
   every member finishes byte-identical to a straight W-host run — with
   zero torn or epoch-stale checkpoints published.
 
+* ``storage_brownout``         — deterministic I/O faults
+  (``fps_tpu.testing.faultfs``: transient EIO writes, slow fsyncs, a
+  torn rename, EIO/stale/ENOENT reads, flaky scans) against a live
+  training run + 2-reader quorum fleet: survives iff training never
+  crashes and finishes BIT-identical to the fault-free run, at least
+  one publish degrades (backlog raised, drained after recovery), the
+  fleet serves last-good throughout with zero fence violations, and
+  the read plane's degradation is counted (poll_errors), never a
+  frozen reader.
+* ``storage_blackout_recover`` — every snapshot write fails for a
+  window covering three publishes' full retry budgets: survives iff
+  training continues with a BOUNDED publish backlog (exactly the
+  blacked-out publishes), the first landed publish drains it, the
+  recovered directory's newest snapshot is bit-identical to the clean
+  run's, and a fresh process resumes from it.
+* ``enospc_compaction``        — ENOSPC through the LSM fold's whole
+  retry budget: survives iff the fold aborts with the delta chain
+  INTACT (still resolvable), ``storage.compaction_aborts`` counts it,
+  and the next publish after recovery re-triggers a compaction that
+  completes bit-exactly.
+* ``slow_lease_near_ttl``      — the pod lease holder's renewal writes
+  are slowed past TTL/2: survives iff the leader steps down CLEANLY
+  before its record expires, stops renewing so the record lapses, a
+  follower seizes with a strictly-higher fencing epoch, and the
+  deposed leader stays out.
+
 The digest also carries the clean run's program CERTIFICATE
 (``fps_tpu.analysis``, ``docs/analysis.md``): the compiled logreg step
 is audited against its derived contract, so a regression in collective
@@ -325,6 +351,22 @@ def _harness_scenarios():
             "run_pod_flapping_member_scenario"),
         "pod_elastic_resize": _subprocess_scenario(
             "run_pod_elastic_resize_scenario"),
+        # Hostile-filesystem scenarios (fps_tpu.testing.faultfs +
+        # fps_tpu/core/retry.py; docs/resilience.md "Hostile
+        # filesystem"): deterministic I/O fault injection against the
+        # framework's own storage seams — ENOSPC/EIO/latency/torn
+        # renames/stale reads — with training, compaction, the serving
+        # fleet, and the pod lease all required to DEGRADE (retry,
+        # skip, step down, serve last-good) instead of crashing or
+        # wedging, and to recover bit-identically.
+        "storage_brownout": _subprocess_scenario(
+            "run_storage_brownout_scenario"),
+        "storage_blackout_recover": _subprocess_scenario(
+            "run_storage_blackout_recover_scenario"),
+        "enospc_compaction": _subprocess_scenario(
+            "run_enospc_compaction_scenario"),
+        "slow_lease_near_ttl": _subprocess_scenario(
+            "run_slow_lease_near_ttl_scenario"),
     }
 
 
@@ -340,6 +382,36 @@ def supervised_scenario_tmp():
 _NEEDS_HARNESS = ("nan_mask", "inf_mask", "huge_norm_mask",
                   "observe_rollback", "ckpt_truncate", "ckpt_bitflip",
                   "tmp_sweep")
+
+
+class _ScenarioTimeout(BaseException):
+    """A scenario overran --timeout-s (raised from the SIGALRM handler
+    so even a blocked subprocess wait unwinds). BaseException — the
+    KeyboardInterrupt pattern — so a scenario's own broad `except
+    Exception` recovery paths cannot swallow the timeout and leave the
+    sweep unbounded with a disarmed timer."""
+
+
+def _run_bounded(fn, harness, timeout_s: float):
+    """Run one scenario under a wall-clock bound. SIGALRM (not a
+    thread) so a scenario wedged inside a blocking syscall — the exact
+    failure mode the flag exists for — is interrupted; 0 disables.
+    Children a timed-out scenario leaks are the price of failing
+    loudly instead of hanging CI."""
+    if timeout_s <= 0:
+        return fn(harness)
+    import signal
+
+    def on_alarm(_sig, _frame):
+        raise _ScenarioTimeout()
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, timeout_s)
+    try:
+        return fn(harness)
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, old)
 
 
 def main(argv=None):
@@ -358,6 +430,17 @@ def main(argv=None):
                     help="print registered scenario names (one per "
                          "line) and exit — CI shards build their "
                          "--only sets from this instead of hardcoding")
+    ap.add_argument("--timeout-s", type=float, default=0.0,
+                    help="per-scenario wall-clock bound (0 = none): a "
+                         "wedged scenario fails LOUDLY under its own "
+                         "name instead of hanging the whole sweep "
+                         "(SIGALRM-interrupted, so even a blocked "
+                         "subprocess wait is bounded)")
+    ap.add_argument("--shard", default=None, metavar="K/N",
+                    help="run shard K of N (1-based) over the --list "
+                         "order, after --only filtering — CI splits "
+                         "the sweep across jobs without hardcoding "
+                         "scenario names")
     args = ap.parse_args(argv)
     if args.list:
         for name in scenarios:
@@ -369,10 +452,20 @@ def main(argv=None):
         ap.error(f"unknown scenario(s) {unknown}; "
                  f"known: {sorted(scenarios)}")
     names = [n for n in scenarios if not selected or n in selected]
+    if args.shard:
+        try:
+            k, n_shards = (int(x) for x in args.shard.split("/"))
+        except ValueError:
+            ap.error(f"--shard wants K/N (e.g. 2/4), got {args.shard!r}")
+        if not 1 <= k <= n_shards:
+            ap.error(f"--shard K must be in [1, N], got {args.shard!r}")
+        names = [nm for i, nm in enumerate(names)
+                 if i % n_shards == k - 1]
 
     harness = None
     certificate = None
-    if any(n in _NEEDS_HARNESS for n in names) or not selected:
+    if any(n in _NEEDS_HARNESS for n in names) or (not selected
+                                                   and not args.shard):
         mesh = make_ps_mesh()
         train, test = logreg_data()
         chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
@@ -387,7 +480,17 @@ def main(argv=None):
     results = {}
     detail = {}
     for name in names:
-        out = scenarios[name](harness)
+        try:
+            out = _run_bounded(scenarios[name], harness, args.timeout_s)
+        except _ScenarioTimeout:
+            # The loud-failure contract: the wedged scenario is NAMED
+            # in the digest and on stderr; the sweep moves on.
+            print(f"chaos_sweep: scenario {name} timed out after "
+                  f"{args.timeout_s}s", file=sys.stderr, flush=True)
+            results[name] = False
+            detail[name] = {"error": "timeout",
+                            "timeout_s": args.timeout_s}
+            continue
         ok, d = out if isinstance(out, tuple) else (out, None)
         results[name] = bool(ok)
         if d is not None:

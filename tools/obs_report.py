@@ -90,6 +90,13 @@ _INCIDENT_EVENTS = (
     # Runtime budget-drift detection (fps_tpu.obs.drift): measured
     # collective traffic departed from the AUDIT_r*.json pinned shape.
     "budget_drift",
+    # Hostile-filesystem degradation (fps_tpu.core.retry + the async
+    # writer's degraded mode): skipped publishes, aborted compactions,
+    # and the backlog-drain marker after storage recovery.
+    "checkpoint_degraded",
+    "checkpoint_backlog_drained",
+    "compaction_aborted",
+    "leader_io_error",
     # Pod coordination (journal-pod.jsonl, written into the pod dir by
     # the lease-holding member — point this tool at the pod dir and the
     # digest narrates the whole pod run).
@@ -420,6 +427,24 @@ def render_digest(obs_dir: str) -> dict:
         "health": dict(sorted(health.items())),
         "poisoned_chunks": int(counters.get("health.poisoned_chunks", 0)),
         "incidents": {k: v for k, v in incidents.items() if v},
+        # Hostile-filesystem survival (fps_tpu.core.retry + degraded-
+        # mode storage): retry traffic, skipped publishes + backlog
+        # (recency spent to keep training alive through a brownout),
+        # and read-plane polls that degraded to last-good state.
+        "storage": {
+            "retries": int(counters.get("storage.retries", 0)),
+            "degraded_publishes": int(
+                counters.get("storage.degraded_publishes", 0)),
+            "publish_backlog_last": gauges.get(
+                "checkpoint.publish_backlog", {}).get("last"),
+            "publish_backlog_max": gauges.get(
+                "checkpoint.publish_backlog", {}).get("max"),
+            "poll_errors": int(counters.get("storage.poll_errors", 0)),
+            "sidecar_skips": int(
+                counters.get("storage.sidecar_skips", 0)),
+            "compaction_aborts": int(
+                counters.get("storage.compaction_aborts", 0)),
+        },
         "checkpoint_saves": int(counters.get("checkpoint.saves", 0)),
         # Async writer: enqueued > saved means a write was still in
         # flight at the last flush — saves are the TRUE durability points.
