@@ -1476,6 +1476,13 @@ def _serve_ab_one(label, trainer, init_state, make_chunks,
         if qerr:
             raise RuntimeError(
                 f"serve[{label}] query load died mid-run") from qerr[0]
+        if qcount[0] == 0:
+            # BENCH_r14 class of bug: a load generator that never got a
+            # query through must FAIL the workload — a reported
+            # queries_per_sec of 0.0 is a dead reader, not a rate.
+            raise RuntimeError(
+                f"serve[{label}] reader_dead: query load finished with "
+                "0 queries served")
         if not any(t.is_alive() for t in threads):
             # Pick up the end-of-run flush's final snapshot — unless a
             # thread outlived its join timeout: poll() is
@@ -1866,7 +1873,12 @@ def run_delta(args):
     from fps_tpu.core.ingest import epoch_chunks
     from fps_tpu.models.matrix_factorization import MFConfig, online_mf
     from fps_tpu.parallel.mesh import default_mesh_shape, make_ps_mesh
-    from fps_tpu.serve import NoSnapshotError, ServingFleet, SnapshotWatcher
+    from fps_tpu.serve import (
+        NoSnapshotError,
+        ServingFleet,
+        SnapshotWatcher,
+        scan_heartbeats,
+    )
 
     devs = jax.devices()
     if len(devs) < 8:
@@ -2009,6 +2021,30 @@ def run_delta(args):
                     for r in fleet.readers}) == 1:
                 break
         fleet_stats = fleet.stats()
+        heartbeats = scan_heartbeats(d)
+        # Silent-zero guard (BENCH_r14): a reader that served nothing,
+        # or whose liveness beacon went stale relative to its peers, is
+        # DEAD — fail the workload instead of averaging a zero into the
+        # fleet rate. ("Stale" = older than the freshest beacon by more
+        # than the liveness timeout; wall-clock ages don't apply here
+        # because training has already stopped by the time we check.)
+        from fps_tpu.serve.fleet import DEFAULT_LIVENESS_TIMEOUT_S
+        newest_beat = max(
+            (hb["t"] for hb in heartbeats.values()), default=None)
+        dead = []
+        for i, r in enumerate(fleet.readers):
+            hb = heartbeats.get(r.reader_id)
+            stale = (hb is None or (
+                newest_beat is not None
+                and newest_beat - hb["t"] > DEFAULT_LIVENESS_TIMEOUT_S))
+            if qcounts[i] == 0 or stale:
+                dead.append({"reader": r.reader_id,
+                             "queries": qcounts[i],
+                             "heartbeat": hb})
+        if dead:
+            raise RuntimeError(
+                f"delta fleet reader_dead: {dead} — zero q/s or stale "
+                "heartbeat means a wedged reader, not a slow one")
 
     ratio = (full_arm["publish_bytes_total"]
              / max(delta_arm["publish_bytes_total"], 1))
@@ -2037,6 +2073,8 @@ def run_delta(args):
             "readers": readers,
             "converged_single_step": len(fence_steps) == 1,
             "queries_per_sec_total": round(sum(qcounts) / wall, 1),
+            "heartbeat_beacons": len(heartbeats),
+            "reader_dead": [],  # non-empty would have raised above
         },
     }
     print(
@@ -2237,11 +2275,224 @@ def run_storage(args):
     }
 
 
+def run_wire(args):
+    """Hostile-network wire A/B (docs/resilience.md "Hostile network"):
+    one fixed snapshot served over TCP three ways —
+
+    * **legacy**   — raw line-JSON over a plain socket (the pre-wire
+      protocol, still accepted by the dual-stack server for one
+      release);
+    * **framed**   — ``WireClient`` (versioned frames, CRC32, deadlines,
+      bounded retry) at the SAME request sequence and load;
+    * **brownout** — framed again, but under a deterministic
+      ``fps_tpu.testing.faultnet`` schedule (refused reconnects,
+      recurring mid-frame cuts, injected send latency) against an
+      admission-limited server with hammer threads forcing BUSY sheds.
+
+    Reported: framed-vs-legacy throughput ratio at equal load (framing
+    must not cost throughput), shed-rate / retry / reconnect /
+    torn-frame counts through the brownout, and RECOVERY BIT-IDENTITY:
+    every brownout response byte-identical to the clean framed run's
+    (retries and replays never corrupt or duplicate an answer)."""
+    import threading
+
+    from fps_tpu.serve import (
+        ReadServer,
+        ServableSnapshot,
+        TcpServe,
+        WireClient,
+    )
+    from fps_tpu.testing import faultnet
+    from fps_tpu.testing.faultnet import NetFaultRule
+
+    NROWS, RANK, N_REQ, N_WARM = 4096, 16, 300, 10
+    rng = np.random.default_rng(0)
+    tables = {"weights": rng.normal(
+        size=(NROWS, RANK)).astype(np.float32)}
+
+    def make_server():
+        server = ReadServer()
+        server.swap_to(ServableSnapshot(7, "bench-wire", tables, [],
+                                        "none"))
+        return server
+
+    reqs = [{"op": "pull", "table": "weights",
+             "ids": rng.integers(0, NROWS, 64).tolist()}
+            for _ in range(N_REQ)]
+
+    def drive(client):
+        """Warm up, then time the fixed sequence; returns
+        (queries_per_sec, [response dicts])."""
+        for r in reqs[:N_WARM]:
+            client.request(r)
+        resps = []
+        t0 = time.perf_counter()
+        for r in reqs:
+            resps.append(client.request(r))
+        wall = time.perf_counter() - t0
+        if not resps or any(not r.get("ok") for r in resps):
+            raise RuntimeError("wire bench arm produced a failed or "
+                               "empty response — that is an error, "
+                               "not a rate")
+        return round(N_REQ / wall, 1), resps
+
+    class _LineClient:
+        """The ACTUAL old protocol (JsonlClient is a framed shim now):
+        one JSON object per line, raw socket."""
+
+        def __init__(self, host, port):
+            import socket
+
+            self._sock = socket.create_connection((host, port),
+                                                  timeout=10.0)
+            self._sock.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
+            self._rfile = self._sock.makefile("rb")
+
+        def request(self, req):
+            self._sock.sendall(json.dumps(req).encode("utf-8") + b"\n")
+            line = self._rfile.readline()
+            if not line:
+                raise ConnectionError("server closed the connection")
+            return json.loads(line)
+
+        def close(self):
+            self._rfile.close()
+            self._sock.close()
+
+    # -- clean arms: legacy vs framed against one healthy server.
+    # Interleaved rounds, median of the PAIRED per-round ratios:
+    # absolute localhost throughput drifts far more run-to-run than
+    # the few-percent protocol delta under measurement, but both arms
+    # of one round share the same box conditions, so the paired ratio
+    # is the stable quantity.
+    N_ROUNDS = 5
+    rounds = []
+    legacy_resps = framed_resps = None
+    with TcpServe(make_server()) as tcp:
+        for _ in range(N_ROUNDS):
+            legacy = _LineClient(tcp.host, tcp.port)
+            lq, legacy_resps = drive(legacy)
+            legacy.close()
+            with WireClient(tcp.host, tcp.port) as wc:
+                fq, framed_resps = drive(wc)
+            rounds.append((fq / lq, lq, fq))
+        clean_stats = tcp.wire_stats()
+    rounds.sort()
+    _, legacy_qps, framed_qps = rounds[len(rounds) // 2]
+
+    # -- brownout arm: deterministic net faults on the measured client
+    # ("client" stream; the hammer threads get their own peer class so
+    # the schedule stays replayable) + admission-limited server.
+    brownout_rules = [
+        # The measured client's first two RECONNECT attempts are
+        # refused (connect #0 is the constructor): a reconnect storm
+        # that must back off and then resume under the same req_id.
+        NetFaultRule("client", "connect", "refuse", start=1, count=2),
+        # Recurring mid-frame cuts: torn frames the server must count
+        # and never decode; the client reconnects and resends.
+        NetFaultRule("client", "send", "cut", cut_bytes=6, start=10,
+                     count=None, every=25),
+        # Background send latency (congested path).
+        NetFaultRule("client", "send", "delay", delay_s=0.001,
+                     start=0, count=None, every=7),
+    ]
+    net = faultnet.install(brownout_rules, seed=0)
+    try:
+        with TcpServe(make_server()) as tcp:
+            wc = WireClient(tcp.host, tcp.port, peer_class="client")
+            brown_qps, brown_resps = drive(wc)
+            wc.close()
+            brown_stats = tcp.wire_stats()
+    finally:
+        faultnet.uninstall()
+
+    # -- load-shed phase: an admission-limited server (max_inflight=1)
+    # whose ONLY execution slot is wedged for a window — every request
+    # arriving during the wedge is shed with a retryable BUSY that the
+    # hammers' WireClients absorb through their retry budgets; after
+    # the slot frees, the same clients recover and get served. Lost
+    # WORK, never corruption (docs/STALENESS.md).
+    server = make_server()
+    with TcpServe(server, max_inflight=1) as tcp:
+        stop = threading.Event()
+        busy_counts = [0] * 3
+
+        def hammer(idx):
+            hc = WireClient(tcp.host, tcp.port, peer_class="hammer")
+            while not stop.is_set():
+                try:
+                    hc.request(reqs[0])
+                except Exception:  # noqa: BLE001 — shed work is lost work
+                    continue
+            busy_counts[idx] = hc.busy_rejections
+            hc.close()
+
+        hammers = [threading.Thread(target=hammer, args=(i,),
+                                    daemon=True,
+                                    name=f"bench-wire-hammer-{i}")
+                   for i in range(3)]
+        tcp._inflight.acquire()  # wedge the slot: full house
+        for t in hammers:
+            t.start()
+        time.sleep(0.5)
+        tcp._inflight.release()  # brownout lifts; clients recover
+        time.sleep(0.5)
+        stop.set()
+        for t in hammers:
+            t.join(timeout=10.0)
+        shed_stats = tcp.wire_stats()
+        served = server.requests
+
+    shed_rate = (shed_stats["shed_requests"]
+                 / max(shed_stats["shed_requests"] + served, 1))
+    out = {
+        "rows": NROWS, "requests": N_REQ,
+        "legacy": {"queries_per_sec": legacy_qps},
+        "framed": {"queries_per_sec": framed_qps,
+                   "wire_stats": clean_stats},
+        "brownout": {
+            "queries_per_sec": brown_qps,
+            "client_retries": wc.retries,
+            "client_reconnects": wc.reconnects,
+            "wire_stats": brown_stats,
+            "injected": dict((f"{k[0]}/{k[1]}/{k[2]}", v) for k, v in
+                             net.injected_counts().items()),
+        },
+        "loadshed": {
+            "shed_rate": round(shed_rate, 4),
+            "shed_requests": shed_stats["shed_requests"],
+            "served_requests": int(served),
+            "client_busy_rejections": sum(busy_counts),
+        },
+        "framed_vs_legacy": round(framed_qps / legacy_qps, 4),
+        "responses_bit_identical": bool(
+            legacy_resps == framed_resps == brown_resps),
+    }
+    print(
+        f"wire A/B: legacy {legacy_qps:.0f} q/s -> framed "
+        f"{framed_qps:.0f} q/s ({out['framed_vs_legacy']}x); brownout "
+        f"{brown_qps:.0f} q/s with {wc.retries} retries / "
+        f"{wc.reconnects} reconnects / "
+        f"{brown_stats['torn_frames']} torn frames; shed rate "
+        f"{out['loadshed']['shed_rate']} "
+        f"({shed_stats['shed_requests']} shed / {served} served), "
+        f"responses bit-identical "
+        f"{out['responses_bit_identical']}", file=sys.stderr)
+    return {
+        "metric": "wire_framed_vs_legacy_qps",
+        "value": out["framed_vs_legacy"],
+        "unit": "x_legacy_throughput",
+        "vs_baseline": out["framed_vs_legacy"],
+        **out,
+    }
+
+
 RUNNERS = {"mf": run_mf, "w2v": run_w2v, "logreg": run_logreg,
            "pa": run_pa, "ials": run_ials, "tiered": run_tiered,
            "tiered_drift": run_tiered_drift, "serve": run_serve,
            "megastep": run_megastep_ab, "delta": run_delta,
-           "storage": run_storage}
+           "storage": run_storage, "wire": run_wire}
 
 
 def compact_summary(results):
@@ -2303,7 +2554,7 @@ def main():
     ap.add_argument("--workload", default="all",
                     choices=["all", "mf", "w2v", "logreg", "pa", "ials",
                              "tiered", "tiered_drift", "serve",
-                             "megastep", "delta", "storage"])
+                             "megastep", "delta", "storage", "wire"])
     ap.add_argument("--scale", default="20m", choices=["100k", "1m", "20m"])
     ap.add_argument("--rank", type=int, default=10)
     ap.add_argument("--local-batch", type=int, default=32768)
@@ -2329,7 +2580,7 @@ def main():
     if args.workload == "all":
         # Headline (mf) LAST among the per-workload lines.
         order = ["w2v", "logreg", "pa", "ials", "tiered", "tiered_drift",
-                 "serve", "megastep", "delta", "storage", "mf"]
+                 "serve", "megastep", "delta", "storage", "wire", "mf"]
     else:
         order = [args.workload]
     results = {}

@@ -44,6 +44,13 @@ some cases shipped and fixed) before:
   nothing, and two traces of the "same" program differ. Host timing
   belongs in ``PhaseTimer`` (``fps_tpu.obs.timing``), outside the
   builders; device timing belongs to the profiler.
+* **FPS008 raw-socket-use** — ``socket.socket()`` /
+  ``socket.create_connection()`` outside ``fps_tpu/serve/`` (where the
+  framed wire layer lives). A raw socket dodges the per-request
+  deadlines, classified bounded retry, and request-id dedupe the
+  hostile-network model guarantees — one naked ``recv`` against a
+  partitioned peer wedges its caller forever. Speak
+  ``fps_tpu.serve.wire.WireClient``.
 
 Suppression: append ``# noqa: FPSNNN`` to the flagged line — but the
 tier-1 test runs this linter over ``fps_tpu/`` expecting zero findings,
@@ -94,6 +101,9 @@ RULES = {
     "FPS007": "host clock call (time.time/perf_counter/...) inside a "
               "compiled-fn builder — it bakes a trace-time constant "
               "into the program; host timing stays in PhaseTimer",
+    "FPS008": "raw socket use outside fps_tpu/serve/ — every caller "
+              "goes through the framed WireClient (deadlines, bounded "
+              "retry, idempotent reconnect)",
 }
 
 # Calls whose presence makes a function (and everything lexically inside
@@ -125,6 +135,15 @@ _CKPT_TOKENS = ("ckpt", "snapshot")
 _CKPT_READER_PATHS = ("fps_tpu/core/checkpoint.py",
                       "fps_tpu/core/snapshot_format.py")
 _CKPT_READER_DIRS = ("fps_tpu/serve/",)
+
+# FPS008: raw socket constructors; only the wire/net modules under
+# fps_tpu/serve/ may call them — everything else speaks the framed
+# protocol through WireClient (docs/serving.md). Both the dotted and
+# the `from socket import ...` bare forms are flagged.
+_RAW_SOCKET_CALLS = {
+    "socket.socket", "socket.create_connection", "create_connection",
+}
+_SOCKET_OK_DIRS = ("fps_tpu/serve/",)
 
 _SYNC_PRIMITIVES = {
     "Lock", "RLock", "Condition", "Event", "Semaphore",
@@ -198,6 +217,8 @@ class _Linter(ast.NodeVisitor):
         self.is_ckpt_reader = (
             any(norm.endswith(p) for p in _CKPT_READER_PATHS)
             or any(d in norm for d in _CKPT_READER_DIRS))
+        # FPS008 exemption: the wire/net modules ARE the framed layer.
+        self.is_wire_module = any(d in norm for d in _SOCKET_OK_DIRS)
         # FPS001: stack of (loop_node, target_names) we are inside of.
         self._loops: list[tuple[ast.AST, set[str]]] = []
         # FPS003: depth of enclosing compiled-fn-builder functions.
@@ -273,6 +294,16 @@ class _Linter(ast.NodeVisitor):
                     "the CRC-verified readers (Checkpointer.read_snapshot, "
                     "snapshot_format.verify_snapshot_file + "
                     "map_snapshot_arrays, or fps_tpu.serve)")
+        # FPS008: raw sockets outside the wire layer dodge deadlines,
+        # bounded retry, and the idempotent reconnect contract.
+        if (not self.is_wire_module
+                and _call_name(node) in _RAW_SOCKET_CALLS):
+            self._add(
+                "FPS008", node,
+                f"{_call_name(node)}() outside fps_tpu/serve/ — speak "
+                "the framed wire through fps_tpu.serve.wire.WireClient "
+                "(per-request deadlines, classified bounded retry, "
+                "request-id dedupe on reconnect)")
         self.generic_visit(node)
 
     # -- FPS002 -----------------------------------------------------------

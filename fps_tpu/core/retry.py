@@ -44,6 +44,9 @@ __all__ = [
     "RetryPolicy", "call_with_retry", "DEFAULT_PUBLISH_RETRY",
     "classify_path", "fault_check", "read_path", "install_injector",
     "remove_injector", "get_injector", "FAULTFS_ENV",
+    "RETRYABLE_NET_ERRNOS", "classify_net", "DEFAULT_NET_RETRY",
+    "net_fault_check", "install_net_injector", "remove_net_injector",
+    "get_net_injector", "FAULTNET_ENV",
 ]
 
 # ---------------------------------------------------------------------------
@@ -79,6 +82,43 @@ def classify_error(err: BaseException) -> str:
     fence refusal, a corruption error) — is fatal: retrying an error we
     do not understand hides bugs behind latency."""
     if isinstance(err, OSError) and err.errno in RETRYABLE_ERRNOS:
+        return "retryable"
+    return "fatal"
+
+
+# The wire plane's transient errnos: a refused/reset/unreachable peer
+# or a timed-out socket may answer on the next attempt (the server is
+# restarting, a partition is healing, a kernel buffer drained). These
+# are DISJOINT in spirit from the filesystem set above — a serve client
+# must never treat EACCES-on-connect as transient.
+RETRYABLE_NET_ERRNOS = frozenset({
+    _errno.ECONNREFUSED, _errno.ECONNRESET, _errno.ECONNABORTED,
+    _errno.EPIPE, _errno.ETIMEDOUT, _errno.EAGAIN, _errno.EINTR,
+    _errno.EHOSTUNREACH, _errno.ENETUNREACH, _errno.ENETDOWN,
+    _errno.ENETRESET, _errno.EADDRNOTAVAIL,
+})
+
+
+def classify_net(err: BaseException) -> str:
+    """The wire twin of :func:`classify_error`: ``"retryable"`` or
+    ``"fatal"`` for one network exception. Retryable: socket timeouts
+    (``TimeoutError`` covers ``socket.timeout`` since 3.10), the
+    connection-lifecycle OSError subclasses (refused / reset / aborted /
+    broken pipe — the peer may be mid-restart), and OSErrors carrying a
+    transient network errno. ``EOFError``/``ConnectionError`` raised by
+    a framing layer on a half-closed peer is retryable for the same
+    reason: reconnect-and-resend (with idempotent request ids) is the
+    correct response. Everything else — protocol violations, CRC
+    failures, application errors — is fatal: retrying a malformed
+    conversation hides bugs behind latency."""
+    if isinstance(err, TimeoutError):
+        return "retryable"
+    if isinstance(err, (ConnectionError, EOFError)):
+        # ConnectionRefusedError/ConnectionResetError/BrokenPipeError/
+        # ConnectionAbortedError plus the bare ConnectionError a client
+        # raises on an empty read (peer closed mid-conversation).
+        return "retryable"
+    if isinstance(err, OSError) and err.errno in RETRYABLE_NET_ERRNOS:
         return "retryable"
     return "fatal"
 
@@ -134,6 +174,14 @@ class RetryPolicy:
 DEFAULT_PUBLISH_RETRY = RetryPolicy(retries=3, base_s=0.02,
                                     max_backoff_s=0.25, deadline_s=10.0)
 
+# The wire-plane default: more retries than the write plane (a serve
+# request is cheap to resend and the request-id dedupe makes resends
+# idempotent) but a tighter per-call deadline — a query client must
+# degrade to "serving unavailable" in seconds, not hold a bench or a
+# reader hostage for the filesystem plane's 10s.
+DEFAULT_NET_RETRY = RetryPolicy(retries=5, base_s=0.02,
+                                max_backoff_s=0.5, deadline_s=5.0)
+
 
 def call_with_retry(fn, *, policy: RetryPolicy, op: str = "",
                     on_retry=None, classify=classify_error,
@@ -175,6 +223,7 @@ _PATH_CLASSES = (
     ("control", re.compile(
         r"pod_control\.json|pod_state\.json|supervisor_state\.json")),
     ("journal", re.compile(r"(journal|events)-.*\.jsonl")),
+    ("liveness", re.compile(r"heartbeat_.*\.json")),
     ("snapshot", re.compile(
         r"ckpt_\d+\.npz|delta_\d+_\d+\.npz|.*\.tmp\.npz|.*\.corrupt")),
 )
@@ -182,9 +231,9 @@ _PATH_CLASSES = (
 
 def classify_path(path: str) -> str:
     """The storage plane ``path`` belongs to: ``lease`` / ``fence`` /
-    ``sidecar`` / ``control`` / ``journal`` / ``snapshot`` / ``other``.
-    Matches on the basename only — directories never change a file's
-    plane."""
+    ``sidecar`` / ``control`` / ``journal`` / ``liveness`` /
+    ``snapshot`` / ``other``. Matches on the basename only —
+    directories never change a file's plane."""
     name = os.path.basename(path.rstrip("/\\"))
     for cls, pat in _PATH_CLASSES:
         if pat.fullmatch(name):
@@ -280,3 +329,82 @@ def fault_check(op: str, path: str, *, path_class: str | None = None):
     if inj is None:
         return None
     return inj.check(op, path_class or classify_path(path), path)
+
+
+# ---------------------------------------------------------------------------
+# The network fault seam (the wire twin of the above).
+# ---------------------------------------------------------------------------
+
+FAULTNET_ENV = "FPS_TPU_FAULTNET"
+
+_net_injector = None
+_net_env_checked = False
+
+
+def install_net_injector(inj) -> None:
+    """Install ``inj`` as the process-global NETWORK fault injector.
+    Its ``check(op, peer_class)`` is consulted by every socket seam in
+    :mod:`fps_tpu.serve.wire` / :mod:`fps_tpu.serve.net`; see
+    :mod:`fps_tpu.testing.faultnet` for the reference implementation.
+    Passing None uninstalls."""
+    global _net_injector
+    _net_injector = inj
+
+
+def remove_net_injector() -> None:
+    install_net_injector(None)
+
+
+def get_net_injector():
+    """The installed network injector, activating the
+    :data:`FAULTNET_ENV` contract lazily on first call — a subprocess
+    launched with ``FPS_TPU_FAULTNET=<json-or-path>`` self-installs the
+    described schedule, exactly like the faultfs env hook. Returns None
+    when no injector is configured."""
+    global _net_env_checked, _net_injector
+    if _net_injector is None and not _net_env_checked:
+        _net_env_checked = True
+        spec = os.environ.get(FAULTNET_ENV)
+        if spec:
+            _net_injector = _load_env_net_injector(spec)
+    return _net_injector
+
+
+def _load_env_net_injector(spec: str):
+    """Build a FaultNet from the env spec — faultnet.py loaded by FILE
+    path (stdlib-only, like this module), so env activation works in
+    jax-free agents and stub-root serving processes alike."""
+    import importlib.util as _ilu
+    import sys as _sys
+
+    mod = _sys.modules.get("fps_tpu.testing.faultnet")
+    if mod is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "testing", "faultnet.py")
+        ld = _ilu.spec_from_file_location("_fps_faultnet", path)
+        mod = _ilu.module_from_spec(ld)
+        _sys.modules[ld.name] = mod
+        ld.loader.exec_module(mod)
+    return mod.FaultNet.from_spec(spec)
+
+
+def net_fault_check(op: str, peer_class: str):
+    """The wire seam: called immediately before a framework socket
+    operation. ``op`` is one of ``connect`` / ``accept`` / ``send`` /
+    ``recv``; ``peer_class`` names which conversation the socket
+    belongs to (``"serve"`` for query traffic, ``"fleet"`` for
+    reader-side sockets — the injector's targeting unit, like
+    faultfs's path classes). With no injector installed this is one
+    module-attribute read. An injector may raise (connect-refused,
+    reset), sleep (read/write delay), or return a directive the seam
+    honors: ``("cut", nbytes)`` — send only a prefix then drop the
+    connection, the torn-frame producer; ``("trickle", chunk, delay_s)``
+    — slow-peer byte-trickle; ``"drop"`` — accept seams close the
+    connection unserved (one-way partition). Seams that get a directive
+    they do not implement ignore it."""
+    inj = (_net_injector if _net_injector is not None
+           else get_net_injector())
+    if inj is None:
+        return None
+    return inj.check(op, peer_class)

@@ -62,15 +62,26 @@ _WINDOW_COUNTERS = (
     # staleness budget; degraded read-plane polls count liveness cost.
     "storage.degraded_publishes",
     "storage.poll_errors",
+    # Hostile-network survival (fps_tpu.serve.wire / serve.net): shed
+    # requests burn the shed-rate SLO; retries/torn frames quantify how
+    # hard the wire is fighting back.
+    "net.shed_requests",
+    "net.retries",
+    "net.torn_frames",
+    "serve.requests",
 )
 # Gauge/sample names kept as (t, value) series for per-window max/last.
 # serve.fence_step feeds the fleet fence-lag rollup: the fence's last
 # published step per window, compared against the newest
 # checkpoint_saved step the trainers reported by then.
-_WINDOW_SAMPLES = ("serve.write_to_servable_s", "serve.fence_step")
+# serve.reader_heartbeat_age_s feeds the heartbeat-staleness SLO: worst
+# beacon age per window across readers.
+_WINDOW_SAMPLES = ("serve.write_to_servable_s", "serve.fence_step",
+                   "serve.reader_heartbeat_age_s")
 # Journal events counted per window.
 _WINDOW_EVENTS = ("pod_restart", "supervisor_restart", "budget_drift",
-                  "checkpoint_fenced", "checkpoint_degraded")
+                  "checkpoint_fenced", "checkpoint_degraded",
+                  "reader_wedged")
 
 
 def _read_jsonl(path):
@@ -155,6 +166,7 @@ def _window_stats(series_by_host, t0, t1) -> dict:
     ev = {n: 0 for n in _WINDOW_EVENTS}
     fresh = []
     fence_lag = None
+    hb_age_max = None
     # The fence-lag reference: newest step ANY trainer durably
     # published by the end of this window (fence readers lag it by
     # design; the SLO bounds by how much).
@@ -178,6 +190,13 @@ def _window_stats(series_by_host, t0, t1) -> dict:
         if fence_last is not None and newest_pub is not None:
             lag = max(0.0, float(newest_pub) - float(fence_last))
             fence_lag = lag if fence_lag is None else max(fence_lag, lag)
+        # Heartbeat staleness: worst beacon age seen in the window
+        # across every reader on every host — one wedged reader burns
+        # the SLO.
+        for t, v in series["samples"]["serve.reader_heartbeat_age_s"]:
+            if t0 <= t < t1 and math.isfinite(v):
+                hb_age_max = (v if hb_age_max is None
+                              else max(hb_age_max, v))
         for name, ts in series["events"].items():
             ev[name] += sum(1 for t in ts if t0 <= t < t1)
     dt = max(t1 - t0, 1e-9)
@@ -209,6 +228,18 @@ def _window_stats(series_by_host, t0, t1) -> dict:
         "storage_poll_errors": int(c["storage.poll_errors"]),
         "fence_lag_steps": (round(fence_lag, 1)
                             if fence_lag is not None else None),
+        # Hostile-network survival: shed RATE is sheds over sheds +
+        # served (None when the wire moved no traffic in the window —
+        # neither good nor bad for the SLO).
+        "net_shed_requests": int(c["net.shed_requests"]),
+        "net_retries": int(c["net.retries"]),
+        "net_torn_frames": int(c["net.torn_frames"]),
+        "net_shed_rate": _ratio(
+            c["net.shed_requests"],
+            c["net.shed_requests"] + c["serve.requests"]),
+        "reader_heartbeat_age_s_max": (
+            round(hb_age_max, 3) if hb_age_max is not None else None),
+        "reader_wedged_incidents": ev["reader_wedged"],
     }
 
 
@@ -316,6 +347,21 @@ DEFAULT_SLOS = (
     SLO("serve_fence_lag", "fence_lag_steps", "<=", 8.0, objective=0.75,
         description="fleet fence (serve.fence_step) within budget of "
                     "the newest checkpoint_saved step"),
+    # Hostile-network survival (docs/resilience.md "Hostile network"):
+    # shedding is the wire's staleness-budget twin — lost WORK spent
+    # deliberately to bound latency, never lost correctness; sustained
+    # burn means capacity (not the framework) needs attention.
+    SLO("net_shed_rate", "net_shed_rate", "<=", 0.05, objective=0.75,
+        description="share of wire requests shed with BUSY by "
+                    "admission control (load lost to keep the serving "
+                    "plane bounded)"),
+    # A beacon older than the liveness timeout in any window means a
+    # reader sat wedged (SIGSTOP, deadlock, partition) — the incident
+    # the supervisor must act on, never a silent 0 q/s (BENCH_r14).
+    SLO("reader_heartbeat_fresh", "reader_heartbeat_age_s_max", "<=",
+        5.0, objective=0.75,
+        description="worst fleet-reader liveness-beacon age per window "
+                    "within the reader_wedged timeout"),
 )
 
 

@@ -336,6 +336,41 @@ def default_registry() -> MetricsRegistry:
                         "forward-monotone fleet-wide within a fencing "
                         "epoch; backward only on a coordinated "
                         "quarantine rollback (epoch bump)"),
+        MetricSpec("serve.reader_heartbeat_age_s", "gauge", unit="s",
+                   labels=("reader",),
+                   help="age of a fleet reader's newest liveness "
+                        "beacon at the last liveness pass "
+                        "(fps_tpu.serve.fleet.liveness_check); beyond "
+                        "the liveness timeout the reader is classified "
+                        "reader_wedged — an incident, never a silent "
+                        "0 q/s (BENCH_r14)"),
+        # Wire plane (fps_tpu.serve.wire / serve.net; docs/resilience.md
+        # "Hostile network").
+        MetricSpec("net.retries", "counter", unit="requests",
+                   labels=("peer_class",),
+                   help="wire requests re-sent after a transient "
+                        "network failure (classify_net: refused / "
+                        "reset / timeout / torn frame), on the bounded "
+                        "sha256-jittered backoff schedule"),
+        MetricSpec("net.reconnects", "counter", unit="connections",
+                   help="client reconnects that re-handshook and "
+                        "resumed under the same session id (resends "
+                        "dedupe server-side by (session, req_id))"),
+        MetricSpec("net.torn_frames", "counter", unit="frames",
+                   help="inbound frames rejected by the length/CRC "
+                        "gates (short read, bad magic, checksum "
+                        "mismatch) — counted and dropped with the "
+                        "connection, NEVER decoded"),
+        MetricSpec("net.shed_requests", "counter", unit="requests",
+                   help="requests shed with a retryable BUSY frame by "
+                        "admission control (bounded in-flight queue) — "
+                        "the shed-rate SLO burns on this; lost work, "
+                        "never lost correctness"),
+        MetricSpec("net.deadline_exceeded", "counter", unit="requests",
+                   help="requests abandoned on an exhausted deadline "
+                        "budget — client side (retry budget ran out "
+                        "inside the per-request deadline) or server "
+                        "side (dead-on-arrival envelope)"),
         # Program contract auditor (fps_tpu.analysis; Trainer(audit=...)).
         MetricSpec("analysis.certified_programs", "counter",
                    unit="programs",

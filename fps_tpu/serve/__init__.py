@@ -29,25 +29,41 @@ from fps_tpu.serve.fleet import (
     FleetReader,
     ServingFleet,
     StepFence,
+    liveness_check,
+    scan_heartbeats,
     tiering_hot_ids,
 )
 from fps_tpu.serve.net import JsonlClient, TcpServe, handle_request
 from fps_tpu.serve.server import NoSnapshotError, ReadServer
 from fps_tpu.serve.snapshot import DeltaView, ServableSnapshot, SnapshotRejected
 from fps_tpu.serve.watcher import SnapshotWatcher
+from fps_tpu.serve.wire import (
+    ProtocolVersionError,
+    ServerBusyError,
+    TornFrameError,
+    WireClient,
+    WireError,
+)
 
 __all__ = [
     "DeltaView",
     "FleetReader",
     "JsonlClient",
     "NoSnapshotError",
+    "ProtocolVersionError",
     "ReadServer",
     "ServableSnapshot",
+    "ServerBusyError",
     "ServingFleet",
     "SnapshotRejected",
     "SnapshotWatcher",
     "StepFence",
     "TcpServe",
+    "TornFrameError",
+    "WireClient",
+    "WireError",
     "handle_request",
+    "liveness_check",
+    "scan_heartbeats",
     "tiering_hot_ids",
 ]

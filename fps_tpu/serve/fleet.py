@@ -56,13 +56,21 @@ from fps_tpu.core import retry as _retry
 from fps_tpu.core import snapshot_format as fmt
 from fps_tpu.serve.snapshot import ServableSnapshot, SnapshotRejected
 from fps_tpu.serve.server import ReadServer
-from fps_tpu.serve.watcher import SnapshotWatcher, _emit_metric
+from fps_tpu.serve.watcher import SnapshotWatcher, _emit_event, \
+    _emit_metric
 
 __all__ = ["StepFence", "FleetReader", "ServingFleet",
-           "tiering_hot_ids"]
+           "tiering_hot_ids", "scan_heartbeats", "liveness_check"]
 
 FLEET_DIR = "fleet"
 FENCE_NAME = "serve_fence.json"
+
+# Liveness defaults: beacons ride the fleet dir (atomic-rename JSON like
+# everything here) at HEARTBEAT_INTERVAL_S; a reader whose newest beacon
+# is older than DEFAULT_LIVENESS_TIMEOUT_S is classified reader_wedged —
+# an INCIDENT the supervisor restarts, never a silent 0 q/s (BENCH_r14).
+HEARTBEAT_INTERVAL_S = 1.0
+DEFAULT_LIVENESS_TIMEOUT_S = 5.0
 
 
 def _atomic_write_json(path: str, obj: dict) -> None:
@@ -289,7 +297,8 @@ class FleetReader:
 
     def __init__(self, ckpt_dir: str, reader_id: str, *, quorum: int = 1,
                  journal: str | None = None, recorder=None,
-                 warm_from=None, verify: bool = True):
+                 warm_from=None, verify: bool = True,
+                 heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S):
         self.ckpt_dir = ckpt_dir
         self.reader_id = str(reader_id)
         self.quorum = int(quorum)
@@ -304,6 +313,16 @@ class FleetReader:
         self.fence_swaps = 0
         self.poll_errors = 0  # transient poll failures (loop survives)
         self.served_steps: list[int] = []  # trail for the chaos harness
+        # Liveness beacon state: throttled (one fsync'd rename per
+        # interval, not per poll tick — the same churn argument as
+        # StepFence.ready), best-effort (a storage fault skips one
+        # beacon, counted, and the next interval retries — a brownout
+        # must not impersonate a wedged reader any longer than it
+        # actually lasts).
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self._last_hb = 0.0
+        self.hb_errors = 0
+        self.polls = 0
         self.watcher = SnapshotWatcher(
             ckpt_dir, journal=journal, recorder=recorder,
             on_swap=self._on_candidate, verify=verify)
@@ -343,8 +362,9 @@ class FleetReader:
         filesystem errors degrade (served state unchanged, counted in
         ``poll_errors`` / ``storage.poll_errors{plane=fleet}``) —
         a storage brownout must never freeze or crash a reader."""
+        self.polls += 1
         try:
-            return self._poll_once()
+            served = self._poll_once()
         except OSError as e:
             self.poll_errors += 1
             _emit_metric(self.recorder, "inc", "storage.poll_errors", 1,
@@ -353,7 +373,42 @@ class FleetReader:
                 "fleet reader %s poll degraded (serving last-good): %r",
                 self.reader_id, e)
             snap = self.server._snap
-            return None if snap is None else snap.step
+            served = None if snap is None else snap.step
+        # Beacon AFTER the poll body, degraded or not: liveness means
+        # "this reader's loop is turning", not "storage is healthy" —
+        # a reader surviving a brownout is alive, a SIGSTOPped or
+        # deadlocked one is not, and only the latter must trip the
+        # reader_wedged classification.
+        self._beat(served)
+        return served
+
+    # -- liveness beacon ----------------------------------------------------
+
+    @property
+    def heartbeat_path(self) -> str:
+        return os.path.join(self.fence.dir,
+                            f"heartbeat_{self.reader_id}.json")
+
+    def _beat(self, served) -> None:
+        now = time.time()
+        if now - self._last_hb < self.heartbeat_interval_s:
+            return
+        beat = {"reader": self.reader_id, "t": now,
+                "step": None if served is None else int(served),
+                "requests": int(self.server.requests),
+                "polls": int(self.polls)}
+        try:
+            _atomic_write_json(self.heartbeat_path, beat)
+        except OSError:
+            self.hb_errors += 1  # best-effort: next interval retries
+            return
+        self._last_hb = now
+        # The beacon rides the obs journal too, so a journal-only
+        # post-mortem (obs_report) can reconstruct per-reader liveness
+        # without the fleet dir.
+        _emit_event(self.recorder, "serve.reader_heartbeat",
+                    reader=self.reader_id, step=beat["step"],
+                    requests=beat["requests"])
 
     def _poll_once(self) -> int | None:
         self.watcher.poll()
@@ -462,6 +517,7 @@ class ServingFleet:
         ]
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        self._interval_s = 0.05
 
     def poll(self) -> None:
         for r in self.readers:
@@ -471,32 +527,33 @@ class ServingFleet:
         """One polling thread per reader (the fleet topology in one
         process). ``stop()`` joins them."""
         self._stop.clear()
-
-        def loop(reader):
-            import logging
-
-            log = logging.getLogger("fps_tpu.serve.fleet")
-            while not self._stop.is_set():
-                try:
-                    reader.poll()
-                except Exception:  # noqa: BLE001 — the loop must live
-                    # A transient shared-filesystem error (ENOSPC/NFS
-                    # hiccup in the fence/readiness writes) must not
-                    # silently kill the poller and freeze this reader on
-                    # a stale snapshot while its peers move on — log,
-                    # count, retry next tick.
-                    reader.poll_errors += 1
-                    log.exception("fleet reader %s poll failed "
-                                  "(retrying)", reader.reader_id)
-                self._stop.wait(interval_s)
-
+        self._interval_s = interval_s
         self._threads = [
-            threading.Thread(target=loop, args=(r,), daemon=True,
+            threading.Thread(target=self._loop, args=(r,), daemon=True,
                              name=f"fps-fleet-{r.reader_id}")
             for r in self.readers
         ]
         for t in self._threads:
             t.start()
+
+    def _loop(self, reader) -> None:
+        # A method (not a start() closure) so check_liveness can spawn
+        # a REPLACEMENT thread for a wedged reader through the same
+        # code path.
+        log = logging.getLogger("fps_tpu.serve.fleet")
+        while not self._stop.is_set():
+            try:
+                reader.poll()
+            except Exception:  # noqa: BLE001 — the loop must live
+                # A transient shared-filesystem error (ENOSPC/NFS
+                # hiccup in the fence/readiness writes) must not
+                # silently kill the poller and freeze this reader on
+                # a stale snapshot while its peers move on — log,
+                # count, retry next tick.
+                reader.poll_errors += 1
+                log.exception("fleet reader %s poll failed "
+                              "(retrying)", reader.reader_id)
+            self._stop.wait(self._interval_s)
 
     def stop(self, timeout: float = 10.0) -> None:
         self._stop.set()
@@ -506,3 +563,102 @@ class ServingFleet:
 
     def stats(self) -> list[dict]:
         return [r.stats() for r in self.readers]
+
+    def check_liveness(self, *,
+                       timeout_s: float = DEFAULT_LIVENESS_TIMEOUT_S,
+                       recorder=None, now=None) -> dict:
+        """One liveness pass over this fleet's beacons:
+        ``{"ages": {reader: age_s}, "wedged": [...], "restarted":
+        [...]}``. Wedged readers whose polling THREAD has died are
+        restarted in place (a replacement thread over the same
+        FleetReader — its boot protocol re-reads the fence, so the
+        restart never regresses). A thread that is still alive but
+        silent (stuck in a blocked syscall) cannot be safely doubled
+        up in-process: it is reported as the ``reader_wedged``
+        incident and left to the process supervisor, exactly like a
+        SIGSTOPped reader process."""
+        ckpt_dir = self.readers[0].ckpt_dir
+        rec = recorder if recorder is not None else (
+            self.readers[0].recorder)
+        report = liveness_check(
+            ckpt_dir, timeout_s=timeout_s, recorder=rec, now=now,
+            expected=[r.reader_id for r in self.readers])
+        restarted = []
+        if self._threads and report["wedged"]:
+            by_id = {r.reader_id: i for i, r in enumerate(self.readers)}
+            for reader_id in report["wedged"]:
+                i = by_id.get(reader_id)
+                if i is None or self._threads[i].is_alive():
+                    continue
+                reader = self.readers[i]
+                t = threading.Thread(
+                    target=self._loop, args=(reader,), daemon=True,
+                    name=f"fps-fleet-{reader.reader_id}")
+                self._threads[i] = t
+                t.start()
+                restarted.append(reader_id)
+                _emit_event(rec, "reader_restarted",
+                            reader=reader_id)
+        report["restarted"] = restarted
+        return report
+
+
+def scan_heartbeats(ckpt_dir: str, *, now=None) -> dict:
+    """Read every ``heartbeat_<id>.json`` beacon under
+    ``<ckpt_dir>/fleet/``: ``{reader: {"t", "step", "requests",
+    "polls", "age_s"}}``. File-based on purpose — the monitor side
+    (supervisor, bench, chaos harness) runs in a DIFFERENT process
+    than the readers it is judging, and a SIGSTOPped reader cannot
+    lie through a file it can no longer write."""
+    now = time.time() if now is None else now
+    out: dict[str, dict] = {}
+    fleet_dir = os.path.join(ckpt_dir, FLEET_DIR)
+    try:
+        names = os.listdir(fleet_dir)
+    except FileNotFoundError:
+        return out
+    for f in names:
+        if not (f.startswith("heartbeat_") and f.endswith(".json")):
+            continue
+        rec = _read_json(os.path.join(fleet_dir, f))
+        if rec is None:
+            continue
+        try:
+            reader = str(rec["reader"])
+            t = float(rec["t"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        out[reader] = {"t": t, "step": rec.get("step"),
+                       "requests": rec.get("requests"),
+                       "polls": rec.get("polls"),
+                       "age_s": max(0.0, now - t)}
+    return out
+
+
+def liveness_check(ckpt_dir: str, *,
+                   timeout_s: float = DEFAULT_LIVENESS_TIMEOUT_S,
+                   recorder=None, now=None,
+                   expected=None) -> dict:
+    """Classify fleet liveness from the beacons: a reader whose newest
+    beacon is older than ``timeout_s`` — or, with ``expected`` ids
+    given, one that never wrote a beacon at all — is WEDGED. Each pass
+    gauges ``serve.reader_heartbeat_age_s`` per reader (the staleness
+    SLO input) and journals one ``reader_wedged`` incident per wedged
+    reader; returns ``{"ages": {reader: age_s}, "wedged": [ids]}``.
+    A wedged reader is an INCIDENT the supervisor acts on, never a
+    silent zero in a bench average (BENCH_r14)."""
+    beats = scan_heartbeats(ckpt_dir, now=now)
+    ages = {r: b["age_s"] for r, b in beats.items()}
+    wedged = sorted(r for r, age in ages.items() if age > timeout_s)
+    for missing in sorted(set(expected or ()) - set(ages)):
+        ages[missing] = None
+        wedged.append(missing)
+    for reader, age in sorted(ages.items()):
+        if age is not None:
+            _emit_metric(recorder, "set",
+                         "serve.reader_heartbeat_age_s", float(age),
+                         reader=reader)
+    for reader in wedged:
+        _emit_event(recorder, "reader_wedged", reader=reader,
+                    age_s=ages.get(reader), timeout_s=timeout_s)
+    return {"ages": ages, "wedged": wedged}

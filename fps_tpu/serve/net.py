@@ -1,33 +1,55 @@
-"""Line-oriented JSON over TCP: the thinnest possible wire for ReadServer.
+"""TCP transport for ReadServer: framed wire (default) + line-JSON compat.
 
-One request per line, one response per line (both JSON objects) — the
-same framing as every other artifact in this repo (journals, event logs,
-bench digests), so the protocol needs no schema machinery and any
-language's socket + JSON can speak it:
+The wire protocol proper lives in :mod:`fps_tpu.serve.wire` (versioned
+length-prefixed frames, CRC32, HELLO negotiation, the failure-aware
+:class:`~fps_tpu.serve.wire.WireClient`). This module is the SERVER
+side plus one release of backward compatibility:
 
-  {"op": "pull",  "table": "weights", "ids": [0, 5, 9]}
-  {"op": "score", "feat_ids": [[...]], "feat_vals": [[...]],
-   "table": "weights", "link": "sigmoid"}
-  {"op": "topk",  "users": [1, 2], "k": 10, "item_table": "item_factors"}
-  {"op": "stats"}
+* :class:`TcpServe` peeks the first byte of each connection: the framed
+  magic routes into the framed handler (handshake, replay cache,
+  admission control, deadline enforcement); anything else — legacy
+  line-JSON clients always start ``{`` or whitespace — falls back to
+  the old one-JSON-object-per-line loop. Dual-stack is a ONE-release
+  bridge (``docs/serving.md``).
+* :class:`JsonlClient` is now a thin compat shim over ``WireClient``
+  (same constructor and ``request()`` surface, framed wire underneath)
+  so existing tools/tests migrate without a flag day.
 
-Responses carry ``"ok": true`` plus the op's payload (every data op tags
-``"step"`` — the publish that answered), or ``"ok": false, "error": ...``
-for malformed requests; the connection survives bad requests (a serving
-endpoint must not let one typo'd client kill the socket).
+Server-side survival (the tentpole's third leg):
 
-This is a test/bench/demo transport, deliberately not a production
-server (no TLS, no auth, no backpressure): the subsystem's contract is
-the :class:`~fps_tpu.serve.server.ReadServer` surface; production fronts
-would sit where :class:`TcpServe` sits.
+* **admission control** — a bounded in-flight semaphore; a request
+  arriving with no slot free is shed with a retryable ``BUSY`` frame
+  (counted as ``net.shed_requests`` — the shed-rate SLO in
+  ``fps_tpu.obs.fleet`` burns on it) instead of queueing unboundedly.
+  Load shedding is lost WORK, never lost CORRECTNESS: the client
+  retries or degrades (``docs/STALENESS.md``).
+* **deadline enforcement** — request envelopes carry the client's
+  remaining budget; a request that is already dead on arrival is
+  answered with a retryable ``deadline_exceeded`` response
+  (``net.deadline_exceeded``) rather than executed into a void. A
+  per-connection socket timeout reaps partitioned peers so a silent
+  client can never pin a handler thread forever.
+* **torn-frame accounting** — a frame that fails its length/CRC gates
+  is counted (``net.torn_frames``), journaled, and the connection
+  dropped loudly; the payload is NEVER decoded.
+* **idempotent replay** — executed responses are cached per
+  ``(session, req_id)`` in a bounded LRU; a reconnecting client
+  resending an in-flight request gets the cached response, not a
+  second execution (the zero-duplicate-applies chaos invariant).
+
+The request/response dicts (and :func:`handle_request`) are unchanged
+from the line protocol — framing added integrity and liveness, not a
+new schema.
 
 thread-safety: one daemon thread per connection plus the acceptor
-(``socketserver.ThreadingTCPServer``); all shared state lives in the
-ReadServer, whose read path is lock-free by design (see its docstring).
+(``socketserver.ThreadingTCPServer``); shared state is the ReadServer
+(lock-free read path by design), the replay cache and wire-stat
+counters (one lock each), and the admission semaphore.
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import math
 import socket
@@ -36,10 +58,18 @@ import threading
 
 import numpy as np
 
+from fps_tpu.core.retry import net_fault_check
 from fps_tpu.obs.sinks import scrub_nonfinite
 from fps_tpu.serve.server import NoSnapshotError, ReadServer
+from fps_tpu.serve.watcher import _emit_event, _emit_metric
+from fps_tpu.serve.wire import (OP_BUSY, OP_ERR, OP_HELLO, OP_HELLO_OK,
+                                OP_REQ, OP_RESP, MAGIC,
+                                SUPPORTED_VERSIONS, FrameTooLargeError,
+                                ProtocolVersionError, TornFrameError,
+                                WireClient, encode_frame, read_frame,
+                                send_frame)
 
-__all__ = ["TcpServe", "JsonlClient"]
+__all__ = ["TcpServe", "JsonlClient", "handle_request"]
 
 
 def _py(v):
@@ -95,23 +125,189 @@ def handle_request(server: ReadServer, req: dict) -> dict:
         return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
 
+def _safe_dumps(resp: dict) -> bytes:
+    try:
+        return json.dumps(resp, allow_nan=False).encode("utf-8")
+    except ValueError:
+        # Belt-and-braces: _py() nulls non-finite floats, so any stray
+        # NaN here is a protocol bug — fail the one response, not the
+        # wire contract.
+        return json.dumps(
+            {"ok": False,
+             "error": "non-finite value in response"}).encode("utf-8")
+
+
 class TcpServe:
     """Serve a :class:`ReadServer` on ``127.0.0.1:port`` (0 = ephemeral;
     read the bound port from :attr:`port`). ``start()`` returns
     immediately (daemon threads); ``close()`` shuts the socket down.
 
-    thread-safety: the handler threads share only the ReadServer, whose
-    read path is lock-free by design (snapshot bound once per request;
-    see its docstring) — this class itself owns no mutable state past
-    construction, and ``ThreadingTCPServer.shutdown`` is the only
-    cross-thread call."""
+    ``max_inflight`` bounds concurrently-EXECUTING requests across all
+    connections (admission control; excess is shed with BUSY);
+    ``conn_timeout_s`` reaps connections whose peer goes silent
+    mid-conversation; ``replay_cache`` bounds the (session, req_id) →
+    response LRU that makes client resends idempotent. Wire-plane
+    metrics ride the ReadServer's recorder; :meth:`wire_stats` exposes
+    the same counts as plain ints for tests and scenarios."""
 
     def __init__(self, server: ReadServer, *, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, max_inflight: int = 64,
+                 conn_timeout_s: float = 60.0,
+                 replay_cache: int = 1024):
         read_server = server
+        tcp_serve = self
+        self._inflight = threading.BoundedSemaphore(max_inflight)
+        self._stats_lock = threading.Lock()
+        self._replay: collections.OrderedDict = collections.OrderedDict()
+        self._replay_cap = int(replay_cache)
+        self._counts = {"torn_frames": 0, "shed_requests": 0,
+                        "deadline_exceeded": 0, "dedup_replays": 0,
+                        "framed_conns": 0, "legacy_conns": 0,
+                        "dropped_accepts": 0}
 
         class Handler(socketserver.StreamRequestHandler):
+            timeout = conn_timeout_s
+
             def handle(self):
+                # Request/response RPC: Nagle only adds delayed-ACK
+                # stalls on single-write responses.
+                self.connection.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    directive = net_fault_check("accept", "serve")
+                except OSError:
+                    return  # injected accept failure: connection dies
+                if directive == "drop":
+                    tcp_serve._bump("dropped_accepts")
+                    return  # one-way partition: accepted, never served
+                head = self.rfile.peek(1)[:1]
+                if not head:
+                    return
+                if head == MAGIC[:1]:
+                    tcp_serve._bump("framed_conns")
+                    self._handle_framed()
+                else:
+                    tcp_serve._bump("legacy_conns")
+                    self._handle_lines()
+
+            # -- framed path --------------------------------------------
+
+            def _send(self, op, req_id, payload: bytes):
+                send_frame(self.connection,
+                           encode_frame(op, req_id, payload), "serve")
+
+            def _handle_framed(self):
+                recorder = read_server.recorder
+                try:
+                    if not self._handshake():
+                        return
+                    while True:
+                        try:
+                            fr = read_frame(self.rfile)
+                        except (TornFrameError, FrameTooLargeError,
+                                ProtocolVersionError) as e:
+                            tcp_serve._bump("torn_frames")
+                            _emit_metric(recorder, "inc",
+                                         "net.torn_frames", 1)
+                            _emit_event(recorder, "wire_torn_frame",
+                                        reason=str(e))
+                            try:
+                                self._send(OP_ERR, 0, _safe_dumps(
+                                    {"ok": False, "error": str(e)}))
+                            except OSError:
+                                pass
+                            return  # drop the connection loudly
+                        if fr is None:
+                            return  # clean EOF at a frame boundary
+                        if fr.op != OP_REQ:
+                            self._send(OP_ERR, fr.req_id, _safe_dumps(
+                                {"ok": False,
+                                 "error": f"unexpected op {fr.op}"}))
+                            return
+                        self._serve_one(fr, recorder)
+                except (TimeoutError, ConnectionError, OSError):
+                    return  # peer vanished / partitioned: reap quietly
+
+            def _handshake(self) -> bool:
+                try:
+                    fr = read_frame(self.rfile)
+                except (TornFrameError, FrameTooLargeError,
+                        ProtocolVersionError) as e:
+                    tcp_serve._bump("torn_frames")
+                    _emit_metric(read_server.recorder, "inc",
+                                 "net.torn_frames", 1)
+                    try:
+                        self._send(OP_ERR, 0, _safe_dumps(
+                            {"ok": False, "error": str(e)}))
+                    except OSError:
+                        pass
+                    return False
+                if fr is None or fr.op != OP_HELLO:
+                    self._send(OP_ERR, 0, _safe_dumps(
+                        {"ok": False,
+                         "error": "expected HELLO as the first frame"}))
+                    return False
+                hello = fr.json()
+                offered = {int(v) for v in hello.get("versions", ())}
+                common = offered & set(SUPPORTED_VERSIONS)
+                if not common:
+                    self._send(OP_ERR, 0, _safe_dumps(
+                        {"ok": False,
+                         "error": "no common protocol version",
+                         "supported": list(SUPPORTED_VERSIONS)}))
+                    return False
+                self.wire_session = str(
+                    hello.get("session", f"conn-{id(self)}"))
+                self.wire_version = max(common)
+                self._send(OP_HELLO_OK, 0, _safe_dumps(
+                    {"ok": True, "version": self.wire_version}))
+                return True
+
+            def _serve_one(self, fr, recorder):
+                envelope = fr.json()
+                key = (self.wire_session, fr.req_id)
+                cached = tcp_serve._replay_get(key)
+                if cached is not None:
+                    # Idempotent resend after a reconnect: replay the
+                    # recorded response, never execute twice.
+                    send_frame(self.connection, cached, "serve")
+                    return
+                deadline = envelope.get("d")
+                if deadline is not None and float(deadline) <= 0:
+                    tcp_serve._bump("deadline_exceeded")
+                    _emit_metric(recorder, "inc",
+                                 "net.deadline_exceeded", 1)
+                    self._send(OP_RESP, fr.req_id, _safe_dumps(
+                        {"ok": False, "error": "deadline exceeded",
+                         "retryable": True, "deadline_exceeded": True}))
+                    return
+                if not tcp_serve._inflight.acquire(blocking=False):
+                    # Admission control: full house. Shed with a
+                    # retryable BUSY — bounded latency beats an
+                    # unbounded queue (docs/STALENESS.md).
+                    tcp_serve._bump("shed_requests")
+                    _emit_metric(recorder, "inc",
+                                 "net.shed_requests", 1)
+                    self._send(OP_BUSY, fr.req_id, _safe_dumps(
+                        {"ok": False, "error": "server busy",
+                         "retryable": True, "busy": True}))
+                    return
+                try:
+                    resp = handle_request(read_server,
+                                          envelope.get("q"))
+                finally:
+                    tcp_serve._inflight.release()
+                data = encode_frame(OP_RESP, fr.req_id,
+                                    _safe_dumps(resp))
+                if resp.get("ok"):
+                    # Only EXECUTED successes are replayable; errors
+                    # and sheds must re-execute on resend.
+                    tcp_serve._replay_put(key, data)
+                send_frame(self.connection, data, "serve")
+
+            # -- legacy line-JSON path (one-release compat) -------------
+
+            def _handle_lines(self):
                 for line in self.rfile:
                     line = line.strip()
                     if not line:
@@ -122,16 +318,7 @@ class TcpServe:
                         resp = {"ok": False, "error": f"bad json: {e}"}
                     else:
                         resp = handle_request(read_server, req)
-                    try:
-                        payload = json.dumps(resp, allow_nan=False)
-                    except ValueError:
-                        # Belt-and-braces: _py() nulls non-finite floats,
-                        # so any stray NaN here is a protocol bug — fail
-                        # the one response, not the wire contract.
-                        payload = json.dumps(
-                            {"ok": False,
-                             "error": "non-finite value in response"})
-                    self.wfile.write((payload + "\n").encode("utf-8"))
+                    self.wfile.write(_safe_dumps(resp) + b"\n")
                     self.wfile.flush()
 
         self._tcp = socketserver.ThreadingTCPServer(
@@ -141,6 +328,36 @@ class TcpServe:
             target=self._tcp.serve_forever, name="fps-serve-tcp",
             daemon=True)
         self.host, self.port = self._tcp.server_address[:2]
+
+    # -- shared wire state (handler threads) --------------------------------
+
+    def _bump(self, name: str) -> None:
+        with self._stats_lock:
+            self._counts[name] += 1
+
+    def _replay_get(self, key):
+        with self._stats_lock:
+            data = self._replay.get(key)
+            if data is not None:
+                self._replay.move_to_end(key)
+                self._counts["dedup_replays"] += 1
+            return data
+
+    def _replay_put(self, key, data: bytes) -> None:
+        with self._stats_lock:
+            self._replay[key] = data
+            self._replay.move_to_end(key)
+            while len(self._replay) > self._replay_cap:
+                self._replay.popitem(last=False)
+
+    def wire_stats(self) -> dict:
+        """Plain-int wire counters (scenario/bench evidence):
+        torn_frames, shed_requests, deadline_exceeded, dedup_replays,
+        framed_conns, legacy_conns, dropped_accepts."""
+        with self._stats_lock:
+            return dict(self._counts)
+
+    # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "TcpServe":
         self._thread.start()
@@ -158,23 +375,23 @@ class TcpServe:
 
 
 class JsonlClient:
-    """Blocking client for the line protocol (tests and the CLI's
-    ``--query`` mode)."""
+    """DEPRECATED compat shim: the old line-protocol client surface
+    (constructor, ``request()``, ``close()``, context manager) speaking
+    the FRAMED wire through :class:`~fps_tpu.serve.wire.WireClient`.
+    Existing tools/tests keep working and silently gain deadlines,
+    bounded retry, and idempotent reconnect; external line-JSON clients
+    keep working against the dual-stack server for one release
+    (``docs/serving.md``). New code should use ``WireClient``."""
 
     def __init__(self, host: str, port: int, *, timeout: float = 10.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._rfile = self._sock.makefile("rb")
+        self._wire = WireClient(host, port, timeout=timeout,
+                                deadline_s=timeout)
 
     def request(self, req: dict) -> dict:
-        self._sock.sendall((json.dumps(req) + "\n").encode("utf-8"))
-        line = self._rfile.readline()
-        if not line:
-            raise ConnectionError("server closed the connection")
-        return json.loads(line)
+        return self._wire.request(req)
 
     def close(self) -> None:
-        self._rfile.close()
-        self._sock.close()
+        self._wire.close()
 
     def __enter__(self):
         return self

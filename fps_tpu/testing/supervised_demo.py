@@ -2177,6 +2177,434 @@ def run_slow_lease_near_ttl_scenario(tmpdir: str, *,
     return ok, detail
 
 
+# ---------------------------------------------------------------------------
+# Hostile-network scenarios (fps_tpu.serve.wire + fps_tpu.testing.faultnet;
+# docs/resilience.md "Hostile network"): deterministic wire-fault schedules
+# against the framed TCP plane — torn frames, refused reconnects, slow
+# peers, one-way partitions — with the framing gates, retry budgets, replay
+# cache, and per-reader liveness all required to hold.
+# ---------------------------------------------------------------------------
+
+def _wire_harness():
+    """One fixed snapshot behind a fresh ReadServer + the deterministic
+    request sequence the net scenarios replay: returns
+    ``(make_server, requests)``. Same snapshot and sequence every call,
+    so the clean run's responses are the bit-identity reference."""
+    import numpy as np
+
+    from fps_tpu.serve import ReadServer, ServableSnapshot
+
+    rng = np.random.default_rng(3)
+    tables = {"weights": rng.normal(size=(256, 8)).astype(np.float32)}
+    reqs = [{"op": "pull", "table": "weights",
+             "ids": rng.integers(0, 256, 16).tolist()}
+            for _ in range(60)]
+
+    def make_server():
+        server = ReadServer()
+        server.swap_to(ServableSnapshot(11, "net-scenario", tables, [],
+                                        "none"))
+        return server
+
+    return make_server, reqs
+
+
+def _fired_by_stream(trail):
+    """Project an evidence trail onto per-(class, op) sublists: the
+    within-stream order is deterministic even when two streams' lock
+    acquisitions interleave differently across runs."""
+    out: dict[tuple, list] = {}
+    for cls, op, n, fault in trail:
+        out.setdefault((cls, op), []).append((n, fault))
+    return out
+
+
+def run_net_torn_frames_scenario(tmpdir: str, *, timeout: float = 600):
+    """Torn frames never decode (``fps_tpu.serve.wire``): a
+    deterministic ``faultnet`` schedule cuts the client's sends
+    mid-frame (and resets one outright) against a live framed server.
+    The contract:
+
+    * the server counts every torn frame and drops the connection —
+      a truncated frame is NEVER decoded into a request (bit-identity
+      of every response against the fault-free run is the witness);
+    * the client classifies the failures as retryable, reconnects with
+      backoff, and completes the whole sequence inside its budgets;
+    * the schedule is REPLAYABLE: a second run with the same seed
+      fires the same faults at the same per-stream operation counts
+      and produces the same responses.
+    """
+    from fps_tpu.serve import TcpServe, WireClient
+    from fps_tpu.testing import faultnet
+    from fps_tpu.testing.faultnet import NetFaultRule
+
+    make_server, reqs = _wire_harness()
+
+    # Clean reference.
+    with TcpServe(make_server()) as tcp:
+        with WireClient(tcp.host, tcp.port) as wc:
+            want = [wc.request(r) for r in reqs]
+
+    rules = [
+        # Mid-frame cuts on the client's sends: the server's framing
+        # gates must reject every one. start=2 keeps the constructor
+        # handshake clean (the ctor connects once, without retry).
+        NetFaultRule("client", "send", "cut", cut_bytes=5, start=2,
+                     count=None, every=9),
+        NetFaultRule("client", "send", "reset", start=30, count=1),
+    ]
+
+    def faulted_run():
+        net = faultnet.install(rules, seed=0)
+        try:
+            with TcpServe(make_server()) as tcp:
+                wc = WireClient(tcp.host, tcp.port,
+                                peer_class="client")
+                got = [wc.request(r) for r in reqs]
+                wc.close()
+                return (got, net.trail(),
+                        {"retries": wc.retries,
+                         "reconnects": wc.reconnects},
+                        tcp.wire_stats())
+        finally:
+            faultnet.uninstall()
+
+    got1, trail1, client1, stats1 = faulted_run()
+    got2, trail2, _client2, stats2 = faulted_run()
+
+    cuts = sum(1 for _, _, _, f in trail1 if f == "cut")
+    detail = {
+        "requests": len(reqs),
+        "injected": {f"{cls}/{op}": len(v)
+                     for (cls, op), v in
+                     _fired_by_stream(trail1).items()},
+        "client": client1,
+        "server_torn_frames": stats1["torn_frames"],
+        "responses_bit_identical": bool(got1 == want),
+        "replay_deterministic": bool(
+            _fired_by_stream(trail1) == _fired_by_stream(trail2)
+            and got1 == got2
+            and stats1["torn_frames"] == stats2["torn_frames"]),
+    }
+    ok = (detail["responses_bit_identical"]
+          and detail["replay_deterministic"]
+          and cuts >= 3
+          # Every cut the server saw was counted, none decoded.
+          and stats1["torn_frames"] >= cuts
+          and client1["reconnects"] >= cuts
+          and client1["retries"] >= cuts)
+    return ok, detail
+
+
+def run_net_reconnect_storm_scenario(tmpdir: str, *,
+                                     timeout: float = 600):
+    """Reconnects dedupe in-flight requests (the replay cache's chaos
+    invariant): the server's RESPONSE sends are cut mid-frame and the
+    client's first reconnect attempts are refused outright. The
+    contract:
+
+    * every logical request EXECUTES exactly once — resends after a
+      reconnect are answered from the (session, req_id) replay cache
+      (``server.requests`` equals the request count; ``dedup_replays``
+      is the positive witness);
+    * the refused-connect storm backs off and recovers under the same
+      session (responses bit-identical to the fault-free run);
+    * the schedule replays deterministically.
+    """
+    from fps_tpu.serve import TcpServe, WireClient
+    from fps_tpu.testing import faultnet
+    from fps_tpu.testing.faultnet import NetFaultRule
+
+    make_server, reqs = _wire_harness()
+
+    with TcpServe(make_server()) as tcp:
+        with WireClient(tcp.host, tcp.port) as wc:
+            want = [wc.request(r) for r in reqs]
+
+    rules = [
+        # Cut the server's data sends (start=2 spares the constructor
+        # HELLO_OK): the executed response dies on the wire, the client
+        # resends, the replay cache answers. count is the WINDOW width
+        # ([start, start+count)), so every=5 in a 25-op window fires 5
+        # cuts.
+        NetFaultRule("serve", "send", "cut", cut_bytes=4, start=2,
+                     count=25, every=5),
+        # And the first two reconnect attempts are REFUSED: the storm
+        # must back off, not busy-loop.
+        NetFaultRule("client", "connect", "refuse", start=1, count=2),
+    ]
+
+    def faulted_run():
+        net = faultnet.install(rules, seed=0)
+        try:
+            server = make_server()
+            with TcpServe(server) as tcp:
+                wc = WireClient(tcp.host, tcp.port,
+                                peer_class="client")
+                got = [wc.request(r) for r in reqs]
+                wc.close()
+                return (got, net.trail(),
+                        {"retries": wc.retries,
+                         "reconnects": wc.reconnects},
+                        tcp.wire_stats(), server.requests)
+        finally:
+            faultnet.uninstall()
+
+    got1, trail1, client1, stats1, executed1 = faulted_run()
+    got2, trail2, _c2, stats2, executed2 = faulted_run()
+
+    cuts = sum(1 for _, _, _, f in trail1 if f == "cut")
+    refused = sum(1 for _, _, _, f in trail1 if f == "refuse")
+    detail = {
+        "requests": len(reqs),
+        "response_cuts": cuts,
+        "refused_connects": refused,
+        "client": client1,
+        "dedup_replays": stats1["dedup_replays"],
+        "executed_requests": executed1,
+        "responses_bit_identical": bool(got1 == want),
+        "replay_deterministic": bool(
+            _fired_by_stream(trail1) == _fired_by_stream(trail2)
+            and got1 == got2 and executed1 == executed2),
+    }
+    ok = (detail["responses_bit_identical"]
+          and detail["replay_deterministic"]
+          and cuts >= 3 and refused == 2
+          # THE invariant: zero duplicate-applied requests.
+          and executed1 == len(reqs)
+          and stats1["dedup_replays"] >= 1
+          and client1["reconnects"] >= 1)
+    return ok, detail
+
+
+def run_net_slow_peer_scenario(tmpdir: str, *, timeout: float = 600):
+    """Slow peers and dead deadlines (``docs/STALENESS.md``): the
+    client's sends are byte-trickled and the server's sends delayed —
+    a slow peer must cost LATENCY, never integrity (zero torn frames,
+    responses bit-identical). A second client then faces a total
+    one-way partition (every recv times out) under a small deadline
+    budget: the request must fail FAST with ``TimeoutError`` — the
+    deadline is a budget, not a suggestion — while the server's
+    replay cache keeps the retried sends idempotent.
+    """
+    import time as _time
+
+    from fps_tpu.serve import TcpServe, WireClient
+    from fps_tpu.testing import faultnet
+    from fps_tpu.testing.faultnet import NetFaultRule
+
+    make_server, reqs = _wire_harness()
+
+    with TcpServe(make_server()) as tcp:
+        with WireClient(tcp.host, tcp.port) as wc:
+            want = [wc.request(r) for r in reqs]
+
+    rules = [
+        NetFaultRule("client", "send", "trickle", chunk=7,
+                     delay_s=0.001, start=1, count=None, every=3),
+        NetFaultRule("serve", "send", "delay", delay_s=0.001,
+                     start=0, count=None, every=4),
+        # The partitioned client: every recv AFTER its constructor
+        # handshake times out — a one-way partition (our bytes leave,
+        # theirs never arrive).
+        NetFaultRule("deadline", "recv", "partition", start=1,
+                     count=None),
+    ]
+    net = faultnet.install(rules, seed=0)
+    try:
+        server = make_server()
+        with TcpServe(server) as tcp:
+            wc = WireClient(tcp.host, tcp.port, peer_class="client")
+            got = [wc.request(r) for r in reqs]
+            wc.close()
+
+            pc = WireClient(tcp.host, tcp.port, peer_class="deadline")
+            t0 = _time.monotonic()
+            deadline_error = None
+            try:
+                pc.request(reqs[0], deadline_s=0.5)
+            except TimeoutError as e:
+                deadline_error = repr(e)
+            elapsed = _time.monotonic() - t0
+            pc.close()
+            stats = tcp.wire_stats()
+            executed = server.requests
+        trail = net.trail()
+    finally:
+        faultnet.uninstall()
+
+    trickles = sum(1 for _, _, _, f in trail if f == "trickle")
+    partitions = sum(1 for _, _, _, f in trail if f == "partition")
+    detail = {
+        "requests": len(reqs),
+        "trickled_sends": trickles,
+        "partitioned_recvs": partitions,
+        "torn_frames": stats["torn_frames"],
+        "responses_bit_identical": bool(got == want),
+        "deadline_error": deadline_error,
+        "deadline_elapsed_s": round(elapsed, 3),
+        "client_deadline_exceeded": pc.deadline_exceeded,
+        "executed_requests": executed,
+    }
+    ok = (detail["responses_bit_identical"]
+          and trickles >= 10
+          # Slow is slow, not torn: every trickled frame arrived whole.
+          and stats["torn_frames"] == 0
+          and deadline_error is not None
+          and partitions >= 1
+          and pc.deadline_exceeded >= 1
+          # The budget BOUND the journey (0.5s budget, generous slack
+          # for backoff rounding — nowhere near a socket timeout).
+          and elapsed < 5.0
+          # Idempotence held for the partitioned client's resends: at
+          # most ONE execution beyond the measured sequence.
+          and executed <= len(reqs) + 1)
+    return ok, detail
+
+
+# The SIGSTOPped-reader child: a quorum-1 FleetReader polling one
+# snapshot dir, beating its liveness beacon fast (0.1s) so the scenario
+# detects the wedge in seconds. Run via ``python -c`` — the serving
+# plane is jax-free, so the child starts fast.
+_READER_LOOP_SRC = """\
+import sys, time
+from fps_tpu.serve.fleet import FleetReader
+r = FleetReader(sys.argv[1], sys.argv[2], quorum=1,
+                heartbeat_interval_s=0.1)
+while True:
+    r.poll()
+    time.sleep(0.05)
+"""
+
+
+def run_net_partition_reader_scenario(tmpdir: str, *,
+                                      timeout: float = 600):
+    """A partitioned (SIGSTOPped) reader becomes a ``reader_wedged``
+    incident, never a silent zero (the tentpole's liveness leg): a
+    reader child polls + beats against a live training run's snapshot
+    dir; mid-run the child is SIGSTOPped — its beacon freezes while its
+    process, from the filesystem's point of view, simply goes silent.
+    The contract:
+
+    * before the stop, the reader is LIVE (beacon fresh, no wedge —
+      no false positives);
+    * within the liveness timeout of the stop, ``liveness_check``
+      reports the reader wedged (the incident a supervisor restarts
+      on);
+    * training is UNAFFECTED: final weights bit-identical to the
+      fault-free run (a dead reader costs serving capacity, never
+      training state);
+    * after SIGCONT (the partition heals) the reader recovers: beats
+      fresh again and catches up to the newest publication.
+    """
+    import signal
+    import subprocess as sp
+    import time as _time
+
+    import numpy as np
+
+    import jax
+
+    from fps_tpu.core.checkpoint import AsyncCheckpointer
+    from fps_tpu.serve import liveness_check, scan_heartbeats
+    from fps_tpu.testing.workloads import weights
+
+    _mesh, chunks, make_trainer = _storage_harness()
+
+    # Clean arm: the bit-identity reference.
+    trainer, store, tables, ls = make_trainer()
+    trainer.fit_stream(tables, ls, iter(chunks), jax.random.key(1))
+    want_w = weights(store).copy()
+
+    LIVENESS = 1.5
+    d = os.path.join(tmpdir, "net_partition")
+    os.makedirs(d, exist_ok=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _ROOT
+    child = sp.Popen([sys.executable, "-c", _READER_LOOP_SRC, d, "r0"],
+                     env=env, cwd=_ROOT, stdout=sp.DEVNULL,
+                     stderr=sp.DEVNULL)
+    stopped_at = [None]
+    live_before = [None]
+    try:
+        trainer, store, tables, ls = make_trainer()
+        ck = AsyncCheckpointer(d, keep=len(chunks) + 2)
+
+        def on_chunk(step, _metrics):
+            if step != 4 or stopped_at[0] is not None:
+                return
+            # Never SIGSTOP a reader that hasn't come up — that would
+            # test a start timeout, not a wedge.
+            dl = _time.monotonic() + 60.0
+            while not scan_heartbeats(d) and _time.monotonic() < dl:
+                _time.sleep(0.05)
+            live_before[0] = liveness_check(d, timeout_s=LIVENESS,
+                                            expected=["r0"])
+            os.kill(child.pid, signal.SIGSTOP)
+            stopped_at[0] = _time.monotonic()
+
+        tables, ls, _ = trainer.fit_stream(
+            tables, ls, iter(chunks), jax.random.key(1),
+            checkpointer=ck, checkpoint_every=1, on_chunk=on_chunk)
+        ck.flush()
+        final_step = ck.latest_valid_step()
+        ck.close()
+        got_w = weights(store)
+        if stopped_at[0] is None:
+            return False, {"error": "reader was never SIGSTOPped"}
+
+        # The wedge becomes an incident within the liveness timeout.
+        wedged_at = None
+        dl = _time.monotonic() + min(timeout, 60.0)
+        while _time.monotonic() < dl:
+            live = liveness_check(d, timeout_s=LIVENESS,
+                                  expected=["r0"])
+            if "r0" in live["wedged"]:
+                wedged_at = _time.monotonic()
+                break
+            _time.sleep(0.05)
+        if wedged_at is None:
+            return False, {"error": "reader_wedged never fired",
+                           "heartbeats": scan_heartbeats(d)}
+        detect_s = wedged_at - stopped_at[0]
+
+        # SIGCONT: the partition heals; the reader must beat fresh
+        # again and converge on the newest publication.
+        os.kill(child.pid, signal.SIGCONT)
+        recovered = caught_up = False
+        dl = _time.monotonic() + min(timeout, 60.0)
+        while _time.monotonic() < dl:
+            live = liveness_check(d, timeout_s=LIVENESS,
+                                  expected=["r0"])
+            hb = scan_heartbeats(d).get("r0")
+            if "r0" not in live["wedged"] and hb is not None:
+                recovered = True
+                if hb.get("step") == final_step:
+                    caught_up = True
+                    break
+            _time.sleep(0.05)
+    finally:
+        child.kill()
+        child.wait(timeout=10)
+
+    detail = {
+        "chunks": len(chunks),
+        "final_step": final_step,
+        "live_before_stop": live_before[0],
+        "wedge_detect_s": round(detect_s, 3),
+        "liveness_timeout_s": LIVENESS,
+        "recovered": recovered,
+        "caught_up_to_final_step": caught_up,
+        "weights_bit_identical": bool(np.array_equal(got_w, want_w)),
+    }
+    ok = (live_before[0] is not None
+          and live_before[0]["wedged"] == []      # no false positive
+          and detect_s < 30.0
+          and recovered and caught_up
+          and detail["weights_bit_identical"])
+    return ok, detail
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="supervised tiny-logreg child (fps_tpu.supervise demo)")

@@ -317,6 +317,39 @@ def test_fps007_noqa_and_explain():
 
 
 # ---------------------------------------------------------------------------
+# FPS008 — raw socket use outside the wire plane (fps_tpu/serve/).
+# ---------------------------------------------------------------------------
+
+
+def test_fps008_flags_raw_sockets():
+    assert rules_of("s = socket.socket()") == ["FPS008"]
+    assert rules_of(
+        "s = socket.create_connection((h, p))") == ["FPS008"]
+    assert rules_of(
+        "from socket import create_connection\n"
+        "s = create_connection((h, p))") == ["FPS008"]
+
+
+def test_fps008_wire_plane_is_exempt():
+    src = "s = socket.create_connection((h, p))"
+    for path in (os.path.join("fps_tpu", "serve", "wire.py"),
+                 os.path.join("fps_tpu", "serve", "net.py")):
+        assert [f.rule for f in lint_source(src, path)] == [], path
+    # Anywhere else in the package flags — every caller goes through
+    # WireClient (deadlines, bounded retry, idempotent reconnect).
+    assert [f.rule for f in lint_source(
+        src, os.path.join("fps_tpu", "core", "driver.py"))] == ["FPS008"]
+
+
+def test_fps008_other_socket_calls_are_clean():
+    # Non-constructor socket.* helpers don't flag: the rule targets
+    # connection creation, not constants or address utilities.
+    assert rules_of("fam = socket.AF_INET") == []
+    assert rules_of("name = socket.gethostname()") == []
+    assert rules_of("s = socket.socket()  # noqa: FPS008") == []
+
+
+# ---------------------------------------------------------------------------
 # Machinery: noqa, syntax errors, file walking, the CI gate.
 # ---------------------------------------------------------------------------
 
@@ -355,7 +388,7 @@ def test_lint_paths_walks_and_selects(tmp_path):
 
 def test_rule_table_is_complete():
     assert set(RULES) == {"FPS001", "FPS002", "FPS003", "FPS004", "FPS005",
-                          "FPS006", "FPS007"}
+                          "FPS006", "FPS007", "FPS008"}
 
 
 def test_package_lints_clean():

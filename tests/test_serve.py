@@ -21,6 +21,7 @@ Contract under test (ISSUE 7, ``docs/serving.md``):
 
 import json
 import os
+import socket
 import subprocess
 import sys
 import threading
@@ -601,7 +602,7 @@ def test_serve_metrics_ride_the_default_registry(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# Line-JSON TCP transport.
+# TCP transport (framed wire; legacy line-JSON rides the dual stack).
 # ---------------------------------------------------------------------------
 
 def test_tcp_round_trip_and_error_tolerance(tmp_path):
@@ -620,27 +621,42 @@ def test_tcp_round_trip_and_error_tolerance(tmp_path):
         assert not c.request([1, 2, 3])["ok"]
         r = c.request({"op": "pull", "table": "weights", "ids": [0]})
         assert r["ok"]  # same connection still answers
-        c._sock.sendall(b"this is not json\n")
-        assert "bad json" in json.loads(c._rfile.readline())["error"]
         r = c.request({"op": "pull", "table": "missing", "ids": [0]})
         assert not r["ok"] and "KeyError" in r["error"]
         r = c.request({"op": "stats"})
         assert r["ok"] and r["requests"] >= 1
+    # The LEGACY line-JSON path (dual stack, one release) still
+    # tolerates non-JSON garbage without dropping the connection.
+    with TcpServe(server) as tcp:
+        s = socket.create_connection((tcp.host, tcp.port), timeout=5.0)
+        try:
+            rf = s.makefile("rb")
+            s.sendall(b"this is not json\n")
+            assert "bad json" in json.loads(rf.readline())["error"]
+            s.sendall(b'{"op": "stats"}\n')
+            assert json.loads(rf.readline())["ok"]  # still answers
+        finally:
+            s.close()
 
 
 def test_tcp_nonfinite_rows_serialize_as_strict_json(tmp_path):
     # Observe-mode guards publish snapshots that still hold non-finite
     # rows; the wire must stay strict JSON (null, never NaN/Infinity —
     # json.loads accepts the Python-only tokens, so assert on the text).
+    # Raw legacy line-JSON socket so the assertion sees the wire TEXT.
     d = str(tmp_path)
     w = np.ones((4, 2), np.float32)
     w[1, 0], w[2, 1] = np.nan, np.inf
     write_snapshot(d, 1, tables={"weights": w})
     server, _ = ReadServer.over(d)
-    with TcpServe(server) as tcp, JsonlClient(tcp.host, tcp.port) as c:
-        c._sock.sendall(b'{"op": "pull", "table": "weights", '
-                        b'"ids": [0, 1, 2]}\n')
-        raw = c._rfile.readline().decode("utf-8")
+    with TcpServe(server) as tcp:
+        s = socket.create_connection((tcp.host, tcp.port), timeout=5.0)
+        try:
+            s.sendall(b'{"op": "pull", "table": "weights", '
+                      b'"ids": [0, 1, 2]}\n')
+            raw = s.makefile("rb").readline().decode("utf-8")
+        finally:
+            s.close()
         assert "NaN" not in raw and "Infinity" not in raw
         r = json.loads(raw)
         assert r["ok"] and r["values"][1][0] is None

@@ -1,0 +1,540 @@
+"""Hostile-network survival (docs/resilience.md "Hostile network"):
+versioned length-prefixed framing (``fps_tpu.serve.wire``), the
+retryable/fatal network-exception split (``classify_net``),
+seed-replayable wire fault injection (``fps_tpu.testing.faultnet``),
+server-side admission control / deadline enforcement / idempotent
+replay (``fps_tpu.serve.net``), and per-reader liveness beacons
+(``fps_tpu.serve.fleet``).
+
+The satellite acceptance contract (ISSUE 16):
+
+* framing round-trips arbitrary payloads; EVERY single-byte truncation
+  of a valid frame is rejected with the failing layer named — a torn
+  frame is never decoded;
+* the ``classify_net`` table is exact (timeouts / connection lifecycle
+  / transient errnos retry; protocol violations are fatal);
+* faultnet schedules are deterministic and replayable (same seed, same
+  op stream, same evidence trail);
+* a reconnecting client resending an in-flight request id is deduped —
+  the server executes once and replays the cached response.
+"""
+
+import errno
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from fps_tpu.core import retry as retry_mod
+from fps_tpu.core.retry import (
+    DEFAULT_NET_RETRY,
+    RETRYABLE_NET_ERRNOS,
+    classify_net,
+    classify_path,
+    net_fault_check,
+)
+from fps_tpu.serve import wire
+from fps_tpu.serve.fleet import (
+    DEFAULT_LIVENESS_TIMEOUT_S,
+    FleetReader,
+    liveness_check,
+    scan_heartbeats,
+)
+from fps_tpu.serve.net import JsonlClient, TcpServe, handle_request
+from fps_tpu.serve.server import ReadServer
+from fps_tpu.serve.snapshot import ServableSnapshot
+from fps_tpu.serve.wire import (
+    MAGIC,
+    MAX_PAYLOAD,
+    OP_ERR,
+    OP_HELLO,
+    OP_HELLO_OK,
+    OP_REQ,
+    OP_RESP,
+    PROTO_VERSION,
+    FrameTooLargeError,
+    ProtocolVersionError,
+    ServerBusyError,
+    TornFrameError,
+    WireClient,
+    decode_frame,
+    encode_frame,
+)
+from fps_tpu.testing import faultnet
+from fps_tpu.testing.faultnet import FaultNet, NetFaultRule
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    """Every test leaves the process net injector uninstalled — a
+    leaked schedule would fault unrelated tests' sockets."""
+    yield
+    faultnet.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# Framing units.
+# ---------------------------------------------------------------------------
+
+
+def test_frame_roundtrip_random_payloads():
+    rng = np.random.default_rng(0)
+    payloads = [b"", b"{}", bytes(rng.integers(0, 256, 1, np.uint8)),
+                bytes(rng.integers(0, 256, 4096, np.uint8)),
+                json.dumps({"op": "pull", "ids": list(range(64))},
+                           ).encode()]
+    for i, payload in enumerate(payloads):
+        data = encode_frame(OP_REQ, i + 1, payload)
+        fr = decode_frame(data)
+        assert fr.op == OP_REQ
+        assert fr.req_id == i + 1
+        assert fr.payload == payload
+        assert fr.version == PROTO_VERSION
+
+
+def test_every_single_byte_truncation_rejected():
+    data = encode_frame(OP_RESP, 7, b'{"ok": true}')
+    # Zero bytes is a CLEAN EOF at a frame boundary, not a torn frame.
+    assert wire.read_frame(__import__("io").BytesIO(b"")) is None
+    for n in range(1, len(data)):
+        with pytest.raises(TornFrameError) as e:
+            decode_frame(data[:n])
+        # The failing layer is named (header / payload / crc trailer).
+        assert "torn frame" in str(e.value), n
+
+
+def test_bad_magic_rejected():
+    data = encode_frame(OP_REQ, 1, b"{}")
+    with pytest.raises(TornFrameError, match="bad magic"):
+        decode_frame(b"XXXX" + data[4:])
+
+
+def test_unknown_version_rejected():
+    data = encode_frame(OP_REQ, 1, b"{}", version=99)
+    with pytest.raises(ProtocolVersionError, match="99"):
+        decode_frame(data)
+
+
+def test_flipped_payload_byte_fails_crc():
+    data = bytearray(encode_frame(OP_REQ, 1, b'{"op": "stats"}'))
+    data[wire._HEADER.size + 3] ^= 0xFF
+    with pytest.raises(TornFrameError, match="crc mismatch"):
+        decode_frame(bytes(data))
+
+
+def test_oversized_length_prefix_rejected_before_allocation():
+    # A corrupt length prefix must reject WITHOUT reading the payload.
+    head = wire._HEADER.pack(MAGIC, PROTO_VERSION, OP_REQ, 0, 1,
+                             MAX_PAYLOAD + 1)
+    with pytest.raises(FrameTooLargeError):
+        decode_frame(head)
+    with pytest.raises(FrameTooLargeError):
+        encode_frame(OP_REQ, 1, b"x" * (MAX_PAYLOAD + 1))
+
+
+def test_torn_frame_is_a_connection_error():
+    # The retry loop treats a torn frame as "the connection is garbage":
+    # reconnect-and-resend, which classify_net already blesses.
+    assert issubclass(TornFrameError, ConnectionError)
+    assert classify_net(TornFrameError("x")) == "retryable"
+
+
+# ---------------------------------------------------------------------------
+# classify_net + the wire retry policy.
+# ---------------------------------------------------------------------------
+
+
+def test_classify_net_table_exact():
+    retryable = [TimeoutError("t"), ConnectionResetError("r"),
+                 ConnectionRefusedError("c"), BrokenPipeError("b"),
+                 EOFError("e"), ConnectionError("closed"),
+                 OSError(errno.EHOSTUNREACH, "x")]
+    for err in retryable:
+        assert classify_net(err) == "retryable", err
+    fatal = [OSError(errno.EACCES, "x"), OSError("no errno"),
+             ValueError("v"), ProtocolVersionError("p"),
+             FrameTooLargeError("f")]
+    for err in fatal:
+        assert classify_net(err) == "fatal", err
+    for code in sorted(RETRYABLE_NET_ERRNOS):
+        assert classify_net(OSError(code, "x")) == "retryable", code
+
+
+def test_default_net_retry_tighter_than_storage():
+    # A query client must degrade in seconds, not inherit the storage
+    # plane's patience.
+    assert DEFAULT_NET_RETRY.retries == 5
+    assert DEFAULT_NET_RETRY.deadline_s <= 5.0
+    assert DEFAULT_NET_RETRY.max_backoff_s <= 0.5
+    seq = [DEFAULT_NET_RETRY.backoff_s(i) for i in range(6)]
+    assert seq == [DEFAULT_NET_RETRY.backoff_s(i) for i in range(6)]
+
+
+# ---------------------------------------------------------------------------
+# faultnet: schedule semantics, determinism, env contract.
+# ---------------------------------------------------------------------------
+
+
+def test_faultnet_env_mirror():
+    assert faultnet.FAULTNET_ENV == retry_mod.FAULTNET_ENV
+
+
+def test_rule_validation_rejects_illegal_combos():
+    with pytest.raises(ValueError):
+        NetFaultRule("serve", "recv", "cut")       # cut is send-only
+    with pytest.raises(ValueError):
+        NetFaultRule("serve", "send", "refuse")    # refuse is connect
+    with pytest.raises(ValueError):
+        NetFaultRule("serve", "connect", "drop")   # drop is accept-only
+    with pytest.raises(ValueError):
+        NetFaultRule("serve", "*", "cut")          # '*' only for delay
+    with pytest.raises(ValueError):
+        NetFaultRule("serve", "send", "nonsense")
+    with pytest.raises(ValueError):
+        NetFaultRule("serve", "send", "cut", every=0)
+    with pytest.raises(ValueError):
+        NetFaultRule("serve", "send", "cut", prob=0.0)
+    NetFaultRule("*", "*", "delay", delay_s=0.001)  # legal wildcard
+
+
+def test_rule_window_semantics():
+    # count is the WINDOW WIDTH [start, start+count), not a fire count:
+    # start=2, count=9, every=3 fires at n = 2, 5, 8.
+    r = NetFaultRule("c", "send", "cut", start=2, count=9, every=3)
+    fired = [n for n in range(20) if r.matches("c", "send", n, seed=0)]
+    assert fired == [2, 5, 8]
+    forever = NetFaultRule("c", "send", "cut", start=1, count=None,
+                           every=4)
+    fired = [n for n in range(14) if forever.matches("c", "send", n, 0)]
+    assert fired == [1, 5, 9, 13]
+    assert not r.matches("other", "send", 2, 0)  # class targeted
+    assert not r.matches("c", "recv", 2, 0)      # op targeted
+
+
+def _drive(net: FaultNet, n: int = 40):
+    """A synthetic deterministic op stream over two peer classes."""
+    for i in range(n):
+        for cls in ("client", "serve"):
+            for op in ("connect", "send", "recv"):
+                try:
+                    net.check(op, cls)
+                except (ConnectionError, TimeoutError, OSError):
+                    pass
+
+
+def test_faultnet_same_seed_same_trail():
+    rules = [NetFaultRule("client", "connect", "refuse", start=3,
+                          count=None, every=5, prob=0.6),
+             NetFaultRule("serve", "send", "cut", start=0, count=20,
+                          every=4),
+             NetFaultRule("*", "*", "delay", delay_s=0.0, start=10,
+                          count=None, every=7, prob=0.4)]
+    a = FaultNet(rules, seed=7, sleep=lambda s: None)
+    b = FaultNet(rules, seed=7, sleep=lambda s: None)
+    _drive(a)
+    _drive(b)
+    assert a.trail() == b.trail()
+    assert a.trail(), "schedule fired nothing — test is vacuous"
+    c = FaultNet(rules, seed=8, sleep=lambda s: None)
+    _drive(c)
+    assert c.trail() != a.trail()  # distinct seeds desynchronize prob
+
+
+def test_faultnet_quiesce_heals_but_keeps_evidence():
+    net = FaultNet([NetFaultRule("c", "connect", "refuse", start=0,
+                                 count=None)], seed=0)
+    with pytest.raises(ConnectionRefusedError):
+        net.check("connect", "c")
+    net.quiesce()
+    assert net.check("connect", "c") is None  # healed
+    assert net.injected_counts() == {("c", "connect", "refuse"): 1}
+
+
+def test_spec_roundtrip_string_and_file(tmp_path):
+    rules = [NetFaultRule("serve", "send", "trickle", chunk=3,
+                          delay_s=0.001, start=1, count=None, every=2)]
+    net = FaultNet(rules, seed=5)
+    again = FaultNet.from_spec(net.to_spec())
+    assert again.rules == net.rules and again.seed == 5
+    p = tmp_path / "schedule.json"
+    p.write_text(net.to_spec(), encoding="utf-8")
+    from_file = FaultNet.from_spec(str(p))
+    assert from_file.rules == net.rules and from_file.seed == 5
+
+
+def test_env_self_install(tmp_path, monkeypatch):
+    """A process launched with FPS_TPU_FAULTNET self-installs the
+    schedule at the first seam crossing — no imports required of it."""
+    net = FaultNet([NetFaultRule("client", "connect", "refuse",
+                                 start=0, count=1)], seed=0)
+    monkeypatch.setenv(retry_mod.FAULTNET_ENV, net.to_spec())
+    monkeypatch.setattr(retry_mod, "_net_injector", None)
+    monkeypatch.setattr(retry_mod, "_net_env_checked", False)
+    try:
+        with pytest.raises(ConnectionRefusedError):
+            net_fault_check("connect", "client")
+        assert net_fault_check("connect", "client") is None  # count=1
+        assert net_fault_check("send", "serve") is None  # other stream
+    finally:
+        retry_mod.remove_net_injector()
+        monkeypatch.setattr(retry_mod, "_net_env_checked", False)
+
+
+def test_cut_and_trickle_directives():
+    net = FaultNet([NetFaultRule("c", "send", "cut", cut_bytes=6,
+                                 start=0, count=1),
+                    NetFaultRule("c", "send", "trickle", chunk=2,
+                                 delay_s=0.0, start=1, count=1)],
+                   seed=0)
+    assert net.check("send", "c") == ("cut", 6)
+    assert net.check("send", "c") == ("trickle", 2, 0.0)
+    assert net.check("send", "c") is None
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: WireClient <-> TcpServe.
+# ---------------------------------------------------------------------------
+
+
+def _snapshot():
+    rng = np.random.default_rng(3)
+    tables = {"weights": rng.normal(size=(64, 4)).astype(np.float32)}
+    return ServableSnapshot(11, "test-wire", tables, [], "none")
+
+
+def _tcp(**kw):
+    server = ReadServer()
+    server.swap_to(_snapshot())
+    return server, TcpServe(server, **kw).start()
+
+
+def _raw_conn(port):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+    return s, s.makefile("rb")
+
+
+def test_wire_client_roundtrip_matches_handle_request():
+    server, tcp = _tcp()
+    try:
+        with WireClient("127.0.0.1", tcp.port,
+                        peer_class="client") as c:
+            assert c.version == PROTO_VERSION
+            req = {"op": "pull", "table": "weights", "ids": [1, 5, 9]}
+            got = c.request(req)
+            want = handle_request(server, req)
+            assert got == json.loads(json.dumps(want))
+            assert c.request({"op": "stats"})["ok"]
+            # Application-level errors return unchanged, NOT retried.
+            bad = c.request({"op": "bogus"})
+            assert not bad["ok"] and c.retries == 0
+        assert tcp.wire_stats()["framed_conns"] == 1
+    finally:
+        tcp.close()
+
+
+def test_dual_stack_serves_legacy_line_json():
+    server, tcp = _tcp()
+    try:
+        sock, rfile = _raw_conn(tcp.port)
+        try:
+            sock.sendall(json.dumps(
+                {"op": "pull", "table": "weights",
+                 "ids": [0]}).encode() + b"\n")
+            resp = json.loads(rfile.readline())
+            assert resp["ok"] and resp["step"] == 11
+        finally:
+            sock.close()
+        stats = tcp.wire_stats()
+        assert stats["legacy_conns"] == 1
+        assert stats["framed_conns"] == 0
+    finally:
+        tcp.close()
+
+
+def test_jsonl_client_is_a_framed_shim():
+    server, tcp = _tcp()
+    try:
+        with JsonlClient("127.0.0.1", tcp.port) as c:
+            assert c.request({"op": "stats"})["ok"]
+        # The compat shim speaks the FRAMED wire, not line-JSON.
+        assert tcp.wire_stats()["framed_conns"] == 1
+        assert tcp.wire_stats()["legacy_conns"] == 0
+    finally:
+        tcp.close()
+
+
+def test_dedupe_on_reconnect_executes_once():
+    """Server response frame cut mid-send -> client sees a torn frame,
+    reconnects, resends the SAME req_id -> server replays the cached
+    response instead of executing twice."""
+    server, tcp = _tcp()
+    try:
+        # serve/send stream: n=0 HELLO_OK, n=1 first response (cut),
+        # n=2 HELLO_OK on reconnect, n=3 cached replay.
+        faultnet.install([NetFaultRule("serve", "send", "cut",
+                                       cut_bytes=5, start=1, count=1)],
+                         seed=0)
+        executed_before = server.requests
+        with WireClient("127.0.0.1", tcp.port,
+                        peer_class="client") as c:
+            resp = c.request({"op": "pull", "table": "weights",
+                              "ids": [2, 3]})
+            assert resp["ok"]
+            assert c.reconnects == 1 and c.retries >= 1
+        stats = tcp.wire_stats()
+        assert stats["dedup_replays"] == 1
+        assert server.requests == executed_before + 1  # at-most-once
+    finally:
+        tcp.close()
+
+
+def test_busy_shed_is_retryable_and_bounded():
+    server, tcp = _tcp(max_inflight=1)
+    try:
+        # Wedge the single admission slot: every request sheds.
+        assert tcp._inflight.acquire(timeout=1.0)
+        try:
+            c = WireClient("127.0.0.1", tcp.port, peer_class="client",
+                           deadline_s=0.3)
+            with pytest.raises(ServerBusyError):
+                c.request({"op": "stats"})
+            assert c.busy_rejections >= 1
+            assert c.deadline_exceeded == 1
+            assert c.reconnects == 0  # BUSY never drops the connection
+            assert tcp.wire_stats()["shed_requests"] >= 1
+        finally:
+            tcp._inflight.release()
+        # The slot freed: the SAME client recovers on its next request.
+        assert c.request({"op": "stats"})["ok"]
+        c.close()
+    finally:
+        tcp.close()
+
+
+def test_dead_on_arrival_deadline_not_executed():
+    server, tcp = _tcp()
+    try:
+        executed_before = server.requests
+        sock, rfile = _raw_conn(tcp.port)
+        try:
+            def _send(op, req_id, obj):
+                sock.sendall(encode_frame(op, req_id, json.dumps(
+                    obj).encode()))
+
+            _send(OP_HELLO, 0, {"versions": [PROTO_VERSION],
+                                "session": "doa"})
+            assert wire.read_frame(rfile).op == OP_HELLO_OK
+            _send(OP_REQ, 1, {"d": 0.0, "q": {"op": "pull",
+                                              "table": "weights",
+                                              "ids": [0]}})
+            fr = wire.read_frame(rfile)
+            assert fr.op == OP_RESP and fr.req_id == 1
+            resp = fr.json()
+            assert resp["deadline_exceeded"] and resp["retryable"]
+        finally:
+            sock.close()
+        assert tcp.wire_stats()["deadline_exceeded"] == 1
+        assert server.requests == executed_before  # never executed
+    finally:
+        tcp.close()
+
+
+def test_version_negotiation_rejects_loudly():
+    server, tcp = _tcp()
+    try:
+        sock, rfile = _raw_conn(tcp.port)
+        try:
+            sock.sendall(encode_frame(OP_HELLO, 0, json.dumps(
+                {"versions": [99], "session": "v99"}).encode()))
+            fr = wire.read_frame(rfile)
+            assert fr.op == OP_ERR
+            body = fr.json()
+            assert "no common protocol version" in body["error"]
+            assert body["supported"] == list(wire.SUPPORTED_VERSIONS)
+        finally:
+            sock.close()
+    finally:
+        tcp.close()
+
+
+def test_garbage_after_magic_byte_counted_as_torn():
+    server, tcp = _tcp()
+    try:
+        sock, _ = _raw_conn(tcp.port)
+        try:
+            # First byte routes to the framed path; the rest is junk.
+            sock.sendall(MAGIC[:1] + b"garbage-not-a-frame")
+            sock.shutdown(socket.SHUT_WR)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if tcp.wire_stats()["torn_frames"]:
+                    break
+                time.sleep(0.01)
+        finally:
+            sock.close()
+        assert tcp.wire_stats()["torn_frames"] == 1
+        assert tcp.wire_stats()["framed_conns"] == 1
+    finally:
+        tcp.close()
+
+
+def test_client_retries_through_injected_resets():
+    server, tcp = _tcp()
+    try:
+        # connect #0 is the constructor (no-retry by contract); faults
+        # start at #1 so only request-path reconnects are faulted.
+        faultnet.install([NetFaultRule("client", "send", "cut",
+                                       cut_bytes=4, start=2, count=5,
+                                       every=2)], seed=0)
+        with WireClient("127.0.0.1", tcp.port,
+                        peer_class="client") as c:
+            for i in range(4):
+                assert c.request({"op": "stats"})["ok"], i
+            assert c.retries >= 1 and c.reconnects >= 1
+    finally:
+        tcp.close()
+
+
+# ---------------------------------------------------------------------------
+# Per-reader liveness beacons.
+# ---------------------------------------------------------------------------
+
+
+def test_reader_heartbeat_beacon_on_poll(tmp_path):
+    d = str(tmp_path)
+    r = FleetReader(d, "r0", heartbeat_interval_s=0.0)
+    r.poll()  # nothing servable yet — the beacon still beats
+    beats = scan_heartbeats(d)
+    assert set(beats) == {"r0"}
+    assert beats["r0"]["polls"] == 1 and beats["r0"]["step"] is None
+    assert beats["r0"]["age_s"] < DEFAULT_LIVENESS_TIMEOUT_S
+    assert os.path.exists(r.heartbeat_path)
+
+
+def test_liveness_check_fresh_stale_and_missing(tmp_path):
+    d = str(tmp_path)
+    r = FleetReader(d, "r0", heartbeat_interval_s=0.0)
+    r.poll()
+    fresh = liveness_check(d)
+    assert fresh["wedged"] == [] and "r0" in fresh["ages"]
+    # Judged 10s in the future the same beacon is stale -> wedged.
+    stale = liveness_check(d, timeout_s=5.0, now=time.time() + 10.0)
+    assert stale["wedged"] == ["r0"]
+    # An expected reader that never wrote a beacon is wedged too —
+    # a reader that never came up must not be a silent absence.
+    ghost = liveness_check(d, expected=["r0", "ghost"])
+    assert ghost["wedged"] == ["ghost"]
+    assert ghost["ages"]["ghost"] is None
+
+
+def test_liveness_check_empty_dir(tmp_path):
+    rep = liveness_check(str(tmp_path))
+    assert rep == {"ages": {}, "wedged": []}
+
+
+def test_heartbeat_path_class_is_liveness():
+    assert classify_path("/ckpt/fleet/heartbeat_r0.json") == "liveness"
+    assert classify_path("/ckpt/fleet/ready_r0.json") != "liveness"

@@ -367,6 +367,22 @@ def _harness_scenarios():
             "run_enospc_compaction_scenario"),
         "slow_lease_near_ttl": _subprocess_scenario(
             "run_slow_lease_near_ttl_scenario"),
+        # Hostile-network scenarios (fps_tpu.serve.wire +
+        # fps_tpu.testing.faultnet; docs/resilience.md "Hostile
+        # network"): deterministic wire-fault schedules against the
+        # framed TCP plane — no torn frame is ever decoded, reconnects
+        # dedupe through the replay cache (zero duplicate applies),
+        # slow peers cost latency never integrity, deadlines bound
+        # every request, and a SIGSTOPped reader becomes a
+        # reader_wedged incident within the liveness timeout.
+        "net_torn_frames": _subprocess_scenario(
+            "run_net_torn_frames_scenario"),
+        "net_reconnect_storm": _subprocess_scenario(
+            "run_net_reconnect_storm_scenario"),
+        "net_slow_peer": _subprocess_scenario(
+            "run_net_slow_peer_scenario"),
+        "net_partition_reader": _subprocess_scenario(
+            "run_net_partition_reader_scenario"),
     }
 
 

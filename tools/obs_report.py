@@ -97,6 +97,12 @@ _INCIDENT_EVENTS = (
     "checkpoint_backlog_drained",
     "compaction_aborted",
     "leader_io_error",
+    # Hostile-network survival (fps_tpu.serve.wire / serve.fleet): a
+    # silent reader became an incident the supervisor can act on, and
+    # torn frames were rejected loudly instead of decoded.
+    "reader_wedged",
+    "reader_restarted",
+    "wire_torn_frame",
     # Pod coordination (journal-pod.jsonl, written into the pod dir by
     # the lease-holding member — point this tool at the pod dir and the
     # digest narrates the whole pod run).
@@ -121,7 +127,7 @@ REQUIRED_FIELDS = (
     "steps", "examples", "phase_seconds", "health", "incidents",
     "checkpoint_saves", "quarantined", "wall_span_s", "prefetch",
     "hot_tier", "megastep", "tiering", "source_stalls", "analysis",
-    "serve", "pod",
+    "serve", "pod", "net",
 )
 
 
@@ -444,6 +450,27 @@ def render_digest(obs_dir: str) -> dict:
                 counters.get("storage.sidecar_skips", 0)),
             "compaction_aborts": int(
                 counters.get("storage.compaction_aborts", 0)),
+        },
+        # Hostile-network survival (fps_tpu.serve.wire / serve.net;
+        # docs/resilience.md "Hostile network"): retry/reconnect
+        # traffic, frames the length/CRC gates rejected, requests shed
+        # by admission control or abandoned on a dead deadline, and
+        # per-reader liveness — a wedged reader is a reader_wedged
+        # incident here, never a silent zero (BENCH_r14).
+        "net": {
+            "retries": int(counters.get("net.retries", 0)),
+            "reconnects": int(counters.get("net.reconnects", 0)),
+            "torn_frames": int(counters.get("net.torn_frames", 0)),
+            "shed_requests": int(
+                counters.get("net.shed_requests", 0)),
+            "deadline_exceeded": int(
+                counters.get("net.deadline_exceeded", 0)),
+            "reader_heartbeat_age_s_last": gauges.get(
+                "serve.reader_heartbeat_age_s", {}).get("last"),
+            "reader_heartbeat_age_s_max": gauges.get(
+                "serve.reader_heartbeat_age_s", {}).get("max"),
+            "reader_wedged_incidents": len(
+                incidents.get("reader_wedged", ())),
         },
         "checkpoint_saves": int(counters.get("checkpoint.saves", 0)),
         # Async writer: enqueued > saved means a write was still in
