@@ -51,6 +51,16 @@ some cases shipped and fixed) before:
   hostile-network model guarantees — one naked ``recv`` against a
   partitioned peer wedges its caller forever. Speak
   ``fps_tpu.serve.wire.WireClient``.
+* **FPS009 raw-tenant-path** — a path call whose arguments spell a
+  tenant-namespace literal (``"tenants"`` / ``"tenant.json"``) outside
+  the sanctioned helper (``fps_tpu/tenancy/paths.py``). Tenant
+  blast-radius isolation is a PATH property: every checkpoint/obs/
+  sidecar file must live under ``<root>/tenants/<name>/...``, and the
+  namespace audit only holds if every plane derives those paths from
+  ``TenantPaths`` (or, in stdlib-only login-node tools, from a mirrored
+  ``TENANTS_DIRNAME`` constant — a Name, which this rule deliberately
+  does not flag). A hand-spelled ``"tenants"`` literal is one typo away
+  from writing into a neighbor's namespace.
 
 Suppression: append ``# noqa: FPSNNN`` to the flagged line — but the
 tier-1 test runs this linter over ``fps_tpu/`` expecting zero findings,
@@ -104,6 +114,9 @@ RULES = {
     "FPS008": "raw socket use outside fps_tpu/serve/ — every caller "
               "goes through the framed WireClient (deadlines, bounded "
               "retry, idempotent reconnect)",
+    "FPS009": "hand-spelled tenant-namespace literal in a path call "
+              "outside fps_tpu/tenancy/paths.py — derive tenant paths "
+              "from TenantPaths (or a mirrored *_DIRNAME constant)",
 }
 
 # Calls whose presence makes a function (and everything lexically inside
@@ -144,6 +157,19 @@ _RAW_SOCKET_CALLS = {
     "socket.socket", "socket.create_connection", "create_connection",
 }
 _SOCKET_OK_DIRS = ("fps_tpu/serve/",)
+
+# FPS009: path-constructing calls whose STRING arguments may not spell
+# the tenant namespace by hand; only the helper module owns the layout.
+# Mirrored Name constants (TENANTS_DIRNAME) pass — the rule keys on
+# string literals, the typo-prone form.
+_TENANT_PATH_CALLS = {
+    "open", "os.path.join", "path.join", "os.makedirs", "os.listdir",
+    "os.path.isdir", "os.path.isfile", "os.path.exists", "os.remove",
+    "os.rmdir", "glob.glob", "glob.iglob", "Path", "pathlib.Path",
+    "shutil.rmtree", "shutil.copytree",
+}
+_TENANT_TOKENS = ("tenants", "tenant.json")
+_TENANT_HELPER_PATHS = ("fps_tpu/tenancy/paths.py",)
 
 _SYNC_PRIMITIVES = {
     "Lock", "RLock", "Condition", "Event", "Semaphore",
@@ -219,6 +245,9 @@ class _Linter(ast.NodeVisitor):
             or any(d in norm for d in _CKPT_READER_DIRS))
         # FPS008 exemption: the wire/net modules ARE the framed layer.
         self.is_wire_module = any(d in norm for d in _SOCKET_OK_DIRS)
+        # FPS009 exemption: the tenant path helper owns the layout.
+        self.is_tenant_helper = any(
+            norm.endswith(p) for p in _TENANT_HELPER_PATHS)
         # FPS001: stack of (loop_node, target_names) we are inside of.
         self._loops: list[tuple[ast.AST, set[str]]] = []
         # FPS003: depth of enclosing compiled-fn-builder functions.
@@ -274,6 +303,21 @@ class _Linter(ast.NodeVisitor):
                     return True
         return False
 
+    def _tenant_flavored(self, node) -> bool:
+        """A string literal in the call's arguments spelling the tenant
+        namespace (``"tenants"`` path segment or the manifest name)."""
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for n in ast.walk(arg):
+                if not (isinstance(n, ast.Constant)
+                        and isinstance(n.value, str)):
+                    continue
+                low = n.value.lower()
+                if ("tenant.json" in low or low == "tenants"
+                        or "tenants/" in low
+                        or low.endswith("/tenants")):
+                    return True
+        return False
+
     def visit_Call(self, node):
         # FPS007: a host clock read under tracing is a constant, not a
         # measurement (the _trace_depth scope is FPS003's).
@@ -294,6 +338,17 @@ class _Linter(ast.NodeVisitor):
                     "the CRC-verified readers (Checkpointer.read_snapshot, "
                     "snapshot_format.verify_snapshot_file + "
                     "map_snapshot_arrays, or fps_tpu.serve)")
+        # FPS009: a hand-spelled tenant-namespace literal in a path call
+        # is one typo from writing into a neighbor's blast radius.
+        if (not self.is_tenant_helper
+                and _call_name(node) in _TENANT_PATH_CALLS
+                and self._tenant_flavored(node)):
+            self._add(
+                "FPS009", node,
+                f"{_call_name(node)}() spells the tenant namespace by "
+                "hand — derive checkpoint/obs/sidecar paths from "
+                "fps_tpu.tenancy.TenantPaths (stdlib-only tools: a "
+                "mirrored TENANTS_DIRNAME constant)")
         # FPS008: raw sockets outside the wire layer dodge deadlines,
         # bounded retry, and the idempotent reconnect contract.
         if (not self.is_wire_module

@@ -371,6 +371,26 @@ def default_registry() -> MetricsRegistry:
                         "budget — client side (retry budget ran out "
                         "inside the per-request deadline) or server "
                         "side (dead-on-arrival envelope)"),
+        MetricSpec("net.replay_cache_evictions", "counter",
+                   unit="responses",
+                   help="cached (session, req_id) replay responses "
+                        "evicted by the byte-bounded LRU (max_bytes "
+                        "cap): an evicted entry's resend is re-executed "
+                        "instead of replayed — duplicate work, never a "
+                        "duplicate side effect for idempotent reads"),
+        # Shadow serving (fps_tpu.serve.shadow): old-vs-new snapshot
+        # scoring gates fleet promotion (docs/STALENESS.md).
+        MetricSpec("serve.shadow_promotions", "counter", unit="snapshots",
+                   help="snapshot candidates promoted by the shadow "
+                        "scorer (score(new) >= score(approved) + "
+                        "min_delta) — the gated fleet's fence may now "
+                        "advance to them"),
+        MetricSpec("serve.shadow_held", "counter", unit="snapshots",
+                   help="snapshot candidates HELD by the shadow scorer "
+                        "(scored worse than the approved snapshot "
+                        "beyond min_delta): the fleet keeps serving the "
+                        "old approved step — lost freshness, never "
+                        "wrong answers"),
         # Program contract auditor (fps_tpu.analysis; Trainer(audit=...)).
         MetricSpec("analysis.certified_programs", "counter",
                    unit="programs",

@@ -35,6 +35,7 @@ from fps_tpu.serve.fleet import (
 )
 from fps_tpu.serve.net import JsonlClient, TcpServe, handle_request
 from fps_tpu.serve.server import NoSnapshotError, ReadServer
+from fps_tpu.serve.shadow import ShadowGate, ShadowScorer
 from fps_tpu.serve.snapshot import DeltaView, ServableSnapshot, SnapshotRejected
 from fps_tpu.serve.watcher import SnapshotWatcher
 from fps_tpu.serve.wire import (
@@ -55,6 +56,8 @@ __all__ = [
     "ServableSnapshot",
     "ServerBusyError",
     "ServingFleet",
+    "ShadowGate",
+    "ShadowScorer",
     "SnapshotRejected",
     "SnapshotWatcher",
     "StepFence",

@@ -293,15 +293,28 @@ class FleetReader:
     the actual server swap to the fence step. Construction re-reads the
     fence FIRST: a reader restarted mid-swap never answers a step older
     than the fleet's published fence.
+
+    ``shadow=True`` gates the reader on the tenant's shadow-serving
+    promotion record (:class:`~fps_tpu.serve.shadow.ShadowGate`):
+    readiness and fence advancement are capped at the newest APPROVED
+    step, so a publication the scorer held (or has not judged yet) is
+    invisible to the fleet — it keeps serving the old approved step.
+    Lost freshness, never wrong answers (docs/STALENESS.md).
     """
 
     def __init__(self, ckpt_dir: str, reader_id: str, *, quorum: int = 1,
                  journal: str | None = None, recorder=None,
                  warm_from=None, verify: bool = True,
-                 heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S):
+                 heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
+                 shadow: bool = False):
         self.ckpt_dir = ckpt_dir
         self.reader_id = str(reader_id)
         self.quorum = int(quorum)
+        if shadow:
+            from fps_tpu.serve.shadow import ShadowGate
+            self.shadow_gate = ShadowGate(ckpt_dir)
+        else:
+            self.shadow_gate = None
         self.recorder = recorder
         self.verify = verify
         # warm_from: None | {table: ids} | "tiering" (sidecar ranking).
@@ -413,8 +426,23 @@ class FleetReader:
     def _poll_once(self) -> int | None:
         self.watcher.poll()
         cand = self._candidate
-        if cand is not None:
-            self.fence.ready(cand.step)
+        # Shadow gating: readiness AND fence advancement are capped at
+        # the approved step. While nothing is approved a gated reader
+        # neither declares readiness nor advances — stale readiness
+        # slots (a gate enabled over an existing fleet dir) must not be
+        # able to drag the fence past the scorer.
+        ready = None if cand is None else cand.step
+        advance_cap = ready
+        if self.shadow_gate is not None:
+            approved = self.shadow_gate.approved_step()
+            if approved is None:
+                ready = advance_cap = None
+            else:
+                advance_cap = (approved if ready is None
+                               else min(ready, approved))
+                ready = None if ready is None else min(ready, approved)
+        if ready is not None:
+            self.fence.ready(ready)
         cur = self.fence.read()
         # Coordinated rollback, EVIDENCE-based and re-assertable: when
         # the fence names a step this reader's watcher has proven
@@ -429,9 +457,8 @@ class FleetReader:
                      or self._fence_step_dead(cur[1]))):
             cur = self.fence.rollback(cand.step)
         self._rollback_due = False
-        cur = self.fence.advance(
-            self.quorum,
-            max_step=None if cand is None else cand.step)
+        if self.shadow_gate is None or advance_cap is not None:
+            cur = self.fence.advance(self.quorum, max_step=advance_cap)
         self._apply_fence(cur)
         snap = self.server._snap
         return None if snap is None else snap.step
@@ -505,14 +532,16 @@ class ServingFleet:
 
     def __init__(self, ckpt_dir: str, n_readers: int = 3, *,
                  quorum: int | None = None, journal: str | None = None,
-                 recorder=None, warm_from=None, verify: bool = True):
+                 recorder=None, warm_from=None, verify: bool = True,
+                 shadow: bool = False):
         if n_readers < 1:
             raise ValueError(f"n_readers must be >= 1, got {n_readers}")
         self.quorum = (n_readers // 2 + 1) if quorum is None else quorum
         self.readers = [
             FleetReader(ckpt_dir, f"r{i}", quorum=self.quorum,
                         journal=journal, recorder=recorder,
-                        warm_from=warm_from, verify=verify)
+                        warm_from=warm_from, verify=verify,
+                        shadow=shadow)
             for i in range(n_readers)
         ]
         self._threads: list[threading.Thread] = []

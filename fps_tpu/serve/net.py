@@ -1,19 +1,16 @@
-"""TCP transport for ReadServer: framed wire (default) + line-JSON compat.
+"""TCP transport for ReadServer: framed wire only.
 
 The wire protocol proper lives in :mod:`fps_tpu.serve.wire` (versioned
 length-prefixed frames, CRC32, HELLO negotiation, the failure-aware
 :class:`~fps_tpu.serve.wire.WireClient`). This module is the SERVER
-side plus one release of backward compatibility:
-
-* :class:`TcpServe` peeks the first byte of each connection: the framed
-  magic routes into the framed handler (handshake, replay cache,
-  admission control, deadline enforcement); anything else — legacy
-  line-JSON clients always start ``{`` or whitespace — falls back to
-  the old one-JSON-object-per-line loop. Dual-stack is a ONE-release
-  bridge (``docs/serving.md``).
-* :class:`JsonlClient` is now a thin compat shim over ``WireClient``
-  (same constructor and ``request()`` surface, framed wire underneath)
-  so existing tools/tests migrate without a flag day.
+side. The PR-16 dual-stack bridge (first-byte peek routing legacy
+line-JSON clients into a compat loop) served its one deprecation
+release and is RETIRED: every connection must open with the framed
+HELLO. A legacy line-JSON peer now fails the first frame's magic/CRC
+gates and gets a counted ``torn_frames`` OP_ERR + dropped connection —
+loud, immediate, and impossible to half-support (``docs/serving.md``).
+:class:`JsonlClient` remains as a thin compat shim over ``WireClient``
+(same constructor and ``request()`` surface, framed wire underneath).
 
 Server-side survival (the tentpole's third leg):
 
@@ -33,9 +30,15 @@ Server-side survival (the tentpole's third leg):
   is counted (``net.torn_frames``), journaled, and the connection
   dropped loudly; the payload is NEVER decoded.
 * **idempotent replay** — executed responses are cached per
-  ``(session, req_id)`` in a bounded LRU; a reconnecting client
-  resending an in-flight request gets the cached response, not a
-  second execution (the zero-duplicate-applies chaos invariant).
+  ``(session, req_id)`` in a BYTE-bounded LRU (``replay_cache_bytes``;
+  cache cost is response-size-dependent, so an entry-count bound would
+  let one big-response tenant evict a small tenant's entries at ~zero
+  byte cost); a reconnecting client resending an in-flight request
+  gets the cached response, not a second execution (the
+  zero-duplicate-applies chaos invariant). Evictions are counted
+  (``net.replay_cache_evictions``): an evicted entry's resend
+  re-executes — duplicate work, never a duplicate side effect for
+  these idempotent reads.
 
 The request/response dicts (and :func:`handle_request`) are unchanged
 from the line protocol — framing added integrity and liveness, not a
@@ -63,7 +66,7 @@ from fps_tpu.obs.sinks import scrub_nonfinite
 from fps_tpu.serve.server import NoSnapshotError, ReadServer
 from fps_tpu.serve.watcher import _emit_event, _emit_metric
 from fps_tpu.serve.wire import (OP_BUSY, OP_ERR, OP_HELLO, OP_HELLO_OK,
-                                OP_REQ, OP_RESP, MAGIC,
+                                OP_REQ, OP_RESP,
                                 SUPPORTED_VERSIONS, FrameTooLargeError,
                                 ProtocolVersionError, TornFrameError,
                                 WireClient, encode_frame, read_frame,
@@ -145,24 +148,31 @@ class TcpServe:
     ``max_inflight`` bounds concurrently-EXECUTING requests across all
     connections (admission control; excess is shed with BUSY);
     ``conn_timeout_s`` reaps connections whose peer goes silent
-    mid-conversation; ``replay_cache`` bounds the (session, req_id) →
-    response LRU that makes client resends idempotent. Wire-plane
+    mid-conversation; the (session, req_id) → response replay LRU that
+    makes client resends idempotent is bounded BOTH by entries
+    (``replay_cache``) and by payload bytes (``replay_cache_bytes`` —
+    the binding bound in practice: responses vary from tens of bytes to
+    MiBs, and fairness between peers is a byte property). Wire-plane
     metrics ride the ReadServer's recorder; :meth:`wire_stats` exposes
     the same counts as plain ints for tests and scenarios."""
 
     def __init__(self, server: ReadServer, *, host: str = "127.0.0.1",
                  port: int = 0, max_inflight: int = 64,
                  conn_timeout_s: float = 60.0,
-                 replay_cache: int = 1024):
+                 replay_cache: int = 1024,
+                 replay_cache_bytes: int = 8 << 20):
         read_server = server
         tcp_serve = self
+        self._read_server = server
         self._inflight = threading.BoundedSemaphore(max_inflight)
         self._stats_lock = threading.Lock()
         self._replay: collections.OrderedDict = collections.OrderedDict()
         self._replay_cap = int(replay_cache)
+        self._replay_max_bytes = int(replay_cache_bytes)
+        self._replay_bytes = 0
         self._counts = {"torn_frames": 0, "shed_requests": 0,
                         "deadline_exceeded": 0, "dedup_replays": 0,
-                        "framed_conns": 0, "legacy_conns": 0,
+                        "framed_conns": 0, "replay_evictions": 0,
                         "dropped_accepts": 0}
 
         class Handler(socketserver.StreamRequestHandler):
@@ -180,15 +190,12 @@ class TcpServe:
                 if directive == "drop":
                     tcp_serve._bump("dropped_accepts")
                     return  # one-way partition: accepted, never served
-                head = self.rfile.peek(1)[:1]
-                if not head:
-                    return
-                if head == MAGIC[:1]:
-                    tcp_serve._bump("framed_conns")
-                    self._handle_framed()
-                else:
-                    tcp_serve._bump("legacy_conns")
-                    self._handle_lines()
+                # Framed wire only (the PR-16 dual-stack peek is
+                # retired): a legacy line-JSON peer fails the first
+                # frame's magic gate inside the handshake and gets a
+                # counted OP_ERR + dropped connection.
+                tcp_serve._bump("framed_conns")
+                self._handle_framed()
 
             # -- framed path --------------------------------------------
 
@@ -305,22 +312,6 @@ class TcpServe:
                     tcp_serve._replay_put(key, data)
                 send_frame(self.connection, data, "serve")
 
-            # -- legacy line-JSON path (one-release compat) -------------
-
-            def _handle_lines(self):
-                for line in self.rfile:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        req = json.loads(line)
-                    except json.JSONDecodeError as e:
-                        resp = {"ok": False, "error": f"bad json: {e}"}
-                    else:
-                        resp = handle_request(read_server, req)
-                    self.wfile.write(_safe_dumps(resp) + b"\n")
-                    self.wfile.flush()
-
         self._tcp = socketserver.ThreadingTCPServer(
             (host, port), Handler, bind_and_activate=True)
         self._tcp.daemon_threads = True
@@ -344,16 +335,39 @@ class TcpServe:
             return data
 
     def _replay_put(self, key, data: bytes) -> None:
+        recorder = self._read_server.recorder
         with self._stats_lock:
+            old = self._replay.pop(key, None)
+            if old is not None:
+                self._replay_bytes -= len(old)
             self._replay[key] = data
-            self._replay.move_to_end(key)
-            while len(self._replay) > self._replay_cap:
-                self._replay.popitem(last=False)
+            self._replay_bytes += len(data)
+            # Byte bound first (the binding one — fairness between a
+            # MiB-response tenant and a tens-of-bytes tenant is a byte
+            # property), entry bound as a backstop. Strict LRU order:
+            # oldest-touched entries go first, pinned by the test.
+            evicted = 0
+            while (self._replay
+                   and (self._replay_bytes > self._replay_max_bytes
+                        or len(self._replay) > self._replay_cap)):
+                _k, v = self._replay.popitem(last=False)
+                self._replay_bytes -= len(v)
+                evicted += 1
+            self._counts["replay_evictions"] += evicted
+        if evicted:
+            # Outside the stats lock: the recorder takes its own.
+            _emit_metric(recorder, "inc",
+                         "net.replay_cache_evictions", evicted)
+
+    def replay_bytes(self) -> int:
+        """Current replay-cache payload bytes (<= replay_cache_bytes)."""
+        with self._stats_lock:
+            return self._replay_bytes
 
     def wire_stats(self) -> dict:
         """Plain-int wire counters (scenario/bench evidence):
         torn_frames, shed_requests, deadline_exceeded, dedup_replays,
-        framed_conns, legacy_conns, dropped_accepts."""
+        framed_conns, replay_evictions, dropped_accepts."""
         with self._stats_lock:
             return dict(self._counts)
 
@@ -379,9 +393,9 @@ class JsonlClient:
     (constructor, ``request()``, ``close()``, context manager) speaking
     the FRAMED wire through :class:`~fps_tpu.serve.wire.WireClient`.
     Existing tools/tests keep working and silently gain deadlines,
-    bounded retry, and idempotent reconnect; external line-JSON clients
-    keep working against the dual-stack server for one release
-    (``docs/serving.md``). New code should use ``WireClient``."""
+    bounded retry, and idempotent reconnect. The dual-stack server that
+    accepted raw line-JSON peers is retired (``docs/serving.md``); new
+    code should use ``WireClient``."""
 
     def __init__(self, host: str, port: int, *, timeout: float = 10.0):
         self._wire = WireClient(host, port, timeout=timeout,

@@ -12,9 +12,10 @@ tell whether its in-flight request executed. Framing fixes all three:
 ``          req_id(u64) | payload_len(u32)``   (network byte order)
 
 * **magic** — ``\\xabFPS``; the first byte is deliberately outside
-  ASCII so a dual-stack server can peek one byte and route legacy
-  line-JSON clients (which always start ``{`` or whitespace) down the
-  old path (``docs/serving.md``, deprecation note).
+  ASCII, so a stray legacy line-JSON peer (whose bytes always start
+  ``{`` or whitespace) fails the magic gate on its FIRST frame and is
+  rejected loudly — the PR-16 dual-stack peek that once routed such
+  peers to a compat loop is retired (``docs/serving.md``).
 * **version** — negotiated by a HELLO exchange: the client offers its
   versions, the server picks the highest common one or rejects LOUDLY
   (:class:`ProtocolVersionError`), never guesses.
@@ -178,24 +179,32 @@ def read_frame(rfile, *, allowed_versions=SUPPORTED_VERSIONS):
     truncated layer named, an unknown version raises
     :class:`ProtocolVersionError`, an oversized length prefix raises
     :class:`FrameTooLargeError` — all BEFORE any payload is decoded."""
-    first = rfile.read(_HEADER.size)
+    # Magic is validated from the first 4 bytes ALONE, before waiting
+    # for the rest of the header: a non-wire peer (e.g. a retired
+    # legacy line-JSON client) may send fewer bytes than a full header
+    # and then wait for a reply — it must fail fast with a torn-frame
+    # OP_ERR, not hang until the connection timeout reaps it.
+    first = rfile.read(len(MAGIC))
     if not first:
         return None
-    if len(first) < _HEADER.size:
-        # A buffered stream may legitimately return a short first read;
-        # top it up before declaring the header torn.
+    if len(first) < len(MAGIC):
         try:
-            first += _read_exact(rfile, _HEADER.size - len(first),
-                                 "header")
+            first += _read_exact(rfile, len(MAGIC) - len(first), "magic")
         except TornFrameError:
             raise TornFrameError(
                 f"torn frame: header truncated "
                 f"({len(first)}/{_HEADER.size} bytes)") from None
-    magic, version, op, flags, req_id, length = _HEADER.unpack(first)
-    if magic != MAGIC:
+    if first != MAGIC:
         raise TornFrameError(
-            f"torn frame: bad magic {magic!r} (mid-stream desync or a "
+            f"torn frame: bad magic {first!r} (mid-stream desync or a "
             f"non-wire peer)")
+    try:
+        first += _read_exact(rfile, _HEADER.size - len(MAGIC), "header")
+    except TornFrameError:
+        raise TornFrameError(
+            f"torn frame: header truncated "
+            f"({len(first)}/{_HEADER.size} bytes)") from None
+    _magic, version, op, flags, req_id, length = _HEADER.unpack(first)
     if version not in allowed_versions:
         raise ProtocolVersionError(
             f"unsupported protocol version {version} "

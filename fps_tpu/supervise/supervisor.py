@@ -442,6 +442,7 @@ class RunSupervisor:
         watch_fp = self._watch_fingerprint()
         aborted = None
         stall_kind = None
+        first_signal_seen = False
         while True:
             rc = proc.poll()
             if rc is not None:
@@ -464,6 +465,19 @@ class RunSupervisor:
                 hb_mtime, watch_fp = new_mtime, new_fp
                 last_signal = now
                 deadline_s = cfg.stall_timeout_s  # startup grace spent
+                if not first_signal_seen:
+                    # The attempt's FIRST liveness signal — the moment a
+                    # restarted child demonstrably dispatches again.
+                    # recovery_times() pairs this with the previous
+                    # attempt_end to measure time_to_recovered_s
+                    # (kill -> first post-restart dispatch), the MTTR
+                    # figure the chaos sweep digests record.
+                    first_signal_seen = True
+                    self._event("attempt_first_signal", attempt=attempt,
+                                t_rel_s=round(now - t0, 3),
+                                trace_id=self.trace_id,
+                                span_id=self._attempt_span,
+                                parent_id=self.run_span)
             if run_deadline is not None and now >= run_deadline:
                 rc = self._abort(proc, "wall_deadline", attempt)
                 aborted = "wall_deadline"
@@ -617,3 +631,44 @@ class RunSupervisor:
             self._event("chunk_quarantined", index=int(idx),
                         after_attempts=len(tail),
                         phase=record.get("last_phase"))
+
+
+def recovery_times(journal_path: str) -> list[float]:
+    """``time_to_recovered_s`` per restart from one supervisor journal:
+    for every attempt k+1 that produced a first liveness signal, the
+    wall-clock seconds from attempt k's ``attempt_end`` (the kill /
+    crash) to attempt k+1's ``attempt_first_signal`` (the first
+    post-restart dispatch). The list is one entry per RECOVERED restart
+    — an attempt that died before signaling contributes nothing (its
+    successor's recovery measures from the newest prior end anyway).
+
+    Stdlib-only and journal-only: the chaos sweep and ``obs_report``
+    both call this against ``journal-supervisor.jsonl`` after the fact.
+    """
+    ends: dict[int, float] = {}
+    firsts: dict[int, float] = {}
+    try:
+        with open(journal_path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail write
+                if rec.get("kind") != "event":
+                    continue
+                ev, attempt = rec.get("event"), rec.get("attempt")
+                if attempt is None or "t" not in rec:
+                    continue
+                if ev == "attempt_end":
+                    ends[int(attempt)] = float(rec["t"])
+                elif ev == "attempt_first_signal":
+                    firsts.setdefault(int(attempt), float(rec["t"]))
+    except OSError:
+        return []
+    out = []
+    for attempt, t_first in sorted(firsts.items()):
+        prior = [t for a, t in ends.items()
+                 if a < attempt and t <= t_first]
+        if prior:
+            out.append(round(t_first - max(prior), 3))
+    return out

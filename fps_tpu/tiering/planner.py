@@ -258,6 +258,152 @@ def plan_tables(
     return plans
 
 
+def tenant_demand_bytes(
+    densities: list[TableDensity] | dict[str, TableDensity],
+    *,
+    replica_budget_bytes: int = DEFAULT_REPLICA_BUDGET_BYTES,
+    coverage_target: float = 0.9,
+    min_head_coverage: float = MIN_HEAD_COVERAGE,
+) -> int:
+    """Replica bytes one tenant would CONSUME given a solo budget of
+    ``replica_budget_bytes`` — the demand figure the multi-tenant
+    arbitration splits. Mirrors :func:`plan_tables`' branch structure
+    exactly (full replication / coverage-target head / flat-untiered),
+    so ``granted >= demand`` guarantees the arbitrated plan is
+    bit-identical to the solo plan."""
+    if isinstance(densities, dict):
+        densities = list(densities.values())
+    demand = 0
+    for d in densities:
+        table_bytes = d.num_ids * d.dim * d.itemsize
+        total = float(d.counts.sum())
+        if total <= 0:
+            continue
+        if table_bytes <= replica_budget_bytes:
+            demand += table_bytes
+            continue
+        order = np.sort(d.counts)[::-1]
+        cum = np.cumsum(order) / total
+        H_cov = int(np.searchsorted(cum, coverage_target) + 1)
+        budget_rows = max(replica_budget_bytes // (d.dim * d.itemsize), 1)
+        H = int(min(H_cov, budget_rows, d.num_ids))
+        if float(cum[H - 1]) < min_head_coverage:
+            continue
+        demand += H * d.dim * d.itemsize
+    return demand
+
+
+def arbitrate_replica_budget(
+    demands: dict[str, int],
+    total_budget: int,
+    *,
+    weights: dict[str, float] | None = None,
+) -> dict[str, int]:
+    """Split ONE replica budget across tenants by weighted water-filling.
+
+    ``demands`` maps tenant → bytes it would consume solo
+    (:func:`tenant_demand_bytes`); ``weights`` maps tenant → arbitration
+    weight (default 1.0 each; must be > 0).
+
+    The blast-radius contract, stated as arithmetic:
+
+    * a tenant demanding no more than its weighted fair share is granted
+      its FULL demand — no neighbor, however hungry, can dilute it;
+    * surplus left by under-demanders is redistributed among the
+      still-hungry by weight (work-conserving);
+    * a tenant's overflow (demand above its final share) is simply not
+      granted — the shortfall degrades only that tenant's coverage.
+
+    Returns ``{tenant: granted_bytes}`` with ``sum(granted) <=
+    total_budget`` and ``granted[t] <= demands[t]`` for every tenant.
+    """
+    if total_budget < 0:
+        raise ValueError(f"total_budget must be >= 0, got {total_budget}")
+    weights = dict(weights or {})
+    for name in demands:
+        w = weights.setdefault(name, 1.0)
+        if not (isinstance(w, (int, float)) and w > 0):
+            raise ValueError(f"tenant {name!r}: weight must be > 0, "
+                             f"got {w!r}")
+    granted = {name: 0 for name in demands}
+    active = {name for name, dem in demands.items() if dem > 0}
+    remaining = int(total_budget)
+    # Water-filling: repeatedly satisfy every tenant whose demand fits
+    # its weighted share of what is left, then re-divide the surplus
+    # among the rest. Terminates: each round either fully satisfies at
+    # least one tenant or performs the final pro-rata split.
+    while active and remaining > 0:
+        wsum = sum(weights[n] for n in active)
+        shares = {n: remaining * weights[n] / wsum for n in active}
+        sated = [n for n in active if demands[n] <= shares[n]]
+        if not sated:
+            # Everyone overflows: final split, largest-remainder so the
+            # full budget is handed out deterministically (sorted name
+            # order breaks ties).
+            floor = {n: int(shares[n]) for n in active}
+            left = remaining - sum(floor.values())
+            by_frac = sorted(active,
+                             key=lambda n: (-(shares[n] - floor[n]), n))
+            for n in by_frac[:left]:
+                floor[n] += 1
+            for n in active:
+                granted[n] = min(floor[n], demands[n])
+            break
+        for n in sated:
+            granted[n] = demands[n]
+            remaining -= demands[n]
+            active.remove(n)
+    return granted
+
+
+def plan_tenants(
+    tenant_densities: dict[str, list[TableDensity] | dict[str, TableDensity]],
+    *,
+    batch_rows_per_step: int,
+    weights: dict[str, float] | None = None,
+    total_replica_budget_bytes: int = DEFAULT_REPLICA_BUDGET_BYTES,
+    coverage_target: float = 0.9,
+    min_head_coverage: float = MIN_HEAD_COVERAGE,
+    **plan_kwargs,
+) -> dict[str, dict]:
+    """Plan every tenant's tables under ONE shared replica budget.
+
+    Each tenant's demand (:func:`tenant_demand_bytes`, measured against
+    the full shared budget — i.e. what it would consume running solo)
+    is arbitrated by :func:`arbitrate_replica_budget`; the grant becomes
+    that tenant's ``replica_budget_bytes`` for a normal
+    :func:`plan_tables` call. Isolation property (tested): a tenant
+    whose demand fits its weighted share gets a plan whose
+    :meth:`TierPlan.knobs` are identical to its solo plan's (``reason``
+    strings cite the differing budgets; knobs are what lower); a noisy
+    neighbor's overflow degrades only the noisy neighbor's coverage.
+
+    Returns ``{tenant: {"demand": bytes, "granted": bytes,
+    "plans": {table: TierPlan}}}``.
+    """
+    demands = {
+        name: tenant_demand_bytes(
+            dens, replica_budget_bytes=total_replica_budget_bytes,
+            coverage_target=coverage_target,
+            min_head_coverage=min_head_coverage)
+        for name, dens in tenant_densities.items()}
+    granted = arbitrate_replica_budget(
+        demands, total_replica_budget_bytes, weights=weights)
+    out: dict[str, dict] = {}
+    for name, dens in tenant_densities.items():
+        out[name] = {
+            "demand": demands[name],
+            "granted": granted[name],
+            "plans": plan_tables(
+                dens, batch_rows_per_step=batch_rows_per_step,
+                replica_budget_bytes=granted[name],
+                coverage_target=coverage_target,
+                min_head_coverage=min_head_coverage,
+                **plan_kwargs),
+        }
+    return out
+
+
 def global_sync_every(plans: dict[str, TierPlan]) -> int:
     """The driver's single reconcile cadence from per-table plans: the
     MIN over tiered tables (tightest staleness bound requested); 1 (the

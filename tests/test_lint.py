@@ -350,6 +350,45 @@ def test_fps008_other_socket_calls_are_clean():
 
 
 # ---------------------------------------------------------------------------
+# FPS009 — hand-spelled tenant-namespace literals outside the path helper.
+# ---------------------------------------------------------------------------
+
+
+def test_fps009_flags_hand_spelled_tenant_paths():
+    assert rules_of(
+        'p = os.path.join(root, "tenants", name, "ckpt")') == ["FPS009"]
+    assert rules_of('f = open(d + "/tenants/a/tenant.json")') == ["FPS009"]
+    assert rules_of('os.makedirs(f"{root}/tenants/{n}/obs")') == ["FPS009"]
+    # A nested path call flags at BOTH call sites (outer glob + inner
+    # join each see the literal) — loud is right for this hazard.
+    assert rules_of(
+        'hits = glob.glob(os.path.join(r, "tenants", "*"))'
+    ) == ["FPS009", "FPS009"]
+
+
+def test_fps009_helper_and_mirrored_constants_are_exempt():
+    src = 'p = os.path.join(root, "tenants", name)'
+    # The sanctioned helper owns the layout.
+    assert [f.rule for f in lint_source(
+        src, os.path.join("fps_tpu", "tenancy", "paths.py"))] == []
+    # Everywhere else in the package flags.
+    assert [f.rule for f in lint_source(
+        src, os.path.join("fps_tpu", "obs", "fleet.py"))] == ["FPS009"]
+    # A mirrored Name constant (the stdlib-only login-node pattern) is
+    # the sanctioned alternative — the rule keys on string literals.
+    assert rules_of(
+        'TENANTS_DIRNAME = "tenants"\n'
+        "p = os.path.join(root, TENANTS_DIRNAME)") == []
+
+
+def test_fps009_generic_paths_and_noqa_are_clean():
+    assert rules_of('p = os.path.join(root, "ckpt", name)') == []
+    assert rules_of('msg = "tenants must not collide"') == []
+    assert rules_of(
+        'p = os.path.join(r, "tenants")  # noqa: FPS009') == []
+
+
+# ---------------------------------------------------------------------------
 # Machinery: noqa, syntax errors, file walking, the CI gate.
 # ---------------------------------------------------------------------------
 
@@ -388,7 +427,7 @@ def test_lint_paths_walks_and_selects(tmp_path):
 
 def test_rule_table_is_complete():
     assert set(RULES) == {"FPS001", "FPS002", "FPS003", "FPS004", "FPS005",
-                          "FPS006", "FPS007", "FPS008"}
+                          "FPS006", "FPS007", "FPS008", "FPS009"}
 
 
 def test_package_lints_clean():

@@ -40,6 +40,7 @@ from fps_tpu.serve import (
     SnapshotWatcher,
     TcpServe,
 )
+from fps_tpu.serve import wire
 from fps_tpu.serve.watcher import _JournalTail
 from fps_tpu.testing import chaos
 
@@ -602,7 +603,7 @@ def test_serve_metrics_ride_the_default_registry(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# TCP transport (framed wire; legacy line-JSON rides the dual stack).
+# TCP transport (framed wire only; the PR-16 legacy dual stack is retired).
 # ---------------------------------------------------------------------------
 
 def test_tcp_round_trip_and_error_tolerance(tmp_path):
@@ -625,25 +626,29 @@ def test_tcp_round_trip_and_error_tolerance(tmp_path):
         assert not r["ok"] and "KeyError" in r["error"]
         r = c.request({"op": "stats"})
         assert r["ok"] and r["requests"] >= 1
-    # The LEGACY line-JSON path (dual stack, one release) still
-    # tolerates non-JSON garbage without dropping the connection.
+    # The legacy line-JSON dual stack is RETIRED: a raw line-JSON peer
+    # fails the first frame's magic gate and gets a counted OP_ERR +
+    # dropped connection — never a silent hang, never a line reply.
     with TcpServe(server) as tcp:
         s = socket.create_connection((tcp.host, tcp.port), timeout=5.0)
         try:
             rf = s.makefile("rb")
             s.sendall(b"this is not json\n")
-            assert "bad json" in json.loads(rf.readline())["error"]
-            s.sendall(b'{"op": "stats"}\n')
-            assert json.loads(rf.readline())["ok"]  # still answers
+            fr = wire.read_frame(rf)
+            assert fr.op == wire.OP_ERR
+            assert not fr.json()["ok"]
+            assert rf.read(1) == b""  # connection dropped after OP_ERR
         finally:
             s.close()
+        assert tcp.wire_stats()["torn_frames"] == 1
 
 
 def test_tcp_nonfinite_rows_serialize_as_strict_json(tmp_path):
     # Observe-mode guards publish snapshots that still hold non-finite
     # rows; the wire must stay strict JSON (null, never NaN/Infinity —
-    # json.loads accepts the Python-only tokens, so assert on the text).
-    # Raw legacy line-JSON socket so the assertion sees the wire TEXT.
+    # json.loads accepts the Python-only tokens, so assert on the raw
+    # OP_RESP payload BYTES, not a parsed dict). Hand-rolled framed
+    # conversation so the assertion sees the wire text.
     d = str(tmp_path)
     w = np.ones((4, 2), np.float32)
     w[1, 0], w[2, 1] = np.nan, np.inf
@@ -652,11 +657,20 @@ def test_tcp_nonfinite_rows_serialize_as_strict_json(tmp_path):
     with TcpServe(server) as tcp:
         s = socket.create_connection((tcp.host, tcp.port), timeout=5.0)
         try:
-            s.sendall(b'{"op": "pull", "table": "weights", '
-                      b'"ids": [0, 1, 2]}\n')
-            raw = s.makefile("rb").readline().decode("utf-8")
+            rf = s.makefile("rb")
+            wire.send_frame(s, wire.encode_frame(
+                wire.OP_HELLO, 0, json.dumps(
+                    {"versions": list(wire.SUPPORTED_VERSIONS),
+                     "session": "nonfinite-test"}).encode()), "serve")
+            assert wire.read_frame(rf).op == wire.OP_HELLO_OK
+            req = {"op": "pull", "table": "weights", "ids": [0, 1, 2]}
+            wire.send_frame(s, wire.encode_frame(
+                wire.OP_REQ, 1, json.dumps({"q": req}).encode()), "serve")
+            fr = wire.read_frame(rf)
         finally:
             s.close()
+        assert fr.op == wire.OP_RESP
+        raw = fr.payload.decode("utf-8")
         assert "NaN" not in raw and "Infinity" not in raw
         r = json.loads(raw)
         assert r["ok"] and r["values"][1][0] is None

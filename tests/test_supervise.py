@@ -96,6 +96,40 @@ def test_deterministic_crash_is_quarantined(tmp_path):
     assert [a["rc"] for a in state["attempts"]] == [3, 3, 0]
 
 
+def test_first_signal_events_and_recovery_times(tmp_path):
+    """Every attempt that produced a liveness signal journals exactly one
+    ``attempt_first_signal``, and ``recovery_times`` pairs it with the
+    previous ``attempt_end`` into time_to_recovered_s (kill -> first
+    post-restart dispatch) — the MTTR datum the chaos sweep records."""
+    rc, digest = _run_supervised(
+        tmp_path / "state",
+        _stub_cmd(tmp_path / "work", "--crash-at", "2"),
+        "--stall-timeout-s", "5", "--backoff-base-s", "0.05",
+        "--max-restarts", "3", "--poll-s", "0.05",
+    )
+    assert rc == 0 and digest["success"], digest
+    journal = tmp_path / "state" / "journal-supervisor.jsonl"
+    events = [json.loads(line) for line in open(journal)]
+    firsts = [e["attempt"] for e in events if e["event"] == "attempt_first_signal"]
+    # Three attempts (crash, crash+quarantine, success).  An attempt that
+    # dies before its first heartbeat (attempt 1 resumes straight into the
+    # poisoned chunk) journals no first-signal; the ones that did work
+    # journal exactly one each, in attempt order.  Attempt 0 always beats,
+    # and the final (successful) attempt always beats.
+    assert firsts == sorted(set(firsts)), firsts
+    assert firsts[0] == 0 and firsts[-1] == 2, firsts
+    from fps_tpu.supervise.supervisor import recovery_times
+    times = recovery_times(str(journal))
+    # One recovery per post-restart attempt that signalled.
+    assert len(times) == len([a for a in firsts if a > 0]), (firsts, times)
+    assert times, times
+    # Recovery spans the backoff sleep (>= 0) and stays well under the
+    # run's own wall clock — a sanity band, not a perf assertion.
+    assert all(0 <= t < 60 for t in times), times
+    # A missing/garbled journal degrades to no data, never a crash.
+    assert recovery_times(str(tmp_path / "nope.jsonl")) == []
+
+
 def test_wall_deadline_gives_up(tmp_path):
     """An unrecoverable hang (wedges every attempt; quarantine disabled
     so nothing can be skipped around) exhausts the wall budget: the

@@ -335,7 +335,10 @@ def test_wire_client_roundtrip_matches_handle_request():
         tcp.close()
 
 
-def test_dual_stack_serves_legacy_line_json():
+def test_legacy_line_json_is_rejected_with_op_err():
+    # The PR-16 dual stack is retired: a raw line-JSON peer fails the
+    # first frame's magic gate, is counted as a torn frame, answered
+    # with one OP_ERR frame, and dropped — never served a line reply.
     server, tcp = _tcp()
     try:
         sock, rfile = _raw_conn(tcp.port)
@@ -343,13 +346,14 @@ def test_dual_stack_serves_legacy_line_json():
             sock.sendall(json.dumps(
                 {"op": "pull", "table": "weights",
                  "ids": [0]}).encode() + b"\n")
-            resp = json.loads(rfile.readline())
-            assert resp["ok"] and resp["step"] == 11
+            fr = wire.read_frame(rfile)
+            assert fr.op == OP_ERR and not fr.json()["ok"]
+            assert rfile.read(1) == b""  # dropped after the OP_ERR
         finally:
             sock.close()
         stats = tcp.wire_stats()
-        assert stats["legacy_conns"] == 1
-        assert stats["framed_conns"] == 0
+        assert stats["torn_frames"] == 1
+        assert stats["framed_conns"] == 1  # every conn is framed now
     finally:
         tcp.close()
 
@@ -361,7 +365,43 @@ def test_jsonl_client_is_a_framed_shim():
             assert c.request({"op": "stats"})["ok"]
         # The compat shim speaks the FRAMED wire, not line-JSON.
         assert tcp.wire_stats()["framed_conns"] == 1
-        assert tcp.wire_stats()["legacy_conns"] == 0
+        assert tcp.wire_stats()["torn_frames"] == 0
+    finally:
+        tcp.close()
+
+
+def test_replay_cache_is_byte_bounded_with_lru_eviction_order():
+    """The (session, req_id) replay cache evicts by BYTES, oldest-touched
+    first: cache cost is response-size-dependent, and a 16 MiB-response
+    tenant must not be able to hold unbounded memory behind a generous
+    entry cap. Pins the eviction order, the byte accounting, and the
+    replay_evictions counter."""
+    server = ReadServer()
+    tcp = TcpServe(server, replay_cache=1024,
+                   replay_cache_bytes=100).start()
+    try:
+        put, get = tcp._replay_put, tcp._replay_get
+        put(("s", 1), b"a" * 40)
+        put(("s", 2), b"b" * 40)
+        assert tcp.replay_bytes() == 80
+        assert tcp.wire_stats()["replay_evictions"] == 0
+        # Touch 1 so 2 becomes the LRU victim.
+        assert get(("s", 1)) == b"a" * 40
+        put(("s", 3), b"c" * 40)  # 120 > 100: evicts exactly (s, 2)
+        assert get(("s", 2)) is None
+        assert get(("s", 1)) == b"a" * 40
+        assert get(("s", 3)) == b"c" * 40
+        assert tcp.replay_bytes() == 80
+        assert tcp.wire_stats()["replay_evictions"] == 1
+        # Re-putting a key replaces its bytes, never double-counts.
+        put(("s", 1), b"A" * 10)
+        assert tcp.replay_bytes() == 50
+        # An entry bigger than the whole budget cannot be cached at all
+        # (and flushes everything older on its way through).
+        put(("s", 4), b"x" * 101)
+        assert get(("s", 4)) is None
+        assert tcp.replay_bytes() == 0
+        assert tcp.wire_stats()["replay_evictions"] == 4
     finally:
         tcp.close()
 

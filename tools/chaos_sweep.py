@@ -127,6 +127,32 @@ fps_tpu.testing.workloads):
   follower seizes with a strictly-higher fencing epoch, and the
   deposed leader stays out.
 
+* ``tenant_poison_isolation``  — two tenants under one
+  ``fps_tpu.tenancy.TenantManager``; tenant a's child poison-crashes at
+  the same chunk every attempt: survives iff a's OWN supervisor
+  quarantines it (2 restarts, chunk skipped) while tenant b finishes
+  with zero restarts, BIT-IDENTICAL to its solo run, both fencing
+  epochs untouched, and the post-run namespace audit clean.
+* ``tenant_enospc_brownout``   — an ENOSPC faultfs schedule carried in
+  tenant a's spec env (the only injection channel — per-tenant by
+  construction) fails a run of its snapshot writes: survives iff a
+  degrades (publishes skipped + counted in a's own telemetry) without
+  restarting and still matches the fault-free solo weights, b sees zero
+  degraded publishes and stays bit-identical, audit clean.
+* ``tenant_reader_wedge``      — each tenant namespace runs its own
+  heartbeating serving reader; a's reader is SIGSTOPped, detected
+  wedged via a's own beacons, and restarted: survives iff b's reader
+  never reads as wedged, b's serve fence bytes are untouched by the
+  whole episode, the restarted reader catches up
+  (``time_to_recovered_s``), both tenants' weights stay bit-identical
+  to the clean run, audit clean.
+* ``tenant_noisy_neighbor``    — a's flat access profile demands more
+  replica budget than its weighted share; ``plan_tenants`` must grant
+  b its FULL demand (plan knobs identical to b's solo plan) while only
+  a's hot tier shrinks, then real children train at the arbitrated
+  knobs: survives iff b is bit-identical to its solo run at those
+  knobs and a still finishes cleanly, audit clean.
+
 The digest also carries the clean run's program CERTIFICATE
 (``fps_tpu.analysis``, ``docs/analysis.md``): the compiled logreg step
 is audited against its derived contract, so a regression in collective
@@ -141,9 +167,10 @@ attempt children all carry the fencing epoch) and a FLEET rollup + SLO
 burn section (``fps_tpu.obs.fleet`` over the member obs dirs), lifted
 into the digest's top-level ``fleet`` field.
 
-``--only SCENARIO[,SCENARIO...]`` (repeatable) runs a subset so CI can
-shard the sweep; a red run exits nonzero and names the failing
-scenarios on stderr (and in the digest's ``failed`` list).
+``--only SCENARIO[,SCENARIO...]`` (repeatable; entries may be fnmatch
+globs like ``tenant_*``) runs a subset so CI can shard the sweep; a red
+run exits nonzero and names the failing scenarios on stderr (and in the
+digest's ``failed`` list).
 
 Run (CPU mesh, like the test suite):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -285,15 +312,18 @@ def supervised_scenario(tmpdir):
     return run_supervised_scenario(tmpdir)
 
 
-def _subprocess_scenario(fn_name):
-    """A scenario that lives in fps_tpu.testing.supervised_demo and runs
-    whole child processes — imported lazily, executed in a fresh
+def _subprocess_scenario(fn_name,
+                         module="fps_tpu.testing.supervised_demo"):
+    """A scenario that lives in a testing module (supervised_demo by
+    default; the multi-tenant ones in fps_tpu.testing.tenant_demo) and
+    runs whole child processes — imported lazily, executed in a fresh
     tempdir."""
     import tempfile
 
     def run(_harness):
-        import fps_tpu.testing.supervised_demo as demo
+        import importlib
 
+        demo = importlib.import_module(module)
         with tempfile.TemporaryDirectory() as d:
             return getattr(demo, fn_name)(d)
 
@@ -383,6 +413,25 @@ def _harness_scenarios():
             "run_net_slow_peer_scenario"),
         "net_partition_reader": _subprocess_scenario(
             "run_net_partition_reader_scenario"),
+        # Multi-tenant blast-radius scenarios (fps_tpu.tenancy +
+        # fps_tpu.testing.tenant_demo; docs/resilience.md "Multi-tenant
+        # blast radius"): one tenant is faulted, and every NON-injected
+        # tenant must finish bit-identical to its solo run with a clean
+        # post-run namespace audit (zero cross-tenant writes) — the
+        # per-scenario time_to_recovered_s and audit verdicts are lifted
+        # into the digest's top-level maps.
+        "tenant_poison_isolation": _subprocess_scenario(
+            "run_tenant_poison_isolation_scenario",
+            module="fps_tpu.testing.tenant_demo"),
+        "tenant_enospc_brownout": _subprocess_scenario(
+            "run_tenant_enospc_brownout_scenario",
+            module="fps_tpu.testing.tenant_demo"),
+        "tenant_reader_wedge": _subprocess_scenario(
+            "run_tenant_reader_wedge_scenario",
+            module="fps_tpu.testing.tenant_demo"),
+        "tenant_noisy_neighbor": _subprocess_scenario(
+            "run_tenant_noisy_neighbor_scenario",
+            module="fps_tpu.testing.tenant_demo"),
     }
 
 
@@ -440,7 +489,8 @@ def main(argv=None):
     ap.add_argument("--only", action="append", default=[],
                     metavar="SCENARIO[,SCENARIO...]",
                     help="run only these scenarios (repeatable / "
-                         "comma-separated) — lets CI shard the sweep; "
+                         "comma-separated; fnmatch globs like "
+                         "'tenant_*' work) — lets CI shard the sweep; "
                          f"known: {', '.join(scenarios)}")
     ap.add_argument("--list", action="store_true",
                     help="print registered scenario names (one per "
@@ -463,11 +513,19 @@ def main(argv=None):
             print(name)
         return 0
     selected = [s for arg in args.only for s in arg.split(",") if s]
-    unknown = sorted(set(selected) - set(scenarios))
+    # Each --only entry may be an exact name or an fnmatch glob
+    # (e.g. 'tenant_*', 'pod_*') — a pattern matching nothing is a
+    # typo and fails loudly, same as an unknown exact name.
+    import fnmatch
+
+    unknown = sorted(pat for pat in selected
+                     if not fnmatch.filter(scenarios, pat))
     if unknown:
-        ap.error(f"unknown scenario(s) {unknown}; "
+        ap.error(f"unknown scenario(s)/pattern(s) {unknown}; "
                  f"known: {sorted(scenarios)}")
-    names = [n for n in scenarios if not selected or n in selected]
+    names = [n for n in scenarios
+             if not selected
+             or any(fnmatch.fnmatch(n, pat) for pat in selected)]
     if args.shard:
         try:
             k, n_shards = (int(x) for x in args.shard.split("/"))
@@ -533,6 +591,20 @@ def main(argv=None):
         # evidence — throughput, cold-route certification rate, restart
         # counts, and burn-rate verdicts ride the digest.
         "fleet": (detail.get("pod_kill_one_host") or {}).get("fleet"),
+        # Per-scenario recovery latency (seconds from the fault landing
+        # to the injected plane demonstrably recovered; null where the
+        # scenario degrades in place instead of restarting) and the
+        # multi-tenant scenarios' post-run namespace-audit verdicts —
+        # obs_report's incident view and CI both read these off the
+        # digest without digging through detail.
+        "time_to_recovered_s": {
+            n: d.get("time_to_recovered_s")
+            for n, d in detail.items()
+            if isinstance(d, dict) and "time_to_recovered_s" in d},
+        "namespace_audit": {
+            n: d.get("namespace_audit")
+            for n, d in detail.items()
+            if isinstance(d, dict) and "namespace_audit" in d},
         "clean_test_acc": (round(harness["acc_clean"], 4)
                            if harness else None),
     }

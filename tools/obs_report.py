@@ -82,6 +82,7 @@ _INCIDENT_EVENTS = (
     "checkpoint_resplit",
     "deadline_abort",
     "supervisor_restart",
+    "attempt_first_signal",
     "chunk_quarantined",
     "heartbeat_rejected",
     "supervisor_give_up",
@@ -127,7 +128,7 @@ REQUIRED_FIELDS = (
     "steps", "examples", "phase_seconds", "health", "incidents",
     "checkpoint_saves", "quarantined", "wall_span_s", "prefetch",
     "hot_tier", "megastep", "tiering", "source_stalls", "analysis",
-    "serve", "pod", "net",
+    "serve", "pod", "net", "recovery",
 )
 
 
@@ -192,6 +193,11 @@ def render_digest(obs_dir: str) -> dict:
     # (flushed per record) can hold incidents the event log's buffered
     # tail lost. Fold both sources, deduping on exact record content.
     seen_events: set[str] = set()
+    # Supervisor recovery pairing (mirrors
+    # fps_tpu.supervise.supervisor.recovery_times — this tool stays
+    # import-free): attempt -> timestamp for each side of the pair.
+    attempt_firsts: dict[int, float] = {}
+    attempt_ends: dict[int, float] = {}
 
     def fold_event(rec):
         key = json.dumps(rec, sort_keys=True, default=str)
@@ -204,6 +210,17 @@ def render_digest(obs_dir: str) -> dict:
                 {k: v for k, v in rec.items() if k != "kind"})
         if et in ("chunk", "epoch") and rec.get("quarantined"):
             quarantined.append(rec.get("index"))
+        if (et in ("attempt_first_signal", "attempt_end")
+                and rec.get("t") is not None
+                and rec.get("attempt") is not None):
+            try:
+                a, t = int(rec["attempt"]), float(rec["t"])
+            except (TypeError, ValueError):
+                return
+            if et == "attempt_end":
+                attempt_ends[a] = max(attempt_ends.get(a, t), t)
+            else:
+                attempt_firsts.setdefault(a, t)  # first signal wins
 
     for rec in (r for p in event_files for r in _read_jsonl(p)):
         see_time(rec.get("t"))
@@ -276,6 +293,17 @@ def render_digest(obs_dir: str) -> dict:
         ph["total_s"] = round(ph["total_s"], 6)
         ph["mean_s"] = round(ph["total_s"] / max(ph["n"], 1), 6)
         ph["max_s"] = round(ph["max_s"], 6)
+
+    # time_to_recovered_s per restart: the gap from an attempt's end to
+    # the NEXT attempt's first liveness signal (kill -> first
+    # post-restart dispatch) — the MTTR figure the chaos sweep records.
+    recovery_times: list[float] = []
+    for a in sorted(attempt_firsts):
+        t_first = attempt_firsts[a]
+        prior = [te for ae, te in attempt_ends.items()
+                 if ae < a and te <= t_first]
+        if prior:
+            recovery_times.append(round(t_first - max(prior), 3))
 
     digest = {
         "schema": DIGEST_SCHEMA_VERSION,
@@ -430,6 +458,16 @@ def render_digest(obs_dir: str) -> dict:
         "source_stalls": sum(
             1 for e in incidents.get("deadline_abort", ())
             if e.get("stall_kind") == "source_stall"),
+        # Supervised-restart MTTR evidence (attempt_first_signal events
+        # ride incidents verbatim; this is their paired summary).
+        "recovery": {
+            "count": len(recovery_times),
+            "times_s": recovery_times,
+            "mean_s": (round(sum(recovery_times) / len(recovery_times), 3)
+                       if recovery_times else None),
+            "max_s": (round(max(recovery_times), 3)
+                      if recovery_times else None),
+        },
         "health": dict(sorted(health.items())),
         "poisoned_chunks": int(counters.get("health.poisoned_chunks", 0)),
         "incidents": {k: v for k, v in incidents.items() if v},
@@ -578,7 +616,18 @@ def main(argv=None) -> int:
 
         out = fleet.fleet_digest(args.obs_dirs, window_s=args.window_s,
                                  digest_fn=_digest_or_none)
-        if not out["rollup"]["windows"]:
+        # Multi-tenant pods (fps_tpu.tenancy): a dir holding a
+        # tenants/ namespace gets a per-tenant rollup + SLO-burn +
+        # recovery section — each tenant's burn rates are its own,
+        # never a neighbor's (blast-radius isolation in telemetry).
+        tenants = {}
+        for d in args.obs_dirs:
+            if os.path.isdir(os.path.join(d, fleet.TENANTS_DIRNAME)):
+                td = fleet.tenant_fleet_digest(d, window_s=args.window_s)
+                tenants.update(td["tenants"])
+        if tenants:
+            out["tenants"] = tenants
+        if not out["rollup"]["windows"] and not tenants:
             print(f"no telemetry under {args.obs_dirs}", file=sys.stderr)
             return 2
     else:
