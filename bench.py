@@ -2432,11 +2432,12 @@ def run_wire(args):
                                     daemon=True,
                                     name=f"bench-wire-hammer-{i}")
                    for i in range(3)]
-        tcp._inflight.acquire()  # wedge the slot: full house
+        # Wedge the whole cost budget: full house, every request sheds.
+        assert tcp.admission.try_admit(tcp.admission.max_cost)
         for t in hammers:
             t.start()
         time.sleep(0.5)
-        tcp._inflight.release()  # brownout lifts; clients recover
+        tcp.admission.release(tcp.admission.max_cost)  # brownout lifts
         time.sleep(0.5)
         stop.set()
         for t in hammers:
@@ -2488,11 +2489,302 @@ def run_wire(args):
     }
 
 
+def run_serve_scale(args):
+    """Closed-loop user-scale read-plane load (ISSUE 19's tentpole
+    witness): a Zipf population of users pulls its feature bundles
+    against a live autoscaled ServingFleet over the batched zero-copy
+    wire, while a publisher keeps hot-swapping fresh snapshots under
+    the load. Four measurements:
+
+    * **unbatched** — the PR-16 shape (one frame per request, JSON
+      responses): the p50/p99/p999 reference every batched number is
+      judged against.
+    * **batch curve** — per-frame latency + aggregate requests/s at
+      batch sizes 1..512 over the binary multi path: the amortization
+      curve ``docs/performance.md`` reprints.
+    * **scaled run** — diurnal shape (ramp → flash crowd → cool) of
+      closed-loop users against the whole fleet, the autoscaler
+      evaluating live (its decisions reported), snapshots publishing
+      throughout; the flash-crowd aggregate q/s is the headline, with
+      the fence-lag freshness sampled continuously — a flash crowd
+      must cost latency, never staleness.
+    * **operating point** — the largest curve batch whose per-frame
+      p99 stays within 2x the unbatched p99 (the acceptance bound).
+
+    ``vs_baseline`` is the flash-crowd aggregate against BENCH_r14's
+    3-reader fleet total (1477.5 q/s, the unbatched read plane)."""
+    import os
+    import tempfile
+    import threading
+
+    from fps_tpu.core import snapshot_format as fmt
+    from fps_tpu.serve import (
+        NoSnapshotError,
+        ReadAutoscaler,
+        ServingFleet,
+        TcpServe,
+        WireClient,
+    )
+    from fps_tpu.serve.wire import CAP_BIN, CAP_MULTI
+
+    R14_FLEET_QPS = 1477.5
+    NROWS, RANK, IDS_PER_REQ = 65536, 16, 16
+    N_USERS = 100_000
+    rng = np.random.default_rng(19)
+
+    # Zipf user population: each request is one user's pull of its
+    # (fixed) feature bundle, users drawn zipf so the head repeats —
+    # the access pattern the warm caches and gathers actually see.
+    user_rows = rng.integers(0, NROWS, size=(N_USERS, IDS_PER_REQ))
+    zipf_users = (rng.zipf(1.2, size=1 << 14) - 1) % N_USERS
+    req_pool = [{"op": "pull", "table": "emb",
+                 "ids": user_rows[u].tolist()} for u in zipf_users]
+
+    table = rng.normal(size=(NROWS, RANK)).astype(np.float32)
+    ckpt_dir = tempfile.mkdtemp(prefix="fps-serve-scale-")
+    published = [0]
+    publish_lock = threading.Lock()
+
+    def publish_next():
+        with publish_lock:
+            published[0] += 1
+            step = published[0]
+            # A few hot rows move per publish: real swaps, tiny deltas.
+            table[rng.integers(0, NROWS, 64)] += 0.001
+            arrays = {"table::emb": table,
+                      "meta::ls_format": np.array("exported")}
+            for k in list(arrays):
+                arrays["meta::crc::" + k] = np.uint32(
+                    fmt.array_crc32(arrays[k]))
+            np.savez(fmt.snapshot_path(ckpt_dir, step), **arrays)
+            return step
+
+    publish_next()
+    fleet = ServingFleet(ckpt_dir, 2)
+    scaler = ReadAutoscaler(fleet, min_readers=2, max_readers=6,
+                            latency_slo_s=0.002,
+                            fence_lag_slo_steps=8.0, cooldown_s=0.5,
+                            liveness_timeout_s=10.0)
+
+    # One TcpServe per live reader, kept in sync with the autoscaler's
+    # membership changes; workers round-robin the current set.
+    serves: dict = {}
+    serve_lock = threading.Lock()
+
+    def sync_serves():
+        with serve_lock:
+            live = {r.reader_id: r for r in fleet.readers}
+            for rid in [r for r in serves if r not in live]:
+                serves.pop(rid).close()
+            for rid, r in live.items():
+                if rid not in serves:
+                    serves[rid] = TcpServe(r.server).start()
+            return list(serves.items())
+
+    stop = threading.Event()
+    active_n = [0]    # workers with idx < active_n[0] run (load shape)
+    batch_n = [1]
+    recording: list = [None]  # per-phase (latency_s, batch) sink
+    N_WORKERS = 8
+
+    def worker(idx):
+        clients: dict = {}
+        pos = idx * 1013
+        while not stop.is_set():
+            if idx >= active_n[0]:
+                time.sleep(0.005)
+                continue
+            with serve_lock:
+                targets = list(serves.items())
+            if not targets:
+                time.sleep(0.01)
+                continue
+            rid, tcp = targets[(pos // 7) % len(targets)]
+            wc = clients.get(rid)
+            if wc is None or wc.port != tcp.port:
+                try:
+                    clients[rid] = wc = WireClient(
+                        tcp.host, tcp.port, caps=(CAP_MULTI, CAP_BIN))
+                except OSError:
+                    time.sleep(0.01)
+                    continue
+            B = batch_n[0]
+            batch = [req_pool[(pos + j) % len(req_pool)]
+                     for j in range(B)]
+            pos += B
+            t0 = time.perf_counter()
+            try:
+                if B == 1:
+                    ok = wc.request(batch[0]).get("ok")
+                else:
+                    ok = all(r.get("ok") for r in wc.multi(batch))
+            except Exception:  # noqa: BLE001 — churned reader: move on
+                clients.pop(rid, None)
+                continue
+            dt = time.perf_counter() - t0
+            sink = recording[0]
+            if ok and sink is not None:
+                sink.append((dt, B))
+        for wc in clients.values():
+            wc.close()
+
+    def measure(n_active, B, seconds):
+        """One closed-loop phase; returns (aggregate requests/s,
+        per-frame latency percentiles, frames)."""
+        sink: list = []
+        batch_n[0] = B
+        active_n[0] = n_active
+        time.sleep(0.15)   # let the shape settle before recording
+        recording[0] = sink
+        time.sleep(seconds)
+        recording[0] = None
+        lat = np.array([d for d, _ in sink]) if sink else np.array([])
+        reqs_done = sum(b for _, b in sink)
+        pct = {p: (round(float(np.percentile(lat, q)), 6)
+                   if lat.size else None)
+               for p, q in (("p50", 50), ("p99", 99), ("p999", 99.9))}
+        return round(reqs_done / seconds, 1), pct, len(sink)
+
+    fence_trail: list = []
+
+    def sample_fence():
+        fence = fleet.readers[0].fence
+        while not stop.is_set():
+            f = fence.read()
+            if f is not None:
+                fence_trail.append(published[0] - f[1])
+            time.sleep(0.02)
+
+    out = {"rows": NROWS, "rank": RANK, "ids_per_request": IDS_PER_REQ,
+           "users": N_USERS, "workers": N_WORKERS}
+    workers = [threading.Thread(target=worker, args=(i,), daemon=True,
+                                name=f"bench-scale-user{i}")
+               for i in range(N_WORKERS)]
+    fleet.start(interval_s=0.02)
+    sync_serves()
+    try:
+        dl = time.monotonic() + 30.0
+        while time.monotonic() < dl:
+            try:
+                if all(r.server.snapshot.step >= 1
+                       for r in fleet.readers):
+                    break
+            except NoSnapshotError:
+                pass
+            time.sleep(0.02)
+        for t in workers:
+            t.start()
+
+        # -- unbatched reference (the PR-16 shape, 4 users).
+        measure(4, 1, 0.5)  # warm connections + caches off the record
+        unb_qps, unb_pct, _ = measure(4, 1, 2.0)
+        out["unbatched"] = {"queries_per_sec": unb_qps, **unb_pct}
+
+        # -- batch-size/latency curve (1 user, binary multi).
+        curve = []
+        for B in (1, 8, 32, 128, 512):
+            qps, pct, frames = measure(1, B, 1.0)
+            curve.append({"batch": B, "queries_per_sec": qps,
+                          "frames": frames, **pct})
+        out["batch_curve"] = curve
+        # Operating point: largest batch whose per-frame p99 holds
+        # within 2x the unbatched p99.
+        bound = 2.0 * (unb_pct["p99"] or float("inf"))
+        oper = [c for c in curve
+                if c["p99"] is not None and c["p99"] <= bound]
+        oper_b = max((c["batch"] for c in oper), default=32)
+        out["operating_batch"] = oper_b
+        out["p99_bound_s"] = round(bound, 6)
+
+        # -- scaled run: publisher + autoscaler live, diurnal shape.
+        pub_stop = threading.Event()
+
+        def publisher():
+            while not pub_stop.is_set():
+                publish_next()
+                pub_stop.wait(0.3)
+
+        def autoscale_loop():
+            while not pub_stop.is_set():
+                scaler.evaluate(newest_step=published[0])
+                sync_serves()
+                pub_stop.wait(0.2)
+
+        sampler = threading.Thread(target=sample_fence, daemon=True)
+        pub_t = threading.Thread(target=publisher, daemon=True)
+        auto_t = threading.Thread(target=autoscale_loop, daemon=True)
+        sampler.start()
+        pub_t.start()
+        auto_t.start()
+        phases = {}
+        flash_lag_start = None
+        for name, n_active, seconds in (("ramp", 2, 1.5),
+                                        ("flash", N_WORKERS, 2.5),
+                                        ("cool", 2, 1.5)):
+            if name == "flash":
+                flash_lag_start = len(fence_trail)
+            qps, pct, frames = measure(n_active, oper_b, seconds)
+            phases[name] = {"queries_per_sec": qps, "frames": frames,
+                            "active_users": n_active, **pct}
+        flash_lags = fence_trail[flash_lag_start:len(fence_trail)]
+        pub_stop.set()
+        pub_t.join(timeout=10)
+        auto_t.join(timeout=10)
+        out["phases"] = phases
+        out["published_steps"] = published[0]
+        out["fence_lag_steps_max"] = (max(fence_trail)
+                                      if fence_trail else None)
+        out["flash_fence_lag_max"] = (max(flash_lags)
+                                      if flash_lags else None)
+        out["autoscale"] = {
+            "final_fleet_size": len(fleet.readers),
+            "actions": sorted({d["action"] for d in scaler.decisions
+                               if d["action"] != "hold"}),
+            "evaluations": len(scaler.decisions),
+        }
+    finally:
+        stop.set()
+        for t in workers:
+            t.join(timeout=10)
+        with serve_lock:
+            for tcp in serves.values():
+                tcp.close()
+            serves.clear()
+        fleet.stop()
+
+    flash_qps = phases["flash"]["queries_per_sec"]
+    oper_curve = next(c for c in curve if c["batch"] == oper_b)
+    out["aggregate_queries_per_sec"] = flash_qps
+    out["speedup_vs_r14_fleet"] = round(flash_qps / R14_FLEET_QPS, 2)
+    out["p99_within_2x_unbatched"] = bool(
+        oper_curve["p99"] is not None and oper_curve["p99"] <= bound)
+    out["fence_slo_held_in_flash"] = bool(
+        out["flash_fence_lag_max"] is not None
+        and out["flash_fence_lag_max"] <= scaler.fence_lag_slo_steps)
+    print(
+        f"serve_scale: unbatched {unb_qps:.0f} q/s "
+        f"(p99 {unb_pct['p99']}s) -> batch {oper_b} flash crowd "
+        f"{flash_qps:.0f} q/s ({out['speedup_vs_r14_fleet']}x r14 "
+        f"fleet), frame p99 {oper_curve['p99']}s "
+        f"(bound {out['p99_bound_s']}s), flash fence lag max "
+        f"{out['flash_fence_lag_max']} steps, fleet "
+        f"{out['autoscale']['final_fleet_size']} readers "
+        f"({out['autoscale']['actions']})", file=sys.stderr)
+    return {
+        "metric": "serve_scale_aggregate_qps",
+        "value": flash_qps,
+        "unit": "queries/s",
+        "vs_baseline": out["speedup_vs_r14_fleet"],
+        **out,
+    }
+
+
 RUNNERS = {"mf": run_mf, "w2v": run_w2v, "logreg": run_logreg,
            "pa": run_pa, "ials": run_ials, "tiered": run_tiered,
            "tiered_drift": run_tiered_drift, "serve": run_serve,
            "megastep": run_megastep_ab, "delta": run_delta,
-           "storage": run_storage, "wire": run_wire}
+           "storage": run_storage, "wire": run_wire,
+           "serve_scale": run_serve_scale}
 
 
 def compact_summary(results):
@@ -2554,7 +2846,8 @@ def main():
     ap.add_argument("--workload", default="all",
                     choices=["all", "mf", "w2v", "logreg", "pa", "ials",
                              "tiered", "tiered_drift", "serve",
-                             "megastep", "delta", "storage", "wire"])
+                             "megastep", "delta", "storage", "wire",
+                             "serve_scale"])
     ap.add_argument("--scale", default="20m", choices=["100k", "1m", "20m"])
     ap.add_argument("--rank", type=int, default=10)
     ap.add_argument("--local-batch", type=int, default=32768)
@@ -2580,7 +2873,8 @@ def main():
     if args.workload == "all":
         # Headline (mf) LAST among the per-workload lines.
         order = ["w2v", "logreg", "pa", "ials", "tiered", "tiered_drift",
-                 "serve", "megastep", "delta", "storage", "wire", "mf"]
+                 "serve", "megastep", "delta", "storage", "wire",
+                 "serve_scale", "mf"]
     else:
         order = [args.workload]
     results = {}

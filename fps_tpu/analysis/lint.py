@@ -117,6 +117,10 @@ RULES = {
     "FPS009": "hand-spelled tenant-namespace literal in a path call "
               "outside fps_tpu/tenancy/paths.py — derive tenant paths "
               "from TenantPaths (or a mirrored *_DIRNAME constant)",
+    "FPS010": "whole-table materialization (np.asarray/np.array/"
+              ".copy()) of a snapshot table view in the serve hot path "
+              "— answer off the mapped pages / DeltaView, or go "
+              "through the sanctioned materialize() seam",
 }
 
 # Calls whose presence makes a function (and everything lexically inside
@@ -170,6 +174,21 @@ _TENANT_PATH_CALLS = {
 }
 _TENANT_TOKENS = ("tenants", "tenant.json")
 _TENANT_HELPER_PATHS = ("fps_tpu/tenancy/paths.py",)
+
+# FPS010: the read plane's zero-copy contract (docs/serving.md
+# "Read-plane throughput"): a snapshot table is a read-only-mmapped view
+# (or a DeltaView overlay on one), and the serve hot path must answer
+# off those pages — an np.asarray/np.array/.copy() of a TABLE there is
+# an O(table) allocation per request, the exact regression the batched
+# wire exists to kill. The ONE sanctioned densification seam is
+# fps_tpu.serve.snapshot.materialize() (and the DeltaView.__array__ it
+# rides), so functions by those names are exempt.
+_FPS010_MATERIALIZERS = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "np.ascontiguousarray", "numpy.ascontiguousarray",
+}
+_FPS010_ALLOW_FUNCS = {"__array__", "materialize"}
+_FPS010_DIRS = ("fps_tpu/serve/",)
 
 _SYNC_PRIMITIVES = {
     "Lock", "RLock", "Condition", "Event", "Semaphore",
@@ -248,6 +267,15 @@ class _Linter(ast.NodeVisitor):
         # FPS009 exemption: the tenant path helper owns the layout.
         self.is_tenant_helper = any(
             norm.endswith(p) for p in _TENANT_HELPER_PATHS)
+        # FPS010 scope: only the serve hot path carries the zero-copy
+        # contract; training/tools code materializes freely.
+        self.is_serve_hot = any(d in norm for d in _FPS010_DIRS)
+        # Names assigned from table-view expressions (filled by
+        # visit_Module's dataflow pre-pass).
+        self._table_names: set[str] = set()
+        # Depth of enclosing materialize()/__array__ defs — the
+        # sanctioned densification seam.
+        self._fps010_allow = 0
         # FPS001: stack of (loop_node, target_names) we are inside of.
         self._loops: list[tuple[ast.AST, set[str]]] = []
         # FPS003: depth of enclosing compiled-fn-builder functions.
@@ -318,7 +346,71 @@ class _Linter(ast.NodeVisitor):
                     return True
         return False
 
+    # -- FPS010 -----------------------------------------------------------
+
+    def visit_Module(self, node):
+        # Dataflow pre-pass: names assigned from table-view expressions
+        # anywhere in the file (iterated to a fixpoint so one level of
+        # aliasing — q = snap.table(n); r = q — still carries flavor).
+        if self.is_serve_hot:
+            for _ in range(4):  # bounded: alias chains are short
+                grew = False
+                for n in ast.walk(node):
+                    if (isinstance(n, ast.Assign)
+                            and len(n.targets) == 1
+                            and isinstance(n.targets[0], ast.Name)
+                            and self._table_flavored(n.value)
+                            and n.targets[0].id not in self._table_names):
+                        self._table_names.add(n.targets[0].id)
+                        grew = True
+                if not grew:
+                    break
+        self.generic_visit(node)
+
+    def _table_flavored(self, node) -> bool:
+        """True for expressions that ARE a snapshot table view: a
+        ``.table(...)`` accessor call, a ``.tables[...]`` subscript, a
+        ``.base`` attribute (DeltaView's mapped base), or a name
+        assigned from one. A SUBSCRIPT of a flavored expression is NOT
+        flavored — ``table[ids]`` is the gather result (bounded by the
+        request), and materializing it is the point."""
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            return bool(chain) and chain.split(".")[-1] == "table"
+        if isinstance(node, ast.Subscript):
+            chain = _attr_chain(node.value)
+            return bool(chain) and chain.split(".")[-1] == "tables"
+        if isinstance(node, ast.Attribute):
+            return node.attr in ("base", "tables")
+        if isinstance(node, ast.Name):
+            return node.id in self._table_names
+        return False
+
+    def _check_fps010(self, node):
+        if not self.is_serve_hot or self._fps010_allow:
+            return
+        name = _call_name(node)
+        if (name in _FPS010_MATERIALIZERS and node.args
+                and self._table_flavored(node.args[0])):
+            self._add(
+                "FPS010", node,
+                f"{name}() of a snapshot table view in the serve hot "
+                "path — an O(table) copy per request; answer off the "
+                "mapped pages (fancy-index the view) or, when a dense "
+                "whole table is genuinely needed, go through "
+                "fps_tpu.serve.snapshot.materialize()")
+        elif (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "copy" and not node.args
+                and self._table_flavored(node.func.value)):
+            self._add(
+                "FPS010", node,
+                ".copy() of a snapshot table view in the serve hot "
+                "path — an O(table) copy per request; answer off the "
+                "mapped pages or go through "
+                "fps_tpu.serve.snapshot.materialize()")
+
     def visit_Call(self, node):
+        self._check_fps010(node)
         # FPS007: a host clock read under tracing is a constant, not a
         # measurement (the _trace_depth scope is FPS003's).
         if self._trace_depth and _call_name(node) in _HOST_CLOCKS:
@@ -450,7 +542,14 @@ class _Linter(ast.NodeVisitor):
         elif self._trace_depth:
             self._trace_depth += 1
             entered = True
+        # FPS010 seam: materialize()/__array__ ARE the sanctioned
+        # densification path — their bodies may copy.
+        allow = node.name in _FPS010_ALLOW_FUNCS
+        if allow:
+            self._fps010_allow += 1
         self.generic_visit(node)
+        if allow:
+            self._fps010_allow -= 1
         if entered:
             self._trace_depth -= 1
 

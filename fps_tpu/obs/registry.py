@@ -344,6 +344,25 @@ def default_registry() -> MetricsRegistry:
                         "the liveness timeout the reader is classified "
                         "reader_wedged — an incident, never a silent "
                         "0 q/s (BENCH_r14)"),
+        MetricSpec("serve.batches", "counter", unit="batches",
+                   help="coalesced/multi batches executed by the "
+                        "ReadServer (one merged fancy-index gather per "
+                        "table per batch; docs/serving.md \"Batched "
+                        "reads\")"),
+        MetricSpec("serve.batch_size", "histogram", unit="requests",
+                   help="requests merged into each coalesced/multi "
+                        "batch — the batch-size/latency curve's x-axis "
+                        "(bench serve_scale)"),
+        MetricSpec("serve.fleet_size", "gauge", unit="readers",
+                   help="fleet membership after each autoscaler "
+                        "evaluation (fps_tpu.serve.fleet."
+                        "ReadAutoscaler)"),
+        MetricSpec("serve.autoscale_actions", "counter", unit="actions",
+                   labels=("action",),
+                   help="autoscaler scale decisions taken (action: "
+                        "scale_up / scale_down / replace) — each one "
+                        "also journaled as an autoscale_evaluate span "
+                        "with its evidence"),
         # Wire plane (fps_tpu.serve.wire / serve.net; docs/resilience.md
         # "Hostile network").
         MetricSpec("net.retries", "counter", unit="requests",
@@ -378,6 +397,18 @@ def default_registry() -> MetricsRegistry:
                         "cap): an evicted entry's resend is re-executed "
                         "instead of replayed — duplicate work, never a "
                         "duplicate side effect for idempotent reads"),
+        MetricSpec("net.bin_responses", "counter", unit="responses",
+                   help="responses answered on the zero-copy binary "
+                        "framing (CAP_BIN negotiated): table rows ride "
+                        "as raw scatter-gather segments straight off "
+                        "the snapshot's mapped pages, never "
+                        "JSON-materialized"),
+        MetricSpec("net.crc_light_frames", "counter", unit="frames",
+                   help="large responses sent with a header-only CRC "
+                        "trailer (CAP_CRC_LIGHT negotiated AND payload "
+                        "over the threshold) on loopback-trusted "
+                        "sessions; default sessions keep the "
+                        "full-payload CRC"),
         # Shadow serving (fps_tpu.serve.shadow): old-vs-new snapshot
         # scoring gates fleet promotion (docs/STALENESS.md).
         MetricSpec("serve.shadow_promotions", "counter", unit="snapshots",

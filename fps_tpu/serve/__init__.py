@@ -25,8 +25,10 @@ from the jax-free :mod:`fps_tpu.core.snapshot_format`): ``tools/serve.py``
 runs this whole plane on a machine with no accelerator runtime.
 """
 
+from fps_tpu.serve.admission import AdmissionController
 from fps_tpu.serve.fleet import (
     FleetReader,
+    ReadAutoscaler,
     ServingFleet,
     StepFence,
     liveness_check,
@@ -34,9 +36,14 @@ from fps_tpu.serve.fleet import (
     tiering_hot_ids,
 )
 from fps_tpu.serve.net import JsonlClient, TcpServe, handle_request
-from fps_tpu.serve.server import NoSnapshotError, ReadServer
+from fps_tpu.serve.server import CoalesceConfig, NoSnapshotError, ReadServer
 from fps_tpu.serve.shadow import ShadowGate, ShadowScorer
-from fps_tpu.serve.snapshot import DeltaView, ServableSnapshot, SnapshotRejected
+from fps_tpu.serve.snapshot import (
+    DeltaView,
+    ServableSnapshot,
+    SnapshotRejected,
+    materialize,
+)
 from fps_tpu.serve.watcher import SnapshotWatcher
 from fps_tpu.serve.wire import (
     ProtocolVersionError,
@@ -47,11 +54,14 @@ from fps_tpu.serve.wire import (
 )
 
 __all__ = [
+    "AdmissionController",
+    "CoalesceConfig",
     "DeltaView",
     "FleetReader",
     "JsonlClient",
     "NoSnapshotError",
     "ProtocolVersionError",
+    "ReadAutoscaler",
     "ReadServer",
     "ServableSnapshot",
     "ServerBusyError",
@@ -67,6 +77,7 @@ __all__ = [
     "WireError",
     "handle_request",
     "liveness_check",
+    "materialize",
     "scan_heartbeats",
     "tiering_hot_ids",
 ]

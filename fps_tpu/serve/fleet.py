@@ -54,12 +54,13 @@ import numpy as np
 
 from fps_tpu.core import retry as _retry
 from fps_tpu.core import snapshot_format as fmt
+from fps_tpu.obs.trace import Tracer
 from fps_tpu.serve.snapshot import ServableSnapshot, SnapshotRejected
 from fps_tpu.serve.server import ReadServer
 from fps_tpu.serve.watcher import SnapshotWatcher, _emit_event, \
     _emit_metric
 
-__all__ = ["StepFence", "FleetReader", "ServingFleet",
+__all__ = ["StepFence", "FleetReader", "ServingFleet", "ReadAutoscaler",
            "tiering_hot_ids", "scan_heartbeats", "liveness_check"]
 
 FLEET_DIR = "fleet"
@@ -336,6 +337,7 @@ class FleetReader:
         self._last_hb = 0.0
         self.hb_errors = 0
         self.polls = 0
+        self.born = time.time()  # boot-grace anchor for liveness
         self.watcher = SnapshotWatcher(
             ckpt_dir, journal=journal, recorder=recorder,
             on_swap=self._on_candidate, verify=verify)
@@ -528,7 +530,11 @@ class ServingFleet:
     process over a shared filesystem).
 
     ``quorum`` defaults to a majority of the fleet — the fence advances
-    once most readers verified a step, and laggards converge to it."""
+    once most readers verified a step, and laggards converge to it.
+    Membership is DYNAMIC: :meth:`add_reader` / :meth:`remove_reader`
+    grow and shrink a running fleet (the autoscaler's levers); a
+    default (majority) quorum re-derives on every membership change,
+    an explicit quorum stays pinned until :meth:`set_quorum`."""
 
     def __init__(self, ckpt_dir: str, n_readers: int = 3, *,
                  quorum: int | None = None, journal: str | None = None,
@@ -536,41 +542,55 @@ class ServingFleet:
                  shadow: bool = False):
         if n_readers < 1:
             raise ValueError(f"n_readers must be >= 1, got {n_readers}")
+        self.ckpt_dir = ckpt_dir
+        self.recorder = recorder
+        # Reader construction kwargs, kept so add_reader() builds
+        # members identical to the ctor's.
+        self._reader_kw = {"journal": journal, "recorder": recorder,
+                           "warm_from": warm_from, "verify": verify,
+                           "shadow": shadow}
+        self._auto_quorum = quorum is None
         self.quorum = (n_readers // 2 + 1) if quorum is None else quorum
         self.readers = [
             FleetReader(ckpt_dir, f"r{i}", quorum=self.quorum,
-                        journal=journal, recorder=recorder,
-                        warm_from=warm_from, verify=verify,
-                        shadow=shadow)
+                        **self._reader_kw)
             for i in range(n_readers)
         ]
+        self._next_id = n_readers
+        self._retired: set[str] = set()
+        self._admin_lock = threading.RLock()
         self._threads: list[threading.Thread] = []
+        self._started = False
         self._stop = threading.Event()
         self._interval_s = 0.05
 
     def poll(self) -> None:
-        for r in self.readers:
+        for r in list(self.readers):
             r.poll()
 
     def start(self, interval_s: float = 0.05) -> None:
         """One polling thread per reader (the fleet topology in one
         process). ``stop()`` joins them."""
-        self._stop.clear()
-        self._interval_s = interval_s
-        self._threads = [
-            threading.Thread(target=self._loop, args=(r,), daemon=True,
-                             name=f"fps-fleet-{r.reader_id}")
-            for r in self.readers
-        ]
-        for t in self._threads:
-            t.start()
+        with self._admin_lock:
+            self._stop.clear()
+            self._started = True
+            self._interval_s = interval_s
+            self._threads = [
+                threading.Thread(target=self._loop, args=(r,),
+                                 daemon=True,
+                                 name=f"fps-fleet-{r.reader_id}")
+                for r in self.readers
+            ]
+            for t in self._threads:
+                t.start()
 
     def _loop(self, reader) -> None:
         # A method (not a start() closure) so check_liveness can spawn
         # a REPLACEMENT thread for a wedged reader through the same
         # code path.
         log = logging.getLogger("fps_tpu.serve.fleet")
-        while not self._stop.is_set():
+        while not (self._stop.is_set()
+                   or reader.reader_id in self._retired):
             try:
                 reader.poll()
             except Exception:  # noqa: BLE001 — the loop must live
@@ -586,9 +606,90 @@ class ServingFleet:
 
     def stop(self, timeout: float = 10.0) -> None:
         self._stop.set()
-        for t in self._threads:
+        with self._admin_lock:
+            threads, self._threads = self._threads, []
+            self._started = False
+        for t in threads:
             t.join(timeout=timeout)
-        self._threads = []
+
+    # -- dynamic membership (the autoscaler's levers) -----------------------
+
+    def set_quorum(self, quorum: int) -> None:
+        """Pin an explicit fence quorum on every current member (future
+        members inherit it). Auto-majority derivation stops."""
+        if quorum < 1:
+            raise ValueError(f"quorum must be >= 1, got {quorum}")
+        with self._admin_lock:
+            self._auto_quorum = False
+            self.quorum = int(quorum)
+            for r in self.readers:
+                r.quorum = self.quorum
+
+    def _requorum(self) -> None:
+        # Default quorum follows the membership: a majority of the
+        # CURRENT fleet. An explicitly pinned quorum is clamped to the
+        # fleet size so a shrink can never make the fence unreachable.
+        if self._auto_quorum:
+            self.quorum = len(self.readers) // 2 + 1
+        else:
+            self.quorum = min(self.quorum, len(self.readers))
+        for r in self.readers:
+            r.quorum = self.quorum
+
+    def add_reader(self, reader_id: str | None = None) -> FleetReader:
+        """Spawn one more fence-coordinated reader (and its polling
+        thread, when the fleet is running). Its boot protocol re-reads
+        the shared fence first, so a scale-up never regresses the
+        served step."""
+        with self._admin_lock:
+            rid = (f"r{self._next_id}" if reader_id is None
+                   else str(reader_id))
+            self._next_id += 1
+            self._retired.discard(rid)
+            reader = FleetReader(self.ckpt_dir, rid, quorum=self.quorum,
+                                 **self._reader_kw)
+            self.readers.append(reader)
+            self._requorum()
+            if self._started:
+                t = threading.Thread(
+                    target=self._loop, args=(reader,), daemon=True,
+                    name=f"fps-fleet-{reader.reader_id}")
+                self._threads.append(t)
+                t.start()
+            _emit_event(self.recorder, "reader_added", reader=rid,
+                        fleet_size=len(self.readers),
+                        quorum=self.quorum)
+            return reader
+
+    def remove_reader(self, reader_id: str,
+                      timeout: float = 5.0) -> bool:
+        """Retire one reader: stop its polling thread, drop it from the
+        fleet, and delete its readiness/heartbeat slots so the fence
+        quorum and the liveness scan stop counting a ghost. The LAST
+        reader is never removable — an empty fleet serves nothing."""
+        with self._admin_lock:
+            idx = next((i for i, r in enumerate(self.readers)
+                        if r.reader_id == reader_id), None)
+            if idx is None or len(self.readers) <= 1:
+                return False
+            reader = self.readers.pop(idx)
+            self._retired.add(reader.reader_id)
+            thread = self._threads.pop(idx) if self._threads else None
+            self._requorum()
+        if thread is not None:
+            thread.join(timeout=timeout)
+        # Ghost-slot cleanup is best-effort: a storage hiccup leaves a
+        # stale slot the next liveness scan flags — loud, not wrong.
+        for path in (reader.fence._ready_path(reader.reader_id),
+                     reader.heartbeat_path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        _emit_event(self.recorder, "reader_removed",
+                    reader=reader.reader_id,
+                    fleet_size=len(self.readers), quorum=self.quorum)
+        return True
 
     def stats(self) -> list[dict]:
         return [r.stats() for r in self.readers]
@@ -612,24 +713,202 @@ class ServingFleet:
         report = liveness_check(
             ckpt_dir, timeout_s=timeout_s, recorder=rec, now=now,
             expected=[r.reader_id for r in self.readers])
+        # Boot grace: a reader added moments ago (the autoscaler's
+        # scale-up) has not had a beacon interval yet — classifying it
+        # wedged would make every scale-up instantly "fail". Younger
+        # than the timeout and beaconless is booting, not wedged.
+        wall = time.time() if now is None else now
+        born = {r.reader_id: r.born for r in self.readers}
+        report["wedged"] = [
+            rid for rid in report["wedged"]
+            if not (report["ages"].get(rid) is None
+                    and wall - born.get(rid, 0.0) < timeout_s)]
         restarted = []
-        if self._threads and report["wedged"]:
-            by_id = {r.reader_id: i for i, r in enumerate(self.readers)}
-            for reader_id in report["wedged"]:
-                i = by_id.get(reader_id)
-                if i is None or self._threads[i].is_alive():
-                    continue
-                reader = self.readers[i]
-                t = threading.Thread(
-                    target=self._loop, args=(reader,), daemon=True,
-                    name=f"fps-fleet-{reader.reader_id}")
-                self._threads[i] = t
-                t.start()
-                restarted.append(reader_id)
-                _emit_event(rec, "reader_restarted",
-                            reader=reader_id)
+        with self._admin_lock:
+            if self._threads and report["wedged"]:
+                by_id = {r.reader_id: i
+                         for i, r in enumerate(self.readers)}
+                for reader_id in report["wedged"]:
+                    i = by_id.get(reader_id)
+                    if i is None or self._threads[i].is_alive():
+                        continue
+                    reader = self.readers[i]
+                    t = threading.Thread(
+                        target=self._loop, args=(reader,), daemon=True,
+                        name=f"fps-fleet-{reader.reader_id}")
+                    self._threads[i] = t
+                    t.start()
+                    restarted.append(reader_id)
+                    _emit_event(rec, "reader_restarted",
+                                reader=reader_id)
         report["restarted"] = restarted
         return report
+
+
+class ReadAutoscaler:
+    """Closed-loop sizing for a :class:`ServingFleet`, keyed to the two
+    signals that actually mean "capacity" on the read plane:
+
+    * **latency-SLO burn** — the worst per-reader p99 over the retained
+      request window against ``latency_slo_s``. Burning latency while
+      the fence is FRESH means the readers are compute-bound: spawn one
+      more (up to ``max_readers``).
+    * **fence lag** — newest published step minus the fence step.
+      Burning latency while the fence is STALE means the bottleneck is
+      publish/verify/quorum, which another reader cannot fix (and whose
+      fence votes would slow): hold instead of thrash.
+
+    Wedged readers (liveness beacons gone silent) are handled first and
+    exempt from the cooldown: dead polling threads are restarted in
+    place by :meth:`ServingFleet.check_liveness`; a thread that is
+    alive-but-silent is REPLACED — a fresh reader joins (re-reading the
+    fence at boot, so no regression), then the wedged one is retired so
+    quorum stops waiting on a ghost.
+
+    Every :meth:`evaluate` is journaled as a trace SPAN (the same
+    causal-tree machinery as pod restart decisions —
+    ``fps_tpu.obs.trace``) with the decision and its evidence as
+    attributes, plus an ``autoscale_decision`` event and the
+    ``serve.fleet_size`` / ``serve.autoscale_actions`` metrics; the
+    in-memory :attr:`decisions` trail serves tests and the bench."""
+
+    def __init__(self, fleet: ServingFleet, *, min_readers: int = 1,
+                 max_readers: int = 8, latency_slo_s: float = 0.050,
+                 fence_lag_slo_steps: float = 8.0,
+                 scale_down_fraction: float = 0.25,
+                 cooldown_s: float = 5.0,
+                 liveness_timeout_s: float = DEFAULT_LIVENESS_TIMEOUT_S,
+                 recorder=None):
+        if not 1 <= min_readers <= max_readers:
+            raise ValueError(
+                f"need 1 <= min_readers <= max_readers, got "
+                f"[{min_readers}, {max_readers}]")
+        self.fleet = fleet
+        self.min_readers = int(min_readers)
+        self.max_readers = int(max_readers)
+        self.latency_slo_s = float(latency_slo_s)
+        self.fence_lag_slo_steps = float(fence_lag_slo_steps)
+        self.scale_down_fraction = float(scale_down_fraction)
+        self.cooldown_s = float(cooldown_s)
+        self.liveness_timeout_s = float(liveness_timeout_s)
+        self.recorder = (recorder if recorder is not None
+                         else fleet.recorder)
+        self._tracer = Tracer(self.recorder)
+        self._last_scale_mono: float | None = None
+        self.decisions: list[dict] = []
+
+    # -- signals ------------------------------------------------------------
+
+    def worst_p99_s(self) -> float | None:
+        """Worst per-reader p99 latency over the retained window (None
+        until any reader has served requests)."""
+        p99s = []
+        for r in list(self.fleet.readers):
+            lat = r.server.latency_s()
+            if lat is not None:
+                p99s.append(lat["p99"])
+        return max(p99s) if p99s else None
+
+    def fence_lag_steps(self, newest_step: int | None = None
+                        ) -> float | None:
+        """Newest published step minus the effective fence step.
+        ``newest_step`` overrides discovery (the bench/chaos harness
+        knows exactly what it published); otherwise the newest
+        readiness slot stands in — some reader VERIFIED that step, so
+        the fence trailing it is real lag."""
+        readers = list(self.fleet.readers)
+        if not readers:
+            return None
+        fence = readers[0].fence.read()
+        if newest_step is None:
+            steps = readers[0].fence.ready_steps().values()
+            newest_step = max(steps, default=None)
+        if newest_step is None or fence is None:
+            return None
+        return float(int(newest_step) - fence[1])
+
+    # -- the control loop body ----------------------------------------------
+
+    def evaluate(self, *, newest_step: int | None = None,
+                 now: float | None = None) -> dict:
+        """One sizing pass: liveness repair first, then at most ONE
+        scale action (cooldown-gated). Returns the decision record
+        (also appended to :attr:`decisions` and journaled)."""
+        t0 = time.time()
+        mono = time.monotonic() if now is None else float(now)
+        report = self.fleet.check_liveness(
+            timeout_s=self.liveness_timeout_s, recorder=self.recorder)
+        replaced = []
+        for rid in report["wedged"]:
+            if rid in report["restarted"]:
+                continue
+            # Alive-but-silent thread: replace, never double up on the
+            # same FleetReader (check_liveness's contract). Join first,
+            # retire after — the fleet never dips below size.
+            if len(self.fleet.readers) < self.max_readers + 1:
+                fresh = self.fleet.add_reader()
+                if self.fleet.remove_reader(rid, timeout=0.5):
+                    replaced.append({"wedged": rid,
+                                     "replacement": fresh.reader_id})
+                    _emit_event(self.recorder, "reader_replaced",
+                                wedged=rid,
+                                replacement=fresh.reader_id)
+        p99 = self.worst_p99_s()
+        lag = self.fence_lag_steps(newest_step)
+        size = len(self.fleet.readers)
+        lag_ok = lag is None or lag <= self.fence_lag_slo_steps
+        cooled = (self._last_scale_mono is None
+                  or mono - self._last_scale_mono >= self.cooldown_s)
+        action, reason, target = "hold", "within slo", None
+        if replaced:
+            action = "replace"
+            reason = f"replaced wedged reader(s): " \
+                     f"{[r['wedged'] for r in replaced]}"
+        elif (p99 is not None and p99 > self.latency_slo_s
+                and not lag_ok):
+            reason = (f"latency burn (p99 {p99:.4f}s) but fence lag "
+                      f"{lag:.0f} steps over slo — publish-bound, "
+                      "another reader won't help")
+        elif (p99 is not None and p99 > self.latency_slo_s
+                and size < self.max_readers and cooled):
+            action, reason = "scale_up", (
+                f"p99 {p99:.4f}s over slo {self.latency_slo_s:.4f}s "
+                f"with fresh fence")
+            target = self.fleet.add_reader().reader_id
+            self._last_scale_mono = mono
+        elif (p99 is not None and size > self.min_readers and cooled
+                and p99 < self.scale_down_fraction * self.latency_slo_s):
+            victim = self.fleet.readers[-1].reader_id
+            if self.fleet.remove_reader(victim):
+                action, reason, target = "scale_down", (
+                    f"p99 {p99:.4f}s under "
+                    f"{self.scale_down_fraction:.0%} of slo"), victim
+                self._last_scale_mono = mono
+        decision = {
+            "t": t0, "action": action, "reason": reason,
+            "target": target, "replaced": replaced,
+            "fleet_size": len(self.fleet.readers),
+            "quorum": self.fleet.quorum,
+            "worst_p99_s": p99, "fence_lag_steps": lag,
+            "wedged": report["wedged"],
+            "restarted": report["restarted"],
+        }
+        self.decisions.append(decision)
+        # Journal the decision as a causal span + event + gauges: the
+        # autoscaler's choices must be post-mortem-able from the obs
+        # journal alone, exactly like pod restart decisions.
+        self._tracer.emit("autoscale_evaluate", t0, time.time(),
+                          action=action, reason=reason, target=target,
+                          fleet_size=decision["fleet_size"],
+                          worst_p99_s=p99, fence_lag_steps=lag)
+        _emit_event(self.recorder, "autoscale_decision", **{
+            k: v for k, v in decision.items() if k != "t"})
+        _emit_metric(self.recorder, "set", "serve.fleet_size",
+                     float(decision["fleet_size"]))
+        if action != "hold":
+            _emit_metric(self.recorder, "inc",
+                         "serve.autoscale_actions", 1, action=action)
+        return decision
 
 
 def scan_heartbeats(ckpt_dir: str, *, now=None) -> dict:

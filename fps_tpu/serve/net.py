@@ -14,12 +14,14 @@ loud, immediate, and impossible to half-support (``docs/serving.md``).
 
 Server-side survival (the tentpole's third leg):
 
-* **admission control** — a bounded in-flight semaphore; a request
-  arriving with no slot free is shed with a retryable ``BUSY`` frame
-  (counted as ``net.shed_requests`` — the shed-rate SLO in
-  ``fps_tpu.obs.fleet`` burns on it) instead of queueing unboundedly.
-  Load shedding is lost WORK, never lost CORRECTNESS: the client
-  retries or degrades (``docs/STALENESS.md``).
+* **admission control** — a cost-weighted, latency-governed
+  :class:`~fps_tpu.serve.admission.AdmissionController` (a ``topk``
+  matmul weighs ~8x a ``pull``; a batched ``multi`` frame weighs the
+  sum of its members); a request the budget cannot cover is shed with
+  a retryable ``BUSY`` frame (counted as ``net.shed_requests`` — the
+  shed-rate SLO in ``fps_tpu.obs.fleet`` burns on it) instead of
+  queueing unboundedly. Load shedding is lost WORK, never lost
+  CORRECTNESS: the client retries or degrades (``docs/STALENESS.md``).
 * **deadline enforcement** — request envelopes carry the client's
   remaining budget; a request that is already dead on arrival is
   answered with a retryable ``deadline_exceeded`` response
@@ -47,7 +49,7 @@ new schema.
 thread-safety: one daemon thread per connection plus the acceptor
 (``socketserver.ThreadingTCPServer``); shared state is the ReadServer
 (lock-free read path by design), the replay cache and wire-stat
-counters (one lock each), and the admission semaphore.
+counters (one lock each), and the admission controller (its own lock).
 """
 
 from __future__ import annotations
@@ -58,21 +60,33 @@ import math
 import socket
 import socketserver
 import threading
+import time
 
 import numpy as np
 
 from fps_tpu.core.retry import net_fault_check
 from fps_tpu.obs.sinks import scrub_nonfinite
+from fps_tpu.serve.admission import AdmissionController
 from fps_tpu.serve.server import NoSnapshotError, ReadServer
 from fps_tpu.serve.watcher import _emit_event, _emit_metric
-from fps_tpu.serve.wire import (OP_BUSY, OP_ERR, OP_HELLO, OP_HELLO_OK,
+from fps_tpu.serve.wire import (CAP_BIN, CAP_CRC_LIGHT, CAP_MULTI,
+                                CRC_LIGHT_THRESHOLD, FLAG_BIN,
+                                OP_BUSY, OP_ERR, OP_HELLO, OP_HELLO_OK,
                                 OP_REQ, OP_RESP,
-                                SUPPORTED_VERSIONS, FrameTooLargeError,
+                                SUPPORTED_CAPS, SUPPORTED_VERSIONS,
+                                FrameTooLargeError,
                                 ProtocolVersionError, TornFrameError,
-                                WireClient, encode_frame, read_frame,
-                                send_frame)
+                                WireClient, encode_frame,
+                                encode_frame_parts, pack_bin_payload,
+                                read_frame, send_frame)
 
-__all__ = ["TcpServe", "JsonlClient", "handle_request"]
+__all__ = ["TcpServe", "JsonlClient", "handle_request",
+           "handle_request_segs", "MULTI_MAX_REQS"]
+
+# One multi frame may carry at most this many sub-requests: bounds the
+# per-frame work admission charges as one unit, and keeps the merged
+# response under MAX_PAYLOAD for any sane row width.
+MULTI_MAX_REQS = 4096
 
 
 def _py(v):
@@ -92,40 +106,128 @@ def _py(v):
     return v
 
 
-def handle_request(server: ReadServer, req: dict) -> dict:
-    """One request → one response dict (transport-independent: the TCP
-    handler and the in-process client in tests both call this)."""
+def _seg_ref(segs: list, arr) -> dict:
+    """Park one result array in the segment list, return its payload
+    placeholder. Gather outputs are C-contiguous by construction; the
+    defensive copy below fires only for exotic strides."""
+    arr = np.asarray(arr)
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+    segs.append(arr)
+    return {"__seg__": len(segs) - 1}
+
+
+def handle_request_segs(server: ReadServer, req) -> tuple[dict, list]:
+    """One request → ``(response, segments)``: the response dict holds
+    ``{"__seg__": i}`` placeholders where result ARRAYS go, and
+    ``segments`` the arrays themselves — the transport decides whether
+    to JSON-materialize them (:func:`handle_request`) or write their
+    bytes straight into a FLAG_BIN frame (zero-copy sessions)."""
+    segs: list = []
     if not isinstance(req, dict):
         # Valid JSON but not an object ('[1]', 'null'): still one error
-        # line, never a dropped connection.
-        return {"ok": False,
-                "error": f"request must be a JSON object, got "
-                         f"{type(req).__name__}"}
+        # response, never a dropped connection.
+        return ({"ok": False,
+                 "error": f"request must be a JSON object, got "
+                          f"{type(req).__name__}"}, segs)
     try:
         op = req.get("op")
         if op == "pull":
             step, vals = server.pull(req["table"], req["ids"])
-            return {"ok": True, "step": step, "values": _py(vals)}
+            return ({"ok": True, "step": step,
+                     "values": _seg_ref(segs, vals)}, segs)
         if op == "score":
             step, scores = server.score_linear(
                 req["feat_ids"], req["feat_vals"],
                 table=req.get("table", "weights"),
                 link=req.get("link", "sigmoid"))
-            return {"ok": True, "step": step, "scores": _py(scores)}
+            return ({"ok": True, "step": step,
+                     "scores": _seg_ref(segs, scores)}, segs)
         if op == "topk":
             step, items, scores = server.topk(
                 req["users"], int(req.get("k", 10)),
                 item_table=req.get("item_table", "item_factors"),
                 user_leaf=int(req.get("user_leaf", 0)))
-            return {"ok": True, "step": step, "items": _py(items),
-                    "scores": _py(scores)}
+            return ({"ok": True, "step": step,
+                     "items": _seg_ref(segs, items),
+                     "scores": _seg_ref(segs, scores)}, segs)
+        if op == "multi":
+            return _handle_multi(server, req, segs), segs
         if op == "stats":
-            return {"ok": True, **server.stats()}
-        return {"ok": False, "error": f"unknown op {op!r}"}
+            return {"ok": True, **server.stats()}, segs
+        return {"ok": False, "error": f"unknown op {op!r}"}, segs
     except NoSnapshotError as e:
-        return {"ok": False, "error": str(e), "retryable": True}
+        return {"ok": False, "error": str(e), "retryable": True}, segs
     except (KeyError, IndexError, TypeError, ValueError) as e:
-        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}, segs
+
+
+def _handle_multi(server: ReadServer, req: dict, segs: list) -> dict:
+    """The batched multi-lookup op: every sub-request in ``reqs``
+    executes as ONE :meth:`ReadServer.multi` batch (one snapshot
+    binding, one merged gather per table). Sub-request failures ride
+    inside their own result entry — siblings are unaffected."""
+    reqs = req.get("reqs")
+    if not isinstance(reqs, list):
+        return {"ok": False, "error": "multi needs a 'reqs' list"}
+    if len(reqs) > MULTI_MAX_REQS:
+        return {"ok": False,
+                "error": f"multi carries {len(reqs)} requests, "
+                         f"cap {MULTI_MAX_REQS}"}
+    calls = []
+    for r in reqs:
+        if isinstance(r, dict):
+            calls.append((r.get("op"), r))
+        else:
+            calls.append(("__not_a_dict__", {}))
+    results = server.multi(calls)  # NoSnapshotError propagates whole
+    out = []
+    for (kind, _payload), r, sub in zip(calls, results, reqs):
+        if kind == "__not_a_dict__":
+            out.append({"ok": False,
+                        "error": f"request must be a JSON object, got "
+                                 f"{type(sub).__name__}"})
+        elif isinstance(r, NoSnapshotError):
+            out.append({"ok": False, "error": str(r), "retryable": True})
+        elif isinstance(r, BaseException):
+            out.append({"ok": False,
+                        "error": f"{type(r).__name__}: {r}"})
+        elif kind == "pull":
+            step, vals = r
+            out.append({"ok": True, "step": step,
+                        "values": _seg_ref(segs, vals)})
+        elif kind == "score":
+            step, scores = r
+            out.append({"ok": True, "step": step,
+                        "scores": _seg_ref(segs, scores)})
+        elif kind == "stats":
+            out.append({"ok": True, **r})
+        else:  # topk
+            step, items, scores = r
+            out.append({"ok": True, "step": step,
+                        "items": _seg_ref(segs, items),
+                        "scores": _seg_ref(segs, scores)})
+    return {"ok": True, "results": out}
+
+
+def _jsonify_resp(node, segs):
+    """Resolve segment placeholders into JSON-safe lists (:func:`_py`)
+    — the compat path for sessions that did not negotiate CAP_BIN."""
+    if isinstance(node, dict):
+        if set(node) == {"__seg__"}:
+            return _py(segs[node["__seg__"]])
+        return {k: _jsonify_resp(v, segs) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_jsonify_resp(v, segs) for v in node]
+    return node
+
+
+def handle_request(server: ReadServer, req: dict) -> dict:
+    """One request → one JSON-safe response dict (transport-independent:
+    the TCP handler's non-binary sessions and the in-process client in
+    tests both ride this)."""
+    resp, segs = handle_request_segs(server, req)
+    return _jsonify_resp(resp, segs) if segs else resp
 
 
 def _safe_dumps(resp: dict) -> bytes:
@@ -145,8 +247,12 @@ class TcpServe:
     read the bound port from :attr:`port`). ``start()`` returns
     immediately (daemon threads); ``close()`` shuts the socket down.
 
-    ``max_inflight`` bounds concurrently-EXECUTING requests across all
-    connections (admission control; excess is shed with BUSY);
+    ``max_inflight`` seeds the default admission budget (cost units of
+    concurrently-EXECUTING work across all connections; excess is shed
+    with BUSY) — pass ``admission=`` for per-op cost weights and a
+    latency-target governor (:mod:`fps_tpu.serve.admission`);
+    ``caps=`` limits which wire capabilities this server will grant
+    (``multi``/``bin``/``crc_light``, default: all).
     ``conn_timeout_s`` reaps connections whose peer goes silent
     mid-conversation; the (session, req_id) → response replay LRU that
     makes client resends idempotent is bounded BOTH by entries
@@ -160,11 +266,18 @@ class TcpServe:
                  port: int = 0, max_inflight: int = 64,
                  conn_timeout_s: float = 60.0,
                  replay_cache: int = 1024,
-                 replay_cache_bytes: int = 8 << 20):
+                 replay_cache_bytes: int = 8 << 20,
+                 admission: AdmissionController | None = None,
+                 caps=SUPPORTED_CAPS):
         read_server = server
         tcp_serve = self
         self._read_server = server
-        self._inflight = threading.BoundedSemaphore(max_inflight)
+        # Admission: cost-weighted and (optionally) latency-governed
+        # (fps_tpu/serve/admission.py). The default reproduces the old
+        # semaphore semantics — unit-ish costs against max_inflight.
+        self.admission = (AdmissionController(max_cost=float(max_inflight))
+                          if admission is None else admission)
+        self._caps = frozenset(caps)
         self._stats_lock = threading.Lock()
         self._replay: collections.OrderedDict = collections.OrderedDict()
         self._replay_cap = int(replay_cache)
@@ -173,7 +286,8 @@ class TcpServe:
         self._counts = {"torn_frames": 0, "shed_requests": 0,
                         "deadline_exceeded": 0, "dedup_replays": 0,
                         "framed_conns": 0, "replay_evictions": 0,
-                        "dropped_accepts": 0}
+                        "dropped_accepts": 0, "bin_responses": 0,
+                        "crc_light_frames": 0, "multi_frames": 0}
 
         class Handler(socketserver.StreamRequestHandler):
             timeout = conn_timeout_s
@@ -266,8 +380,16 @@ class TcpServe:
                 self.wire_session = str(
                     hello.get("session", f"conn-{id(self)}"))
                 self.wire_version = max(common)
+                # Capability negotiation (additive — the protocol
+                # version does not move): grant the intersection of
+                # what the client offered and what this server allows.
+                # Old clients offer nothing and get nothing; every
+                # pre-capability frame shape still works.
+                offered_caps = {str(c) for c in hello.get("caps", ())}
+                self.wire_caps = offered_caps & tcp_serve._caps
                 self._send(OP_HELLO_OK, 0, _safe_dumps(
-                    {"ok": True, "version": self.wire_version}))
+                    {"ok": True, "version": self.wire_version,
+                     "caps": sorted(self.wire_caps)}))
                 return True
 
             def _serve_one(self, fr, recorder):
@@ -288,10 +410,22 @@ class TcpServe:
                         {"ok": False, "error": "deadline exceeded",
                          "retryable": True, "deadline_exceeded": True}))
                     return
-                if not tcp_serve._inflight.acquire(blocking=False):
-                    # Admission control: full house. Shed with a
-                    # retryable BUSY — bounded latency beats an
-                    # unbounded queue (docs/STALENESS.md).
+                q = envelope.get("q")
+                if (isinstance(q, dict) and q.get("op") == "multi"
+                        and CAP_MULTI not in self.wire_caps):
+                    # A multi frame on a session that never negotiated
+                    # the capability is a protocol bug, not load.
+                    self._send(OP_RESP, fr.req_id, _safe_dumps(
+                        {"ok": False,
+                         "error": "multi not negotiated on this "
+                                  "session"}))
+                    return
+                cost = tcp_serve.admission.cost_of(q)
+                if not tcp_serve.admission.try_admit(cost):
+                    # Admission control: the cost budget (queue depth
+                    # in op-weighted units, latency-governed) is spent.
+                    # Shed with a retryable BUSY — bounded latency
+                    # beats an unbounded queue (docs/STALENESS.md).
                     tcp_serve._bump("shed_requests")
                     _emit_metric(recorder, "inc",
                                  "net.shed_requests", 1)
@@ -299,18 +433,62 @@ class TcpServe:
                         {"ok": False, "error": "server busy",
                          "retryable": True, "busy": True}))
                     return
+                t0 = time.monotonic()
                 try:
-                    resp = handle_request(read_server,
-                                          envelope.get("q"))
+                    resp, segs = handle_request_segs(read_server, q)
                 finally:
-                    tcp_serve._inflight.release()
-                data = encode_frame(OP_RESP, fr.req_id,
-                                    _safe_dumps(resp))
+                    tcp_serve.admission.release(
+                        cost, time.monotonic() - t0)
+                if isinstance(q, dict) and q.get("op") == "multi":
+                    tcp_serve._bump("multi_frames")
+                data = self._encode_resp(fr.req_id, resp, segs,
+                                         recorder)
                 if resp.get("ok"):
                     # Only EXECUTED successes are replayable; errors
                     # and sheds must re-execute on resend.
                     tcp_serve._replay_put(key, data)
                 send_frame(self.connection, data, "serve")
+
+            def _encode_resp(self, req_id, resp, segs, recorder):
+                """Encode a response for THIS session's capabilities.
+
+                * ``bin`` negotiated and array segments present →
+                  binary payload framing: the raw table rows ride as
+                  memoryview segments straight off the snapshot (no
+                  base64, no JSON digit-printing, no copy).
+                * ``crc_light`` negotiated and the payload is large →
+                  header-only CRC trailer (the loopback-trusted mode;
+                  default sessions keep the full-payload CRC).
+
+                Returns either ``bytes`` or a parts list; both
+                ``send_frame`` and the replay cache accept either.
+                """
+                use_bin = bool(segs) and CAP_BIN in self.wire_caps
+                if use_bin:
+                    parts = pack_bin_payload(resp, segs)
+                    flags = FLAG_BIN
+                else:
+                    # No bin capability: materialize segments into the
+                    # JSON body (the compatible, copying path).
+                    parts = [_safe_dumps(_jsonify_resp(resp, segs))]
+                    flags = 0
+                nbytes = sum(
+                    getattr(p, "nbytes", None) or len(p) for p in parts)
+                crc_light = (CAP_CRC_LIGHT in self.wire_caps
+                             and nbytes > CRC_LIGHT_THRESHOLD)
+                if use_bin:
+                    tcp_serve._bump("bin_responses")
+                    _emit_metric(recorder, "inc",
+                                 "net.bin_responses", 1)
+                if crc_light:
+                    tcp_serve._bump("crc_light_frames")
+                    _emit_metric(recorder, "inc",
+                                 "net.crc_light_frames", 1)
+                if not use_bin and not crc_light:
+                    return encode_frame(OP_RESP, req_id, parts[0])
+                return encode_frame_parts(
+                    OP_RESP, req_id, parts,
+                    flags=flags, crc_light=crc_light)
 
         self._tcp = socketserver.ThreadingTCPServer(
             (host, port), Handler, bind_and_activate=True)
@@ -334,24 +512,36 @@ class TcpServe:
                 self._counts["dedup_replays"] += 1
             return data
 
-    def _replay_put(self, key, data: bytes) -> None:
+    @staticmethod
+    def _frame_nbytes(data) -> int:
+        """Wire size of a cached response — bytes or a parts list
+        (binary responses are cached as the scatter-gather parts they
+        were sent as; no join on the hot path)."""
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            return len(data)
+        return sum(getattr(p, "nbytes", None) or len(p) for p in data)
+
+    def _replay_put(self, key, data) -> None:
         recorder = self._read_server.recorder
         with self._stats_lock:
             old = self._replay.pop(key, None)
             if old is not None:
-                self._replay_bytes -= len(old)
+                self._replay_bytes -= self._frame_nbytes(old)
             self._replay[key] = data
-            self._replay_bytes += len(data)
+            self._replay_bytes += self._frame_nbytes(data)
             # Byte bound first (the binding one — fairness between a
             # MiB-response tenant and a tens-of-bytes tenant is a byte
             # property), entry bound as a backstop. Strict LRU order:
             # oldest-touched entries go first, pinned by the test.
+            # The just-inserted entry is IN FLIGHT (its response may
+            # still be resent after a reconnect) — eviction never
+            # touches it, even when it alone exceeds the byte bound.
             evicted = 0
-            while (self._replay
+            while (len(self._replay) > 1
                    and (self._replay_bytes > self._replay_max_bytes
                         or len(self._replay) > self._replay_cap)):
                 _k, v = self._replay.popitem(last=False)
-                self._replay_bytes -= len(v)
+                self._replay_bytes -= self._frame_nbytes(v)
                 evicted += 1
             self._counts["replay_evictions"] += evicted
         if evicted:
@@ -367,9 +557,13 @@ class TcpServe:
     def wire_stats(self) -> dict:
         """Plain-int wire counters (scenario/bench evidence):
         torn_frames, shed_requests, deadline_exceeded, dedup_replays,
-        framed_conns, replay_evictions, dropped_accepts."""
+        framed_conns, replay_evictions, dropped_accepts,
+        bin_responses, crc_light_frames, multi_frames — plus the
+        admission controller's snapshot under ``"admission"``."""
         with self._stats_lock:
-            return dict(self._counts)
+            out = dict(self._counts)
+        out["admission"] = self.admission.stats()
+        return out
 
     # -- lifecycle ----------------------------------------------------------
 

@@ -31,7 +31,8 @@ import numpy as np
 
 from fps_tpu.core import snapshot_format as fmt
 
-__all__ = ["ServableSnapshot", "SnapshotRejected", "DeltaView"]
+__all__ = ["ServableSnapshot", "SnapshotRejected", "DeltaView",
+           "materialize"]
 
 
 class SnapshotRejected(RuntimeError):
@@ -121,6 +122,23 @@ class DeltaView:
     @property
     def overlay_rows(self) -> int:
         return int(len(self.ids))
+
+
+def materialize(table):
+    """The ONE sanctioned whole-table densification seam (lint rule
+    FPS010 allowlists exactly this and :meth:`DeltaView.__array__`).
+
+    Plain ndarray/memmap tables return AS-IS — zero copy, zero
+    allocation; whole-table consumers (MF top-k's matmul) read the
+    mapped pages directly. :class:`DeltaView` overlays return their
+    CACHED dense form (one O(table) copy per overlay lifetime, amortized
+    across every request that binds the snapshot). Hot-path serve code
+    must route whole-table access through here instead of
+    ``np.asarray``/``np.array``/``.copy()`` — the static guard that
+    keeps zero-copy zero-copy."""
+    if isinstance(table, DeltaView):
+        return table.__array__()
+    return table
 
 
 def _merge_overlay(base_ids, base_rows, ids, rows):
@@ -403,11 +421,12 @@ class ServableSnapshot:
                 f"snapshot step {self.step} has no table {name!r} "
                 f"(tables: {sorted(self.tables)})") from None
 
-    def lookup(self, name: str, ids) -> np.ndarray:
-        """Batched pull-by-id: rows ``ids`` of table ``name`` (logical id
-        order). Padding ids (``-1``) read as zero rows, matching the
-        training plane's dropped-row contract; out-of-range ids — above
-        the table or below the ``-1`` sentinel — raise."""
+    def check_ids(self, name: str, ids) -> np.ndarray:
+        """Validate ``ids`` against table ``name`` — same parse and
+        errors as :meth:`lookup`, WITHOUT the gather. The batched
+        request path pre-validates every sub-request through here so a
+        bad one fails alone instead of poisoning its merged gather.
+        Returns the ids as int64."""
         t = self.table(name)
         ids = np.asarray(ids, np.int64)
         if ids.size and ids.max(initial=-1) >= t.shape[0]:
@@ -420,6 +439,15 @@ class ServableSnapshot:
             raise IndexError(
                 f"table {name!r}: id {int(ids.min())} below the -1 "
                 f"padding sentinel")
+        return ids
+
+    def lookup(self, name: str, ids) -> np.ndarray:
+        """Batched pull-by-id: rows ``ids`` of table ``name`` (logical id
+        order). Padding ids (``-1``) read as zero rows, matching the
+        training plane's dropped-row contract; out-of-range ids — above
+        the table or below the ``-1`` sentinel — raise."""
+        t = self.table(name)
+        ids = self.check_ids(name, ids)
         live = ids >= 0
         out = t[np.where(live, ids, 0)]
         if not live.all():

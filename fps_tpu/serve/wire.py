@@ -66,9 +66,14 @@ from fps_tpu.core.retry import (DEFAULT_NET_RETRY, classify_net,
 __all__ = [
     "PROTO_VERSION", "MAGIC", "MAX_PAYLOAD",
     "OP_HELLO", "OP_HELLO_OK", "OP_REQ", "OP_RESP", "OP_BUSY", "OP_ERR",
+    "CAP_MULTI", "CAP_BIN", "CAP_CRC_LIGHT", "SUPPORTED_CAPS",
+    "DEFAULT_CLIENT_CAPS", "FLAG_BIN", "FLAG_CRC_LIGHT",
+    "CRC_LIGHT_THRESHOLD",
     "Frame", "WireError", "TornFrameError", "FrameTooLargeError",
     "ProtocolVersionError", "ServerBusyError",
-    "encode_frame", "decode_frame", "read_frame", "WireClient",
+    "encode_frame", "encode_frame_parts", "decode_frame", "read_frame",
+    "pack_bin_payload", "split_bin_payload", "decode_bin_response",
+    "WireClient",
 ]
 
 MAGIC = b"\xabFPS"
@@ -78,6 +83,31 @@ SUPPORTED_VERSIONS = (1,)
 # a big batch) is well under a MiB; 16 MiB rejects corrupt/hostile
 # prefixes before any allocation.
 MAX_PAYLOAD = 16 << 20
+
+# HELLO-negotiated CAPABILITIES (the version stays 1: capabilities are
+# strictly additive, and a peer that never offers them gets the exact
+# PR-16 wire — old clients keep working against new servers and vice
+# versa). The server replies with the intersection of what the client
+# offered and what it supports; a capability is live on a session only
+# when BOTH sides named it.
+CAP_MULTI = "multi"          # batched multi-lookup op in one frame
+CAP_BIN = "bin"              # binary row segments in responses (FLAG_BIN)
+CAP_CRC_LIGHT = "crc_light"  # header-only CRC above CRC_LIGHT_THRESHOLD
+SUPPORTED_CAPS = (CAP_MULTI, CAP_BIN, CAP_CRC_LIGHT)
+# Clients offer only CAP_MULTI by default: binary responses change the
+# response value types (ndarrays, NaN passthrough) and crc-light trades
+# payload integrity for throughput — both are explicit opt-ins
+# (loopback-trusted, throughput-hungry sessions like bench serve_scale).
+DEFAULT_CLIENT_CAPS = (CAP_MULTI,)
+
+# Frame flag bits (header ``flags`` byte).
+FLAG_BIN = 0x01        # payload = u32 meta_len | meta json | raw segments
+FLAG_CRC_LIGHT = 0x02  # CRC trailer covers the HEADER only (negotiated)
+
+# Payloads at or below this size always carry the full CRC even on a
+# crc-light session: integrity of small control/response frames is
+# ~free, and the ~2% CRC tax only matters on MiB-scale batched rows.
+CRC_LIGHT_THRESHOLD = 64 << 10
 
 _HEADER = struct.Struct("!4sHBBQI")  # magic, version, op, flags, id, len
 _CRC = struct.Struct("!I")
@@ -155,6 +185,46 @@ def encode_frame(op: int, req_id: int, payload: bytes, *,
     return b"".join((head, payload, _CRC.pack(crc)))
 
 
+def _as_buf(part):
+    """Normalize any C-contiguous buffer (bytes, memoryview, ndarray)
+    to a flat byte view WITHOUT copying the underlying memory."""
+    if isinstance(part, (bytes, bytearray)):
+        return part
+    mv = part if isinstance(part, memoryview) else memoryview(part)
+    if mv.ndim != 1 or mv.format != "B":
+        mv = mv.cast("B")
+    return mv
+
+
+def encode_frame_parts(op: int, req_id: int, parts, *,
+                       version: int = PROTO_VERSION, flags: int = 0,
+                       crc_light: bool = False) -> list:
+    """Scatter-gather frame encoder: header + the caller's buffers +
+    CRC trailer, returned as a LIST of buffers for ``socket.sendmsg``
+    — row bytes gathered off the mmap'd tables go straight to the
+    kernel, never joined into an intermediate payload copy (the
+    zero-copy response path; :func:`send_frame` accepts the list).
+
+    ``crc_light=True`` (only on sessions that negotiated
+    :data:`CAP_CRC_LIGHT`, for payloads above
+    :data:`CRC_LIGHT_THRESHOLD`) computes the trailer over the header
+    alone and sets :data:`FLAG_CRC_LIGHT` — the length prefix and
+    header stay guarded, the MiB-scale row bytes skip the CRC pass."""
+    bufs = [_as_buf(p) for p in parts]
+    total = sum(len(b) for b in bufs)
+    if total > MAX_PAYLOAD:
+        raise FrameTooLargeError(
+            f"payload {total} bytes exceeds cap {MAX_PAYLOAD}")
+    if crc_light:
+        flags |= FLAG_CRC_LIGHT
+    head = _HEADER.pack(MAGIC, version, op, flags, req_id, total)
+    crc = zlib.crc32(head)
+    if not (flags & FLAG_CRC_LIGHT):
+        for b in bufs:
+            crc = zlib.crc32(b, crc)
+    return [head, *bufs, _CRC.pack(crc & 0xFFFFFFFF)]
+
+
 def _read_exact(rfile, n: int, what: str) -> bytes:
     """Read exactly ``n`` bytes or reject the frame as torn, naming the
     layer that came up short (the truncation tests assert the reason)."""
@@ -171,14 +241,21 @@ def _read_exact(rfile, n: int, what: str) -> bytes:
     return buf
 
 
-def read_frame(rfile, *, allowed_versions=SUPPORTED_VERSIONS):
+def read_frame(rfile, *, allowed_versions=SUPPORTED_VERSIONS,
+               allow_crc_light: bool = False):
     """Read one complete frame from a buffered binary stream.
 
     Returns None on clean EOF AT a frame boundary (zero bytes read);
     any partial frame raises :class:`TornFrameError` with the
     truncated layer named, an unknown version raises
     :class:`ProtocolVersionError`, an oversized length prefix raises
-    :class:`FrameTooLargeError` — all BEFORE any payload is decoded."""
+    :class:`FrameTooLargeError` — all BEFORE any payload is decoded.
+
+    ``allow_crc_light`` accepts frames whose trailer CRCs the header
+    only (:data:`FLAG_CRC_LIGHT`) — legal ONLY on sessions that
+    negotiated :data:`CAP_CRC_LIGHT`; an unnegotiated crc-light frame
+    is rejected as torn (a peer must not be able to opt itself out of
+    integrity unilaterally)."""
     # Magic is validated from the first 4 bytes ALONE, before waiting
     # for the rest of the header: a non-wire peer (e.g. a retired
     # legacy line-JSON client) may send fewer bytes than a full header
@@ -214,7 +291,14 @@ def read_frame(rfile, *, allowed_versions=SUPPORTED_VERSIONS):
             f"frame announces {length} payload bytes, cap {MAX_PAYLOAD}")
     payload = _read_exact(rfile, length, "payload") if length else b""
     (crc,) = _CRC.unpack(_read_exact(rfile, _CRC.size, "crc trailer"))
-    want = zlib.crc32(payload, zlib.crc32(first)) & 0xFFFFFFFF
+    if flags & FLAG_CRC_LIGHT:
+        if not allow_crc_light:
+            raise TornFrameError(
+                "torn frame: crc-light flag on a session that did not "
+                "negotiate it")
+        want = zlib.crc32(first) & 0xFFFFFFFF
+    else:
+        want = zlib.crc32(payload, zlib.crc32(first)) & 0xFFFFFFFF
     if crc != want:
         raise TornFrameError(
             f"torn frame: crc mismatch (got {crc:#010x}, "
@@ -222,27 +306,135 @@ def read_frame(rfile, *, allowed_versions=SUPPORTED_VERSIONS):
     return Frame(op, req_id, payload, version, flags)
 
 
-def decode_frame(data: bytes):
+def decode_frame(data: bytes, *, allow_crc_light: bool = False):
     """Decode one frame from a complete byte string (tests and tools).
     Truncated input rejects exactly like a torn stream read."""
-    fr = read_frame(io.BytesIO(data))
+    fr = read_frame(io.BytesIO(data), allow_crc_light=allow_crc_light)
     if fr is None:
         raise TornFrameError("torn frame: empty input")
     return fr
 
 
 # ---------------------------------------------------------------------------
+# Binary (zero-copy) response payloads — FLAG_BIN.
+#
+# ``payload := meta_len(u32) || meta_json || seg_0 || seg_1 || ...``
+# where ``meta = {"resp": <response dict with {"__seg__": i}
+# placeholders>, "segs": [{"dtype", "shape", "nbytes"}, ...]}``. The
+# server packs each segment as a memoryview over the fancy-index gather
+# output (O(batch) rows, already a fresh buffer — the mmap'd table
+# itself is never materialized); the client reconstructs ndarrays with
+# ``np.frombuffer`` over payload slices. numpy stays a LAZY import on
+# the client side: the stdlib-only import contract holds, and only
+# sessions that negotiated CAP_BIN ever decode these.
+# ---------------------------------------------------------------------------
+
+_U32 = struct.Struct("!I")
+
+
+def pack_bin_payload(resp: dict, segs) -> list:
+    """Build the parts list for a FLAG_BIN payload: ``resp`` is the
+    response dict with ``{"__seg__": i}`` placeholders, ``segs`` the
+    matching buffers (ndarrays/memoryviews, C-contiguous). Returns
+    buffers ready for :func:`encode_frame_parts` — segment bytes are
+    referenced, not copied."""
+    bufs = [_as_buf(s) for s in segs]
+    descs = []
+    for s, b in zip(segs, bufs):
+        dt = getattr(s, "dtype", None)
+        descs.append({
+            "dtype": "B" if dt is None else str(getattr(dt, "str", dt)),
+            "shape": list(getattr(s, "shape", (len(b),))),
+            "nbytes": len(b)})
+    meta = {"resp": resp, "segs": descs}
+    mb = _dumps(meta)
+    return [_U32.pack(len(mb)), mb, *bufs]
+
+
+def split_bin_payload(payload) -> tuple[dict, list]:
+    """Inverse of :func:`pack_bin_payload` framing: returns
+    ``(meta, [seg memoryviews])`` — slices of the received payload,
+    no copies."""
+    mv = memoryview(payload)
+    if len(mv) < _U32.size:
+        raise TornFrameError("torn frame: bin payload shorter than its "
+                             "meta length prefix")
+    (mlen,) = _U32.unpack(mv[:_U32.size])
+    end = _U32.size + mlen
+    if end > len(mv):
+        raise TornFrameError("torn frame: bin meta block truncated")
+    meta = json.loads(bytes(mv[_U32.size:end]))
+    segs, off = [], end
+    for d in meta.get("segs", ()):
+        n = int(d["nbytes"])
+        if off + n > len(mv):
+            raise TornFrameError("torn frame: bin segment truncated")
+        segs.append(mv[off:off + n])
+        off += n
+    return meta, segs
+
+
+def _resolve_segs(node, arrays):
+    if isinstance(node, dict):
+        if set(node) == {"__seg__"}:
+            return arrays[int(node["__seg__"])]
+        return {k: _resolve_segs(v, arrays) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_resolve_segs(v, arrays) for v in node]
+    return node
+
+
+def decode_bin_response(payload) -> dict:
+    """Decode a FLAG_BIN payload into the response dict, segment
+    placeholders resolved to ndarrays (``np.frombuffer`` over payload
+    slices — the copy happens only if the caller writes)."""
+    import numpy as np  # lazy: only CAP_BIN sessions pay the import
+
+    meta, segs = split_bin_payload(payload)
+    arrays = [np.frombuffer(seg, dtype=np.dtype(d["dtype"])).reshape(
+        d["shape"]) for seg, d in zip(segs, meta.get("segs", ()))]
+    return _resolve_segs(meta["resp"], arrays)
+
+
+# ---------------------------------------------------------------------------
 # Seam-aware socket I/O (shared by client and server).
 # ---------------------------------------------------------------------------
 
-def send_frame(sock, data: bytes, peer_class: str,
+def _sendall_parts(sock, parts) -> None:
+    """Scatter-gather sendall: one ``sendmsg`` (kernel writev) per
+    <=512-buffer slice with partial-send continuation — the frame's
+    header, row segments, and CRC trailer leave the process without
+    ever being joined into one contiguous copy."""
+    bufs = [b if isinstance(b, memoryview) else memoryview(b)
+            for b in parts]
+    i = 0
+    while i < len(bufs):
+        sent = sock.sendmsg(bufs[i:i + 512])  # IOV_MAX headroom
+        while i < len(bufs) and sent >= len(bufs[i]):
+            sent -= len(bufs[i])
+            i += 1
+        if sent:
+            bufs[i] = bufs[i][sent:]
+
+
+def send_frame(sock, data, peer_class: str,
                sleep=time.sleep) -> None:
     """Send one encoded frame through the :func:`net_fault_check` seam.
+    ``data`` is either one contiguous frame (:func:`encode_frame`) or a
+    parts LIST (:func:`encode_frame_parts` — scatter-gather, zero-copy).
     Honors the injector's directives: ``("cut", n)`` transmits only the
     first ``n`` bytes and kills the connection (the torn-frame
     producer); ``("trickle", chunk, delay_s)`` drips the frame out
     ``chunk`` bytes at a time (the slow peer)."""
     directive = net_fault_check("send", peer_class)
+    if isinstance(data, (list, tuple)):
+        if directive is None:
+            _sendall_parts(sock, data)
+            return
+        # Fault path only (never the hot path): directives address byte
+        # offsets, so flatten the parts to apply cut/trickle exactly.
+        data = b"".join(bytes(p) if not isinstance(p, (bytes, bytearray))
+                        else p for p in data)
     if directive is None:
         sock.sendall(data)
         return
@@ -266,11 +458,13 @@ def send_frame(sock, data: bytes, peer_class: str,
 
 
 def recv_frame(rfile, peer_class: str, *,
-               allowed_versions=SUPPORTED_VERSIONS):
+               allowed_versions=SUPPORTED_VERSIONS,
+               allow_crc_light: bool = False):
     """Read one frame through the seam (``recv`` faults: partition
     timeouts, delays) then :func:`read_frame`."""
     net_fault_check("recv", peer_class)
-    return read_frame(rfile, allowed_versions=allowed_versions)
+    return read_frame(rfile, allowed_versions=allowed_versions,
+                      allow_crc_light=allow_crc_light)
 
 
 def _emit_metric(recorder, kind: str, name: str, value,
@@ -307,7 +501,7 @@ class WireClient:
     def __init__(self, host: str, port: int, *, timeout: float = 10.0,
                  deadline_s: float = 10.0, policy=None,
                  peer_class: str = "serve", session: str | None = None,
-                 recorder=None):
+                 recorder=None, caps=DEFAULT_CLIENT_CAPS):
         self.host, self.port = host, int(port)
         self._timeout = float(timeout)
         self._deadline_s = float(deadline_s)
@@ -317,6 +511,12 @@ class WireClient:
         self.session = session or binascii.hexlify(
             os.urandom(8)).decode("ascii")
         self.version: int | None = None
+        # Capabilities OFFERED in HELLO; ``self.caps`` holds what the
+        # server granted (intersection) after the handshake. A server
+        # predating capabilities replies without a "caps" key → empty
+        # set → the exact PR-16 behavior.
+        self._offered_caps = tuple(caps)
+        self.caps: set = set()
         self._req_seq = 0
         self._sock = None
         self._rfile = None
@@ -340,7 +540,8 @@ class WireClient:
         self._rfile = self._sock.makefile("rb")
         try:
             hello = {"versions": list(SUPPORTED_VERSIONS),
-                     "session": self.session}
+                     "session": self.session,
+                     "caps": list(self._offered_caps)}
             send_frame(self._sock, encode_frame(OP_HELLO, 0,
                                                 _dumps(hello)),
                        self._peer_class)
@@ -359,7 +560,9 @@ class WireClient:
             self._drop()
             raise TornFrameError(
                 f"torn frame: expected HELLO_OK, got op {fr.op}")
-        self.version = int(fr.json().get("version", PROTO_VERSION))
+        ok = fr.json()
+        self.version = int(ok.get("version", PROTO_VERSION))
+        self.caps = set(ok.get("caps", ())) & set(self._offered_caps)
         if self._connected_once:
             self.reconnects += 1
             _emit_metric(self._recorder, "inc", "net.reconnects", 1)
@@ -450,7 +653,8 @@ class WireClient:
                                             _dumps(envelope)),
                    self._peer_class)
         while True:
-            fr = recv_frame(self._rfile, self._peer_class)
+            fr = recv_frame(self._rfile, self._peer_class,
+                            allow_crc_light=CAP_CRC_LIGHT in self.caps)
             if fr is None:
                 raise ConnectionError("server closed the connection")
             if fr.op == OP_BUSY:
@@ -472,10 +676,40 @@ class WireClient:
                 raise TornFrameError(
                     f"torn frame: response id {fr.req_id} from the "
                     f"future (sent {req_id})")
-            resp = fr.json()
+            resp = (decode_bin_response(fr.payload)
+                    if fr.flags & FLAG_BIN else fr.json())
             if (not resp.get("ok") and resp.get("deadline_exceeded")
                     and resp.get("retryable")):
                 # The server gave up on our stale deadline; retry with
                 # what is left of OUR budget.
                 raise ServerBusyError("server-side deadline exceeded")
             return resp
+
+    def multi(self, reqs, *, deadline_s: float | None = None) -> list:
+        """Batched lookups: ONE frame carries every request in ``reqs``
+        (pull/score/topk dicts, same shapes as :meth:`request`), one
+        frame comes back with per-request results — the per-request
+        framing/syscall/CRC overhead is amortized across the batch, and
+        the server merges the whole frame into one fancy-index gather
+        per table. Returns the per-request response list (each entry an
+        ``{"ok": ...}`` dict; item failures ride inside their entry and
+        never fail siblings).
+
+        Against a server that did not grant :data:`CAP_MULTI` (an old
+        peer), falls back to sequential single requests — same results,
+        PR-16 throughput."""
+        reqs = list(reqs)
+        if CAP_MULTI in self.caps:
+            resp = self.request({"op": "multi", "reqs": reqs},
+                                deadline_s=deadline_s)
+            if not resp.get("ok"):
+                raise WireError(
+                    f"multi rejected: {resp.get('error')}")
+            results = resp.get("results")
+            if not isinstance(results, list) or len(results) != len(reqs):
+                raise TornFrameError(
+                    f"torn frame: multi returned "
+                    f"{None if results is None else len(results)} "
+                    f"results for {len(reqs)} requests")
+            return results
+        return [self.request(r, deadline_s=deadline_s) for r in reqs]

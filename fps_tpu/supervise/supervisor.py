@@ -587,6 +587,13 @@ class RunSupervisor:
                 self.state.get("heartbeat_rejected", 0)),
             "last_index": attempts[-1].get("last_index") if attempts else None,
             "wall_s": round(time.monotonic() - t0, 3),
+            # Restart-to-first-signal seconds per recovery (the chaos
+            # digest's restart-cost evidence: with a persistent
+            # JAX compilation cache the retrace disappears from this
+            # number; without one every restart pays it again).
+            "restart_to_first_signal_s": [
+                round(v, 3)
+                for v in recovery_times(self.journal_path)],
             "state_path": self.state_path,
             "journal_path": self.journal_path,
         }

@@ -2605,6 +2605,347 @@ def run_net_partition_reader_scenario(tmpdir: str, *,
     return ok, detail
 
 
+# ---------------------------------------------------------------------------
+# Batched read-plane scenarios (ISSUE 19): the multi-lookup wire op and
+# the autoscaled serving fleet under the same hostile-network /
+# reader-churn treatment as everything above. Deterministic explicit
+# multi batches (never the racing coalescer) so bit-identity assertions
+# stay exact.
+# ---------------------------------------------------------------------------
+
+def run_serve_batch_storm_scenario(tmpdir: str, *, timeout: float = 600):
+    """Batched multi frames under a wire-fault storm (the tentpole's
+    coalesced read path meets PR-16's hostile network): the 60-request
+    harness sequence rides in 5 ``multi`` frames whose sends are cut on
+    BOTH directions. The contract:
+
+    * a torn multi frame is NEVER partially applied — the server
+      executes exactly ``len(reqs)`` sub-requests across the whole
+      storm (resent frames dedupe through the replay cache as ONE
+      unit, ``dedup_replays`` the positive witness);
+    * batched responses are bit-identical to the fault-free batched
+      run, which is itself bit-identical to the fault-free UNBATCHED
+      run (batching changes framing, never answers) — and the
+      zero-copy binary encoding returns the same numbers as JSON;
+    * an admission-wedged server sheds the whole batch with a
+      retryable BUSY inside the deadline budget, and the identical
+      batch succeeds bit-identically once capacity returns;
+    * the fault schedule replays deterministically.
+    """
+    import time as _time
+
+    import numpy as np
+
+    from fps_tpu.serve import ServerBusyError, TcpServe, WireClient
+    from fps_tpu.serve.wire import CAP_BIN, CAP_MULTI
+    from fps_tpu.testing import faultnet
+    from fps_tpu.testing.faultnet import NetFaultRule
+
+    make_server, reqs = _wire_harness()
+    batches = [reqs[i:i + 12] for i in range(0, len(reqs), 12)]
+
+    # Clean references: solo, batched-JSON, batched-binary — all three
+    # must agree bitwise before any fault is injected.
+    with TcpServe(make_server()) as tcp:
+        with WireClient(tcp.host, tcp.port) as wc:
+            want_solo = [wc.request(r) for r in reqs]
+            want = [wc.multi(b) for b in batches]
+        with WireClient(tcp.host, tcp.port,
+                        caps=(CAP_MULTI, CAP_BIN)) as wb:
+            bin_granted = CAP_BIN in wb.caps
+            got_bin = [wb.multi(b) for b in batches]
+        clean_stats = tcp.wire_stats()
+    flat = [r for batch in want for r in batch]
+    flat_bin = [r for batch in got_bin for r in batch]
+    bin_matches_json = bin_granted and all(
+        b["ok"] and b["step"] == j["step"]
+        and np.array_equal(np.asarray(j["values"], np.float32),
+                           np.asarray(b["values"]))
+        for j, b in zip(flat, flat_bin))
+
+    rules = [
+        # Cut the client's multi sends (start=2 spares the ctor HELLO):
+        # every torn frame must be rejected whole, resent whole, and
+        # applied once.
+        NetFaultRule("client", "send", "cut", cut_bytes=9, start=2,
+                     count=None, every=3),
+        # And cut the server's response sends inside an early window:
+        # the executed batch's response dies on the wire, the resend is
+        # answered from the replay cache as one unit.
+        NetFaultRule("serve", "send", "cut", cut_bytes=4, start=3,
+                     count=8, every=4),
+    ]
+
+    def faulted_run():
+        net = faultnet.install(rules, seed=0)
+        try:
+            server = make_server()
+            with TcpServe(server) as tcp:
+                wc = WireClient(tcp.host, tcp.port,
+                                peer_class="client")
+                got = [wc.multi(b) for b in batches]
+                wc.close()
+                return (got, net.trail(),
+                        {"retries": wc.retries,
+                         "reconnects": wc.reconnects},
+                        tcp.wire_stats(), server.requests)
+        finally:
+            faultnet.uninstall()
+
+    got1, trail1, client1, stats1, executed1 = faulted_run()
+    got2, trail2, _c2, stats2, executed2 = faulted_run()
+    client_cuts = len([1 for (cls, _op), v in
+                       _fired_by_stream(trail1).items()
+                       if cls == "client" for _ in v])
+
+    # BUSY leg on a clean network: wedge the admission budget, the
+    # whole batch sheds retryably inside its deadline; release, and the
+    # identical batch answers bit-identically.
+    with TcpServe(make_server()) as tcp:
+        with WireClient(tcp.host, tcp.port) as wc:
+            assert tcp.admission.try_admit(tcp.admission.max_cost)
+            shed_error = None
+            t0 = _time.monotonic()
+            try:
+                wc.multi(batches[0], deadline_s=0.4)
+            except ServerBusyError as e:
+                shed_error = repr(e)
+            shed_elapsed = _time.monotonic() - t0
+            tcp.admission.release(tcp.admission.max_cost)
+            after_release = wc.multi(batches[0])
+            shed_stats = tcp.wire_stats()
+
+    detail = {
+        "requests": len(reqs),
+        "batches": len(batches),
+        "injected": {f"{cls}/{op}": len(v)
+                     for (cls, op), v in
+                     _fired_by_stream(trail1).items()},
+        "client": client1,
+        "server_torn_frames": stats1["torn_frames"],
+        "multi_frames": stats1["multi_frames"],
+        "dedup_replays": stats1["dedup_replays"],
+        "executed_subrequests": executed1,
+        "clean_multi_frames": clean_stats["multi_frames"],
+        "bin_responses_clean": clean_stats["bin_responses"],
+        "batched_equals_unbatched": bool(flat == want_solo),
+        "bin_matches_json": bool(bin_matches_json),
+        "responses_bit_identical": bool(got1 == want),
+        "replay_deterministic": bool(
+            _fired_by_stream(trail1) == _fired_by_stream(trail2)
+            and got1 == got2 and executed1 == executed2),
+        "shed_error": shed_error,
+        "shed_elapsed_s": round(shed_elapsed, 3),
+        "shed_requests": shed_stats["shed_requests"],
+        "after_release_bit_identical": bool(after_release == want[0]),
+    }
+    ok = (detail["batched_equals_unbatched"]
+          and detail["bin_matches_json"]
+          and detail["responses_bit_identical"]
+          and detail["replay_deterministic"]
+          and client_cuts >= 3
+          and stats1["torn_frames"] >= 1
+          # THE invariant: a torn multi frame is never partially
+          # applied and a resent one never double-applied.
+          and executed1 == len(reqs)
+          and stats1["dedup_replays"] >= 1
+          and stats1["multi_frames"] >= len(batches)
+          and clean_stats["bin_responses"] >= 1
+          and shed_error is not None
+          and shed_stats["shed_requests"] >= 1
+          and shed_elapsed < 5.0
+          and detail["after_release_bit_identical"])
+    return ok, detail
+
+
+def run_autoscale_reader_churn_scenario(tmpdir: str, *,
+                                        timeout: float = 600):
+    """The autoscaler survives reader churn with a monotone fence (the
+    tentpole's capacity leg): a 2-reader fleet over a real snapshot dir
+    scales to ``max_readers`` under latency burn, absorbs a publish
+    train, REPLACES an alive-but-silent wedged reader without ever
+    dipping below size, and scales back down to ``min_readers`` when
+    the burn ends. The contract:
+
+    * every scale decision is journaled with its evidence
+      (``decisions`` trail: scale_up, replace, scale_down all fire);
+    * the shared step fence NEVER regresses across the whole churn
+      (sampled continuously) and lands on the last published step;
+    * the wedged reader's replacement catches up to the fence and
+      answers bit-identically to the published table — capacity
+      changes reframe the fleet, never the answers;
+    * the fleet never shrinks below ``min_readers`` and quorum follows
+      membership (majority of the current fleet).
+    """
+    import threading as _threading
+    import time as _time
+
+    import numpy as np
+
+    from fps_tpu.core import snapshot_format as fmt
+    from fps_tpu.serve import ReadAutoscaler, ServingFleet
+
+    rng = np.random.default_rng(7)
+    steps = 5
+    tables = [rng.normal(size=(64, 4)).astype(np.float32)
+              for _ in range(steps)]
+    d = os.path.join(tmpdir, "autoscale_churn")
+    os.makedirs(d, exist_ok=True)
+
+    def publish(step):
+        arrays = {"table::w": tables[step - 1],
+                  "meta::ls_format": np.array("exported")}
+        for k in list(arrays):
+            arrays["meta::crc::" + k] = np.uint32(
+                fmt.array_crc32(arrays[k]))
+        np.savez(fmt.snapshot_path(d, step), **arrays)
+
+    def wait_for(pred, budget):
+        dl = _time.monotonic() + min(timeout, budget)
+        while _time.monotonic() < dl:
+            if pred():
+                return True
+            _time.sleep(0.02)
+        return pred()
+
+    publish(1)
+    fleet = ServingFleet(d, 2)  # auto-quorum: majority of the fleet
+    scaler = ReadAutoscaler(
+        fleet, min_readers=2, max_readers=4,
+        latency_slo_s=1e-6,      # any real request burns the SLO
+        fence_lag_slo_steps=64.0, cooldown_s=0.0,
+        liveness_timeout_s=2.5)
+    ids = list(range(0, 64, 5))
+    fence_trail: list[tuple[int, int]] = []
+    stop_sampler = _threading.Event()
+
+    def sample_fence():
+        fence = fleet.readers[0].fence
+        while not stop_sampler.is_set():
+            f = fence.read()
+            if f is not None:
+                fence_trail.append(f)
+            _time.sleep(0.005)
+
+    sampler = _threading.Thread(target=sample_fence, daemon=True)
+    fleet.start(interval_s=0.02)
+    sampler.start()
+    try:
+        if not wait_for(lambda: all(
+                r.stats()["step"] == 1 for r in fleet.readers), 60.0):
+            return False, {"error": "initial fleet never converged",
+                           "stats": fleet.stats()}
+
+        # Latency burn: real pulls through every reader's server put a
+        # real p99 over the (microscopic) SLO; the fence is fresh, so
+        # the scaler must add capacity up to max_readers.
+        from fps_tpu.serve import NoSnapshotError
+        sizes = []
+        for _ in range(8):
+            for r in list(fleet.readers):
+                for _i in range(5):
+                    try:
+                        r.server.pull("w", ids)
+                    except NoSnapshotError:
+                        break  # still booting: no latency sample yet
+            decision = scaler.evaluate(newest_step=1)
+            sizes.append(decision["fleet_size"])
+            if decision["fleet_size"] >= 4:
+                break
+        scaled_up = len(fleet.readers) == 4 and fleet.quorum == 3
+
+        # Publish train: the grown fleet's fence must walk 2..5
+        # monotonically (the sampler is watching for any regression).
+        for step in range(2, steps + 1):
+            publish(step)
+        if not wait_for(lambda: all(
+                r.stats()["step"] == steps for r in fleet.readers),
+                60.0):
+            return False, {"error": "fleet never reached the last "
+                                    "publish", "stats": fleet.stats()}
+
+        # Wedge one reader alive-but-silent: its polling thread keeps
+        # cycling but the beacon freezes — the scaler must REPLACE it
+        # (join a fresh reader first, retire the ghost after).
+        victim = fleet.readers[1].reader_id
+        fleet.readers[1].poll = lambda: None  # instance-attr shadow
+        replaced = None
+
+        def try_replace():
+            nonlocal replaced
+            decision = scaler.evaluate(newest_step=steps)
+            if decision["action"] == "replace":
+                replaced = decision
+            return replaced is not None
+
+        if not wait_for(try_replace, 30.0):
+            return False, {"error": "wedged reader never replaced",
+                           "decisions": scaler.decisions[-3:]}
+        replacement = replaced["replaced"][0]["replacement"]
+        if not wait_for(lambda: all(
+                r.stats()["step"] == steps for r in fleet.readers),
+                60.0):
+            return False, {"error": "replacement never caught up",
+                           "stats": fleet.stats()}
+        # Membership right after the replace (scale-down below may
+        # legitimately retire the newest reader — the replacement).
+        post_replace_ids = [r.reader_id for r in fleet.readers]
+
+        # The burn ends: with the SLO now generous, the scaler retires
+        # readers one per pass down to min_readers, then holds.
+        scaler.latency_slo_s = 1e6
+        down_actions = []
+        for _ in range(4):
+            down_actions.append(scaler.evaluate(newest_step=steps))
+        final_actions = [dec["action"] for dec in down_actions]
+
+        # Bit-identity: every surviving reader answers the last
+        # published table exactly.
+        answers_exact = all(
+            np.array_equal(r.server.pull("w", ids)[1],
+                           tables[-1][np.asarray(ids)])
+            for r in fleet.readers)
+        final_size = len(fleet.readers)
+        final_quorum = fleet.quorum
+    finally:
+        stop_sampler.set()
+        sampler.join(timeout=5)
+        fleet.stop()
+
+    fence_steps = [s for _e, s in fence_trail]
+    fence_monotone = all(a <= b for a, b in
+                         zip(fence_steps, fence_steps[1:]))
+    actions = [dec["action"] for dec in scaler.decisions]
+    detail = {
+        "published_steps": steps,
+        "scale_up_sizes": sizes,
+        "scaled_to_max": scaled_up,
+        "replaced": replaced["replaced"] if replaced else None,
+        "replacement_in_fleet": replacement in post_replace_ids,
+        "victim_gone": victim not in post_replace_ids,
+        "down_actions": final_actions,
+        "final_size": final_size,
+        "final_quorum": final_quorum,
+        "fence_samples": len(fence_trail),
+        "fence_monotone": fence_monotone,
+        "fence_final_step": fence_steps[-1] if fence_steps else None,
+        "answers_bit_identical": bool(answers_exact),
+        "actions_seen": sorted(set(actions)),
+    }
+    ok = (scaled_up
+          and replaced is not None
+          and detail["replacement_in_fleet"]
+          and detail["victim_gone"]
+          and final_actions.count("scale_down") == 2
+          and final_size == 2 and final_quorum == 2
+          and final_actions[-1] == "hold"   # never below min_readers
+          and fence_monotone
+          and detail["fence_final_step"] == steps
+          and answers_exact
+          and {"scale_up", "replace", "scale_down"} <= set(actions))
+    return ok, detail
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="supervised tiny-logreg child (fps_tpu.supervise demo)")

@@ -389,6 +389,72 @@ def test_fps009_generic_paths_and_noqa_are_clean():
 
 
 # ---------------------------------------------------------------------------
+# FPS010 — whole-table materialization in the serve hot path.
+# ---------------------------------------------------------------------------
+
+SERVE_PATH = os.path.join("fps_tpu", "serve", "hot.py")
+
+
+def serve_rules(src, path=SERVE_PATH):
+    return [f.rule for f in lint_source(textwrap.dedent(src), path)]
+
+
+def test_fps010_flags_table_materialization_in_serve():
+    assert serve_rules('q = np.asarray(snap.table("items"))') == [
+        "FPS010"]
+    assert serve_rules('q = np.array(snap.tables["w"])') == ["FPS010"]
+    assert serve_rules('q = np.ascontiguousarray(view.base)') == [
+        "FPS010"]
+    assert serve_rules('q = snap.tables["w"].copy()') == ["FPS010"]
+
+
+def test_fps010_tracks_table_aliases():
+    src = """
+    t = snap.table(name)
+    u = t
+    dense = np.asarray(u)
+    """
+    assert serve_rules(src) == ["FPS010"]
+
+
+def test_fps010_gather_results_are_clean():
+    # A SUBSCRIPT of a table view is the request-bounded gather result —
+    # materializing it is the point, not the hazard.
+    src = """
+    t = snap.table(name)
+    rows = np.ascontiguousarray(t[ids])
+    """
+    assert serve_rules(src) == []
+
+
+def test_fps010_materialize_seam_and_array_dunder_are_exempt():
+    src = """
+    def materialize(table):
+        return np.asarray(snap.table(name))
+
+    class DeltaView:
+        def __array__(self, dtype=None):
+            return self.base.copy()
+    """
+    assert serve_rules(src) == []
+
+
+def test_fps010_outside_serve_and_noqa_are_clean():
+    assert rules_of('q = np.asarray(snap.table("items"))') == []
+    assert serve_rules(
+        'q = np.asarray(snap.table("i"))  # noqa: FPS010') == []
+
+
+def test_fps010_serve_package_is_clean():
+    """The tentpole's zero-copy guarantee as a standing gate: the whole
+    serve package answers off mapped pages — any new whole-table
+    materialization in the hot path fails here with file:line."""
+    findings = lint_paths([os.path.join(ROOT, "fps_tpu", "serve")],
+                          select={"FPS010"})
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
 # Machinery: noqa, syntax errors, file walking, the CI gate.
 # ---------------------------------------------------------------------------
 
@@ -427,7 +493,7 @@ def test_lint_paths_walks_and_selects(tmp_path):
 
 def test_rule_table_is_complete():
     assert set(RULES) == {"FPS001", "FPS002", "FPS003", "FPS004", "FPS005",
-                          "FPS006", "FPS007", "FPS008", "FPS009"}
+                          "FPS006", "FPS007", "FPS008", "FPS009", "FPS010"}
 
 
 def test_package_lints_clean():

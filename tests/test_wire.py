@@ -396,12 +396,15 @@ def test_replay_cache_is_byte_bounded_with_lru_eviction_order():
         # Re-putting a key replaces its bytes, never double-counts.
         put(("s", 1), b"A" * 10)
         assert tcp.replay_bytes() == 50
-        # An entry bigger than the whole budget cannot be cached at all
-        # (and flushes everything older on its way through).
+        # An entry bigger than the whole budget flushes everything
+        # older — but NEVER itself: the just-executed response is in
+        # flight (a reconnecting client may resend its req_id, and a
+        # replay miss means a duplicate execution), so the newest entry
+        # survives even when it alone exceeds the byte bound.
         put(("s", 4), b"x" * 101)
-        assert get(("s", 4)) is None
-        assert tcp.replay_bytes() == 0
-        assert tcp.wire_stats()["replay_evictions"] == 4
+        assert get(("s", 4)) == b"x" * 101
+        assert tcp.replay_bytes() == 101
+        assert tcp.wire_stats()["replay_evictions"] == 3
     finally:
         tcp.close()
 
@@ -434,8 +437,8 @@ def test_dedupe_on_reconnect_executes_once():
 def test_busy_shed_is_retryable_and_bounded():
     server, tcp = _tcp(max_inflight=1)
     try:
-        # Wedge the single admission slot: every request sheds.
-        assert tcp._inflight.acquire(timeout=1.0)
+        # Wedge the whole cost budget: every request sheds.
+        assert tcp.admission.try_admit(tcp.admission.max_cost)
         try:
             c = WireClient("127.0.0.1", tcp.port, peer_class="client",
                            deadline_s=0.3)
@@ -446,8 +449,8 @@ def test_busy_shed_is_retryable_and_bounded():
             assert c.reconnects == 0  # BUSY never drops the connection
             assert tcp.wire_stats()["shed_requests"] >= 1
         finally:
-            tcp._inflight.release()
-        # The slot freed: the SAME client recovers on its next request.
+            tcp.admission.release(tcp.admission.max_cost)
+        # The budget freed: the SAME client recovers on its next request.
         assert c.request({"op": "stats"})["ok"]
         c.close()
     finally:

@@ -413,6 +413,17 @@ def _harness_scenarios():
             "run_net_slow_peer_scenario"),
         "net_partition_reader": _subprocess_scenario(
             "run_net_partition_reader_scenario"),
+        # Batched read-plane scenarios (ISSUE 19: multi-lookup wire op
+        # + admission control + the fleet autoscaler): a torn multi
+        # frame is never partially applied (exactly-once across the
+        # storm, batched == unbatched == binary bit-identical, BUSY
+        # sheds whole batches retryably), and reader churn under the
+        # autoscaler — scale-up, wedged-reader replacement, scale-down
+        # — keeps the step fence monotone and the answers exact.
+        "serve_batch_storm": _subprocess_scenario(
+            "run_serve_batch_storm_scenario"),
+        "autoscale_reader_churn": _subprocess_scenario(
+            "run_autoscale_reader_churn_scenario"),
         # Multi-tenant blast-radius scenarios (fps_tpu.tenancy +
         # fps_tpu.testing.tenant_demo; docs/resilience.md "Multi-tenant
         # blast radius"): one tenant is faulted, and every NON-injected

@@ -143,6 +143,15 @@ def main(argv=None) -> int:
     pod.add_argument("--rejoin-delay-s", type=float, default=0.5,
                      help="cooldown before an evicted member reports "
                           "ready again")
+    ap.add_argument("--compilation-cache-dir", default=None,
+                    help="persistent JAX compilation-cache directory "
+                         "exported to every child attempt (and every "
+                         "pod member) as JAX_COMPILATION_CACHE_DIR: a "
+                         "restarted child reloads compiled programs "
+                         "from disk instead of retracing, so "
+                         "restart-to-first-dispatch (the digest's "
+                         "restart_to_first_signal_s) stops paying the "
+                         "compile on every recovery")
     ap.add_argument("--pretty", action="store_true",
                     help="indent the digest JSON")
     # Split at the first literal "--" BEFORE parsing: parse_known_args
@@ -161,6 +170,12 @@ def main(argv=None) -> int:
         ap.error("--pod-dir and --pod-host must be given together")
     if not args.pod_dir and not args.state_dir:
         ap.error("--state-dir is required outside pod mode")
+
+    extra_env = {}
+    if args.compilation_cache_dir:
+        cache_dir = os.path.abspath(args.compilation_cache_dir)
+        os.makedirs(cache_dir, exist_ok=True)
+        extra_env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
 
     sup_mod = _load_supervisor_module()
     config = sup_mod.SupervisorConfig(
@@ -190,13 +205,13 @@ def main(argv=None) -> int:
         )
         member = pod_mod.PodMember(
             cmd, pod_dir=args.pod_dir, host=args.pod_host,
-            config=pod_config, watch=tuple(args.watch),
+            config=pod_config, watch=tuple(args.watch), env=extra_env,
         )
         digest = member.run()
     else:
         supervisor = sup_mod.RunSupervisor(
             cmd, state_dir=args.state_dir, config=config,
-            watch=tuple(args.watch),
+            watch=tuple(args.watch), env=extra_env,
         )
         digest = supervisor.run()
     print(json.dumps(digest, indent=2 if args.pretty else None), flush=True)
