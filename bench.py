@@ -1744,14 +1744,18 @@ def run_megastep_ab(args):
            "hot_sync_every": E_SYNC, "epochs": EPOCHS,
            "mesh": dict(mesh.shape)}
     finals = {}
-    for label in ("per_chunk", "megastep"):
+    # Third arm (ISSUE 20): chunks_per_dispatch="auto" — the calibrated
+    # K must land in the explicit arm's dispatch-amortized regime
+    # (host_serial_share <= explicit K's) while staying bit-identical.
+    for label in ("per_chunk", "megastep", "auto"):
         trainer, store, plan = make_trainer()
 
         def go(t, ls, key, epochs, _tr=trainer, _p=plan, _label=label):
             if _label == "per_chunk":
                 return _tr.run_indexed(t, ls, _p, key, epochs=epochs)
-            return _tr.run_megastep(t, ls, _p, key, epochs=epochs,
-                                    chunks_per_dispatch=K)
+            return _tr.run_megastep(
+                t, ls, _p, key, epochs=epochs,
+                chunks_per_dispatch=K if _label == "megastep" else "auto")
 
         # Warm-up pass (compile) on throwaway state, then the timed run
         # on fresh state with a fresh aggregates-only recorder.
@@ -1767,17 +1771,24 @@ def run_megastep_ab(args):
         phases = {ph: round(v["s"], 4)
                   for ph, v in sorted(rec.phase_totals().items())}
         serial = sum(phases.get(ph, 0.0) for ph in HOST_SERIAL_PHASES)
+        arm_k = K
+        if label == "auto":
+            arm_k = max(int(rec.snapshot()["gauges"]["megastep.auto_k"]),
+                        1)
         arm = {
             "examples_per_sec": round(n_ex / wall, 1),
             "wall_s": round(wall, 4),
             "host_serial_s": round(serial, 4),
             "host_serial_share": (round(serial / wall, 4) if wall
                                   else None),
-            "dispatches": int(plan.calls_per_epoch(SPC) * EPOCHS
-                              if label == "per_chunk" else
-                              -(-plan.calls_per_epoch(SPC) // K) * EPOCHS),
+            "dispatches": int(
+                plan.calls_per_epoch(SPC) * EPOCHS
+                if label == "per_chunk" else
+                -(-plan.calls_per_epoch(SPC) // arm_k) * EPOCHS),
             "phases": phases,
         }
+        if label == "auto":
+            arm["chosen_k"] = arm_k
         if label == "megastep":
             arm["vote_compact_windows"] = int(
                 rec.counter_value("cold_route.vote_compact_windows"))
@@ -1799,8 +1810,17 @@ def run_megastep_ab(args):
         out[label] = arm
 
     out["numerics_bit_identical"] = all(
-        np.array_equal(finals["per_chunk"][k], finals["megastep"][k])
+        np.array_equal(finals["per_chunk"][k], finals[other][k])
+        for other in ("megastep", "auto")
         for k in finals["per_chunk"])
+    # ISSUE 20 acceptance: the calibrated K buys at least the explicit
+    # K's dispatch amortization (shares are noisy at the 4th decimal —
+    # judge with a hair of slack).
+    out["auto_share_le_explicit"] = bool(
+        out["auto"]["host_serial_share"] is not None
+        and out["megastep"]["host_serial_share"] is not None
+        and out["auto"]["host_serial_share"]
+        <= out["megastep"]["host_serial_share"] + 0.005)
     # The O(traffic)-not-O(K) claim, measured on the lowered programs:
     # doubling K must leave the collective census byte-identical (the
     # per-step collectives live inside the scan body; boundary ticks
@@ -1826,9 +1846,13 @@ def run_megastep_ab(args):
         f"megastep A/B: examples/s "
         f"{out['per_chunk']['examples_per_sec']:.0f} -> "
         f"{out['megastep']['examples_per_sec']:.0f} "
-        f"({out['speedup']}x at K={K}), host_serial_share "
+        f"({out['speedup']}x at K={K}) -> "
+        f"{out['auto']['examples_per_sec']:.0f} "
+        f"(auto K={out['auto']['chosen_k']}), host_serial_share "
         f"{out['per_chunk']['host_serial_share']} -> "
-        f"{out['megastep']['host_serial_share']}, bit-identical "
+        f"{out['megastep']['host_serial_share']} -> "
+        f"{out['auto']['host_serial_share']} (auto<=explicit "
+        f"{out['auto_share_le_explicit']}), bit-identical "
         f"{out['numerics_bit_identical']}, census K-independent "
         f"{out['collective_bytes_k_independent']} (vote compact "
         f"{out['megastep']['vote_compact_windows']} / overflow "
@@ -2117,7 +2141,17 @@ def run_storage(args):
     loop), the publish-backlog drain curve (rise through the blackout,
     cliff to 0 at the first landed publish), retry/degraded counts, and
     the headline invariant: final weights AND the final recovered
-    snapshot's state are BIT-identical to the clean run's."""
+    snapshot's state are BIT-identical to the clean run's.
+
+    ISSUE 20 (the raw-speed pass): both arms run the overlapped
+    pipeline (``prefetch=2`` → boundary copies → ``save_deferred``) with
+    ``when_full="degrade"`` — the device→host capture, the serialize,
+    the fsync delays, AND the retry backoff all live on the writer
+    thread, and a save arriving while the writer is wedged is skipped
+    (recency spent, dispatch never stalled). The dump/capture second
+    totals land in each arm: dump (what the TRAINING thread paid) must
+    stay flat under brownout while capture absorbs the damage."""
+    import dataclasses
     import tempfile
     import threading
 
@@ -2164,6 +2198,7 @@ def run_storage(args):
     def run_arm(faulted: bool):
         cfg = LogRegConfig(num_features=NF, learning_rate=0.5)
         trainer, store = logistic_regression(mesh, cfg)
+        trainer.config = dataclasses.replace(trainer.config, prefetch=2)
         rec = obs.Recorder(sinks=[])
         trainer.recorder = rec
         # Checkpoint-layer telemetry (storage.retries, the backlog
@@ -2176,7 +2211,8 @@ def run_storage(args):
         curve = []  # (t_rel, backlog) drain-curve samples
         stop = threading.Event()
         with tempfile.TemporaryDirectory() as d:
-            ck = AsyncCheckpointer(d, keep=n_chunks + 2)
+            ck = AsyncCheckpointer(d, keep=n_chunks + 2,
+                                   when_full="degrade")
             t0 = time.perf_counter()
 
             def sample():
@@ -2213,9 +2249,19 @@ def run_storage(args):
             if b != last or len(keep) < 2:
                 keep.append([t, int(b)])
                 last = b
+        hists = rec.snapshot()["histograms"]
+        dump_h = hists.get("checkpoint.dump_seconds", {})
+        cap_h = hists.get("checkpoint.capture_seconds", {})
         arm = {
             "examples_per_sec": round(n_ex / wall, 1),
             "wall_s": round(wall, 4),
+            # The raw-speed split: dump = what each save cost the
+            # TRAINING thread (an enqueue, with deferred capture);
+            # capture = the device→host materialization the WRITER paid.
+            "dump_seconds_total": round(dump_h.get("sum", 0.0), 6),
+            "dump_count": int(dump_h.get("count", 0)),
+            "capture_seconds_total": round(cap_h.get("sum", 0.0), 6),
+            "capture_count": int(cap_h.get("count", 0)),
             "publishes_landed": ck.full_publishes + ck.delta_publishes,
             "degraded_publishes": ck.degraded_publishes,
             "retries": int(rec.counter_value("storage.retries",
@@ -2265,7 +2311,11 @@ def run_storage(args):
         f"{faulted_arm['backlog_max']} drained "
         f"{out['backlog_drained']}, bit-identical "
         f"{out['weights_bit_identical']} (snapshot "
-        f"{out['recovered_snapshot_bit_identical']})", file=sys.stderr)
+        f"{out['recovered_snapshot_bit_identical']}), dump_s "
+        f"{clean_arm['dump_seconds_total']:.3f} -> "
+        f"{faulted_arm['dump_seconds_total']:.3f} / capture_s "
+        f"{clean_arm['capture_seconds_total']:.3f} -> "
+        f"{faulted_arm['capture_seconds_total']:.3f}", file=sys.stderr)
     return {
         "metric": "storage_brownout_throughput_retention",
         "value": out["throughput_retention"],
@@ -2779,12 +2829,93 @@ def run_serve_scale(args):
     }
 
 
+def run_restart(args):
+    """The cost of the restart ITSELF (ISSUE 20): wedge a real training
+    child under ``tools/supervise.py`` twice — once with
+    ``--compilation-cache-dir`` (a persistent XLA cache every attempt
+    shares) and once without — and report the supervisor's
+    ``restart_to_first_signal_s`` for both: seconds from the supervisor
+    killing the wedged attempt to its replacement observably making
+    progress. One supervised run per arm is the honest A/B: the FIRST
+    attempt populates the cache, so the restarted attempt is the warm
+    reader. On CPU the recompile is cheap and the arms sit close; on a
+    real TPU recompilation dominates the restart, which is what the
+    cache-dir flag exists to kill."""
+    import os
+    import subprocess
+    import tempfile
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=root,
+               # Cache even sub-second CPU compiles so the with-cache
+               # arm exercises the real read path (no-op without a
+               # cache dir, so the cold arm is untouched).
+               JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+               JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES="-1")
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    demo = [sys.executable, "-m", "fps_tpu.testing.supervised_demo",
+            "--examples", "8000", "--epochs", "2"]
+
+    def one_arm(workdir, cache_dir):
+        sup_dir = os.path.join(workdir, "sup")
+        cmd = [sys.executable,
+               os.path.join(root, "tools", "supervise.py"),
+               "--state-dir", sup_dir, "--stall-timeout-s", "10",
+               "--startup-grace-s", "300", "--term-grace-s", "2",
+               "--backoff-base-s", "0.2", "--max-restarts", "2",
+               "--poll-s", "0.2"]
+        if cache_dir is not None:
+            cmd += ["--compilation-cache-dir", cache_dir]
+        cmd += ["--", *demo, "--ckpt-dir", sup_dir,
+                "--out", os.path.join(workdir, "out.npz"),
+                "--wedge-at", "3", "--wedge-mode", "sigstop"]
+        r = subprocess.run(cmd, env=env, cwd=root, capture_output=True,
+                           text=True, timeout=600)
+        try:
+            digest = json.loads(r.stdout.strip().splitlines()[-1])
+        except (json.JSONDecodeError, IndexError):
+            return {"success": False,
+                    "error": (r.stdout + r.stderr)[-500:]}
+        rts = [round(float(t), 3) for t in
+               digest.get("restart_to_first_signal_s") or []]
+        return {"success": bool(digest.get("success")),
+                "restarts": digest.get("restarts"),
+                "restart_to_first_signal_s": rts,
+                "worst_s": max(rts) if rts else None}
+
+    with tempfile.TemporaryDirectory() as d:
+        cache = os.path.join(d, "xla-cache")
+        cold = one_arm(os.path.join(d, "cold"), None)
+        warm = one_arm(os.path.join(d, "warm"), cache)
+        cache_entries = sum(len(fs) for _, _, fs in os.walk(cache))
+
+    cold_s, warm_s = cold.get("worst_s"), warm.get("worst_s")
+    speedup = (round(cold_s / warm_s, 3)
+               if cold_s and warm_s else None)
+    print(f"restart: restart_to_first_signal_s "
+          f"{cold_s} (no cache) -> {warm_s} "
+          f"(--compilation-cache-dir, {cache_entries} cache entries), "
+          f"ratio {speedup}", file=sys.stderr)
+    return {
+        "metric": "restart_to_first_signal_s",
+        "value": warm_s,
+        "unit": "s",
+        "vs_baseline": speedup,
+        "without_cache": cold,
+        "with_cache": warm,
+        "compilation_cache_entries": cache_entries,
+    }
+
+
 RUNNERS = {"mf": run_mf, "w2v": run_w2v, "logreg": run_logreg,
            "pa": run_pa, "ials": run_ials, "tiered": run_tiered,
            "tiered_drift": run_tiered_drift, "serve": run_serve,
            "megastep": run_megastep_ab, "delta": run_delta,
            "storage": run_storage, "wire": run_wire,
-           "serve_scale": run_serve_scale}
+           "serve_scale": run_serve_scale, "restart": run_restart}
 
 
 def compact_summary(results):
@@ -2847,7 +2978,7 @@ def main():
                     choices=["all", "mf", "w2v", "logreg", "pa", "ials",
                              "tiered", "tiered_drift", "serve",
                              "megastep", "delta", "storage", "wire",
-                             "serve_scale"])
+                             "serve_scale", "restart"])
     ap.add_argument("--scale", default="20m", choices=["100k", "1m", "20m"])
     ap.add_argument("--rank", type=int, default=10)
     ap.add_argument("--local-batch", type=int, default=32768)
@@ -2874,7 +3005,7 @@ def main():
         # Headline (mf) LAST among the per-workload lines.
         order = ["w2v", "logreg", "pa", "ials", "tiered", "tiered_drift",
                  "serve", "megastep", "delta", "storage", "wire",
-                 "serve_scale", "mf"]
+                 "serve_scale", "restart", "mf"]
     else:
         order = [args.workload]
     results = {}

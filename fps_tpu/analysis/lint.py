@@ -62,6 +62,15 @@ some cases shipped and fixed) before:
   does not flag). A hand-spelled ``"tenants"`` literal is one typo away
   from writing into a neighbor's namespace.
 
+* **FPS011 blocking-host-work-on-training-thread** — ``time.sleep`` /
+  ``os.fsync`` / ``jax.device_get`` / ``.block_until_ready`` in the
+  training-thread scope (``core/driver.py`` / ``core/megastep.py``).
+  The raw-speed contract: a save costs the training thread one enqueue
+  of on-device boundary copies, a degraded publish one counter bump —
+  capture, fsync, and retry backoff run on the checkpoint writer and
+  background retrier threads (the calibration window's forced syncs
+  live in ``core/autok.py``, outside the scope).
+
 Suppression: append ``# noqa: FPSNNN`` to the flagged line — but the
 tier-1 test runs this linter over ``fps_tpu/`` expecting zero findings,
 so in-tree fixes are the norm, suppressions the exception.
@@ -121,6 +130,11 @@ RULES = {
               ".copy()) of a snapshot table view in the serve hot path "
               "— answer off the mapped pages / DeltaView, or go "
               "through the sanctioned materialize() seam",
+    "FPS011": "blocking host work (time.sleep/os.fsync/jax.device_get/"
+              ".block_until_ready) in the training-thread scope of "
+              "core/driver.py or core/megastep.py — capture, fsync, "
+              "and retry backoff belong on the checkpoint writer / "
+              "background retrier threads",
 }
 
 # Calls whose presence makes a function (and everything lexically inside
@@ -189,6 +203,27 @@ _FPS010_MATERIALIZERS = {
 }
 _FPS010_ALLOW_FUNCS = {"__array__", "materialize"}
 _FPS010_DIRS = ("fps_tpu/serve/",)
+
+# FPS011: the raw-speed contract (docs/performance.md "The raw-speed
+# pass"): nothing on the training thread may sleep, fsync, or force a
+# device->host sync — a brownout's retry backoff or a snapshot capture
+# landing here is exactly the host-serial share the deferred-capture /
+# background-retrier seams exist to absorb. Scope is the two
+# training-loop files; the sanctioned seams (the AsyncCheckpointer
+# writer, the sidecar retrier, the auto-K calibration window in
+# core/autok.py) live OUTSIDE them, so any new blocking call here is a
+# regression, not a judgment call. Both dotted and `from x import y`
+# bare forms are flagged.
+_FPS011_BLOCKING_CALLS = {
+    "time.sleep", "sleep", "os.fsync", "fsync",
+    "jax.device_get", "device_get", "jax.block_until_ready",
+}
+_FPS011_PATHS = ("fps_tpu/core/driver.py", "fps_tpu/core/megastep.py")
+# Functions that ARE a sanctioned off-thread seam, should one ever move
+# into a scoped file (writer loops / background retriers run on their
+# own threads — blocking there is the point).
+_FPS011_ALLOW_FUNCS = {"_writer_loop", "_run_capture",
+                       "_sidecar_retry_loop"}
 
 _SYNC_PRIMITIVES = {
     "Lock", "RLock", "Condition", "Event", "Semaphore",
@@ -270,6 +305,12 @@ class _Linter(ast.NodeVisitor):
         # FPS010 scope: only the serve hot path carries the zero-copy
         # contract; training/tools code materializes freely.
         self.is_serve_hot = any(d in norm for d in _FPS010_DIRS)
+        # FPS011 scope: the training-thread files; depth of enclosing
+        # sanctioned off-thread seams (writer loop / background
+        # retrier defs).
+        self.is_training_hot = any(
+            norm.endswith(p) for p in _FPS011_PATHS)
+        self._fps011_allow = 0
         # Names assigned from table-view expressions (filled by
         # visit_Module's dataflow pre-pass).
         self._table_names: set[str] = set()
@@ -409,8 +450,28 @@ class _Linter(ast.NodeVisitor):
                 "mapped pages or go through "
                 "fps_tpu.serve.snapshot.materialize()")
 
+    def _check_fps011(self, node):
+        if not self.is_training_hot or self._fps011_allow:
+            return
+        name = _call_name(node)
+        if name in _FPS011_BLOCKING_CALLS:
+            self._add(
+                "FPS011", node,
+                f"{name}() on the training thread — sleeps, fsyncs, and "
+                "forced device->host syncs are host-serial share; move "
+                "them onto the checkpoint writer / background retrier "
+                "(or core/autok.py's calibration window)")
+        elif (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"):
+            self._add(
+                "FPS011", node,
+                ".block_until_ready() on the training thread — a forced "
+                "device->host sync serializes dispatch; adjudicate off "
+                "host copies or move the sync to a background seam")
+
     def visit_Call(self, node):
         self._check_fps010(node)
+        self._check_fps011(node)
         # FPS007: a host clock read under tracing is a constant, not a
         # measurement (the _trace_depth scope is FPS003's).
         if self._trace_depth and _call_name(node) in _HOST_CLOCKS:
@@ -547,7 +608,14 @@ class _Linter(ast.NodeVisitor):
         allow = node.name in _FPS010_ALLOW_FUNCS
         if allow:
             self._fps010_allow += 1
+        # FPS011 seam: writer-loop / background-retrier defs run on
+        # their own threads — blocking there is the point.
+        allow11 = node.name in _FPS011_ALLOW_FUNCS
+        if allow11:
+            self._fps011_allow += 1
         self.generic_visit(node)
+        if allow11:
+            self._fps011_allow -= 1
         if allow:
             self._fps010_allow -= 1
         if entered:

@@ -1,0 +1,152 @@
+"""Measured megastep K derivation (``chunks_per_dispatch="auto"``).
+
+The megastep amortizes per-dispatch host overhead (Python dispatch,
+arg placement, host sync) over K in-graph chunk segments: one dispatch
+of K chunks costs roughly ``h + K*c`` wall seconds, where ``h`` is the
+per-dispatch overhead and ``c`` the per-chunk device compute. The
+host-serial share of a dispatch is therefore modeled as
+
+    share(K) = h / (h + K*c)
+
+and the smallest K that drives it under a target share ``s`` is
+
+    K >= h * (1 - s) / (s * c)
+
+Instead of asking the operator to guess K (the old flag), a short
+calibration window measures ``h`` and ``c`` directly: time a dispatch
+of one cadence block and a dispatch of two cadence blocks (post-
+compile, median of a few samples) — the difference is the marginal
+block cost ``c``, the extrapolated zero-block intercept is ``h``.
+
+The chosen K is ALWAYS a multiple of the tick cadence
+(``tick.check_every``) so every in-graph tier tick lands on a static
+boundary, and the run it drives is bit-identical to passing the same
+K explicitly — calibration dispatches run on :func:`tree_copy`
+throwaways (the megastep program donates its inputs), so they never
+touch the real state, host RNG, or the trainer's store.
+
+Resume caveat: calibration is for FRESH runs. ``start_megastep`` is
+counted in megasteps of K chunks, so a resumed run must reuse the
+original run's chosen K (recorded as the ``megastep.auto_k`` gauge and
+returned here) — re-calibrating under different load could move the
+checkpoint boundaries.
+"""
+from __future__ import annotations
+
+import math
+import statistics
+import time
+
+import jax
+
+__all__ = [
+    "derive_chunks_per_dispatch",
+    "calibrate_chunks_per_dispatch",
+]
+
+#: Modeled host-serial share a chosen K must clear.
+DEFAULT_TARGET_SHARE = 0.05
+
+#: Upper bound on the chosen K: one compiled program of max_k segments
+#: is already >=95% amortized for any workload that needs it, and
+#: larger programs cost compile time and trace memory superlinearly.
+DEFAULT_MAX_K = 64
+
+#: Timed samples per calibration point (median taken; first untimed
+#: call pays the compile).
+DEFAULT_SAMPLES = 3
+
+
+def derive_chunks_per_dispatch(overhead_s: float, per_chunk_s: float, *,
+                               target_share: float = DEFAULT_TARGET_SHARE,
+                               max_k: int = DEFAULT_MAX_K,
+                               multiple_of: int = 1,
+                               n_calls: int | None = None) -> int:
+    """Smallest K with modeled host-serial share <= ``target_share``.
+
+    Pure — the measured-trace half of auto-K feeds this, and the
+    fixed-trace tests pin it. ``multiple_of`` (the tick cadence) is
+    always honored by rounding UP; ``max_k`` is rounded DOWN to the
+    cadence (never below one block). ``n_calls`` (chunk calls per
+    epoch) caps K at one epoch's work, rounded up to the cadence —
+    beyond that every extra segment is a trailing phantom.
+    """
+    if multiple_of < 1:
+        raise ValueError(f"multiple_of must be >= 1, got {multiple_of}")
+    if not (0.0 < target_share < 1.0):
+        raise ValueError(
+            f"target_share must be in (0, 1), got {target_share}")
+    if max_k < 1:
+        raise ValueError(f"max_k must be >= 1, got {max_k}")
+    cap = max((max_k // multiple_of) * multiple_of, multiple_of)
+    if n_calls is not None and n_calls >= 1:
+        cap = min(cap, -(-n_calls // multiple_of) * multiple_of)
+    h = max(float(overhead_s), 0.0)
+    c = float(per_chunk_s)
+    if h <= 0.0:
+        return multiple_of  # no measurable overhead: smallest legal K
+    if c <= 0.0:
+        return cap  # dispatch-bound: no K clears the share; take the cap
+    k_needed = math.ceil(h * (1.0 - target_share) / (target_share * c))
+    blocks = max(1, -(-k_needed // multiple_of))
+    return min(blocks * multiple_of, cap)
+
+
+def _measure_dispatch(trainer, fn, tables, local_state, iargs, ekey,
+                      tick_ops, samples: int,
+                      clock=time.perf_counter) -> float:
+    """Median wall seconds of ``samples`` post-compile dispatches of
+    ``fn`` on throwaway copies (the program donates its inputs).
+    Module-level so the fixed-trace tests can replace the measurement
+    while exercising the real derivation and dispatch plumbing."""
+    from fps_tpu.core.resilience import tree_copy
+
+    def once():
+        out = fn(tree_copy(tables), tree_copy(local_state), iargs,
+                 jax.numpy.int32(0), ekey, tick_ops)
+        jax.block_until_ready(out)
+
+    once()  # compile + first-touch, untimed
+    walls = []
+    for _ in range(max(1, samples)):
+        t0 = clock()
+        once()
+        walls.append(clock() - t0)
+    return statistics.median(walls)
+
+
+def calibrate_chunks_per_dispatch(trainer, tables, local_state, plan,
+                                  key, *, mode: str, tick=None,
+                                  n_calls: int | None = None,
+                                  target_share: float =
+                                  DEFAULT_TARGET_SHARE,
+                                  max_k: int = DEFAULT_MAX_K,
+                                  samples: int = DEFAULT_SAMPLES):
+    """Measure ``(h, c)`` and derive K. Returns
+    ``(K, overhead_s, per_chunk_s)``.
+
+    Times one-cadence-block and two-cadence-block megastep programs on
+    epoch-0 args: ``wall(B blocks) = h + B*block*c`` gives
+    ``c = (wall2 - wall1) / block`` and ``h = 2*wall1 - wall2``.
+    Negative noise is clamped (h >= 0; c >= a tiny positive floor so a
+    noisy fast machine degrades to the max-K cap, never a crash).
+    """
+    from fps_tpu.parallel.mesh import key_to_replicated
+
+    block = int(tick.check_every) if tick is not None else 1
+    iargs = plan.epoch_args(0)
+    ekey = key_to_replicated(jax.random.fold_in(key, 0), trainer.mesh)
+    tick_ops = tick.tick_ops(trainer) if tick is not None else {}
+    walls = []
+    for blocks in (1, 2):
+        fn = trainer._get_megastep_fn(plan, mode, blocks * block, tick)
+        walls.append(_measure_dispatch(trainer, fn, tables, local_state,
+                                       iargs, ekey, tick_ops, samples))
+    wall1, wall2 = walls
+    per_chunk_s = max((wall2 - wall1) / block, 1e-9)
+    overhead_s = max(2.0 * wall1 - wall2, 0.0)
+    k = derive_chunks_per_dispatch(overhead_s, per_chunk_s,
+                                   target_share=target_share,
+                                   max_k=max_k, multiple_of=block,
+                                   n_calls=n_calls)
+    return k, overhead_s, per_chunk_s

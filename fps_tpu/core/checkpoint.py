@@ -807,13 +807,26 @@ class Checkpointer:
                 return None
         return out
 
-    def _collect_timed(self, store, local_state, local_state_format):
-        """:meth:`_collect` plus the ``checkpoint.dump_seconds`` metric —
-        the device→host capture is the only part of a save the training
-        thread must pay even under the async writer, so it gets its own
-        series (the overlapped pipeline's win shows up here)."""
+    def _capture_timed(self, store, local_state, local_state_format):
+        """:meth:`_collect` plus the ``checkpoint.capture_seconds``
+        metric — the device→host capture cost wherever it runs (caller
+        thread here; the AsyncCheckpointer's deferred path runs it on
+        the writer thread, where it overlaps device compute instead of
+        stalling dispatch)."""
         t0 = time.perf_counter()
         arrays = self._collect(store, local_state, local_state_format)
+        _obs_metric("observe", "checkpoint.capture_seconds",
+                    time.perf_counter() - t0)
+        return arrays
+
+    def _collect_timed(self, store, local_state, local_state_format):
+        """:meth:`_capture_timed` plus the ``checkpoint.dump_seconds``
+        metric — what a save costs the TRAINING thread. On this inline
+        path the two series coincide (the caller pays the capture); a
+        deferred capture records dump_seconds around the enqueue only,
+        so the split attributes any residual stall."""
+        t0 = time.perf_counter()
+        arrays = self._capture_timed(store, local_state, local_state_format)
         _obs_metric("observe", "checkpoint.dump_seconds",
                     time.perf_counter() - t0)
         return arrays
@@ -1469,18 +1482,42 @@ class AsyncCheckpointer(Checkpointer):
       flushes first, so an in-process restore always sees the newest
       accepted save. :meth:`steps` itself does NOT flush — the writer's
       own retention GC runs on the writer thread and must not deadlock.
+    * **deferred capture** (:meth:`save_deferred`) — the device→host
+      dump itself can move onto the writer thread behind on-device
+      boundary copies: the training thread pays one enqueue
+      (``checkpoint.dump_seconds``), the writer pays the capture
+      (``checkpoint.capture_seconds``) overlapped with device compute.
+      Delta planning rides along (queue order = save order = chain
+      order), and a crash mid-capture publishes nothing — at most the
+      last boundary's save is lost, exactly the inline crash window
+      plus one boundary (docs/STALENESS.md).
+    * **non-blocking degraded enqueue** (``when_full="degrade"``) — a
+      save arriving while the slot is full (writer wedged in brownout
+      retries) is skipped as a degraded publish: backlog + staleness
+      SLO carry the cost, dispatch never stalls. Default stays
+      ``"block"`` (lossless back-pressure).
     """
 
     def __init__(self, directory: str, *, keep: int = 3,
                  fence_epoch: int | None = None,
                  delta: DeltaPolicy | None = None,
                  retry: _retry.RetryPolicy | None = None,
-                 degrade: bool = True):
+                 degrade: bool = True,
+                 when_full: str = "block"):
         super().__init__(directory, keep=keep, fence_epoch=fence_epoch,
                          delta=delta, retry=retry)
+        if when_full not in ("block", "degrade"):
+            raise ValueError(
+                f"when_full must be 'block' or 'degrade', got {when_full!r}")
         self._cv = threading.Condition()
-        # One queue slot: (step, base_step_or_None, payload_arrays).
-        self._queued: tuple[int, int | None, dict] | None = None
+        # One queue slot: ("host", step, base_step_or_None, payload) for a
+        # caller-captured save, or ("deferred", step, collect, touched)
+        # for a writer-side capture (save_deferred).
+        self._queued: tuple | None = None
+        # Deferred items enqueued but not yet chain-planned by the
+        # writer: an inline save() must not plan past them (chain order
+        # is save order).
+        self._unplanned = 0
         self._writing = False
         self._error: BaseException | None = None
         self._closed = False
@@ -1492,6 +1529,12 @@ class AsyncCheckpointer(Checkpointer):
         # Fatal errors (EACCES/EROFS, fence refusals, corruption) keep
         # the first-error retention contract and re-raise on the caller.
         self.degrade = bool(degrade)
+        # ``when_full="degrade"``: a save arriving while the queue slot
+        # is still full (the writer wedged in a brownout's retry
+        # backoff) is SKIPPED as a degraded publish instead of blocking
+        # the training thread — one enqueue attempt, nothing more. The
+        # default keeps the historical lossless back-pressure.
+        self.when_full = when_full
         self._degraded_chain = False
         self._writer = threading.Thread(
             target=self._writer_loop,
@@ -1504,10 +1547,16 @@ class AsyncCheckpointer(Checkpointer):
 
     def save(self, step: int, store: ParamStore, local_state: Pytree = None,
              *, local_state_format: str = "raw",
-             touched_rows: Mapping | None = None) -> str:
+             touched_rows: Mapping | None = None,
+             when_full: str | None = None) -> str:
         arrays = self._collect_timed(store, local_state, local_state_format)
         with self._cv:
             self._raise_pending_error()
+            # An inline save must not plan past a deferred item the
+            # writer hasn't planned yet — chain order is save order.
+            while self._unplanned and not self._closed:
+                self._cv.wait()
+                self._raise_pending_error()
             if self._degraded_chain:
                 # A degraded (skipped) publication may be the head the
                 # planner would diff against: force the next
@@ -1531,26 +1580,88 @@ class AsyncCheckpointer(Checkpointer):
         for k, v in payload.items():
             if isinstance(v, np.ndarray) and not v.flags["OWNDATA"]:
                 payload[k] = np.array(v, copy=True)
+        path = (self._path(step) if base is None
+                else snapshot_format.delta_path(self.dir, step, base))
+        if not self._enqueue(("host", int(step), base, payload),
+                             int(step), path, when_full):
+            # Skipped (degraded enqueue): the planned chain state
+            # described a publication that will never land.
+            with self._cv:
+                self._chain_reset()
+        return path
+
+    def save_deferred(self, step: int, collect, *,
+                      touched_rows: Mapping | None = None,
+                      when_full: str | None = None) -> str:
+        """Enqueue a save whose device→host capture runs on the WRITER
+        thread: ``collect()`` must return the host arrays dict a
+        :meth:`_collect` call would (the driver builds it over on-device
+        boundary copies, so the state it describes is frozen however
+        late the writer runs it). The training thread pays one enqueue —
+        capture, CRC, serialize, fsync, and any brownout's retry backoff
+        all happen behind it. Delta planning moves to the writer too
+        (the single serial consumer: queue order = save order = chain
+        order). Requires fully-addressable state — the multi-controller
+        dump's ``replicate_to_mesh`` is a collective and must stay on
+        the training thread (the caller gates on this).
+
+        Returns the nominal full-snapshot path; the writer may publish
+        a delta instead (the chain plan runs after capture)."""
+        t0 = time.perf_counter()
+        path = self._path(int(step))
+        self._enqueue(("deferred", int(step), collect, touched_rows),
+                      int(step), path, when_full)
+        _obs_metric("observe", "checkpoint.dump_seconds",
+                    time.perf_counter() - t0)
+        return path
+
+    def _enqueue(self, item, step: int, path: str,
+                 when_full: str | None) -> bool:
+        """Place one save in the queue slot. Returns True when enqueued;
+        False when the slot stayed full and ``when_full='degrade'``
+        turned the save into a SKIP (degraded-publish accounting — the
+        training thread never waits on a wedged writer)."""
+        mode = self.when_full if when_full is None else when_full
+        deferred = item[0] == "deferred"
         with self._cv:
             self._raise_pending_error()
-            while self._queued is not None and not self._closed:
-                self._cv.wait()
-                self._raise_pending_error()
-            if self._closed:
-                raise RuntimeError(
-                    f"AsyncCheckpointer for {self.dir} is closed")
-            self._queued = (int(step), base, payload)
-            path = (self._path(step) if base is None
-                    else snapshot_format.delta_path(self.dir, step, base))
-            # Emitted while still HOLDING the cv (the writer can't pop
-            # the slot until we release), so the journal's enqueued →
-            # saved ordering holds even for an instantaneous write. No
-            # lock cycle: the writer takes the recorder lock only from
-            # _write, never while waiting on this cv.
-            _obs_event("checkpoint_enqueued", step=int(step), path=path)
-            _obs_metric("inc", "checkpoint.enqueues", 1)
-            self._cv.notify_all()
-        return path
+            if (mode == "degrade" and self._queued is not None
+                    and not self._closed):
+                self.degraded_publishes += 1
+                self._publish_backlog += 1
+                self._degraded_chain = True
+                backlog = self._publish_backlog
+            else:
+                backlog = None
+                while self._queued is not None and not self._closed:
+                    self._cv.wait()
+                    self._raise_pending_error()
+                if self._closed:
+                    raise RuntimeError(
+                        f"AsyncCheckpointer for {self.dir} is closed")
+                self._queued = item
+                if deferred:
+                    self._unplanned += 1
+                # Emitted while still HOLDING the cv (the writer can't
+                # pop the slot until we release), so the journal's
+                # enqueued → saved ordering holds even for an
+                # instantaneous write. No lock cycle: the writer takes
+                # the recorder lock only from _write, never while
+                # waiting on this cv.
+                _obs_event("checkpoint_enqueued", step=step, path=path,
+                           **({"capture": "writer"} if deferred else {}))
+                _obs_metric("inc", "checkpoint.enqueues", 1)
+                self._cv.notify_all()
+        if backlog is not None:
+            _log.warning(
+                "checkpoint publish step %d DEGRADED (writer busy; "
+                "backlog %d)", step, backlog)
+            _obs_event("checkpoint_degraded", step=step, backlog=backlog,
+                       error="writer busy (queue slot full)")
+            _obs_metric("inc", "storage.degraded_publishes", 1)
+            _obs_metric("set", "checkpoint.publish_backlog", backlog)
+            return False
+        return True
 
     def flush(self) -> None:
         with self._cv:
@@ -1627,11 +1738,34 @@ class AsyncCheckpointer(Checkpointer):
                     self._cv.wait()
                 if self._queued is None:  # closed and drained
                     return
-                step, base, arrays = self._queued
+                item = self._queued
                 self._queued = None
                 self._writing = True
                 self._cv.notify_all()  # free the queue slot for save()
+            arrays = None
             try:
+                if item[0] == "deferred":
+                    _, step, collect, touched_rows = item
+                    try:
+                        t0 = time.perf_counter()
+                        arrays = _run_capture(collect)
+                        _obs_metric("observe", "checkpoint.capture_seconds",
+                                    time.perf_counter() - t0)
+                        with self._cv:
+                            if self._degraded_chain:
+                                self._chain_reset()
+                                self._degraded_chain = False
+                        step, base, arrays = self._plan_publication(
+                            step, arrays, touched_rows)
+                    finally:
+                        # Planned (or failed trying): an inline save()
+                        # waiting to plan may proceed. On failure the
+                        # chain resets below/with the surfaced error.
+                        with self._cv:
+                            self._unplanned -= 1
+                            self._cv.notify_all()
+                else:
+                    _, step, base, arrays = item
                 self._write(step, arrays, base=base)
                 if self._publish_backlog:
                     # Recovery: a landed publish is a FULL description
@@ -1676,7 +1810,9 @@ class AsyncCheckpointer(Checkpointer):
                                 "suppressing follow-on checkpoint write "
                                 "error (first failure pending): %r", e)
             finally:
-                del arrays  # drop the buffer before blocking on the cv
+                # Drop the buffers (and a deferred item's on-device
+                # boundary copies) before blocking on the cv.
+                del arrays, item
                 with self._cv:
                     self._writing = False
                     self._cv.notify_all()
@@ -1689,6 +1825,15 @@ def fence_epoch_from_env() -> int | None:
     from fps_tpu.supervise import child as _pod
 
     return _pod.pod_env()["epoch"]
+
+
+def _run_capture(collect):
+    """Writer-thread capture seam: runs a deferred save's ``collect()``
+    (the device→host dump over on-device boundary copies). Module-level
+    — like ``_atomic_savez`` — so the chaos harness can monkeypatch a
+    SIGKILL into the middle of a background capture and prove the
+    resume contract holds for the deferred delta chain too."""
+    return collect()
 
 
 # ---------------------------------------------------------------------------

@@ -25,6 +25,7 @@ by a host-side ingest loop. Two execution modes:
 from __future__ import annotations
 
 import contextlib
+import copy
 import dataclasses
 import logging
 import warnings
@@ -209,6 +210,12 @@ class TrainerConfig:
     # ingest. 0 (default) keeps the fully synchronous host loop; numerics
     # and chunk order are bit-identical either way.
     prefetch: int = 0
+    # Adaptive prefetch depth bound: > 0 lets the pipeline raise its
+    # depth from `prefetch` up to this many chunks when the consumer
+    # keeps draining the buffer empty (measured queue-empty stalls),
+    # vetoed by available host memory — see ChunkPrefetcher.max_depth.
+    # 0 (default) keeps the depth fixed at `prefetch`.
+    prefetch_max: int = 0
     # Staleness (in chunks) of the forced host metrics sync that health /
     # watchdog / rollback consumers require: 0 (default) inspects chunk
     # i's metrics before dispatching i+1 (today's serial behavior); 1
@@ -379,7 +386,7 @@ class Trainer:
         return jax.tree.map(to_host, local_state)
 
     def _save_checkpoint(self, checkpointer, step: int, local_state, *,
-                         tables=None, touched=None) -> None:
+                         tables=None, touched=None, final=False) -> None:
         """Snapshot tables + local state, with the local state in the
         logic's worker-count-independent export form (default: the raw
         layout, tagged either way so a mismatched restore fails loudly).
@@ -388,8 +395,15 @@ class Trainer:
         instead of the live store — the overlapped pipeline takes them at
         the chunk boundary and runs the save after the NEXT dispatch, by
         which time the live tables already hold a later chunk's state.
-        The store's table view is swapped in for the duration of the dump
-        (single-threaded: only the driver thread touches the store).
+        With an :class:`~fps_tpu.core.checkpoint.AsyncCheckpointer` and
+        fully-addressable state, the device→host capture itself defers
+        onto the WRITER thread (``save_deferred``) — the training thread
+        pays one enqueue and the capture overlaps device compute; the
+        multi-controller dump replicates through a COLLECTIVE and must
+        stay inline, so non-addressable state falls back to the
+        store-swap path below. Otherwise the store's table view is
+        swapped in for the duration of the dump (single-threaded: only
+        the driver thread touches the store).
 
         ``touched``: delta-chain sourcing — an ``(ids_by_table, marker,
         tracker)`` capture from a :class:`~fps_tpu.core.checkpoint.
@@ -397,23 +411,57 @@ class Trainer:
         being saved (the overlapped paths capture alongside their
         on-device boundary copies). The tracker prefix is committed only
         after the checkpointer ACCEPTED the save, so a failed/raced save
-        never loses touched ids for the next publication."""
-        prev = None
-        if tables is not None:
-            prev = self.store.tables
-            self.store.tables = dict(tables)
+        never loses touched ids for the next publication.
+
+        ``final``: the end-of-run save — forced to LAND (``when_full=
+        "block"``) even on a checkpointer configured to skip saves while
+        its writer is busy; the run's terminal state must be durable."""
         kwargs = {}
         if touched is not None:
             kwargs["touched_rows"] = touched[0]
+        if final and hasattr(checkpointer, "when_full"):
+            kwargs["when_full"] = "block"
         try:
-            checkpointer.save(
-                step, self.store,
-                self.logic.export_local_state(
-                    self._host_local_state(local_state)
-                ),
-                local_state_format="exported",
-                **kwargs,
-            )
+            if (tables is not None
+                    and hasattr(checkpointer, "save_deferred")
+                    and self._fully_addressable(tables, local_state)):
+                # Writer-side capture: hand the writer a private store
+                # view over the boundary copies (shallow copy — specs /
+                # mesh / shard layout are stable; only ``tables`` is
+                # swapped) plus the on-device local-state copy. The
+                # closure runs on the writer thread; everything it
+                # touches is either frozen (the copies) or immutable.
+                view = copy.copy(self.store)
+                view.tables = dict(tables)
+                ckpt, logic = checkpointer, self.logic
+                ls_dev = local_state
+
+                def collect():
+                    return ckpt._collect(
+                        view,
+                        logic.export_local_state(
+                            self._host_local_state(ls_dev)),
+                        "exported",
+                    )
+
+                checkpointer.save_deferred(step, collect, **kwargs)
+            else:
+                prev = None
+                if tables is not None:
+                    prev = self.store.tables
+                    self.store.tables = dict(tables)
+                try:
+                    checkpointer.save(
+                        step, self.store,
+                        self.logic.export_local_state(
+                            self._host_local_state(local_state)
+                        ),
+                        local_state_format="exported",
+                        **kwargs,
+                    )
+                finally:
+                    if prev is not None:
+                        self.store.tables = prev
             if touched is not None:
                 touched[2].commit(touched[1])
         except Exception as e:
@@ -434,9 +482,19 @@ class Trainer:
                     break
                 cause = cause.__cause__
             raise
-        finally:
-            if prev is not None:
-                self.store.tables = prev
+
+    @staticmethod
+    def _fully_addressable(tables, local_state) -> bool:
+        """True when every array leaf is fully addressable — the gate
+        for writer-thread capture (a non-addressable leaf's dump path
+        runs ``replicate_to_mesh``, a COLLECTIVE every process must
+        reach together on the training thread)."""
+        for leaf in (list(tables.values())
+                     + jax.tree.leaves(local_state)):
+            if (isinstance(leaf, jax.Array)
+                    and not leaf.is_fully_addressable):
+                return False
+        return True
 
     def restore_checkpoint(self, checkpointer, local_state_like, *,
                            step: int | None = None):
@@ -2605,7 +2663,9 @@ class Trainer:
 
             pf = ChunkPrefetcher(
                 it, _place_for_pf,
-                depth=cfg.prefetch, recorder=rec, timer=timer,
+                depth=cfg.prefetch,
+                max_depth=cfg.prefetch_max or None,
+                recorder=rec, timer=timer,
                 start_index=start_step,
                 # Preset-quarantined chunks are consumed but never
                 # dispatched — don't pay their host→device upload.
@@ -2867,7 +2927,8 @@ class Trainer:
             if checkpointer is not None and i >= start_step and saved_at != i + 1:
                 with _phase(timer, "checkpoint"):
                     self._save_checkpoint(checkpointer, i + 1, local_state,
-                                          touched=capture_touched())
+                                          touched=capture_touched(),
+                                          final=True)
         finally:
             if pf is not None:
                 # Every exit path — normal end, raising on_chunk, health

@@ -451,6 +451,16 @@ def run_megastep(trainer, tables, local_state, plan, key, *,
     metrics, and checkpoints; tested), but with per-chunk Python
     dispatch, host sync, and transfer overhead out of the hot loop.
 
+    ``chunks_per_dispatch="auto"`` replaces the flag with measurement:
+    a short calibration window (:mod:`fps_tpu.core.autok`) times one-
+    and two-cadence-block dispatches on throwaway copies, models the
+    host-serial share as ``h / (h + K*c)``, and picks the smallest K
+    that clears the target share — rounded to the tick cadence, capped
+    at one epoch's calls. The chosen K (``megastep.auto_k`` gauge) then
+    drives a run bit-identical to passing it explicitly. Resuming a
+    run (``start_megastep > 0``) should pass the original chosen K
+    explicitly — megastep indices are counted in units of K.
+
     Checkpoints land every ``checkpoint_every`` megasteps under the
     GLOBAL megastep index (``start_megastep`` resumes there — shuffles
     and PRNG keys derive from the (epoch, chunk) pair, so a restart
@@ -479,10 +489,19 @@ def run_megastep(trainer, tables, local_state, plan, key, *,
     )
 
     cfg = trainer.config
-    K = int(chunks_per_dispatch)
-    if K < 1:
-        raise ValueError(
-            f"chunks_per_dispatch must be >= 1, got {chunks_per_dispatch}")
+    auto_k = isinstance(chunks_per_dispatch, str)
+    if auto_k:
+        if chunks_per_dispatch != "auto":
+            raise ValueError(
+                f"chunks_per_dispatch must be an int >= 1 or 'auto', "
+                f"got {chunks_per_dispatch!r}")
+        K = None  # resolved by the calibration window below
+    else:
+        K = int(chunks_per_dispatch)
+        if K < 1:
+            raise ValueError(
+                f"chunks_per_dispatch must be >= 1, got "
+                f"{chunks_per_dispatch}")
     if cfg.push_delay:
         raise ValueError(
             "run_megastep does not support push_delay: the in-flight ring "
@@ -511,7 +530,7 @@ def run_megastep(trainer, tables, local_state, plan, key, *,
             raise ValueError(
                 "trainer already has a retierer attached — run_megastep "
                 "drives tier boundaries in-graph via its own MegastepTick")
-        if K % tick.check_every:
+        if not auto_k and K % tick.check_every:
             raise ValueError(
                 f"chunks_per_dispatch={K} must be a multiple of "
                 f"tick.check_every={tick.check_every} so every tick "
@@ -544,9 +563,20 @@ def run_megastep(trainer, tables, local_state, plan, key, *,
 
     T_call = trainer._indexed_call_steps(plan)
     n_calls = calls_per_epoch_of(plan, T_call)
-    M = -(-n_calls // K)
     T = plan.steps_per_epoch
     tables = trainer._attach_hot(tables, timer)
+    if auto_k:
+        from fps_tpu.core.autok import calibrate_chunks_per_dispatch
+
+        K, overhead_s, per_chunk_s = calibrate_chunks_per_dispatch(
+            trainer, tables, local_state, plan, key, mode=mode,
+            tick=tick, n_calls=n_calls)
+        if rec is not None:
+            rec.set("megastep.auto_k", K)
+            rec.event("megastep_auto_k", chosen_k=K,
+                      overhead_s=round(overhead_s, 6),
+                      per_chunk_s=round(per_chunk_s, 6))
+    M = -(-n_calls // K)
     compact_cfg = trainer._cold_compact_map()
     vote_on = bool(compact_cfg) and bool(
         vote_certifiable_tables(trainer, plan))
@@ -725,7 +755,8 @@ def run_megastep(trainer, tables, local_state, plan, key, *,
         if (checkpointer is not None and end > start_megastep
                 and saved_at != end):
             with _phase(timer, "checkpoint"):
-                trainer._save_checkpoint(checkpointer, end, local_state)
+                trainer._save_checkpoint(checkpointer, end, local_state,
+                                         final=True)
                 if tick is not None and tick.state_dir is not None:
                     tick.save_boundary(end, tables)
     finally:

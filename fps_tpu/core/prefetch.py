@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 import threading
 import time
 from typing import Callable, Iterable
@@ -57,6 +58,40 @@ _log = logging.getLogger("fps_tpu.prefetch")
 
 # Worker→consumer end-of-stream marker (never buffered, never yielded).
 _END = object()
+
+#: Adaptive depth: consumed chunks per adaptation window, and the
+#: queue-empty stall count within one window that triggers a raise.
+ADAPT_WINDOW = 8
+ADAPT_STALLS = 2
+
+#: A depth raise must keep the whole buffer under this share of the
+#: currently-available host memory.
+ADAPT_MEM_SHARE = 0.25
+
+
+def _available_host_bytes() -> int | None:
+    """Available (not merely free) host memory, or ``None`` when the
+    platform can't say — ``None`` means the memory veto abstains."""
+    try:
+        return os.sysconf("SC_AVPHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+    except (ValueError, OSError, AttributeError):
+        return None
+
+
+def _chunk_nbytes(item) -> int:
+    """Dependency-free byte estimate of one buffered chunk (device
+    arrays count too — a placed chunk's device footprint tracks its
+    host footprint, and overestimating only makes the veto stricter)."""
+    if isinstance(item, PlacedChunk):
+        return _chunk_nbytes(item.batches) + _chunk_nbytes(item.host_ids)
+    if isinstance(item, dict):
+        return sum(_chunk_nbytes(v) for v in item.values())
+    if isinstance(item, (list, tuple)):
+        return sum(_chunk_nbytes(v) for v in item)
+    try:
+        return int(getattr(item, "nbytes", 0) or 0)
+    except TypeError:
+        return 0
 
 
 class PlacedChunk:
@@ -94,7 +129,21 @@ class ChunkPrefetcher:
         upload); when given, yielded items are :class:`PlacedChunk`
         wrappers around its result. ``None`` overlaps assembly only.
       depth: max chunks buffered ahead (>= 1; default 2 — one in flight
-        on the device, one ready, one being assembled).
+        on the device, one ready, one being assembled). With
+        ``max_depth`` set this is the STARTING depth.
+      max_depth: enable adaptive depth — when the consumer keeps
+        draining the buffer empty (>= ``ADAPT_STALLS`` queue-empty
+        stalls inside a window of ``ADAPT_WINDOW`` consumed chunks) the
+        depth is raised one chunk at a time up to this bound, provided
+        the grown buffer stays under ``ADAPT_MEM_SHARE`` of available
+        host memory. Each raise increments the
+        ``prefetch.depth_adjustments`` counter. ``None`` (default)
+        keeps the fixed-depth behavior. Depth never adapts downward:
+        the buffer bound is what certifies memory, and a transiently
+        fast consumer should keep the headroom it earned.
+      mem_probe: available-host-bytes callable for the memory veto
+        (test seam; default reads ``SC_AVPHYS_PAGES``; returning
+        ``None`` abstains).
       recorder: optional :class:`fps_tpu.obs.Recorder` for the
         ``prefetch.queue_depth`` gauge and ``prefetch.chunks`` counter.
       timer: optional :class:`fps_tpu.obs.PhaseTimer`; worker seconds are
@@ -111,12 +160,21 @@ class ChunkPrefetcher:
     """
 
     def __init__(self, chunks: Iterable, place_fn: Callable | None = None, *,
-                 depth: int = 2, recorder=None, timer=None,
-                 start_index: int = 0, skip_place=frozenset(),
-                 name: str = "fps-prefetch"):
+                 depth: int = 2, max_depth: int | None = None,
+                 mem_probe: Callable | None = None, recorder=None,
+                 timer=None, start_index: int = 0,
+                 skip_place=frozenset(), name: str = "fps-prefetch"):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        if max_depth is not None and max_depth < depth:
+            raise ValueError(
+                f"prefetch max_depth={max_depth} must be >= depth={depth}")
         self.depth = depth
+        self.max_depth = max_depth
+        self._mem_probe = (mem_probe if mem_probe is not None
+                           else _available_host_bytes)
+        self._stalls = 0
+        self._consumed = 0
         self._it = iter(chunks)
         self._place = place_fn
         self._index = start_index
@@ -190,7 +248,12 @@ class ChunkPrefetcher:
         return self
 
     def __next__(self):
+        raised = False
         with self._cv:
+            if not self._buf and not self._done:
+                # The device is about to idle waiting on the host
+                # pipeline — the signal adaptive depth sizes from.
+                self._stalls += 1
             while not self._buf and not self._done:
                 self._cv.wait()
             if self._buf:
@@ -204,8 +267,32 @@ class ChunkPrefetcher:
                 raise err
             else:
                 raise StopIteration
+            self._consumed += 1
+            if self._consumed >= ADAPT_WINDOW:
+                raised = self._maybe_raise_depth_locked(item)
+                self._stalls = 0
+                self._consumed = 0
         self._gauge(depth)
+        if raised and self._rec is not None:
+            # Outside the cv, like _gauge: sinks may do file I/O.
+            self._rec.inc("prefetch.depth_adjustments")
         return item
+
+    def _maybe_raise_depth_locked(self, item) -> bool:
+        """One-chunk depth raise at a window boundary (cv held):
+        stall-justified and memory-vetoed."""
+        if self.max_depth is None or self.depth >= self.max_depth:
+            return False
+        if self._stalls < ADAPT_STALLS:
+            return False
+        nbytes = _chunk_nbytes(item)
+        avail = self._mem_probe()
+        if (avail is not None and nbytes > 0
+                and (self.depth + 1) * nbytes > ADAPT_MEM_SHARE * avail):
+            return False
+        self.depth += 1
+        self._cv.notify_all()  # the worker may now run further ahead
+        return True
 
     def close(self, timeout: float = 10.0) -> None:
         """Stop the worker and join it (idempotent).

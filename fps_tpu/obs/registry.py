@@ -122,6 +122,11 @@ def default_registry() -> MetricsRegistry:
         MetricSpec("megastep.chunks_per_dispatch", "gauge", unit="chunks",
                    help="K of the current megastep program: chunk "
                         "segments fused into one compiled dispatch"),
+        MetricSpec("megastep.auto_k", "gauge", unit="chunks",
+                   help="K chosen by the auto-K calibration window "
+                        "(chunks_per_dispatch='auto'): smallest K whose "
+                        "modeled host-serial share h/(h+K*c) clears the "
+                        "target, rounded up to the tick cadence"),
         MetricSpec("cold_route.vote_compact_windows", "counter",
                    unit="windows",
                    help="megastep chunk windows whose device-side "
@@ -143,6 +148,11 @@ def default_registry() -> MetricsRegistry:
         MetricSpec("prefetch.queue_depth", "gauge", unit="chunks",
                    help="placed chunks buffered ahead of the driver "
                         "(sampled at every pipeline put/get)"),
+        MetricSpec("prefetch.depth_adjustments", "counter", unit="steps",
+                   help="adaptive depth raises: the consumer kept "
+                        "draining the buffer empty inside a stall "
+                        "window and host memory allowed one more "
+                        "buffered chunk"),
         # Two-tier hot storage (TableSpec.hot_tier / TrainerConfig.
         # hot_sync_every; docs/performance.md "Two-tier storage").
         MetricSpec("hot_tier.hot_rows", "counter", unit="rows",
@@ -225,9 +235,17 @@ def default_registry() -> MetricsRegistry:
                         "(checkpoint.saves marks the durability point)"),
         MetricSpec("checkpoint.save_seconds", "histogram", unit="s"),
         MetricSpec("checkpoint.dump_seconds", "histogram", unit="s",
-                   help="device->host snapshot capture time (the part of "
-                        "a save the training thread pays; the overlapped "
-                        "pipeline hides it behind the next dispatch)"),
+                   help="what a save costs the TRAINING thread: the "
+                        "inline device->host capture, or — on the "
+                        "deferred path — just the enqueue of the "
+                        "boundary copies (capture itself then rides "
+                        "checkpoint.capture_seconds on the writer)"),
+        MetricSpec("checkpoint.capture_seconds", "histogram", unit="s",
+                   help="device->host snapshot capture time (touched-row "
+                        "device_get + CRC prep) wherever it runs — on "
+                        "the writer thread under deferred capture, "
+                        "inline otherwise; dump_seconds minus this is "
+                        "the training thread's residual share"),
         MetricSpec("checkpoint.bytes", "gauge", unit="bytes",
                    help="size of the last written FULL snapshot (delta "
                         "publications ride checkpoint.delta_bytes; the "

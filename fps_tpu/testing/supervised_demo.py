@@ -96,6 +96,16 @@ SCENARIO_DELTA_KILL_AT = 3
 SCENARIO_FLEET_READERS = 3
 
 
+def _ttr_from_digest(digest) -> float | None:
+    """Slowest ``restart_to_first_signal_s`` in a supervisor digest —
+    the scenario's ``time_to_recovered_s`` figure the chaos sweep's
+    time-to-recovered SLO gate judges (``None`` when the run never
+    restarted: nothing recovered, nothing to bound)."""
+    rts = digest.get("restart_to_first_signal_s") or []
+    return round(max(rts), 3) if rts else None
+
+
+
 def run_supervised_scenario(tmpdir: str, *, timeout: float = 600):
     """THE end-to-end supervisor survival scenario, shared by
     ``tools/chaos_sweep.py`` (``supervised``) and the slow test in
@@ -157,6 +167,7 @@ def run_supervised_scenario(tmpdir: str, *, timeout: float = 600):
                            np.load(sup_out)["weights"])
     )
     detail = {
+        "time_to_recovered_s": _ttr_from_digest(digest),
         "supervisor": {k: digest.get(k) for k in
                        ("success", "attempts", "restarts",
                         "deadline_aborts", "quarantined")},
@@ -247,6 +258,7 @@ def run_prefetch_kill_scenario(tmpdir: str, *, timeout: float = 600):
     except (OSError, _json.JSONDecodeError, IndexError):
         killed_phase = None
     detail = {
+        "time_to_recovered_s": _ttr_from_digest(digest),
         "supervisor": {k: digest.get(k) for k in
                        ("success", "attempts", "restarts",
                         "deadline_aborts", "quarantined")},
@@ -329,6 +341,7 @@ def run_hot_tier_kill_scenario(tmpdir: str, *, timeout: float = 600):
                            np.load(sup_out)["weights"])
     )
     detail = {
+        "time_to_recovered_s": _ttr_from_digest(digest),
         "supervisor": {k: digest.get(k) for k in
                        ("success", "attempts", "restarts",
                         "deadline_aborts", "quarantined")},
@@ -414,6 +427,7 @@ def run_megastep_kill_scenario(tmpdir: str, *, timeout: float = 600):
                            np.load(sup_out)["weights"])
     )
     detail = {
+        "time_to_recovered_s": _ttr_from_digest(digest),
         "supervisor": {k: digest.get(k) for k in
                        ("success", "attempts", "restarts",
                         "deadline_aborts", "quarantined")},
@@ -509,6 +523,7 @@ def run_reconcile_shard_kill_scenario(tmpdir: str, *, timeout: float = 600):
         with np.load(snaps[-1]) as z:
             fold_persisted = any(k.startswith("fold::") for k in z.files)
     detail = {
+        "time_to_recovered_s": _ttr_from_digest(digest),
         "supervisor": {k: digest.get(k) for k in
                        ("success", "attempts", "restarts",
                         "deadline_aborts", "quarantined")},
@@ -605,6 +620,7 @@ def run_retier_kill_scenario(tmpdir: str, *, timeout: float = 600):
                            np.load(sup_out)["weights"])
     )
     detail = {
+        "time_to_recovered_s": _ttr_from_digest(digest),
         "supervisor": {k: digest.get(k) for k in
                        ("success", "attempts", "restarts",
                         "deadline_aborts", "quarantined")},
@@ -764,6 +780,7 @@ def run_serve_while_train_scenario(tmpdir: str, *, timeout: float = 600):
                                server.pull("weights", [0, 1])[1])))
 
     detail = {
+        "time_to_recovered_s": _ttr_from_digest(digest),
         "supervisor": {k: digest.get(k) for k in
                        ("success", "attempts", "restarts",
                         "deadline_aborts", "quarantined")},
@@ -1097,6 +1114,7 @@ def run_fleet_fence_scenario(tmpdir: str, *, timeout: float = 600):
                         for r in fleet.readers if r.server._snap
                         is not None), default=0)
     detail = {
+        "time_to_recovered_s": _ttr_from_digest(digest),
         "supervisor": {k: digest.get(k) for k in
                        ("success", "restarts")},
         "polls": polls,

@@ -485,12 +485,14 @@ class Retierer:
         return jax.device_put(np.asarray(arr), trainer._replicated)
 
     def _save_sidecar(self, step: int, windows: dict) -> None:
-        """Write the boundary sidecar — retried through the storage
-        retry policy, then DEGRADED on persistent transient failure:
-        the sidecar is advisory (a missing one only cold-starts the
-        tracker on resume, warned loudly by :meth:`restore`), so a
-        storage brownout at a boundary must not crash training over
-        it. ``storage.sidecar_skips`` counts the lost durability."""
+        """Write the boundary sidecar — ONE inline attempt, then the
+        retry/backoff budget runs on a background retrier thread so
+        its sleeps never land on the training thread; persistent
+        transient failure DEGRADES to a skip: the sidecar is advisory
+        (a missing one only cold-starts the tracker on resume, warned
+        loudly by :meth:`restore`), so a storage brownout at a
+        boundary must not crash — or throttle — training over it.
+        ``storage.sidecar_skips`` counts the lost durability."""
         from fps_tpu.core import retry as _retry
 
         os.makedirs(self.state_dir, exist_ok=True)
@@ -508,23 +510,77 @@ class Retierer:
         for name in sorted(windows):
             arrays[f"window::{name}"] = windows[name]
         try:
-            _retry.call_with_retry(
-                lambda: self._write_sidecar_file(path, arrays),
-                policy=dataclasses.replace(_retry.DEFAULT_PUBLISH_RETRY,
-                                           seed=path),
-                op="sidecar",
-                on_retry=lambda a, e, d: self._obs_metric(
-                    "inc", "storage.retries", 1, plane="sidecar"))
+            self._write_sidecar_file(path, arrays)
         except OSError as e:
             if _retry.classify_error(e) != "retryable":
                 raise
-            _log.warning("tiering: sidecar write for step %d DEGRADED "
-                         "(skipped after retries): %r — a resume past "
-                         "this boundary cold-starts the tracker", step,
-                         e)
-            self._obs_metric("inc", "storage.sidecar_skips", 1)
+            self._obs_metric("inc", "storage.retries", 1,
+                             plane="sidecar")
+            self._sidecar_retry_bg(step, path, arrays)
             return
         self._sweep_sidecars()
+
+    def _sidecar_retry_bg(self, step: int, path: str,
+                          arrays: dict) -> None:
+        """Hand a transiently-failed sidecar write to the background
+        retrier (lazily spawned, latest-wins slot): the remaining
+        retry budget and its backoff sleeps run there. A pending older
+        sidecar displaced by a newer boundary counts as a skip — only
+        the newest sidecar matters for resume."""
+        import threading
+
+        lock = self.__dict__.setdefault("_sc_lock", threading.Lock())
+        with lock:
+            prev = self.__dict__.get("_sc_pending")
+            if prev is not None:
+                _log.warning("tiering: sidecar for step %d displaced by "
+                             "step %d before its background retry ran — "
+                             "skipped", prev[0], step)
+                self._obs_metric("inc", "storage.sidecar_skips", 1)
+            self._sc_pending = (step, path, arrays)
+            t = self.__dict__.get("_sc_thread")
+            if t is None or not t.is_alive():
+                t = threading.Thread(target=self._sidecar_retry_loop,
+                                     name="sidecar-retrier", daemon=True)
+                self._sc_thread = t
+                t.start()
+
+    def _sidecar_retry_loop(self) -> None:
+        from fps_tpu.core import retry as _retry
+
+        while True:
+            with self._sc_lock:
+                item = self.__dict__.pop("_sc_pending", None)
+            if item is None:
+                return
+            step, path, arrays = item
+            try:
+                _retry.call_with_retry(
+                    lambda: self._write_sidecar_file(path, arrays),
+                    policy=dataclasses.replace(
+                        _retry.DEFAULT_PUBLISH_RETRY, seed=path),
+                    op="sidecar",
+                    on_retry=lambda a, e, d: self._obs_metric(
+                        "inc", "storage.retries", 1, plane="sidecar"))
+            except OSError as e:
+                _log.warning("tiering: sidecar write for step %d "
+                             "DEGRADED (skipped after retries): %r — a "
+                             "resume past this boundary cold-starts the "
+                             "tracker", step, e)
+                self._obs_metric("inc", "storage.sidecar_skips", 1)
+                continue
+            try:
+                self._sweep_sidecars()
+            except OSError:
+                pass
+
+    def sidecar_flush(self, timeout: float | None = None) -> None:
+        """Block until any background sidecar retry has drained —
+        test/shutdown seam; training never calls this on the hot
+        path."""
+        t = self.__dict__.get("_sc_thread")
+        if t is not None and t.is_alive():
+            t.join(timeout)
 
     @staticmethod
     def _obs_metric(kind: str, name: str, value, **labels) -> None:

@@ -35,6 +35,22 @@ tests/test_resilience.py):
   file first), continue to 4 total epochs, dump the model. The parent
   still asserts bit-identity with ``straight``, extending the kill-resume
   contract to corrupted/torn snapshots.
+
+Deferred-capture chain extensions (ISSUE 20; tests/test_checkpoint.py
+``test_deferred_capture_sigkill_midcapture_resumes``): ``fit_stream``
+with ``prefetch=2`` routes saves through ``save_deferred`` — the
+device→host capture runs on the WRITER thread over a delta chain
+(``DeltaPolicy(full_every=50)``):
+
+* ``straight-stream`` — 6 chunks of ``fit_stream``, no checkpointer.
+* ``victim-capture-kill`` — same stream, AsyncCheckpointer + delta
+  chain + ``prefetch=2``, and SIGKILL from INSIDE the writer's THIRD
+  ``_run_capture`` call: the crash lands mid-device→host-capture, after
+  steps 1 (full) and 2 (delta) published but before step 3 touched disk.
+* ``resume-stream`` — FRESH process: restore through the delta chain
+  (full 1 + delta 2), continue with ``start_step=2``, dump. The parent
+  asserts bit-identity with ``straight-stream`` — a kill mid-capture
+  loses at most the boundary being captured, never recovered bytes.
 """
 
 import os
@@ -81,6 +97,71 @@ def main() -> int:
     if mode == "straight":
         tables, ls, _ = trainer.run_indexed(tables, ls, plan, key, epochs=4)
         dump(out)
+        return 0
+
+    if mode in ("straight-stream", "victim-capture-kill", "resume-stream"):
+        import dataclasses
+
+        from fps_tpu.core import checkpoint as ck_mod
+        from fps_tpu.core.ingest import epoch_chunks
+
+        # A user table big enough that a per-boundary touched-row delta
+        # is genuinely smaller than a full dump (the planner falls back
+        # to a full when the delta wouldn't save bytes).
+        NU, NI = 1024, 64
+        cfg2 = MFConfig(num_users=NU, num_items=NI, rank=4,
+                        learning_rate=0.1)
+        trainer2, store2 = online_mf(mesh, cfg2)
+        # prefetch=2 turns on the overlapped pipeline: boundary copies +
+        # writer-side capture (save_deferred) — the layer under test.
+        trainer2.config = dataclasses.replace(trainer2.config, prefetch=2)
+        tables, ls = trainer2.init_state(jax.random.key(0))
+        data2 = synthetic_ratings(NU, NI, 2000, seed=0)
+        chunks = list(epoch_chunks(data2, num_workers=W, local_batch=32,
+                                   steps_per_chunk=2, route_key="user",
+                                   seed=0))[:6]
+        skey = jax.random.key(7)
+
+        def dump_stream(path):
+            np.savez(path,
+                     item_factors=store2.dump_model("item_factors")[1],
+                     user_factors=mf_user_vectors(np.asarray(ls), W,
+                                                  np.arange(NU)))
+
+        if mode == "straight-stream":
+            tables, ls, _ = trainer2.fit_stream(tables, ls, chunks, skey)
+            dump_stream(out)
+            return 0
+
+        ackpt = ck_mod.AsyncCheckpointer(
+            ckdir, keep=8, delta=ck_mod.DeltaPolicy(full_every=50))
+
+        if mode == "victim-capture-kill":
+            real_capture = ck_mod._run_capture
+            calls = {"n": 0}
+
+            def dying_capture(collect):
+                calls["n"] += 1
+                if calls["n"] == 3:
+                    # Step 3's WRITER-side device→host capture: die
+                    # before a single byte of it reaches disk.
+                    os.kill(os.getpid(), signal.SIGKILL)
+                return real_capture(collect)
+
+            ck_mod._run_capture = dying_capture
+            trainer2.fit_stream(tables, ls, chunks, skey,
+                                checkpointer=ackpt, checkpoint_every=1)
+            raise AssertionError("victim-capture-kill must never get here")
+
+        # resume-stream: a fresh process restores through the delta
+        # chain and continues the same stream from the same boundary.
+        tables, ls, step = trainer2.restore_checkpoint(ackpt, ls)
+        assert step == 2, step
+        tables, ls, _ = trainer2.fit_stream(
+            tables, ls, chunks[step:], skey, checkpointer=ackpt,
+            checkpoint_every=1, start_step=step)
+        ackpt.close()
+        dump_stream(out)
         return 0
 
     ckpt = Checkpointer(ckdir, keep=2)

@@ -754,6 +754,6 @@ def test_audit_programs_importable_without_reexec():
         text=True, timeout=300,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr
-    # 10 pinned rows since PR 10 (mf_tiered_gathered/mf_tiered_compact
-    # joined the census).
-    assert "IMPORT_OK 10" in proc.stdout
+    # 11 pinned rows (mf_megastep joined the PR-10 census of 10 when
+    # the fused dispatch got its own budget).
+    assert "IMPORT_OK 11" in proc.stdout

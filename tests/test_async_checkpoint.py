@@ -267,6 +267,128 @@ def test_compiled_program_identical_with_async_writer_attached(
     assert lowered_text(False) == lowered_text(True)
 
 
+def test_save_deferred_runs_capture_on_writer_thread(tmp_path, jaxmods,
+                                                     devices8):
+    """save_deferred pays one enqueue on the caller; collect() — the
+    device→host capture — runs on the WRITER thread, arbitrarily late,
+    and publishes the same snapshot an inline save of the same state
+    would."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    _, _, trainer, store = _mf(jaxmods)
+    store.init(jax.random.key(0))
+    seen = {}
+    gate = threading.Event()
+
+    with ck.AsyncCheckpointer(str(tmp_path / "a"), keep=3) as ackpt:
+        def collect():
+            seen["thread"] = threading.current_thread().name
+            gate.wait(5.0)  # held capture: the enqueue must not wait on it
+            return ackpt._collect(store, None, "raw")
+
+        t0 = time.perf_counter()
+        ackpt.save_deferred(1, collect)
+        enqueue_s = time.perf_counter() - t0
+        assert enqueue_s < 0.5, f"enqueue blocked for {enqueue_s:.2f}s"
+        gate.set()
+        ackpt.flush()
+        assert seen["thread"].startswith("fps-ckpt-writer")
+        assert ackpt.steps() == [1]
+
+    sync = ck.Checkpointer(str(tmp_path / "b"), keep=3)
+    sync.save(1, store, None)
+    _, ta, la, fa = ck.Checkpointer(str(tmp_path / "a")).read_snapshot(1)
+    _, tb, lb, fb = sync.read_snapshot(1)
+    assert fa == fb and set(ta) == set(tb)
+    for k in ta:
+        np.testing.assert_array_equal(ta[k], tb[k])
+
+
+def test_deferred_capture_byte_identical_to_inline(tmp_path, jaxmods,
+                                                   devices8, monkeypatch):
+    """ISSUE 20 acceptance: fit_stream with prefetch (boundary copies →
+    save_deferred, writer-side capture) publishes byte-identical
+    snapshots to the inline-capture run — and the deferred path really
+    ran (counted at save_deferred)."""
+    import dataclasses
+
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    chunks = _chunks(jaxmods)
+    deferred_calls = {"n": 0}
+    real_deferred = ck.AsyncCheckpointer.save_deferred
+
+    def counting_deferred(self, *a, **kw):
+        deferred_calls["n"] += 1
+        return real_deferred(self, *a, **kw)
+
+    monkeypatch.setattr(ck.AsyncCheckpointer, "save_deferred",
+                        counting_deferred)
+    dirs = {}
+    for name, pf in [("inline", 0), ("deferred", 2)]:
+        _, _, trainer, store = _mf(jaxmods)
+        trainer.config = dataclasses.replace(trainer.config, prefetch=pf)
+        tab, ls = trainer.init_state(jax.random.key(1))
+        before = deferred_calls["n"]
+        with ck.AsyncCheckpointer(str(tmp_path / name)) as ckpt:
+            trainer.fit_stream(tab, ls, chunks, jax.random.key(5),
+                               checkpointer=ckpt, checkpoint_every=2)
+        if name == "deferred":
+            assert deferred_calls["n"] > before, \
+                "prefetch run never took the writer-capture path"
+        else:
+            assert deferred_calls["n"] == before
+        dirs[name] = str(tmp_path / name)
+    a = ck.Checkpointer(dirs["inline"])
+    b = ck.Checkpointer(dirs["deferred"])
+    assert a.steps() == b.steps() == [2, 4]
+    for s in a.steps():
+        sa, ta, la, fa = a.read_snapshot(s)
+        sb, tb, lb, fb = b.read_snapshot(s)
+        assert (sa, fa) == (sb, fb)
+        assert set(ta) == set(tb)
+        for k in ta:
+            np.testing.assert_array_equal(ta[k], tb[k])
+        for x, y in zip(la, lb):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_when_full_degrade_skips_without_blocking(tmp_path, jaxmods,
+                                                  devices8, monkeypatch):
+    """when_full="degrade": a save landing while the queue slot is full
+    returns immediately as a SKIP (degraded publish + backlog), the next
+    landed publish drains the backlog, and when_full="block" per call
+    overrides the instance default — the final save always lands."""
+    jax, ck = jaxmods["jax"], jaxmods["ck"]
+    _, _, trainer, store = _mf(jaxmods)
+    store.init(jax.random.key(0))
+    started = threading.Event()
+    _slow_savez(jaxmods, monkeypatch, 0.4, started)
+
+    with ck.AsyncCheckpointer(str(tmp_path / "c"), keep=8,
+                              when_full="degrade") as ckpt:
+        ckpt.save(1, store, None)  # -> writer
+        # Wait for the writer to TAKE save 1 (degrade mode never waits
+        # on a momentarily-full slot, so save 2 must find it empty).
+        assert started.wait(5.0)
+        ckpt.save(2, store, None)  # -> queue slot
+        t0 = time.perf_counter()
+        ckpt.save(3, store, None)  # slot full -> skipped, not blocked
+        third_save_s = time.perf_counter() - t0
+        assert third_save_s < 0.1, third_save_s
+        assert ckpt.degraded_publishes == 1
+        # Per-call "block" (the driver's final-save spelling) overrides
+        # the instance default and waits for the slot.
+        t0 = time.perf_counter()
+        ckpt.save(4, store, None, when_full="block")
+        blocked_save_s = time.perf_counter() - t0
+        assert blocked_save_s > 0.1, blocked_save_s
+        ckpt.flush()
+        # 3 was the degraded skip; 1/2/4 landed, and 2's publish (the
+        # first landed write after the skip) drained the backlog.
+        assert ckpt.steps() == [1, 2, 4]
+    with pytest.raises(ValueError, match="when_full"):
+        ck.AsyncCheckpointer(str(tmp_path / "bad"), when_full="drop")
+
+
 def test_corrupt_quarantine_sweep_bounded(tmp_path, jaxmods, devices8):
     """Satellite: *.corrupt files are bounded by count AND age at
     Checkpointer construction — they no longer accumulate forever."""

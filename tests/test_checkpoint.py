@@ -535,6 +535,49 @@ def test_async_writer_sigkill_midwrite_never_publishes_torn(tmp_path):
     np.testing.assert_array_equal(a["user_factors"], b["user_factors"])
 
 
+@pytest.mark.slow
+def test_deferred_capture_sigkill_midcapture_resumes(tmp_path):
+    """ISSUE 20 crash window of the WRITER-side capture: fit_stream with
+    prefetch routes saves through save_deferred over a delta chain, and
+    SIGKILL lands inside the writer's step-3 device→host capture — after
+    steps 1 (full) + 2 (delta) published, before step 3 touched disk.
+    Nothing torn exists (the capture never reached serialize), the chain
+    restores to step 2, and a fresh process resumes to the straight
+    run's exact state: a kill mid-capture loses at most the boundary
+    being captured, never served or recovered bytes."""
+    import glob
+    import os
+    import signal
+
+    from fps_tpu.core.checkpoint import Checkpointer, DeltaPolicy
+    from fps_tpu.core.snapshot_format import delta_path
+
+    ckdir = str(tmp_path / "roll")
+    straight = str(tmp_path / "straight.npz")
+    resumed = str(tmp_path / "resumed.npz")
+
+    r = _run_kill_worker("straight-stream", ckdir, straight)
+    assert r.returncode == 0, r.stdout + r.stderr
+    v = _run_kill_worker("victim-capture-kill", ckdir, "-")
+    assert v.returncode == -signal.SIGKILL, v.stdout + v.stderr
+
+    # The chain the kill left behind: full 1 + delta 2(<-1), step 3
+    # absent entirely — no tmp litter, because the capture died before
+    # any serialize started.
+    ck = Checkpointer(ckdir, keep=8, delta=DeltaPolicy(full_every=50))
+    assert ck.steps() == [1, 2]
+    assert ck.latest_valid_step() == 2
+    assert os.path.exists(delta_path(ckdir, 2, 1))
+    assert glob.glob(ckdir + "/*.tmp.npz") == []
+
+    r2 = _run_kill_worker("resume-stream", ckdir, resumed)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+    a, b = np.load(straight), np.load(resumed)
+    np.testing.assert_array_equal(a["item_factors"], b["item_factors"])
+    np.testing.assert_array_equal(a["user_factors"], b["user_factors"])
+
+
 def test_sigkill_and_fresh_process_resume(tmp_path):
     """END-TO-END crash recovery: a training process is SIGKILLed mid-run
     (epoch 3 trained, not yet checkpointed), and a FRESH OS process

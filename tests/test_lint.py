@@ -493,7 +493,8 @@ def test_lint_paths_walks_and_selects(tmp_path):
 
 def test_rule_table_is_complete():
     assert set(RULES) == {"FPS001", "FPS002", "FPS003", "FPS004", "FPS005",
-                          "FPS006", "FPS007", "FPS008", "FPS009", "FPS010"}
+                          "FPS006", "FPS007", "FPS008", "FPS009", "FPS010",
+                          "FPS011"}
 
 
 def test_package_lints_clean():
@@ -532,3 +533,83 @@ def test_cli_explain(tmp_path):
     assert r.returncode == 0
     for rule in RULES:
         assert rule in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# FPS011 — blocking host work in the training-thread scope.
+# ---------------------------------------------------------------------------
+
+DRIVER_PATH = os.path.join("fps_tpu", "core", "driver.py")
+MEGASTEP_PATH = os.path.join("fps_tpu", "core", "megastep.py")
+
+
+def hot_rules(src, path=DRIVER_PATH):
+    return [f.rule for f in lint_source(textwrap.dedent(src), path)]
+
+
+def test_fps011_flags_blocking_calls_in_training_scope():
+    for path in (DRIVER_PATH, MEGASTEP_PATH):
+        assert hot_rules("time.sleep(0.1)", path) == ["FPS011"], path
+        assert hot_rules("os.fsync(fd)", path) == ["FPS011"], path
+        assert hot_rules("x = jax.device_get(t)", path) == [
+            "FPS011"], path
+        assert hot_rules("out.block_until_ready()", path) == [
+            "FPS011"], path
+    # `from time import sleep` / `from os import fsync` bare forms.
+    assert hot_rules("sleep(0.1)") == ["FPS011"]
+    assert hot_rules("fsync(fd)") == ["FPS011"]
+    assert hot_rules("jax.block_until_ready(out)") == ["FPS011"]
+
+
+def test_fps011_scope_is_the_training_files_only():
+    for path in (os.path.join("fps_tpu", "core", "checkpoint.py"),
+                 os.path.join("fps_tpu", "core", "autok.py"),
+                 os.path.join("fps_tpu", "tiering", "retier.py"),
+                 os.path.join("tools", "bench_helper.py")):
+        assert hot_rules("time.sleep(0.1)", path) == [], path
+        assert hot_rules("out.block_until_ready()", path) == [], path
+
+
+def test_fps011_writer_seam_functions_are_exempt():
+    src = """
+    def _writer_loop(self):
+        time.sleep(backoff)
+        os.fsync(fd)
+
+    def _run_capture(collect):
+        jax.device_get(collect())
+
+    def _sidecar_retry_loop(self):
+        time.sleep(d)
+    """
+    assert hot_rules(src) == []
+
+
+def test_fps011_non_seam_functions_still_flagged():
+    src = """
+    def fit_stream(self):
+        time.sleep(0.1)
+    """
+    assert hot_rules(src) == ["FPS011"]
+    # A method named like a random helper gets no exemption.
+    assert hot_rules("""
+    def _dispatch(self):
+        out.block_until_ready()
+    """) == ["FPS011"]
+
+
+def test_fps011_noqa_and_unrelated_calls_clean():
+    assert hot_rules("time.sleep(0.1)  # noqa: FPS011") == []
+    # Method chains that merely END in a scoped bare name are not the
+    # stdlib calls the rule targets.
+    assert hot_rules("self.sleep(0.1)") == []
+    assert hot_rules("clock.monotonic()") == []
+
+
+def test_fps011_training_files_are_clean_in_tree():
+    """The contract the rule enforces holds for the shipped tree: zero
+    findings over the scoped files (capture/retry moved off-thread)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    files = [os.path.join(repo, "fps_tpu", "core", "driver.py"),
+             os.path.join(repo, "fps_tpu", "core", "megastep.py")]
+    assert [str(f) for f in lint_paths(files, select={"FPS011"})] == []

@@ -464,3 +464,111 @@ def test_megastep_kill_scenario(tmp_path):
 
     ok, detail = run_megastep_kill_scenario(str(tmp_path))
     assert ok, detail
+
+
+# -- auto-K (chunks_per_dispatch="auto") ---------------------------------
+
+
+def test_auto_k_derivation_fixed_points():
+    """The pure derivation on fixed calibration traces: smallest K with
+    h/(h+K*c) <= share, cadence-rounded, epoch- and max-capped."""
+    from fps_tpu.core.autok import derive_chunks_per_dispatch as derive
+
+    # h=1ms, c=1ms, s=0.05 -> ceil(0.95/0.05) = 19.
+    assert derive(0.001, 0.001, target_share=0.05) == 19
+    # Cadence rounds UP, never truncates a tick block.
+    assert derive(0.001, 0.001, target_share=0.05, multiple_of=4) == 20
+    # Dominant overhead hits the max-K cap (rounded DOWN to cadence).
+    assert derive(0.1, 0.001, target_share=0.05, max_k=64) == 64
+    assert derive(0.1, 0.001, target_share=0.05, max_k=62,
+                  multiple_of=4) == 60
+    # No measurable overhead: smallest legal K.
+    assert derive(0.0, 0.001) == 1
+    assert derive(0.0, 0.001, multiple_of=4) == 4
+    # Dispatch-bound (c ~ 0): cap, not a crash.
+    assert derive(0.001, 0.0, max_k=32) == 32
+    # One epoch's calls bound the useful K (cadence-rounded up).
+    assert derive(0.001, 0.001, n_calls=6) == 6
+    assert derive(0.001, 0.001, n_calls=6, multiple_of=4) == 8
+    with pytest.raises(ValueError, match="target_share"):
+        derive(0.001, 0.001, target_share=1.5)
+
+
+def test_auto_k_fixed_trace_bit_identical_to_flag(mesh, data, tmp_path,
+                                                  monkeypatch):
+    """On a FIXED calibration trace, "auto" picks the derived K and the
+    run it drives is bit-identical to passing that K explicitly —
+    tables, metrics, and every boundary checkpoint."""
+    from fps_tpu.core import autok
+    from fps_tpu.core.checkpoint import Checkpointer
+
+    # wall(1 block) = h + c, wall(2 blocks) = h + 2c with h=0.2ms,
+    # c=1ms -> derived K = ceil(0.0002*0.95/(0.05*0.001)) = 4.
+    walls = iter([0.0012, 0.0022])
+    monkeypatch.setattr(autok, "_measure_dispatch",
+                        lambda *a, **kw: next(walls))
+    K = 4
+
+    tr1, st1, p1 = _make(mesh, data)
+    t1, l1 = tr1.init_state(jax.random.key(0))
+    ck1 = Checkpointer(str(tmp_path / "flag"), keep=20)
+    rec1 = obs.Recorder(sinks=[])
+    tr1.run_megastep(t1, l1, p1, jax.random.key(1), epochs=2,
+                     chunks_per_dispatch=K, checkpointer=ck1,
+                     checkpoint_every=1, recorder=rec1)
+
+    tr2, st2, p2 = _make(mesh, data)
+    t2, l2 = tr2.init_state(jax.random.key(0))
+    ck2 = Checkpointer(str(tmp_path / "auto"), keep=20)
+    rec2 = obs.Recorder(sinks=[])
+    tr2.run_megastep(t2, l2, p2, jax.random.key(1), epochs=2,
+                     chunks_per_dispatch="auto", checkpointer=ck2,
+                     checkpoint_every=1, recorder=rec2)
+
+    assert rec2.snapshot()["gauges"]["megastep.auto_k"] == K
+    assert (rec2.snapshot()["gauges"]["megastep.chunks_per_dispatch"]
+            == K)
+    for k in st1.tables:
+        np.testing.assert_array_equal(
+            np.asarray(st1.tables[k]), np.asarray(st2.tables[k]),
+            err_msg=f"table {k} diverged under auto-K")
+    assert ck1.steps() == ck2.steps()
+    for g in ck1.steps():
+        _, va, la, _ = ck1.read_snapshot(g)
+        _, vb, lb, _ = ck2.read_snapshot(g)
+        assert sorted(va) == sorted(vb)
+        for k in va:
+            np.testing.assert_array_equal(
+                np.asarray(va[k]), np.asarray(vb[k]),
+                err_msg=f"checkpoint {g} table {k} diverged")
+        for x, y in zip(la, lb):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_auto_k_live_calibration_runs(mesh, data):
+    """The real (unmocked) calibration window: runs, records the gauge,
+    and never perturbs the model state (the throwaway-copy contract) —
+    the resulting tables still match the per-chunk host loop."""
+    rec = obs.Recorder(sinks=[])
+    tr1, st1, p1 = _make(mesh, data)
+    t1, l1 = tr1.init_state(jax.random.key(0))
+    t1, l1, m1 = tr1.run_indexed(t1, l1, p1, jax.random.key(1),
+                                 epochs=1)
+    tr2, st2, p2 = _make(mesh, data)
+    t2, l2 = tr2.init_state(jax.random.key(0))
+    tr2.run_megastep(t2, l2, p2, jax.random.key(1), epochs=1,
+                     chunks_per_dispatch="auto", recorder=rec)
+    chosen = rec.snapshot()["gauges"]["megastep.auto_k"]
+    assert chosen >= 1
+    for k in st1.tables:
+        np.testing.assert_array_equal(
+            np.asarray(st1.tables[k]), np.asarray(st2.tables[k]),
+            err_msg=f"table {k} diverged under live auto-K")
+
+
+def test_auto_k_rejects_unknown_string(mesh, data):
+    tr, st, p = _make(mesh, data)
+    t, ls = tr.init_state(jax.random.key(0))
+    with pytest.raises(ValueError, match="'auto'"):
+        tr.run_megastep(t, ls, p, jax.random.key(1),
+                        chunks_per_dispatch="fastest")

@@ -128,6 +128,82 @@ def test_obs_report_surfaces_incidents(tmp_path):
     assert digest["run_complete"] is False  # no journal run_end
 
 
+def test_obs_report_raw_speed_sections(tmp_path):
+    """ISSUE 20 telemetry lands in the digest: the checkpoint
+    dump/capture split, the auto-K gauge, and the adaptive-prefetch
+    raise counter (synthetic event files — pure JSONL consumer)."""
+    report = _load_report()
+    d = str(tmp_path)
+    with open(os.path.join(d, "events-p0.jsonl"), "w") as f:
+        for rec in [
+            {"kind": "metric", "t": 1.0, "name": "checkpoint.dump_seconds",
+             "mtype": "histogram", "value": 0.001},
+            {"kind": "metric", "t": 1.1, "name": "checkpoint.dump_seconds",
+             "mtype": "histogram", "value": 0.003},
+            {"kind": "metric", "t": 1.2,
+             "name": "checkpoint.capture_seconds",
+             "mtype": "histogram", "value": 0.05},
+            {"kind": "metric", "t": 1.3, "name": "megastep.auto_k",
+             "mtype": "gauge", "value": 12.0},
+            {"kind": "metric", "t": 1.4,
+             "name": "prefetch.depth_adjustments",
+             "mtype": "counter", "value": 3},
+        ]:
+            f.write(json.dumps(rec) + "\n")
+    digest = report.render_digest(d)
+    ck = digest["checkpoint"]
+    assert ck["dump"]["n"] == 2
+    assert ck["dump"]["total_s"] == pytest.approx(0.004)
+    assert ck["dump"]["max_s"] == pytest.approx(0.003)
+    assert ck["capture"] == {"n": 1, "total_s": 0.05, "mean_s": 0.05,
+                             "p99_s": 0.05, "max_s": 0.05}
+    assert digest["megastep"]["auto_k"] == 12.0
+    assert digest["prefetch"]["depth_adjustments"] == 3
+    # No samples at all still yields the full shape (nulls, n=0).
+    assert report._seconds_stats([]) == {
+        "n": 0, "total_s": None, "mean_s": None, "p99_s": None,
+        "max_s": None}
+
+
+def test_obs_report_recovery_slo_breach(tmp_path):
+    """--recovery-slo-s turns a late paired restart into a
+    recovery_slo_breach incident and annotates the recovery section;
+    without the flag the same dir reports without judging."""
+    report = _load_report()
+    d = str(tmp_path)
+    with open(os.path.join(d, "journal-supervisor.jsonl"), "w") as f:
+        for rec in [
+            # Attempt 0 dies at t=10; attempt 1 first signal at t=18
+            # (recovery 8s). Attempt 1 dies at t=30; attempt 2 first
+            # signal at t=90 (recovery 60s — over a 20s bound).
+            {"kind": "event", "t": 10.0, "event": "attempt_end",
+             "attempt": 0},
+            {"kind": "event", "t": 18.0, "event": "attempt_first_signal",
+             "attempt": 1},
+            {"kind": "event", "t": 30.0, "event": "attempt_end",
+             "attempt": 1},
+            {"kind": "event", "t": 90.0, "event": "attempt_first_signal",
+             "attempt": 2},
+        ]:
+            f.write(json.dumps(rec) + "\n")
+
+    plain = report.render_digest(d)
+    assert plain["recovery"]["times_s"] == [8.0, 60.0]
+    assert plain["recovery"]["slo_s"] is None
+    assert plain["recovery"]["breaches"] == 0
+    assert "recovery_slo_breach" not in plain["incidents"]
+
+    judged = report.render_digest(d, recovery_slo_s=20.0)
+    assert judged["recovery"]["slo_s"] == 20.0
+    assert judged["recovery"]["breaches"] == 1
+    [breach] = judged["incidents"]["recovery_slo_breach"]
+    assert breach["time_to_recovered_s"] == 60.0
+    assert breach["slo_s"] == 20.0
+
+    # The CLI spelling reaches the same path.
+    assert report.main([d, "--recovery-slo-s", "20"]) == 0
+
+
 def test_obs_report_empty_dir_errors(tmp_path):
     report = _load_report()
     with pytest.raises(FileNotFoundError):

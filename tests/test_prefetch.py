@@ -506,3 +506,95 @@ def test_sigkill_mid_prefetch_resumes_clean(tmp_path):
 
     ok, detail = run_prefetch_kill_scenario(str(tmp_path))
     assert ok, detail
+
+
+# ---------------------------------------------------------------------------
+# Adaptive depth (max_depth): stall-driven raises, memory veto.
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_adaptive_depth_raises_on_stalls():
+    """A slow source against a fast consumer stalls the queue empty
+    every window — depth climbs one chunk per window up to max_depth,
+    each raise counted on prefetch.depth_adjustments."""
+    from fps_tpu import obs
+
+    def slow_src():
+        for i in range(40):
+            time.sleep(0.002)
+            yield {"x": np.full(4, i)}
+
+    rec = obs.Recorder(sinks=[])
+    pf = ChunkPrefetcher(slow_src(), depth=2, max_depth=4,
+                         mem_probe=lambda: 1 << 40, recorder=rec)
+    got = list(pf)
+    pf.close()
+    assert len(got) == 40
+    assert pf.depth == 4
+    assert rec.counter_value("prefetch.depth_adjustments") == 2
+    assert _no_prefetch_threads()
+
+
+def test_prefetcher_adaptive_depth_memory_veto():
+    """No raise when one more buffered chunk would push the buffer past
+    the available-memory share — depth stays put, counter stays zero."""
+    from fps_tpu import obs
+
+    def slow_src():
+        for i in range(24):
+            time.sleep(0.002)
+            yield {"x": np.zeros(1024, np.float32)}  # 4 KiB chunks
+
+    rec = obs.Recorder(sinks=[])
+    pf = ChunkPrefetcher(slow_src(), depth=2, max_depth=8,
+                         mem_probe=lambda: 1024, recorder=rec)
+    got = list(pf)
+    pf.close()
+    assert len(got) == 24
+    assert pf.depth == 2
+    assert rec.counter_value("prefetch.depth_adjustments") == 0
+
+
+def test_prefetcher_fixed_depth_without_max():
+    """max_depth=None (the default) keeps the PR-5 fixed-depth
+    behavior exactly: stalls never move the depth."""
+    from fps_tpu import obs
+
+    def slow_src():
+        for i in range(24):
+            time.sleep(0.002)
+            yield {"x": np.full(4, i)}
+
+    rec = obs.Recorder(sinks=[])
+    pf = ChunkPrefetcher(slow_src(), depth=2, recorder=rec)
+    got = list(pf)
+    pf.close()
+    assert len(got) == 24
+    assert pf.depth == 2
+    assert rec.counter_value("prefetch.depth_adjustments") == 0
+
+
+def test_prefetcher_rejects_bad_max_depth():
+    with pytest.raises(ValueError, match="max_depth"):
+        ChunkPrefetcher(iter([]), depth=3, max_depth=2)
+
+
+def test_fit_stream_adaptive_prefetch_bit_identical(devices8):
+    """prefetch_max on/off cannot change numerics — depth is pure host
+    plumbing, whatever it adapts to."""
+    mesh = make_ps_mesh(num_shards=4, num_data=1, devices=devices8[:4])
+    train, _ = logreg_data()
+    chunks = logreg_chunks(train, num_workers_of(mesh), epochs=2)
+
+    results = {}
+    for pf_max in (0, 6):
+        trainer, store = _make_trainer(mesh, prefetch=1,
+                                       prefetch_max=pf_max)
+        tables, ls = trainer.init_state(jax.random.key(0))
+        tables, ls, m = trainer.fit_stream(
+            tables, ls, iter(chunks), jax.random.key(1)
+        )
+        results[pf_max] = (weights(store), m)
+        assert len(trainer._compiled) == 1
+    assert np.array_equal(results[0][0], results[6][0])
+    assert _tree_equal(results[0][1], results[6][1])
+    assert _no_prefetch_threads()

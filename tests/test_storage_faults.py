@@ -501,8 +501,11 @@ def test_fleet_reader_poll_survives_fence_io_errors(jaxmods, tmp_path):
 
 def test_sidecar_write_degrades(jaxmods, tmp_path, caplog):
     """A sidecar write that fails transiently through its retry budget
-    is SKIPPED (advisory state), never a crash."""
+    is SKIPPED (advisory state), never a crash — and the retry budget
+    (with its backoff sleeps) runs on the background retrier thread,
+    costing the caller only the single inline attempt."""
     import logging
+    import time
 
     from fps_tpu.tiering.retier import Retierer
 
@@ -516,7 +519,13 @@ def test_sidecar_write_degrades(jaxmods, tmp_path, caplog):
     faultfs.install([FaultRule("sidecar", "write", "errno",
                                errno_name="EIO", start=0, count=8)])
     with caplog.at_level(logging.WARNING, logger="fps_tpu.tiering"):
+        t0 = time.perf_counter()
         rt._save_sidecar(3, {})
+        inline_s = time.perf_counter() - t0
+        rt.sidecar_flush(timeout=30.0)
+    # The inline attempt raises EIO immediately; the retry backoff
+    # (>= 0.02 + 0.04 + 0.08 s of sleeps) must NOT have run here.
+    assert inline_s < 0.1
     assert "DEGRADED" in caplog.text
     assert not os.listdir(rt.state_dir)
     faultfs.uninstall()

@@ -87,6 +87,11 @@ _INCIDENT_EVENTS = (
     "heartbeat_rejected",
     "supervisor_give_up",
     "supervised_run_end",
+    # Time-to-recovered SLO (ISSUE 20): synthesized by this tool when a
+    # paired attempt_end -> attempt_first_signal gap exceeds the
+    # --recovery-slo-s bound; also folded verbatim if a journal carries
+    # one (tools/chaos_sweep.py keeps its own per-scenario bounds).
+    "recovery_slo_breach",
     "analysis.contract_violation",
     # Runtime budget-drift detection (fps_tpu.obs.drift): measured
     # collective traffic departed from the AUDIT_r*.json pinned shape.
@@ -126,10 +131,25 @@ DIGEST_SCHEMA_VERSION = 1
 REQUIRED_FIELDS = (
     "schema", "obs_dir", "run_ids", "processes", "chunks", "epochs",
     "steps", "examples", "phase_seconds", "health", "incidents",
-    "checkpoint_saves", "quarantined", "wall_span_s", "prefetch",
+    "checkpoint", "checkpoint_saves", "quarantined", "wall_span_s",
+    "prefetch",
     "hot_tier", "megastep", "tiering", "source_stalls", "analysis",
     "serve", "pod", "net", "recovery",
 )
+
+
+def _seconds_stats(samples: list) -> dict:
+    """Summary of one histogram's raw samples (n/total/mean/p99/max) —
+    the checkpoint dump/capture split in the digest."""
+    if not samples:
+        return {"n": 0, "total_s": None, "mean_s": None,
+                "p99_s": None, "max_s": None}
+    s = sorted(samples)
+    return {"n": len(s),
+            "total_s": round(sum(s), 6),
+            "mean_s": round(sum(s) / len(s), 6),
+            "p99_s": round(_quantile(s, 0.99), 6),
+            "max_s": round(s[-1], 6)}
 
 
 def _quantile(sorted_vals: list, q: float):
@@ -155,8 +175,14 @@ def _read_jsonl(path: str):
                 return
 
 
-def render_digest(obs_dir: str) -> dict:
-    """Digest dict from an obs directory (see module docstring)."""
+def render_digest(obs_dir: str, *, recovery_slo_s: float | None = None) -> dict:
+    """Digest dict from an obs directory (see module docstring).
+
+    ``recovery_slo_s`` enforces a time-to-recovered bound: every paired
+    restart whose kill→first-signal gap exceeds it becomes a
+    ``recovery_slo_breach`` incident, and the ``recovery`` section gains
+    ``slo_s`` / ``breaches`` fields. ``None`` (default) reports without
+    judging."""
     event_files = sorted(glob.glob(os.path.join(obs_dir, "events-p*.jsonl")))
     # journal-* (not journal-p*): also picks up journal-supervisor.jsonl
     # when the supervisor's --state-dir is (or is joined into) this dir.
@@ -171,6 +197,12 @@ def render_digest(obs_dir: str) -> dict:
     counters: dict[str, float] = collections.defaultdict(float)
     gauges: dict[str, dict] = {}  # name -> {"last": v, "max": v}
     serve_latency: list[float] = []  # serve.request_seconds samples
+    # Raw-speed split (ISSUE 20): what a save costs the TRAINING thread
+    # (dump = enqueue) vs what the WRITER pays off-thread (capture).
+    ckpt_seconds: dict[str, list[float]] = {
+        "checkpoint.dump_seconds": [],
+        "checkpoint.capture_seconds": [],
+    }
     swap_directions: dict[str, int] = collections.defaultdict(int)
     phases: dict[str, dict] = {}
     health: dict[str, dict] = {}
@@ -250,6 +282,8 @@ def render_digest(obs_dir: str) -> dict:
                 )[tier] += int(v)
             elif name == "serve.request_seconds":
                 serve_latency.append(v)
+            elif name in ckpt_seconds:
+                ckpt_seconds[name].append(v)
             elif rec.get("mtype") == "counter":
                 if name == "serve.swaps":
                     swap_directions[labels.get("direction", "?")] += int(v)
@@ -305,6 +339,18 @@ def render_digest(obs_dir: str) -> dict:
         if prior:
             recovery_times.append(round(t_first - max(prior), 3))
 
+    # Time-to-recovered SLO enforcement: every paired restart slower
+    # than the bound becomes an incident, synthesized here next to any
+    # recovery_slo_breach events a journal already carried.
+    if recovery_slo_s is not None and recovery_slo_s > 0:
+        for i, t in enumerate(recovery_times):
+            if t > recovery_slo_s:
+                incidents["recovery_slo_breach"].append({
+                    "event": "recovery_slo_breach", "restart": i,
+                    "time_to_recovered_s": t,
+                    "slo_s": round(float(recovery_slo_s), 3),
+                })
+
     digest = {
         "schema": DIGEST_SCHEMA_VERSION,
         "obs_dir": os.path.abspath(obs_dir),
@@ -324,6 +370,12 @@ def render_digest(obs_dir: str) -> dict:
                 "prefetch.queue_depth", {}).get("last"),
             "queue_depth_max": gauges.get(
                 "prefetch.queue_depth", {}).get("max"),
+            # Adaptive depth (ISSUE 20): each +1 raise the stall-driven
+            # sizing applied. 0 with a pinned max at the starting depth
+            # means the fixed depth was already enough (or adaptation
+            # was off); nonzero narrates how far the buffer grew.
+            "depth_adjustments": int(
+                counters.get("prefetch.depth_adjustments", 0)),
         },
         # Two-tier storage (labels fold across tables; the per-table
         # split lives in the raw event files if needed).
@@ -355,6 +407,9 @@ def render_digest(obs_dir: str) -> dict:
             "windows": int(counters.get("megastep.windows", 0)),
             "chunks_per_dispatch": gauges.get(
                 "megastep.chunks_per_dispatch", {}).get("last"),
+            # Auto-K calibration (ISSUE 20): the K chosen by
+            # chunks_per_dispatch="auto" (null when K was explicit).
+            "auto_k": gauges.get("megastep.auto_k", {}).get("last"),
             "vote_compact_windows": int(
                 counters.get("cold_route.vote_compact_windows", 0)),
             "vote_overflow_windows": int(
@@ -467,6 +522,12 @@ def render_digest(obs_dir: str) -> dict:
                        if recovery_times else None),
             "max_s": (round(max(recovery_times), 3)
                       if recovery_times else None),
+            # Only meaningful when --recovery-slo-s was given: the bound
+            # and how many paired restarts broke it (each breach also
+            # rides incidents verbatim).
+            "slo_s": (round(float(recovery_slo_s), 3)
+                      if recovery_slo_s else None),
+            "breaches": len(incidents.get("recovery_slo_breach", ())),
         },
         "health": dict(sorted(health.items())),
         "poisoned_chunks": int(counters.get("health.poisoned_chunks", 0)),
@@ -510,6 +571,17 @@ def render_digest(obs_dir: str) -> dict:
             "reader_wedged_incidents": len(
                 incidents.get("reader_wedged", ())),
         },
+        # Raw-speed split (ISSUE 20): dump_seconds is what a save costs
+        # the TRAINING thread (deferred captures make this the enqueue
+        # cost only); capture_seconds is the device->host materialization
+        # the WRITER pays off-thread. dump collapsing toward zero while
+        # capture stays flat is the off-thread capture working.
+        "checkpoint": {
+            "dump": _seconds_stats(
+                ckpt_seconds["checkpoint.dump_seconds"]),
+            "capture": _seconds_stats(
+                ckpt_seconds["checkpoint.capture_seconds"]),
+        },
         "checkpoint_saves": int(counters.get("checkpoint.saves", 0)),
         # Async writer: enqueued > saved means a write was still in
         # flight at the last flush — saves are the TRUE durability points.
@@ -549,12 +621,12 @@ def scrub(x):
     return x
 
 
-def digest_json(obs_dir: str) -> dict:
+def digest_json(obs_dir: str, *, recovery_slo_s: float | None = None) -> dict:
     """The `--json` payload: the digest with every non-finite float
     scrubbed to null — the stable machine-readable schema
     (``DIGEST_SCHEMA_VERSION``) CI and ``fps_tpu/obs/fleet.py`` consume
     without scraping text."""
-    return scrub(render_digest(obs_dir))
+    return scrub(render_digest(obs_dir, recovery_slo_s=recovery_slo_s))
 
 
 def _load_fleet():
@@ -600,6 +672,13 @@ def main(argv=None) -> int:
                          "--pretty)")
     ap.add_argument("--pretty", action="store_true",
                     help="indent the JSON for humans")
+    ap.add_argument("--recovery-slo-s", type=float, default=None,
+                    metavar="S",
+                    help="time-to-recovered bound: every paired restart "
+                         "whose kill->first-signal gap exceeds S seconds "
+                         "becomes a recovery_slo_breach incident and the "
+                         "recovery section reports slo_s/breaches "
+                         "(default: report without judging)")
     args = ap.parse_args(argv)
     if args.json and args.pretty:
         ap.error("--json is the compact machine form; drop --pretty")
@@ -610,7 +689,8 @@ def main(argv=None) -> int:
         fleet = _load_fleet()
         def _digest_or_none(d):
             try:
-                return render_digest(d)
+                return render_digest(
+                    d, recovery_slo_s=args.recovery_slo_s)
             except FileNotFoundError:
                 return None
 
@@ -632,7 +712,8 @@ def main(argv=None) -> int:
             return 2
     else:
         try:
-            out = render_digest(args.obs_dirs[0])
+            out = render_digest(args.obs_dirs[0],
+                                recovery_slo_s=args.recovery_slo_s)
         except FileNotFoundError as e:
             print(str(e), file=sys.stderr)
             return 2
